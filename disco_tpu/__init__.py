@@ -1,0 +1,21 @@
+"""disco_tpu — a TPU-native (JAX/XLA/pallas/pjit) framework for distributed
+microphone-array speech enhancement and separation.
+
+Re-designed from scratch with the capabilities of the nfurnon/disco reference
+(see SURVEY.md): room simulation of ad-hoc microphone arrays, DNN time-frequency
+mask estimation, and two-step DANSE-style distributed rank-1 GEVD-MWF
+beamforming ("TANGO") — with rooms, nodes, frequency bins and STFT frames
+treated as array axes on a TPU mesh instead of Python loops.
+
+Subpackages
+-----------
+core      DSP kernels: STFT/ISTFT filterbank, TF masks, VAD, math utilities, metrics
+beam      spatial covariance estimation + MWF / rank-1 MWF / GEVD-MWF filters
+enhance   the TANGO two-step distributed enhancement pipeline
+parallel  mesh topology + shard_map node-parallel execution (z = all_gather over ICI)
+nn        Flax CRNN mask estimator + training engine
+sim       room geometry sampling, batched image-source RIRs, FFT convolution
+io        wav / npy I/O and the dataset file layout
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,23 @@
+from disco_tpu.beam.covariance import (
+    frame_mean_covariance,
+    masked_covariances,
+    smoothed_covariance,
+)
+from disco_tpu.beam.filters import (
+    get_filter_type,
+    mwf,
+    r1_mwf,
+    gevd_mwf,
+    intern_filter,
+)
+
+__all__ = [
+    "frame_mean_covariance",
+    "masked_covariances",
+    "smoothed_covariance",
+    "get_filter_type",
+    "mwf",
+    "r1_mwf",
+    "gevd_mwf",
+    "intern_filter",
+]

@@ -1,0 +1,68 @@
+"""Spatial covariance estimation.
+
+The reference accumulates per-frame outer products in nested Python loops over
+(freq, frame) (tango.py:357-364,433-440) and has a separate online
+exponential-smoothing variant (se_utils/internal_formulas.py:84-103).  Here
+both are single einsum contractions, batched over any leading axes — on TPU the
+(C,T)x(T,C) contraction per frequency bin lands on the MXU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def frame_mean_covariance(a: jnp.ndarray, b: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Frame-averaged spatial covariance.
+
+    Args:
+      a: STFT stack, shape (..., C, F, T).
+      b: optional second stack for cross-covariance (defaults to ``a``).
+
+    Returns:
+      (..., F, C, C) complex covariance: ``mean_t a[...,c,f,t] conj(b[...,d,f,t])``
+      — the offline frame-mean estimator of reference tango.py:357-364.
+    """
+    b = a if b is None else b
+    T = a.shape[-1]
+    return jnp.einsum("...cft,...dft->...fcd", a, jnp.conj(b)) / T
+
+
+@jax.jit
+def masked_covariances(y: jnp.ndarray, mask: jnp.ndarray):
+    """Speech/noise covariances from a mixture and a TF mask.
+
+    The reference forms ``s_hat = m * y`` and ``n_hat = (1-m) * y`` per channel
+    (tango.py:347-348) then frame-averages outer products.  Fused here.
+
+    Args:
+      y: mixture STFT, (..., C, F, T).
+      mask: real TF mask, (..., F, T) — broadcast over channels.
+
+    Returns:
+      (Rss, Rnn), each (..., F, C, C).
+    """
+    m = mask[..., None, :, :]
+    s_hat = m * y
+    n_hat = (1.0 - m) * y
+    return frame_mean_covariance(s_hat), frame_mean_covariance(n_hat)
+
+
+@jax.jit
+def smoothed_covariance(
+    R: jnp.ndarray, x: jnp.ndarray, lambda_cor: float = 0.95, mask=None
+) -> jnp.ndarray:
+    """One step of exponential smoothing ``R <- λR + (1-λ)[m] x xᴴ`` — the
+    online/streaming estimator of internal_formulas.py:84-103, for frame-by-
+    frame operation (scan over frames in a streaming pipeline).
+
+    Args:
+      R: previous estimate, (..., C, C).
+      x: current frame, (..., C).
+      mask: optional scalar/broadcastable mask weight applied to the update.
+    """
+    upd = jnp.einsum("...c,...d->...cd", x, jnp.conj(x))
+    if mask is not None:
+        upd = mask[..., None, None] * upd
+    return lambda_cor * R + (1.0 - lambda_cor) * upd

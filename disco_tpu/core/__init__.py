@@ -1,0 +1,34 @@
+from disco_tpu.core.mathx import (
+    db2lin,
+    lin2db,
+    cart2pol,
+    pol2cart,
+    floor_to_multiple,
+    round_to_base,
+    my_mse,
+    next_pow_2,
+    WelfordsOnlineAlgorithm,
+)
+from disco_tpu.core.dsp import stft, istft, n_stft_frames, N_FFT, N_HOP, N_FREQ
+from disco_tpu.core.masks import tf_mask, vad_oracle_batch, vad_to_mask
+
+__all__ = [
+    "db2lin",
+    "lin2db",
+    "cart2pol",
+    "pol2cart",
+    "floor_to_multiple",
+    "round_to_base",
+    "my_mse",
+    "next_pow_2",
+    "WelfordsOnlineAlgorithm",
+    "stft",
+    "istft",
+    "n_stft_frames",
+    "N_FFT",
+    "N_HOP",
+    "N_FREQ",
+    "tf_mask",
+    "vad_oracle_batch",
+    "vad_to_mask",
+]

@@ -1,0 +1,92 @@
+"""Time-frequency masks and the oracle power VAD.
+
+Capability parity with reference ``disco_theque/sigproc_utils.py:12-86``
+(``vad_oracle_batch``, ``tf_mask``) and its duplicate ``dnn/utils.py:44-71``,
+re-expressed as loop-free jitted JAX ops so a whole (rooms, nodes, channels)
+batch of spectrograms is masked in one fused kernel.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from disco_tpu.core.mathx import db2lin, FLOAT64_EPS as _EPS
+
+
+@partial(jax.jit, static_argnames=("mask_type",))
+def tf_mask(s: jnp.ndarray, n: jnp.ndarray, mask_type: str = "irm1", bin_thr: float = 0.0):
+    """Ideal TF mask from target/noise spectrograms (sigproc_utils.py:58-86).
+
+    ``mask_type`` is 'irmX' (Wiener-like ratio mask), 'ibmX' (binary) or
+    'iamX' (amplitude mask), X the integer power applied to the magnitude
+    ratio.  Shapes broadcast; output matches ``s``.
+    """
+    power = int(mask_type[-1])
+    family = mask_type[:-1]
+    if family == "irm":
+        xi = (jnp.abs(s) / jnp.maximum(jnp.abs(n), _EPS)) ** power
+        return xi / (1.0 + xi)
+    if family == "ibm":
+        xi = (jnp.abs(s) / jnp.maximum(jnp.abs(n), _EPS)) ** power
+        return (xi >= db2lin(bin_thr)).astype(s.real.dtype)
+    if family == "iam":
+        return (jnp.abs(s) / jnp.abs(s + n)) ** power
+    raise ValueError('Unknown mask type. Should be "irmX", "ibmX" or "iamX"')
+
+
+@partial(jax.jit, static_argnames=("win_len", "win_hop", "rat"))
+def vad_oracle_batch(
+    x: jnp.ndarray,
+    win_len: int = 512,
+    win_hop: int = 256,
+    thr: float = 0.001,
+    rat: int = 2,
+) -> jnp.ndarray:
+    """Oracle power-threshold VAD (sigproc_utils.py:12-55).
+
+    A window is voice-active when more than ``len(window)/rat`` of its samples
+    have instantaneous power above ``thr * q99(power)``; active windows paint
+    1s over the samples they cover (overlapping windows OR together).
+
+    Args:
+      x: waveform, shape (length,).
+
+    Returns:
+      float32 0/1 vector, same length as ``x``.
+    """
+    x = jnp.asarray(x)
+    length = x.shape[-1]
+    x2 = jnp.abs((x - jnp.mean(x)) ** 2)
+    thr_ = thr * jnp.quantile(x2, 0.99)
+
+    n_win = -(-(length - win_len) // win_hop) + 1  # ceil((L - w)/h) + 1
+    if n_win <= 0:
+        # Shorter than one window: the reference evaluates zero windows and
+        # returns an all-zero VAD (sigproc_utils.py:48).
+        return jnp.zeros(length, jnp.float32)
+    starts = jnp.arange(n_win) * win_hop
+    offs = jnp.arange(win_len)
+    idx = starts[:, None] + offs[None, :]  # (n_win, win_len)
+    valid = idx < length
+    idx_c = jnp.minimum(idx, length - 1)
+    above = (x2[idx_c] > thr_) & valid
+    n_above = jnp.sum(above, axis=-1)
+    n_samples = jnp.sum(valid, axis=-1)
+    active = n_above >= (n_samples // rat)  # int(N/rat) of the reference
+
+    # Scatter-OR each active window back onto its samples.
+    vad = jnp.zeros(length, jnp.float32)
+    contrib = (active[:, None] & valid).astype(jnp.float32)
+    vad = vad.at[idx_c.reshape(-1)].max(contrib.reshape(-1))
+    return vad
+
+
+def vad_to_mask(vad: jnp.ndarray, n_freq: int, n_frames: int, hop: int = 256) -> jnp.ndarray:
+    """Spread a sample-level VAD across frequencies as a mask-like STFT matrix
+    (the 'ivad' branch of reference tango.py:216-221: subsample every ``hop``
+    samples, tile over ``n_freq`` rows, zero-pad trailing frames)."""
+    v = vad[::hop]
+    v = jnp.pad(v, (0, max(0, n_frames - v.shape[0])))[:n_frames]
+    return jnp.tile(v[None, :], (n_freq, 1))

@@ -1,0 +1,21 @@
+"""Test configuration: force the CPU backend with 8 virtual devices so the
+multi-chip sharding paths (Mesh / shard_map / pjit) are exercised without TPU
+hardware, per the build environment contract."""
+import os
+
+# Hard override: the image may export JAX_PLATFORMS=axon (single real TPU chip
+# behind a tunnel); tests must run on the virtual 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

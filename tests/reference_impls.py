@@ -1,0 +1,206 @@
+"""NumPy/SciPy oracle implementations of the reference semantics, used as
+ground truth in parity tests.
+
+These deliberately re-state the *formulas* of the reference (librosa's centered
+STFT, the ideal-mask definitions of sigproc_utils.py:58-86, the SDW-MWF /
+GEVD-MWF filters of se_utils/internal_formulas.py:31-103, and the two-step
+TANGO pipeline of speech_enhancement/tango.py:252-457) in plain float64 NumPy,
+independent of the JAX implementations under test.
+"""
+from __future__ import annotations
+
+import numpy as np
+import scipy.linalg
+import scipy.signal
+
+F64_EPS = np.finfo(np.float64).eps
+ETA = 1e6
+
+
+# ---------------------------------------------------------------- STFT oracle
+def hann_periodic_np(n):
+    return 0.5 - 0.5 * np.cos(2.0 * np.pi * np.arange(n) / n)
+
+
+def stft_np(x, n_fft=512, hop=256):
+    """Centered STFT with reflect padding and periodic Hann — the librosa
+    conventions the reference relies on (tango.py:335)."""
+    pad = n_fft // 2
+    xp = np.pad(np.asarray(x, np.float64), pad, mode="reflect")
+    n_frames = 1 + (len(xp) - n_fft) // hop
+    win = hann_periodic_np(n_fft)
+    out = np.empty((n_fft // 2 + 1, n_frames), np.complex128)
+    for t in range(n_frames):
+        out[:, t] = np.fft.rfft(xp[t * hop : t * hop + n_fft] * win)
+    return out
+
+
+def istft_np(spec, length, n_fft=512, hop=256):
+    """Windowed overlap-add inverse with squared-window normalization
+    (librosa istft conventions, tango.py:528-539)."""
+    n_freq, n_frames = spec.shape
+    win = hann_periodic_np(n_fft)
+    total = (n_frames - 1) * hop + n_fft
+    y = np.zeros(total)
+    wss = np.zeros(total)
+    for t in range(n_frames):
+        frame = np.fft.irfft(spec[:, t], n=n_fft)
+        y[t * hop : t * hop + n_fft] += frame * win
+        wss[t * hop : t * hop + n_fft] += win**2
+    nz = wss > np.finfo(np.float64).tiny
+    y[nz] /= wss[nz]
+    pad = n_fft // 2
+    y = y[pad : pad + length]
+    if len(y) < length:
+        y = np.pad(y, (0, length - len(y)))
+    return y
+
+
+# ---------------------------------------------------------------- mask oracle
+def tf_mask_np(s, n, mask_type="irm1", bin_thr=0.0):
+    power = int(mask_type[-1])
+    if mask_type.startswith("irm"):
+        xi = (np.abs(s) / np.maximum(np.abs(n), F64_EPS)) ** power
+        return xi / (1 + xi)
+    if mask_type.startswith("ibm"):
+        xi = (np.abs(s) / np.maximum(np.abs(n), F64_EPS)) ** power
+        return (xi >= 10 ** (bin_thr / 10)).astype(np.float64)
+    if mask_type.startswith("iam"):
+        return (np.abs(s) / np.abs(s + n)) ** power
+    raise ValueError(mask_type)
+
+
+def vad_oracle_np(x, win_len=512, win_hop=256, thr=0.001, rat=2):
+    """Windowed power-threshold VAD (sigproc_utils.py:12-55)."""
+    x = np.asarray(x, np.float64)
+    x2 = np.abs((x - x.mean()) ** 2)
+    thr_ = thr * np.quantile(x2, 0.99)
+    vad = np.zeros(len(x2))
+    n_win = int(np.ceil((len(x2) - win_len) / win_hop + 1))
+    for n in range(n_win):
+        lo = n * win_hop
+        hi = min(lo + win_len, len(x2))
+        seg = x2[lo:hi]
+        if np.sum(seg > thr_) >= int(len(seg) / rat):
+            vad[lo:hi] = 1
+    return vad
+
+
+# -------------------------------------------------------------- filter oracle
+def intern_filter_np(Rxx, Rnn, mu=1.0, ftype="gevd", rank=1):
+    """SDW-MWF / GEVD-MWF filters (internal_formulas.py:31-81), float64."""
+    C = Rxx.shape[0]
+    t1 = np.zeros(C, dtype=Rxx.dtype)
+    t1[0] = 1.0
+    if ftype == "r1-mwf":
+        D, X = np.linalg.eig(Rxx)
+        D = np.real(D)
+        imax = D.argmax()
+        Rxx1 = np.outer(np.abs(D[imax]) * X[:, imax], np.conj(X[:, imax]))
+        P = np.linalg.lstsq(Rnn, Rxx1, rcond=None)[0]
+        return P[:, 0] / (mu + np.trace(P)), t1
+    if ftype == "gevd":
+        D, Q = scipy.linalg.eig(Rxx, Rnn)
+        D = np.clip(D.real, F64_EPS, ETA)
+        order = np.argsort(D)[::-1]
+        D = D[order]
+        Q = Q[:, order]
+        if rank != "full":
+            D = np.where(np.arange(C) < rank, D, 0.0)
+        Qinv = np.linalg.inv(Q)
+        W = (Q @ np.diag(D / (D + mu)) @ Qinv)[:, 0]
+        t1 = Q[:, 0] * Qinv[0, 0]
+        return W, t1
+    if ftype == "mwf":
+        P = np.linalg.lstsq(Rnn + Rxx, Rxx, rcond=None)[0]
+        return P[:, 0], t1
+    raise ValueError(ftype)
+
+
+def covariances_np(a, b=None):
+    """Frame-mean of rank-1 outer products: (C, F, T) -> (F, C, C)
+    (tango.py:357-364)."""
+    b = a if b is None else b
+    C, F, T = a.shape
+    R = np.zeros((F, C, C), np.complex128)
+    for f in range(F):
+        for t in range(T):
+            R[f] += np.outer(a[:, f, t], np.conj(b[:, f, t]))
+    return R / T
+
+
+# ---------------------------------------------------------------- TANGO oracle
+def tango_np(y, s, n, mask_type="irm1", mask_for_z="local"):
+    """Two-step distributed rank-1 GEVD-MWF (tango.py:252-457) with oracle
+    masks, equal channel counts per node.  y/s/n: (K, C, L) float64.
+
+    Returns dict of (K, F, T) stacks: yf, sf, nf, z_y, z_s, z_n, zn, plus the
+    per-node masks.
+    """
+    K, C, L = y.shape
+    Y = np.stack([[stft_np(y[k, c]) for c in range(C)] for k in range(K)])
+    S = np.stack([[stft_np(s[k, c]) for c in range(C)] for k in range(K)])
+    N = np.stack([[stft_np(n[k, c]) for c in range(C)] for k in range(K)])
+    F, T = Y.shape[-2:]
+
+    # Step 1: local rank-1 GEVD at each node -> compressed signal z.
+    masks_z = np.stack([tf_mask_np(S[k, 0], N[k, 0], mask_type) for k in range(K)])
+    z_y = np.zeros((K, F, T), np.complex128)
+    z_s = np.zeros((K, F, T), np.complex128)
+    z_n = np.zeros((K, F, T), np.complex128)
+    for k in range(K):
+        sh = masks_z[k][None] * Y[k]
+        nh = (1 - masks_z[k][None]) * Y[k]
+        Rss = covariances_np(sh)
+        Rnn = covariances_np(nh)
+        for f in range(F):
+            w, _ = intern_filter_np(Rss[f], Rnn[f], mu=1.0, ftype="gevd", rank=1)
+            z_y[k, f] = np.conj(w) @ Y[k, :, f, :]
+            z_s[k, f] = np.conj(w) @ S[k, :, f, :]
+            z_n[k, f] = np.conj(w) @ N[k, :, f, :]
+    zn = Y[:, 0] - z_y
+
+    # Step 2: global rank-1 GEVD on [local mics ‖ z_{j != k}].
+    yf = np.zeros((K, F, T), np.complex128)
+    sf = np.zeros((K, F, T), np.complex128)
+    nf = np.zeros((K, F, T), np.complex128)
+    mask_w = masks_z  # oracle masks: step-2 mask equals step-1 mask at ref mic
+    for k in range(K):
+        others = [j for j in range(K) if j != k]
+        stack_y = np.concatenate([Y[k], z_y[others]], axis=0)
+        stack_s = np.concatenate([S[k], z_s[others]], axis=0)
+        stack_n = np.concatenate([N[k], z_n[others]], axis=0)
+        m = mask_w[k][None]
+        if mask_for_z == "local":
+            zs_stat = np.concatenate([m * Y[k], m * z_y[others]], axis=0)
+            zn_stat = np.concatenate([(1 - m) * Y[k], (1 - m) * z_y[others]], axis=0)
+        elif mask_for_z is None:
+            zs_stat = np.concatenate([m * Y[k], z_y[others]], axis=0)
+            zn_stat = np.concatenate([(1 - m) * Y[k], zn[others]], axis=0)
+        else:
+            raise NotImplementedError(mask_for_z)
+        Rss = covariances_np(zs_stat)
+        Rnn = covariances_np(zn_stat)
+        for f in range(F):
+            w, _ = intern_filter_np(Rss[f], Rnn[f], mu=1.0, ftype="gevd", rank=1)
+            yf[k, f] = np.conj(w) @ stack_y[:, f, :]
+            sf[k, f] = np.conj(w) @ stack_s[:, f, :]
+            nf[k, f] = np.conj(w) @ stack_n[:, f, :]
+
+    return {
+        "yf": yf, "sf": sf, "nf": nf,
+        "z_y": z_y, "z_s": z_s, "z_n": z_n, "zn": zn,
+        "masks_z": masks_z, "mask_w": mask_w,
+    }
+
+
+def si_sdr_np(reference, estimation):
+    """Scale-invariant SDR (metrics.py:342-392 semantics), float64."""
+    reference = np.asarray(reference, np.float64)
+    estimation = np.asarray(estimation, np.float64)
+    alpha = np.sum(reference * estimation, -1, keepdims=True) / np.sum(
+        reference**2, -1, keepdims=True
+    )
+    proj = alpha * reference
+    noise = estimation - proj
+    return 10 * np.log10(np.sum(proj**2, -1) / np.sum(noise**2, -1))

@@ -1,0 +1,136 @@
+"""Parity tests for disco_tpu.core against the NumPy oracles."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from disco_tpu.core import (
+    db2lin,
+    lin2db,
+    cart2pol,
+    pol2cart,
+    floor_to_multiple,
+    round_to_base,
+    my_mse,
+    next_pow_2,
+    WelfordsOnlineAlgorithm,
+    stft,
+    istft,
+    n_stft_frames,
+    tf_mask,
+    vad_oracle_batch,
+)
+from tests.reference_impls import stft_np, istft_np, tf_mask_np, vad_oracle_np
+
+
+# ----------------------------------------------------------------- math utils
+@pytest.mark.parametrize("num,div,expected", [(102, 10, 100), (65, 8, 64), (64, 8, 64)])
+def test_floor_to_multiple(num, div, expected):
+    assert floor_to_multiple(num, div) == expected
+
+
+@pytest.mark.parametrize("x,base,expected", [(109.56, 5, 110), (108.56, 4, 108), (56, 10, 60)])
+def test_round_to_base(x, base, expected):
+    assert float(round_to_base(x, base)) == expected
+
+
+@pytest.mark.parametrize("db,lin,exp", [(10.0, 10.0, 1), (20.0, 10.0, 2), (0.0, 1.0, 1)])
+def test_db2lin_lin2db(db, lin, exp):
+    assert np.isclose(float(db2lin(db, exp)), lin)
+    if exp == 1:
+        assert np.isclose(float(lin2db(lin)), db)
+
+
+def test_polar_roundtrip(rng):
+    x, y = rng.normal(size=50), rng.normal(size=50)
+    r, th = cart2pol(x, y)
+    x2, y2 = pol2cart(r, th)
+    np.testing.assert_allclose(np.asarray(x2), x, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(y2), y, atol=1e-5)
+
+
+def test_my_mse(rng):
+    a, b = rng.normal(size=(4, 7)), rng.normal(size=(4, 7))
+    assert np.isclose(float(my_mse(a, b)), np.mean((a - b) ** 2), atol=1e-6)
+
+
+@pytest.mark.parametrize("x,expected", [(3, 4), (4, 4), (5, 8), (250.3, 256)])
+def test_next_pow_2(x, expected):
+    assert next_pow_2(x) == expected
+
+
+@pytest.mark.parametrize("chunk", [100, 400])
+def test_welford_streaming_stats(rng, chunk):
+    dim = 6
+    data = rng.normal(loc=2.0, scale=3.0, size=(dim, 1200)).astype(np.float32)
+    w = WelfordsOnlineAlgorithm(dim)
+    for start in range(0, data.shape[1], chunk):
+        w.quick_update(data[:, start : start + chunk])
+    np.testing.assert_allclose(np.asarray(w.mean), data.mean(axis=1), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(w.std), data.std(axis=1), rtol=1e-3)
+    assert w.count == data.shape[1]
+
+
+def test_welford_dim_mismatch():
+    w = WelfordsOnlineAlgorithm(4)
+    with pytest.raises(AssertionError, match="4 features"):
+        w.quick_update(np.zeros((3, 10)))
+
+
+# ----------------------------------------------------------------------- STFT
+@pytest.mark.parametrize("length", [16000, 16001, 80000])
+def test_stft_matches_librosa_convention(rng, length):
+    x = rng.normal(size=length).astype(np.float32)
+    got = np.asarray(stft(x))
+    want = stft_np(x)
+    assert got.shape == want.shape
+    assert got.shape[-1] == n_stft_frames(length)
+    np.testing.assert_allclose(got, want.astype(np.complex64), atol=2e-3)
+
+
+def test_stft_batched(rng):
+    x = rng.normal(size=(2, 3, 8000)).astype(np.float32)
+    got = np.asarray(stft(x))
+    for i in range(2):
+        for j in range(3):
+            np.testing.assert_allclose(
+                got[i, j], stft_np(x[i, j]).astype(np.complex64), atol=2e-3
+            )
+
+
+@pytest.mark.parametrize("length", [16000, 16123])
+def test_istft_roundtrip(rng, length):
+    x = rng.normal(size=length).astype(np.float32)
+    y = np.asarray(istft(stft(x), length=length))
+    # centered STFT round-trip is exact away from the very edges
+    np.testing.assert_allclose(y[256:-256], x[256:-256], atol=1e-3)
+
+
+def test_istft_matches_oracle(rng):
+    x = rng.normal(size=16000).astype(np.float32)
+    spec = stft_np(x)
+    got = np.asarray(istft(jnp.asarray(spec.astype(np.complex64)), length=16000))
+    want = istft_np(spec, 16000)
+    np.testing.assert_allclose(got, want, atol=1e-3)
+
+
+# ---------------------------------------------------------------------- masks
+@pytest.mark.parametrize("mask_type", ["irm1", "irm2", "ibm1", "iam1", "iam2"])
+def test_tf_mask_parity(rng, mask_type):
+    s = (rng.normal(size=(257, 60)) + 1j * rng.normal(size=(257, 60))).astype(np.complex64)
+    n = (rng.normal(size=(257, 60)) + 1j * rng.normal(size=(257, 60))).astype(np.complex64)
+    got = np.asarray(tf_mask(s, n, mask_type=mask_type))
+    want = tf_mask_np(s.astype(np.complex128), n.astype(np.complex128), mask_type)
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_vad_oracle_parity(rng):
+    # speech-like: silence, burst, silence
+    x = np.concatenate(
+        [0.001 * rng.normal(size=4000), rng.normal(size=8000), 0.001 * rng.normal(size=4000)]
+    ).astype(np.float32)
+    got = np.asarray(vad_oracle_batch(x))
+    want = vad_oracle_np(x)
+    # Allow disagreement on a tiny fraction of samples from f32 threshold ties
+    assert np.mean(got != want) < 0.01
+    assert got[6000] == 1.0 and got[100] == 0.0
