@@ -1,0 +1,92 @@
+"""Headline benchmark: real-time factor of 8-node MWF (TANGO) speech
+enhancement @16 kHz (BASELINE.md north star).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``value`` is audio-seconds enhanced per wall-second (x realtime) for the
+jitted batched TPU pipeline; ``vs_baseline`` is the speedup over the float64
+NumPy reference implementation (the loop-per-(node,freq) formulas of
+reference tango.py:252-457) measured on this same host and extrapolated from
+a short clip.
+"""
+import json
+import time
+
+import numpy as np
+
+FS = 16000
+K, C = 8, 4  # 8-node, 4 mics per node (north-star config)
+
+
+def _scene(K, C, L, seed=0):
+    rng = np.random.default_rng(seed)
+    src = rng.standard_normal(L)
+    s = np.stack(
+        [np.stack([np.convolve(src, rng.standard_normal(8), mode="same") for _ in range(C)]) for _ in range(K)]
+    ).astype(np.float32)
+    n = 0.5 * rng.standard_normal((K, C, L)).astype(np.float32)
+    return s + n, s, n
+
+
+def bench_jax(batch=4, dur_s=10.0, iters=3):
+    import jax
+    import jax.numpy as jnp
+
+    from disco_tpu.core.dsp import stft
+    from disco_tpu.enhance import oracle_masks, tango
+
+    L = int(dur_s * FS)
+    y, s, n = _scene(K, C, L)
+    yb = jnp.asarray(np.stack([y] * batch))
+    sb = jnp.asarray(np.stack([s] * batch))
+    nb = jnp.asarray(np.stack([n] * batch))
+
+    @jax.jit
+    def run(yb, sb, nb):
+        def one(y, s, n):
+            Y, S, N = stft(y), stft(s), stft(n)
+            m = oracle_masks(S, N, "irm1")
+            return tango(Y, S, N, m, m, policy="local").yf
+
+        return jax.vmap(one)(yb, sb, nb)
+
+    run(yb, sb, nb).block_until_ready()  # compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        run(yb, sb, nb).block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+    audio_s = batch * K * dur_s  # per-node enhanced outputs
+    return audio_s / dt
+
+
+def bench_numpy(dur_s=1.0):
+    from tests.reference_impls import tango_np
+
+    L = int(dur_s * FS)
+    y, s, n = _scene(K, C, L)
+    t0 = time.perf_counter()
+    tango_np(np.asarray(y, np.float64), np.asarray(s, np.float64), np.asarray(n, np.float64))
+    dt = time.perf_counter() - t0
+    return K * dur_s / dt
+
+
+def main():
+    rtf = bench_jax()
+    try:
+        rtf_np = bench_numpy()
+    except Exception:
+        rtf_np = None
+    vs = (rtf / rtf_np) if rtf_np else None
+    print(
+        json.dumps(
+            {
+                "metric": "rtf_8node_mwf_enhancement",
+                "value": round(rtf, 2),
+                "unit": "x_realtime",
+                "vs_baseline": round(vs, 2) if vs else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

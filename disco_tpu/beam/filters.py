@@ -19,6 +19,7 @@ instead of ``K × 257`` interpreted eigendecompositions.
 """
 from __future__ import annotations
 
+import re
 from functools import partial
 
 import jax
@@ -38,11 +39,15 @@ DIAG_LOADING = 1e-6
 
 
 def get_filter_type(name: str):
-    """Parse a filter spec like 'gevd', 'rank2-gevd', 'r1-mwf', 'mwf'
-    (internal_formulas.py:10-28): returns (type, rank)."""
+    """Parse a filter spec like 'gevd', 'rank2-gevd', 'rank12-gevd', 'r1-mwf',
+    'mwf' (internal_formulas.py:10-28): returns (type, rank)."""
     if "gevd" in name:
-        rank = int(name.split("-")[0][-1]) if "-" in name else "full"
-        return "gevd", rank
+        if "-" in name:
+            m = re.fullmatch(r"rank(\d+)-gevd", name)
+            if m is None:
+                raise ValueError(f"malformed GEVD filter spec {name!r}; expected 'gevd' or 'rankN-gevd'")
+            return "gevd", int(m.group(1))
+        return "gevd", "full"
     return name, None
 
 

@@ -1,0 +1,215 @@
+"""Evaluation metrics.
+
+Capability parity with reference ``disco_theque/metrics.py`` (snr:9,
+delta_snr:25, sd:46, fw_snr:63, seg_snr:131, reverb_ratios:176, fw_sd:211,
+ci_wp:283, si_bss:291, si_sdr:342).  Metrics are *evaluation-time* quantities:
+the reference computes them in float64 NumPy (``metrics.py:376-377`` asserts
+f64) and SDR parity against it is the acceptance bar, so the canonical
+implementations here are host-side float64 NumPy as well.  ``si_sdr_jax`` is
+the on-device batched variant for use inside jitted eval loops.
+
+The reference's ``seg_snr`` is dead code (imports a nonexistent
+``sliding_window`` / ``db_utils.frame_vad``, metrics.py:144-145); here the
+evident intent is implemented and working (see ``disco_tpu.core.sigproc`` for
+the two helpers).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from disco_tpu.core.sigproc import (
+    band_importance,
+    sliding_window,
+    frame_vad,
+    third_octave_filterbank,
+)
+
+__all__ = [
+    "snr",
+    "delta_snr",
+    "sd",
+    "fw_snr",
+    "seg_snr",
+    "reverb_ratios",
+    "fw_sd",
+    "ci_wp",
+    "si_bss",
+    "si_sdr",
+    "si_sdr_jax",
+]
+
+
+def _nz_var(x, sel=None):
+    """Variance over nonzero samples (or over ``sel != 0``) — the reference's
+    convention for ignoring zero-padded segments (metrics.py:21,59)."""
+    x = np.asarray(x)
+    m = (x != 0) if sel is None else (np.asarray(sel) != 0)
+    return np.var(x[m])
+
+
+def snr(s, n, db=True):
+    """Broadband SNR over nonzero segments (metrics.py:9-22)."""
+    r = _nz_var(s) / _nz_var(n)
+    return 10 * np.log10(r) if db else r
+
+
+def delta_snr(s_out, n_out, s_in, n_in, db=True):
+    """Output-minus-input SNR (metrics.py:25-43)."""
+    d = snr(s_out, n_out, True) - snr(s_in, n_in, True)
+    return d if db else 10 ** (d / 10)
+
+
+def sd(s_out, s_in, db=True):
+    """Speech distortion var(s_in)/var(s_out) over nonzero segments
+    (metrics.py:46-60)."""
+    r = _nz_var(s_in) / _nz_var(s_out)
+    return 10 * np.log10(r) if db else r
+
+
+def _fw_banded(a, b_coefs, a_coefs, sel_vad=None):
+    """Per-band dB power of ``a`` filtered through each bandpass filter."""
+    import scipy.signal
+
+    out = np.zeros(b_coefs.shape[0])
+    for i in range(b_coefs.shape[0]):
+        f = scipy.signal.lfilter(b_coefs[i], a_coefs[i], a, axis=0)
+        out[i] = 10 * np.log10(_nz_var(f, sel=sel_vad if sel_vad is not None else f))
+    return out
+
+
+def fw_snr(s, n, fs, vad_tar=None, vad_noi=None, clipping=1, db=True):
+    """Frequency-weighted (band-importance) SNR, ANSI/Pavlovic weights
+    (metrics.py:63-128, duplicate sigproc_utils.py:120-190).
+
+    Returns (per-band weighted SNR, scalar mean, center frequencies).
+    """
+    I, F = band_importance(fs)
+    b, a = third_octave_filterbank(F, fs, order=4)
+    s_p = _fw_banded(s, b, a, vad_tar)
+    n_p = _fw_banded(n, b, a, vad_noi)
+    snr_var = s_p - n_p
+    if clipping:
+        snr_var = np.clip(snr_var, -15, 25)
+    fqwt = I / np.sum(I) * snr_var
+    mean = np.sum(fqwt)
+    if not db:
+        fqwt, mean = 10 ** (fqwt / 10), 10 ** (mean / 10)
+    return fqwt, mean, F
+
+
+def fw_sd(s_out, s_in, fs, clipping=1, db=True):
+    """Frequency-weighted speech distortion (metrics.py:211-279): per-band
+    in-minus-out dB power, clipped to [0, 25], band-importance-averaged."""
+    I, F = band_importance(fs)
+    b, a = third_octave_filterbank(F, fs, order=4)
+    out_p = _fw_banded(s_out, b, a)
+    in_p = _fw_banded(s_in, b, a)
+    sd_var = in_p - out_p
+    if clipping:
+        sd_var = np.clip(sd_var, 0, 25)
+    fqwt = I / np.sum(I) * sd_var
+    mean = np.sum(fqwt)
+    if not db:
+        fqwt, mean = 10 ** (fqwt / 10), 10 ** (mean / 10)
+    return fqwt, mean, F
+
+
+def seg_snr(s, n, win_len, win_hop, vad=None, axis=-1):
+    """Segmental SNR in dB, VAD-gated, per-window SNR clipped to [-15, 25]
+    (working implementation of the intent of metrics.py:131-173)."""
+    eps = np.finfo(np.float64).eps
+    s = np.asarray(s, np.float64)
+    n = np.asarray(n, np.float64)
+    if len(s) != len(n):
+        pad_s = max(len(n) - len(s), 0)
+        pad_n = max(len(s) - len(n), 0)
+        s = np.pad(s, (0, pad_s), mode="reflect")
+        n = np.pad(n, (0, pad_n), mode="reflect")
+    sw = sliding_window(s, win_len, win_hop, axis=axis)
+    nw = sliding_window(n, win_len, win_hop, axis=axis)
+    sw_var = np.maximum(np.var(sw, axis=-1), eps)
+    nw_var = np.maximum(np.var(nw, axis=-1), eps)
+    if vad is None:
+        batch_vad = np.ones(sw_var.shape)
+    else:
+        batch_vad = frame_vad(vad, win_len, win_hop)[: sw_var.shape[0]]
+    per_win = batch_vad * np.clip(10 * np.log10(sw_var / nw_var), -15, 25)
+    return np.sum(per_win) / np.sum(batch_vad)
+
+
+def reverb_ratios(x, rir, reverb_start=20, fs=16000):
+    """Direct-to-reverberant and signal-to-reverberation ratios in dB
+    (metrics.py:176-208): split the RIR at ``argmax + reverb_start`` ms."""
+    rir = np.asarray(rir)
+    i_peak = int(np.argmax(rir))
+    n_d = int(1e-3 * reverb_start * fs)
+    h_d, h_r = rir[: i_peak + n_d], rir[i_peak + n_d :]
+    drr = 10 * np.log10(np.sum(h_d**2) / np.sum(h_r**2))
+    x_d = np.convolve(x, h_d)
+    x_r = np.convolve(x, h_r)
+    srr = 10 * np.log10(np.sum(x_d**2) / np.sum(x_r**2))
+    return drr, srr
+
+
+def ci_wp(x, axis=0):
+    """95% normal-approximation confidence half-interval (metrics.py:283-288)."""
+    return 1.96 * np.nanstd(x, axis=axis) / np.sqrt(np.shape(x)[axis])
+
+
+def si_bss(estimated_signal, targets, j, scaling=True):
+    """Scale-invariant SDR / SIR / SAR of ``estimated_signal`` against source
+    ``j`` of ``targets`` (n_samples, n_src) — Le Roux et al. 2019 decomposition
+    (metrics.py:291-339)."""
+    targets = np.asarray(targets, np.float64)
+    est = np.asarray(estimated_signal, np.float64)
+    Rss = targets.T @ targets
+    this_s = targets[:, j]
+    a = (this_s @ est) / Rss[j, j] if scaling else 1.0
+    e_true = a * this_s
+    e_res = est - e_true
+    Sss = np.sum(e_true**2)
+    b = np.linalg.solve(Rss, targets.T @ e_res)
+    e_interf = targets @ b
+    e_artif = e_res - e_interf
+    sisdr = 10 * np.log10(Sss / np.sum(e_res**2))
+    sisir = 10 * np.log10(Sss / np.sum(e_interf**2))
+    sisar = 10 * np.log10(Sss / np.sum(e_artif**2))
+    return sisdr, sisir, sisar
+
+
+def si_sdr(reference, estimation):
+    """Scale-invariant SDR, float64, batched over leading axes
+    (metrics.py:342-392; doctest values preserved).
+
+    >>> rng = np.random.RandomState(0)
+    >>> ref = rng.randn(100)
+    >>> bool(np.isinf(si_sdr(ref, ref)))
+    True
+    >>> round(float(si_sdr(ref, np.flip(ref))), 12)
+    -25.127672346461
+    >>> round(float(si_sdr(ref, ref + np.flip(ref))), 12)
+    0.481070445786
+    >>> round(float(si_sdr(ref, ref + 0.5)), 12)
+    6.370460603258
+    """
+    estimation, reference = np.broadcast_arrays(
+        np.asarray(estimation, np.float64), np.asarray(reference, np.float64)
+    )
+    ref_energy = np.sum(reference**2, axis=-1, keepdims=True)
+    alpha = np.sum(reference * estimation, axis=-1, keepdims=True) / ref_energy
+    projection = alpha * reference
+    noise = estimation - projection
+    ratio = np.sum(projection**2, axis=-1) / np.sum(noise**2, axis=-1)
+    return 10 * np.log10(ratio)
+
+
+def si_sdr_jax(reference: jnp.ndarray, estimation: jnp.ndarray) -> jnp.ndarray:
+    """On-device SI-SDR for jitted eval loops — same math as :func:`si_sdr`,
+    batched over leading axes, in the ambient JAX precision."""
+    ref_energy = jnp.sum(reference**2, axis=-1, keepdims=True)
+    alpha = jnp.sum(reference * estimation, axis=-1, keepdims=True) / ref_energy
+    projection = alpha * reference
+    noise = estimation - projection
+    ratio = jnp.sum(projection**2, axis=-1) / jnp.sum(noise**2, axis=-1)
+    return 10.0 * jnp.log10(ratio)

@@ -1,0 +1,17 @@
+from disco_tpu.enhance.tango import (
+    TangoResult,
+    oracle_masks,
+    tango,
+    tango_step1,
+    tango_step2,
+    others_index,
+)
+
+__all__ = [
+    "TangoResult",
+    "oracle_masks",
+    "tango",
+    "tango_step1",
+    "tango_step2",
+    "others_index",
+]

@@ -1,0 +1,4 @@
+from disco_tpu.io.audio import read_wav, write_wav
+from disco_tpu.io.layout import DatasetLayout
+
+__all__ = ["read_wav", "write_wav", "DatasetLayout"]

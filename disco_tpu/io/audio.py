@@ -1,0 +1,36 @@
+"""WAV I/O with soundfile-compatible float semantics.
+
+The reference reads/writes audio through ``soundfile``/libsndfile
+(e.g. tango.py:95-109,605-608): integer PCM is returned as float in
+[-1, 1), float files pass through.  libsndfile is not in this image, so the
+same contract is provided over ``scipy.io.wavfile``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+_PCM_SCALE = {np.dtype(np.int16): 2**15, np.dtype(np.int32): 2**31}
+
+
+def read_wav(path, dtype=np.float32):
+    """Read a WAV file as float in [-1, 1), shape (n_samples,) or
+    (n_samples, n_channels).  Returns (signal, fs) — note the (signal, fs)
+    order of soundfile.read, which the reference relies on."""
+    import scipy.io.wavfile
+
+    fs, data = scipy.io.wavfile.read(str(path))
+    if data.dtype in _PCM_SCALE:
+        data = data.astype(dtype) / _PCM_SCALE[data.dtype]
+    elif data.dtype == np.uint8:  # 8-bit WAV is unsigned
+        data = (data.astype(dtype) - 128.0) / 128.0
+    else:
+        data = data.astype(dtype)
+    return data, fs
+
+
+def write_wav(path, data, fs):
+    """Write float audio in [-1, 1) as a float32 WAV (the reference writes
+    float via soundfile; float32 WAV preserves that exactly)."""
+    import scipy.io.wavfile
+
+    scipy.io.wavfile.write(str(path), int(fs), np.asarray(data, np.float32))

@@ -1,0 +1,3 @@
+from disco_tpu.parallel.mesh import make_mesh, node_sharding, tango_sharded
+
+__all__ = ["make_mesh", "node_sharding", "tango_sharded"]

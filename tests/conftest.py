@@ -1,16 +1,23 @@
 """Test configuration: force the CPU backend with 8 virtual devices so the
 multi-chip sharding paths (Mesh / shard_map / pjit) are exercised without TPU
-hardware, per the build environment contract."""
+hardware, per the build environment contract.
+
+The image's sitecustomize imports jax at interpreter start (to register the
+axon TPU plugin), so setting JAX_PLATFORMS via os.environ here is too late —
+jax has already read the env at import. Use jax.config.update instead, which
+works as long as no backend has been initialised yet.
+"""
 import os
 
-# Hard override: the image may export JAX_PLATFORMS=axon (single real TPU chip
-# behind a tunnel); tests must run on the virtual 8-device CPU mesh.
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

@@ -55,10 +55,16 @@ def test_smoothed_covariance(rng):
 # -------------------------------------------------------------------- filters
 @pytest.mark.parametrize(
     "name,expected", [("gevd", ("gevd", "full")), ("rank2-gevd", ("gevd", 2)),
+                      ("rank12-gevd", ("gevd", 12)),
                       ("r1-mwf", ("r1-mwf", None)), ("mwf", ("mwf", None))]
 )
 def test_get_filter_type(name, expected):
     assert get_filter_type(name) == expected
+
+
+def test_get_filter_type_rejects_malformed():
+    with pytest.raises(ValueError):
+        get_filter_type("rankX-gevd")
 
 
 @pytest.mark.parametrize("C", [2, 4, 7])

@@ -1,0 +1,274 @@
+"""Parity and property tests for disco_tpu.core.metrics / core.sigproc / io.
+
+The float64 NumPy formulas of the reference (metrics.py, sigproc_utils.py) are
+the oracle; the si_sdr doctest values of reference metrics.py:355-372 are
+reproduced verbatim.
+"""
+import doctest
+
+import numpy as np
+import pytest
+
+import disco_tpu.core.metrics as M
+from disco_tpu.core.sigproc import (
+    band_importance,
+    frame_vad,
+    increase_to_snr,
+    noise_from_signal,
+    sliding_window,
+    third_octave_band,
+    third_octave_filterbank,
+)
+from disco_tpu.io.layout import DatasetLayout, case_of_rir, snr_dirname
+
+
+# ------------------------------------------------------------------- si_sdr
+def test_si_sdr_reference_doctest_values():
+    """The exact doctest values of reference metrics.py:355-372."""
+    np.random.seed(0)
+    ref = np.random.randn(100)
+    assert np.isinf(M.si_sdr(ref, ref))
+    assert np.isinf(M.si_sdr(ref, ref * 2))
+    assert M.si_sdr(ref, np.flip(ref)) == pytest.approx(-25.127672346460717)
+    assert M.si_sdr(ref, ref + np.flip(ref)) == pytest.approx(0.481070445785553)
+    assert M.si_sdr(ref, ref + 0.5) == pytest.approx(6.3704606032577304)
+    assert M.si_sdr(ref, ref * 2 + 1) == pytest.approx(6.3704606032577304)
+    np.testing.assert_allclose(
+        M.si_sdr([ref, ref], [ref * 2 + 1, ref * 1 + 0.5]),
+        [6.3704606, 6.3704606],
+        rtol=1e-6,
+    )
+
+
+def test_si_sdr_jax_matches_numpy(rng):
+    ref = rng.standard_normal((3, 4000))
+    est = ref + 0.1 * rng.standard_normal((3, 4000))
+    got = np.asarray(M.si_sdr_jax(ref.astype(np.float32), est.astype(np.float32)))
+    np.testing.assert_allclose(got, M.si_sdr(ref, est), rtol=1e-3)
+
+
+def test_module_doctests():
+    failures, _ = doctest.testmod(M)
+    assert failures == 0
+
+
+# ------------------------------------------------------- broadband snr / sd
+def test_snr_known_value(rng):
+    s = rng.standard_normal(8000)
+    n = 0.1 * rng.standard_normal(8000)
+    assert M.snr(s, n) == pytest.approx(20.0, abs=0.5)
+    assert M.snr(s, n, db=False) == pytest.approx(100.0, rel=0.15)
+
+
+def test_snr_ignores_zero_padding(rng):
+    s = rng.standard_normal(8000)
+    n = 0.1 * rng.standard_normal(8000)
+    sp = np.concatenate([s, np.zeros(4000)])
+    np_ = np.concatenate([n, np.zeros(4000)])
+    assert M.snr(sp, np_) == pytest.approx(M.snr(s, n))
+
+
+def test_delta_snr_and_sd(rng):
+    s = rng.standard_normal(8000)
+    n = rng.standard_normal(8000)
+    assert M.delta_snr(s, 0.5 * n, s, n) == pytest.approx(20 * np.log10(2), abs=1e-6)
+    assert M.sd(0.5 * s, s) == pytest.approx(20 * np.log10(2), abs=1e-6)
+
+
+# ---------------------------------------------------------------- fw_snr/sd
+def test_fw_snr_recovers_broadband_snr_of_white_noise(rng):
+    """For white target and white noise, every band has the same SNR, so the
+    importance-weighted mean must equal the broadband SNR."""
+    s = rng.standard_normal(32000)
+    n = 0.1 * rng.standard_normal(32000)
+    _, mean, F = M.fw_snr(s, n, fs=16000)
+    assert mean == pytest.approx(20.0, abs=1.0)
+    assert F[-1] * 2 ** (1 / 6) < 8000
+
+
+def test_fw_snr_clipping(rng):
+    s = rng.standard_normal(16000)
+    _, mean_hi, _ = M.fw_snr(s, 1e-6 * rng.standard_normal(16000), fs=16000)
+    _, mean_lo, _ = M.fw_snr(s, 1e6 * rng.standard_normal(16000), fs=16000)
+    assert mean_hi == pytest.approx(25.0, abs=1e-9)
+    assert mean_lo == pytest.approx(-15.0, abs=1e-9)
+
+
+def test_fw_sd_identity_is_zero(rng):
+    s = rng.standard_normal(16000)
+    _, mean, _ = M.fw_sd(s, s, fs=16000)
+    assert mean == pytest.approx(0.0, abs=1e-9)
+
+
+def test_band_importance_narrowband():
+    I, F = band_importance(8000)
+    assert F[0] == 200 and F[-1] * 2 ** (1 / 6) < 4000
+    # At fs=16 kHz the 8000 Hz band's upper edge exceeds Nyquist, so the
+    # reference's selection keeps 17 of the 18 wideband bands.
+    I16, F16 = band_importance(16000)
+    assert len(F16) == 17 and I16.shape == (17,)
+
+
+# ----------------------------------------------------------------- seg_snr
+def test_seg_snr_constant_snr(rng):
+    s = rng.standard_normal(16000)
+    n = 0.1 * rng.standard_normal(16000)
+    assert M.seg_snr(s, n, 512, 256) == pytest.approx(20.0, abs=1.0)
+
+
+def test_seg_snr_vad_gates_silence(rng):
+    s = np.concatenate([rng.standard_normal(8000), np.zeros(8000)])
+    n = 0.1 * rng.standard_normal(16000)
+    vad = np.concatenate([np.ones(8000), np.zeros(8000)])
+    gated = M.seg_snr(s, n, 512, 256, vad=vad)
+    assert gated == pytest.approx(20.0, abs=1.5)
+
+
+# ---------------------------------------------------------- reverb_ratios
+def test_reverb_ratios_known_split(rng):
+    fs = 16000
+    rir = np.zeros(4000)
+    rir[10] = 1.0  # direct path
+    tail = 0.01 * rng.standard_normal(4000 - (10 + 320))
+    rir[10 + 320 :] = tail  # reverberant tail after 20 ms
+    drr, srr = M.reverb_ratios(rng.standard_normal(8000), rir, reverb_start=20, fs=fs)
+    expected_drr = 10 * np.log10(1.0 / np.sum(tail**2))
+    assert drr == pytest.approx(expected_drr, abs=1e-9)
+    assert srr == pytest.approx(expected_drr, abs=2.0)
+
+
+# ----------------------------------------------------------------- si_bss
+def test_si_bss_clean_estimate_high_sdr(rng):
+    t = rng.standard_normal((8000, 2))
+    est = t[:, 0] + 0.01 * rng.standard_normal(8000)
+    sisdr, sisir, sisar = M.si_bss(est, t, 0)
+    assert sisdr > 35
+    assert sisir > sisdr  # interference share of a white residual is small
+    assert M.si_bss(2.0 * est, t, 0)[0] == pytest.approx(sisdr, abs=1e-6)
+
+
+def test_si_bss_interference(rng):
+    t = rng.standard_normal((8000, 2))
+    est = t[:, 0] + 0.1 * t[:, 1]
+    sisdr, sisir, sisar = M.si_bss(est, t, 0)
+    assert sisir == pytest.approx(20.0, abs=0.5)
+    assert sisar > 50  # no artifacts: residual lies in span(targets)
+
+
+def test_ci_wp(rng):
+    x = rng.standard_normal((400, 3))
+    np.testing.assert_allclose(
+        M.ci_wp(x), 1.96 * np.nanstd(x, axis=0) / np.sqrt(400), rtol=1e-12
+    )
+
+
+# ----------------------------------------------------------------- sigproc
+def test_sliding_window_and_frame_vad():
+    x = np.arange(10.0)
+    w = sliding_window(x, 4, 2)
+    assert w.shape == (4, 4)
+    np.testing.assert_array_equal(w[0], [0, 1, 2, 3])
+    np.testing.assert_array_equal(w[-1], [6, 7, 8, 9])
+    vad = np.concatenate([np.ones(6), np.zeros(6)])
+    fv = frame_vad(vad, 4, 4)
+    np.testing.assert_array_equal(fv, [1, 1, 0])
+
+
+def test_increase_to_snr(rng):
+    x = rng.standard_normal(16000)
+    n = 3.7 * rng.standard_normal(16000)
+    n_ = increase_to_snr(x, n, 5.0)
+    assert M.snr(x, n_) == pytest.approx(5.0, abs=1e-6)
+
+
+def test_noise_from_signal_preserves_spectrum(rng):
+    x = rng.standard_normal(4096)
+    out = noise_from_signal(x, rng=rng)
+    assert out.shape == x.shape
+    # irfft discards the imaginary parts of the DC and Nyquist bins, so the
+    # magnitude match holds on the interior bins only.
+    X = np.abs(np.fft.rfft(x))
+    N = np.abs(np.fft.rfft(out))
+    np.testing.assert_allclose(N[1:-1], X[1:-1], rtol=1e-6, atol=1e-9)
+
+
+def test_third_octave_band_ratios():
+    fc, fl, fu = third_octave_band(1000, i_band=0)
+    assert fc == 1000 and fl == pytest.approx(1000 * 2 ** (-1 / 6)) and fu == pytest.approx(1000 * 2 ** (1 / 6))
+    fc, fl, fu = third_octave_band(1000, n_band=18)
+    assert len(fc) == 18
+
+
+def test_third_octave_filterbank_band_selectivity(rng):
+    import scipy.signal
+
+    fs = 16000
+    F = np.array([500.0, 2000.0])
+    b, a = third_octave_filterbank(F, fs, order=4)
+    assert b.shape == (2, 9) and a.shape == (2, 9)
+    t = np.arange(fs) / fs
+    tone_in = np.sin(2 * np.pi * 500 * t)
+    tone_out = np.sin(2 * np.pi * 2000 * t)
+    in_band = scipy.signal.lfilter(b[0], a[0], tone_in)
+    out_band = scipy.signal.lfilter(b[0], a[0], tone_out)
+    assert np.var(in_band[2000:]) > 100 * np.var(out_band[2000:])
+
+
+# ---------------------------------------------------------------------- io
+def test_wav_roundtrip(tmp_path, rng):
+    from disco_tpu.io import read_wav, write_wav
+
+    x = (0.5 * rng.standard_normal(1600)).astype(np.float32)
+    p = tmp_path / "a.wav"
+    write_wav(p, x, 16000)
+    y, fs = read_wav(p)
+    assert fs == 16000
+    np.testing.assert_allclose(y, x, atol=1e-7)
+
+
+def test_wav_reads_int16_as_float(tmp_path):
+    import scipy.io.wavfile
+
+    from disco_tpu.io import read_wav
+
+    p = tmp_path / "i.wav"
+    scipy.io.wavfile.write(str(p), 16000, np.array([0, 16384, -32768], np.int16))
+    y, fs = read_wav(p)
+    np.testing.assert_allclose(y, [0.0, 0.5, -1.0])
+
+
+def test_layout_paths_match_reference_conventions(tmp_path):
+    lay = DatasetLayout(str(tmp_path), "living", "train")
+    assert str(lay.wav_original("cnv", "target", 12, 1, 3)).endswith(
+        "living/train/wav_original/cnv/target/12_S-1_Ch-3.wav"
+    )
+    assert str(lay.wav_original("cnv", "noise", 12, 2, 3, noise="ssn")).endswith(
+        "living/train/wav_original/cnv/noise/12_S-2_ssn_Ch-3.wav"
+    )
+    assert str(lay.wav_processed([0, 6], "mixture", 12, 3, noise="ssn")).endswith(
+        "living/train/wav_processed/0-6/mixture/12_ssn_Ch-3.wav"
+    )
+    assert str(lay.stft_processed([0, 6], "mixture", 12, 3, noise="ssn", normed=True)).endswith(
+        "living/train/stft_processed/normed/abs/0-6/mixture/12_ssn_Ch-3.npy"
+    )
+    assert str(lay.mask_processed([0, 6], 12, 3, "ssn")).endswith(
+        "living/train/mask_processed/0-6/12_ssn_Ch-3.npy"
+    )
+    assert str(lay.stft_z("zf", [0, 6], "zs_hat", 12, 2, "ssn")).endswith(
+        "living/train/stft_z/zf/raw/0-6/zs_hat/12_ssn_Node-2.npy"
+    )
+    assert str(lay.snr_log([0, 6], 12, "ssn")).endswith(
+        "living/train/log/snrs/dry/0-6/12_ssn.npy"
+    )
+    assert snr_dirname([0, 6]) == "0-6"
+
+
+def test_case_of_rir_split():
+    assert case_of_rir(1) == "train"
+    assert case_of_rir(10000) == "train"
+    assert case_of_rir(10001) == "val"
+    assert case_of_rir(11000) == "val"
+    assert case_of_rir(11001) == "test"
+    assert case_of_rir(12000) == "test"
+    with pytest.raises(AssertionError):
+        case_of_rir(12001)

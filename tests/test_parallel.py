@@ -1,0 +1,65 @@
+"""The mesh contract: node-sharded shard_map TANGO == single-device vmap
+TANGO, on the virtual 8-device CPU mesh (SURVEY.md §7 step 3)."""
+import jax
+import numpy as np
+import pytest
+
+from disco_tpu.core.dsp import stft
+from disco_tpu.enhance import oracle_masks, tango
+from disco_tpu.parallel import make_mesh, node_sharding, tango_sharded
+
+from tests.test_tango import _scene
+
+
+@pytest.fixture(scope="module")
+def scene8():
+    # 8 nodes x 2 mics so every virtual device owns exactly one node.
+    return _scene(np.random.default_rng(3), K=8, C=2, L=8192)
+
+
+def test_mesh_shape():
+    mesh = make_mesh(n_node=8)
+    assert dict(mesh.shape) == {"batch": 1, "node": 8}
+    mesh2 = make_mesh(n_node=4, n_batch=2)
+    assert dict(mesh2.shape) == {"batch": 2, "node": 4}
+
+
+@pytest.mark.parametrize("policy", ["local", "none", "distant", "use_oracle_zs"])
+def test_sharded_matches_vmap(scene8, policy):
+    y, s, n = scene8
+    Y, S, N = stft(y), stft(s), stft(n)
+    masks = oracle_masks(S, N, "irm1")
+
+    want = tango(Y, S, N, masks, masks, policy=policy)
+
+    mesh = make_mesh(n_node=8)
+    sh = node_sharding(mesh)
+    Ys, Ss, Ns = (jax.device_put(a, sh) for a in (Y, S, N))
+    ms = jax.device_put(masks, sh)
+    got = tango_sharded(Ys, Ss, Ns, ms, ms, mesh, policy=policy)
+
+    for key in ("yf", "sf", "nf", "z_y", "z_s", "z_n", "zn"):
+        a = np.asarray(getattr(got, key))
+        b = np.asarray(getattr(want, key))
+        err = np.linalg.norm(a - b) / np.linalg.norm(b)
+        assert err < 1e-5, (key, err)
+
+
+def test_sharded_two_nodes_per_device(scene8):
+    """K=8 nodes on 4 devices: two nodes per shard still produces identical
+    results (the n_local > 1 path)."""
+    y, s, n = scene8
+    Y, S, N = stft(y), stft(s), stft(n)
+    masks = oracle_masks(S, N, "irm1")
+    want = tango(Y, S, N, masks, masks, policy="local")
+
+    mesh = make_mesh(n_node=4)
+    sh = node_sharding(mesh)
+    got = tango_sharded(
+        jax.device_put(Y, sh), jax.device_put(S, sh), jax.device_put(N, sh),
+        jax.device_put(masks, sh), jax.device_put(masks, sh), mesh, policy="local",
+    )
+    err = np.linalg.norm(np.asarray(got.yf) - np.asarray(want.yf)) / np.linalg.norm(
+        np.asarray(want.yf)
+    )
+    assert err < 1e-5, err

@@ -1,0 +1,160 @@
+"""End-to-end parity tests for the TANGO two-step pipeline against the
+float64 NumPy oracle (tests/reference_impls.tango_np, restating reference
+tango.py:252-457)."""
+import numpy as np
+import pytest
+
+from disco_tpu.core.dsp import istft, stft
+from disco_tpu.core.metrics import si_sdr
+from disco_tpu.enhance import oracle_masks, others_index, tango
+
+from tests.reference_impls import istft_np, si_sdr_np, stft_np, tango_np
+
+K, C, L = 3, 2, 16384  # small but non-trivial: 3 nodes x 2 mics x 1 s
+FS = 16000
+
+
+def _scene(rng, K=K, C=C, L=L):
+    """Synthesized multichannel scene: a shared 'speech' source with random
+    per-mic FIR channels + diffuse noise, so covariances are genuinely rank-
+    deficient-ish and the GEVD has work to do."""
+    src = rng.standard_normal(L)
+    s = np.stack(
+        [
+            np.stack(
+                [np.convolve(src, rng.standard_normal(8) * 0.5, mode="same") for _ in range(C)]
+            )
+            for _ in range(K)
+        ]
+    )
+    n = 0.8 * rng.standard_normal((K, C, L))
+    y = s + n
+    return y, s, n
+
+
+@pytest.fixture(scope="module")
+def scene():
+    return _scene(np.random.default_rng(7))
+
+
+@pytest.fixture(scope="module")
+def oracle(scene):
+    y, s, n = scene
+    return tango_np(y, s, n, mask_type="irm1", mask_for_z="local")
+
+
+@pytest.fixture(scope="module")
+def ours(scene):
+    y, s, n = scene
+    Y, S, N = stft(y), stft(s), stft(n)
+    masks_z = oracle_masks(S, N, "irm1")
+    return tango(Y, S, N, masks_z, masks_z, policy="local"), (Y, S, N)
+
+
+def test_others_index():
+    np.testing.assert_array_equal(others_index(3), [[1, 2], [0, 2], [0, 1]])
+
+
+def test_step1_z_parity(oracle, ours):
+    """Compressed streams match the float64 oracle closely in relative l2."""
+    res, _ = ours
+    for key in ("z_y", "z_s", "z_n", "zn"):
+        got = np.asarray(res.__getattribute__(key))
+        want = oracle[key]
+        err = np.linalg.norm(got - want) / np.linalg.norm(want)
+        assert err < 5e-3, (key, err)
+
+
+def test_step2_output_parity(oracle, ours):
+    # Two chained f32 eigendecompositions vs the f64 oracle: in the
+    # ill-conditioned near-DC bins the GEVD direction is sensitive to
+    # precision, so raw-STFT agreement is checked at 5% on the energetic
+    # half of the bins and 10% overall; the meaningful anchor is SDR-level
+    # parity (test_sdr_parity_with_oracle, 0.1 dB).
+    # nf is the residual the filter suppresses by ~20 dB, so tiny absolute
+    # deviations inflate its relative error — it gets the looser bound.
+    res, _ = ours
+    for key, tol, tol_hi in (("yf", 1e-1, 5e-2), ("sf", 1e-1, 5e-2), ("nf", 2e-1, 2e-1)):
+        got = np.asarray(getattr(res, key))
+        want = oracle[key]
+        err = np.linalg.norm(got - want) / np.linalg.norm(want)
+        assert err < tol, (key, err)
+        pw = np.linalg.norm(want, axis=-1)
+        hi = pw > np.percentile(pw, 50)
+        err_hi = np.linalg.norm((got - want)[None, hi]) / np.linalg.norm(want[None, hi])
+        assert err_hi < tol_hi, (key, err_hi)
+
+
+def test_enhancement_improves_snr(scene, ours):
+    """The acceptance bar: output SNR (filtered-speech vs filtered-noise
+    power) beats the ref-mic input SNR by several dB at every node."""
+    y, s, n = scene
+    res, _ = ours
+    for k in range(K):
+        snr_in = 10 * np.log10(np.var(s[k, 0]) / np.var(n[k, 0]))
+        sf = np.asarray(istft(res.sf[k], L), np.float64)
+        nf = np.asarray(istft(res.nf[k], L), np.float64)
+        snr_out = 10 * np.log10(np.var(sf) / np.var(nf))
+        assert snr_out > snr_in + 3.0, (k, snr_in, snr_out)
+
+
+def test_sdr_parity_with_oracle(scene, oracle, ours):
+    y, s, n = scene
+    res, _ = ours
+    for k in range(K):
+        ref = s[k, 0]
+        ours_sdr = si_sdr(ref, np.asarray(istft(res.yf[k], L), np.float64))
+        oracle_sdr = si_sdr_np(ref, istft_np(oracle["yf"][k], L))
+        assert abs(ours_sdr - oracle_sdr) < 0.1, (k, ours_sdr, oracle_sdr)
+
+
+def test_policy_none_matches_oracle(scene):
+    y, s, n = scene
+    want = tango_np(y, s, n, mask_type="irm1", mask_for_z=None)
+    Y, S, N = stft(y), stft(s), stft(n)
+    masks = oracle_masks(S, N, "irm1")
+    res = tango(Y, S, N, masks, masks, policy="none")
+    err = np.linalg.norm(np.asarray(res.yf) - want["yf"]) / np.linalg.norm(want["yf"])
+    assert err < 5e-3, err
+
+
+@pytest.mark.parametrize("policy", ["distant", "compressed", "use_oracle_refs", "use_oracle_zs"])
+def test_other_policies_run_and_enhance(scene, policy):
+    """The remaining policy branches execute and still enhance (no oracle
+    restated for each — the branch semantics are covered by code review +
+    the 'local'/'none' parity anchors)."""
+    y, s, n = scene
+    Y, S, N = stft(y), stft(s), stft(n)
+    masks = oracle_masks(S, N, "irm1")
+    res = tango(Y, S, N, masks, masks, policy=policy)
+    enh = np.asarray(istft(res.yf[0], L), np.float64)
+    assert si_sdr(s[0, 0], enh) > si_sdr(s[0, 0], y[0, 0])
+
+
+def test_oracle_step1_stats_branch(scene):
+    y, s, n = scene
+    Y, S, N = stft(y), stft(s), stft(n)
+    masks = oracle_masks(S, N, "irm1")
+    res = tango(Y, S, N, masks, masks, policy="local", oracle_step1_stats=True)
+    assert np.isfinite(np.asarray(res.yf)).all()
+
+
+def test_batched_tango_vmaps_over_rooms(scene):
+    """Rooms are an array axis: vmap(tango) on a stacked batch equals per-room
+    calls."""
+    import jax
+
+    y, s, n = scene
+    Y, S, N = stft(y), stft(s), stft(n)
+    masks = oracle_masks(S, N, "irm1")
+    Yb = np.stack([Y, Y * 0.5])
+    Sb = np.stack([S, S * 0.5])
+    Nb = np.stack([N, N * 0.5])
+    mb = np.stack([masks, masks])
+    batched = jax.vmap(lambda a, b, c, d: tango(a, b, c, d, d, policy="local"))(
+        Yb, Sb, Nb, mb
+    )
+    single = tango(Y, S, N, masks, masks, policy="local")
+    np.testing.assert_allclose(
+        np.asarray(batched.yf[0]), np.asarray(single.yf), rtol=2e-4, atol=1e-5
+    )
