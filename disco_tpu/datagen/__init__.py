@@ -1,0 +1,27 @@
+from disco_tpu.datagen.disco import (
+    generate_disco_rirs,
+    reverb_other_noises,
+    simulate_scene,
+    snr_at_mics,
+)
+from disco_tpu.datagen.meetit import (
+    check_sir_validity,
+    get_masks,
+    get_value_range,
+    simulate_meetit_room,
+    sir_at_node,
+)
+from disco_tpu.datagen.postgen import PostGenerator
+
+__all__ = [
+    "simulate_meetit_room",
+    "sir_at_node",
+    "check_sir_validity",
+    "get_value_range",
+    "get_masks",
+    "simulate_scene",
+    "snr_at_mics",
+    "reverb_other_noises",
+    "generate_disco_rirs",
+    "PostGenerator",
+]

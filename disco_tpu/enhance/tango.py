@@ -57,7 +57,8 @@ class TangoResult:
     mask_w: jnp.ndarray  # step-2 masks
 
     def tree_flatten(self):
-        return dataclasses.astuple(self), None
+        # Not dataclasses.astuple — that deep-copies every array leaf.
+        return tuple(getattr(self, f.name) for f in dataclasses.fields(self)), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):

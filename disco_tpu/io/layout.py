@@ -46,6 +46,17 @@ class DatasetLayout:
         tag = f"{rir}_S-{s}" + (f"_{noise}" if noise else "") + f"_Ch-{ch}.wav"
         return self.base / "wav_original" / kind / source / tag
 
+    def dry_source(self, source: str, rir: int, s: int, noise: str | None = None) -> Path:
+        """Dry source wav — no channel suffix: {rir}_S-{s}[_{noise}].wav
+        (convolve_signals.py:305-310)."""
+        tag = f"{rir}_S-{s}" + (f"_{noise}" if noise else "") + ".wav"
+        return self.base / "wav_original" / "dry" / source / tag
+
+    def cnv_image(self, source: str, rir: int, s: int, ch: int, noise: str | None = None) -> Path:
+        """Convolved image wav: {rir}_S-{s}[_{noise}]_Ch-{ch}.wav
+        (convolve_signals.py:312-325)."""
+        return self.wav_original("cnv", source, rir, s, ch, noise=noise)
+
     # -- wav_processed / stft_processed / mask_processed (mixing output) ---
     def wav_processed(self, snr_range, source: str, rir: int, ch: int, noise: str | None = None) -> Path:
         tag = f"{rir}" + (f"_{noise}" if noise else "") + f"_Ch-{ch}.wav"

@@ -1,0 +1,43 @@
+from disco_tpu.sim.defaults import RoomDefaults, SignalDefaults, make_setup
+from disco_tpu.sim.geometry import (
+    LivingRoomSetup,
+    MeetingRoomSetup,
+    MeetitSetup,
+    RandomRoomSetup,
+    RoomSetup,
+    circular_array_2d,
+    eyring_absorption,
+)
+from disco_tpu.sim.signals import (
+    InterferentSpeakersSetup,
+    SpeechAndNoiseSetup,
+    normalize_to_var,
+)
+from disco_tpu.sim.ism import (
+    fft_convolve,
+    image_lattice,
+    rir_length_for,
+    shoebox_rir,
+    shoebox_rirs,
+)
+
+__all__ = [
+    "RoomDefaults",
+    "SignalDefaults",
+    "make_setup",
+    "RandomRoomSetup",
+    "MeetingRoomSetup",
+    "LivingRoomSetup",
+    "MeetitSetup",
+    "RoomSetup",
+    "circular_array_2d",
+    "eyring_absorption",
+    "shoebox_rir",
+    "shoebox_rirs",
+    "fft_convolve",
+    "rir_length_for",
+    "image_lattice",
+    "SpeechAndNoiseSetup",
+    "InterferentSpeakersSetup",
+    "normalize_to_var",
+]

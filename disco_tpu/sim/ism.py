@@ -1,0 +1,158 @@
+"""Batched image-source-method (ISM) room impulse responses on TPU.
+
+The reference delegates RIR computation to pyroomacoustics' C++ ``libroom``
+engine (``gen_disco/convolve_signals.py:84-99`` calls
+``room.image_source_model(use_libroom=True)`` + ``compute_rir`` on a
+``pra.ShoeBox(max_order=20)``).  This module is the compiled, performance-
+class equivalent (SURVEY.md §2.9): the Allen & Berkley shoebox ISM as one
+fused XLA program —
+
+* image enumeration for ``|n|+|l|+|m| <= max_order`` is a *static* lattice
+  (computed once per ``max_order`` on host, ~12k images at order 20),
+* per-image positions / reflection counts / distances / amplitudes are one
+  broadcast batch over (images, mics),
+* the fractional-delay injection is a windowed-sinc (81-tap Hann, the
+  libroom convention) scatter-add into the RIR buffer,
+
+and the whole thing ``vmap``s over sources, mics and rooms — a 64-room ×
+8-node MEETIT batch is one device launch (BASELINE.md milestone config 5).
+
+Conventions matched to pyroomacoustics: sound speed c = 343 m/s, uniform
+wall energy absorption ``alpha`` (reflection coefficient sqrt(1-alpha)),
+amplitude 1/(4·pi·d), fs 16 kHz.
+"""
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+C_SOUND = 343.0
+FDL = 81  # fractional-delay filter length (libroom's windowed-sinc taps)
+
+
+@lru_cache(maxsize=None)
+def image_lattice(max_order: int) -> tuple[np.ndarray, np.ndarray]:
+    """Static image lattice for the shoebox ISM.
+
+    Returns (lattice, parity):
+      lattice: (n_img, 3) int — the (n, l, m) cell indices,
+      parity:  (n_img, 3) int in {0, 1} — the (u, v, w) mirror parities,
+    enumerating every image with total reflection count
+    ``|n-u|+|n| + |l-v|+|l| + |m-w|+|m| <= max_order`` (Allen & Berkley 1979;
+    the sum-order truncation libroom applies).
+    """
+    rng = np.arange(-max_order, max_order + 1)
+    cells = np.stack(np.meshgrid(rng, rng, rng, indexing="ij"), -1).reshape(-1, 3)
+    par = np.stack(np.meshgrid([0, 1], [0, 1], [0, 1], indexing="ij"), -1).reshape(-1, 3)
+    lat = np.repeat(cells, len(par), axis=0)
+    pr = np.tile(par, (len(cells), 1))
+    n_refl = np.abs(lat - pr).sum(-1) + np.abs(lat).sum(-1)
+    keep = n_refl <= max_order
+    return lat[keep].astype(np.int32), pr[keep].astype(np.int32)
+
+
+@partial(jax.jit, static_argnames=("max_order", "rir_len", "fs"))
+def shoebox_rir(
+    room_dim: jnp.ndarray,
+    source: jnp.ndarray,
+    mics: jnp.ndarray,
+    alpha,
+    max_order: int = 20,
+    rir_len: int = 8192,
+    fs: int = 16000,
+) -> jnp.ndarray:
+    """RIRs from one source to M mics in a shoebox room.
+
+    Args:
+      room_dim: (3,) room dimensions [Lx, Ly, Lz] in meters.
+      source: (3,) source position.
+      mics: (M, 3) microphone positions.
+      alpha: scalar energy absorption of all walls (the Eyring-calibrated
+        value of reference room_setups.py:92).
+      max_order: maximum total reflection count (reference uses 20,
+        convolve_signals.py:245).
+      rir_len: output length in samples (static under jit; images arriving
+        later are dropped, as a finite libroom RIR does).
+
+    Returns:
+      (M, rir_len) float32 RIRs.
+    """
+    lat_np, par_np = image_lattice(max_order)
+    lat = jnp.asarray(lat_np, jnp.float32)  # (I, 3)
+    par = jnp.asarray(par_np, jnp.float32)
+    n_refl = jnp.sum(jnp.abs(lat - par), -1) + jnp.sum(jnp.abs(lat), -1)  # (I,)
+
+    # Image positions: x_im = (1-2u)·x_s + 2 n L   (per axis).
+    img = (1.0 - 2.0 * par) * source[None, :] + 2.0 * lat * room_dim[None, :]  # (I, 3)
+    beta = jnp.sqrt(jnp.maximum(1.0 - alpha, 0.0))
+    amp_refl = beta**n_refl  # (I,)
+
+    d = jnp.linalg.norm(img[None, :, :] - mics[:, None, :], axis=-1)  # (M, I)
+    d = jnp.maximum(d, 1e-3)
+    amp = amp_refl[None, :] / (4.0 * jnp.pi * d)  # (M, I)
+    delay = d * (fs / C_SOUND)  # fractional samples
+
+    # Windowed-sinc fractional delay: each image injects FDL taps centered
+    # on its (fractional) delay.
+    half = FDL // 2
+    t0 = jnp.floor(delay).astype(jnp.int32)  # integer part
+    frac = delay - t0
+    taps = jnp.arange(-half, half + 1, dtype=jnp.float32)  # (FDL,)
+    arg = taps[None, None, :] - frac[..., None]  # (M, I, FDL)
+    win = 0.5 * (1.0 + jnp.cos(jnp.pi * arg / (half + 1)))
+    win = jnp.where(jnp.abs(arg) <= half + 1, win, 0.0)
+    sinc = jnp.sinc(arg) * win
+    vals = amp[..., None] * sinc  # (M, I, FDL)
+
+    idx = t0[..., None] + taps.astype(jnp.int32)[None, None, :]  # (M, I, FDL)
+    # Out-of-range taps (negative or beyond rir_len) are routed to a
+    # sacrificial slot.
+    oob = (idx < 0) | (idx >= rir_len)
+    idx = jnp.where(oob, rir_len, idx)
+    vals = jnp.where(oob, 0.0, vals)
+
+    def scatter_one(vals_m, idx_m):
+        buf = jnp.zeros(rir_len + 1, jnp.float32)
+        return buf.at[idx_m.reshape(-1)].add(vals_m.reshape(-1))[:rir_len]
+
+    return jax.vmap(scatter_one)(vals, idx)
+
+
+@partial(jax.jit, static_argnames=("max_order", "rir_len", "fs"))
+def shoebox_rirs(room_dim, sources, mics, alpha, max_order: int = 20, rir_len: int = 8192, fs: int = 16000):
+    """(S, 3) sources × (M, 3) mics -> (S, M, rir_len) RIRs; one launch."""
+    return jax.vmap(
+        lambda src: shoebox_rir(room_dim, src, mics, alpha, max_order=max_order, rir_len=rir_len, fs=fs)
+    )(sources)
+
+
+def rir_length_for(beta: float, fs: int = 16000, margin: float = 1.3) -> int:
+    """A static RIR length comfortably covering an RT60 of ``beta`` seconds."""
+    return int(np.ceil(beta * margin * fs / 256) * 256)
+
+
+@partial(jax.jit, static_argnames=("out_len",))
+def fft_convolve(signals: jnp.ndarray, rirs: jnp.ndarray, out_len: int) -> jnp.ndarray:
+    """Batched linear convolution via rFFT (the compiled equivalent of the
+    reference's per-channel ``np.convolve`` loops, convolve_signals.py:161
+    and ``room.simulate``).
+
+    Args:
+      signals: (..., L) float.
+      rirs: (..., R) float, broadcast-compatible leading axes.
+      out_len: static output length (<= L + R - 1); typically L.
+
+    Returns:
+      (..., out_len) float32.
+    """
+    L = signals.shape[-1]
+    R = rirs.shape[-1]
+    n = L + R - 1
+    nfft = 1 << (n - 1).bit_length()
+    out = jnp.fft.irfft(
+        jnp.fft.rfft(signals, nfft) * jnp.fft.rfft(rirs, nfft), nfft
+    )[..., :out_len]
+    return out.astype(jnp.float32)

@@ -204,3 +204,45 @@ def si_sdr_np(reference, estimation):
     proj = alpha * reference
     noise = estimation - proj
     return 10 * np.log10(np.sum(proj**2, -1) / np.sum(noise**2, -1))
+
+
+# ------------------------------------------------------------------ ISM oracle
+def shoebox_rir_np(room_dim, source, mic, alpha, max_order=3, rir_len=4096, fs=16000, c=343.0, fdl=81):
+    """Loop-based Allen & Berkley shoebox ISM with windowed-sinc fractional
+    delays — the independent float64 oracle for disco_tpu.sim.ism (same
+    conventions as pyroomacoustics' libroom: sum-order truncation, uniform
+    sqrt(1-alpha) wall reflection, 1/(4 pi d) spreading)."""
+    room_dim = np.asarray(room_dim, np.float64)
+    source = np.asarray(source, np.float64)
+    mic = np.asarray(mic, np.float64)
+    beta = np.sqrt(max(1.0 - alpha, 0.0))
+    half = fdl // 2
+    rir = np.zeros(rir_len)
+    N = max_order
+    for n in range(-N, N + 1):
+        for l in range(-N, N + 1):
+            for m in range(-N, N + 1):
+                for u in (0, 1):
+                    for v in (0, 1):
+                        for w in (0, 1):
+                            n_refl = (abs(n - u) + abs(n) + abs(l - v) + abs(l)
+                                      + abs(m - w) + abs(m))
+                            if n_refl > N:
+                                continue
+                            img = np.array([
+                                (1 - 2 * u) * source[0] + 2 * n * room_dim[0],
+                                (1 - 2 * v) * source[1] + 2 * l * room_dim[1],
+                                (1 - 2 * w) * source[2] + 2 * m * room_dim[2],
+                            ])
+                            d = max(np.linalg.norm(img - mic), 1e-3)
+                            amp = beta**n_refl / (4 * np.pi * d)
+                            delay = d * fs / c
+                            t0 = int(np.floor(delay))
+                            frac = delay - t0
+                            for tap in range(-half, half + 1):
+                                t = t0 + tap
+                                if 0 <= t < rir_len:
+                                    arg = tap - frac
+                                    win = 0.5 * (1 + np.cos(np.pi * arg / (half + 1)))
+                                    rir[t] += amp * np.sinc(arg) * win
+    return rir

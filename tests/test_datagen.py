@@ -1,0 +1,121 @@
+"""End-to-end dataset generation test: simulate → save → mix (PostGenerator)
+→ consume with TANGO — the reference's three-stage filesystem pipeline
+(SURVEY.md §1 inter-layer contract) on a tiny synthetic corpus."""
+import numpy as np
+import pytest
+
+from disco_tpu.datagen import PostGenerator, generate_disco_rirs
+from disco_tpu.io import DatasetLayout, read_wav, write_wav
+from disco_tpu.sim import SpeechAndNoiseSetup
+
+FS = 16000
+
+
+@pytest.fixture
+def corpus(tmp_path):
+    rng = np.random.default_rng(0)
+    speech = []
+    for spk in ("7", "8"):
+        d = tmp_path / "LibriSpeech" / spk / "1"
+        d.mkdir(parents=True)
+        f = d / f"{spk}-1-0001.wav"
+        t = np.arange(6 * FS) / FS
+        env = (np.sin(2 * np.pi * 1.1 * t + float(spk)) > -0.2).astype(np.float64)
+        write_wav(f, 0.3 * env * rng.standard_normal(len(t)), FS)
+        speech.append(str(f))
+    noise_dir = tmp_path / "noises"
+    noise_dir.mkdir()
+    nf = noise_dir / "n0.wav"
+    write_wav(nf, 0.2 * rng.standard_normal(8 * FS), FS)
+    return speech, [str(nf)]
+
+
+@pytest.fixture
+def signal_setup(corpus):
+    speech, noise = corpus
+    return SpeechAndNoiseSetup(
+        target_list=speech,
+        talkers_list=speech,
+        noises_dict={"fs": noise},
+        duration_range=(5, 10),
+        var_tar=10 ** (-23 / 10),
+        snr_dry_range=[[0, 0]],
+        snr_cnv_range=(-60, 60),  # wide gate: tiny corpus must not redraw forever
+        min_delta_snr=-1,
+        rng=np.random.default_rng(3),
+    )
+
+
+def test_generate_then_mix_then_enhance(tmp_path, signal_setup):
+    root = str(tmp_path / "dataset")
+    layout = DatasetLayout(root, "random", "train")
+    # max_order=6 keeps the CPU test fast; the kernel is order-agnostic.
+    done = generate_disco_rirs(
+        "random", "train", 1, 1, signal_setup, layout,
+        rng=np.random.default_rng(5), max_order=6,
+    )
+    assert done == [1]
+
+    # --- generated layout ---------------------------------------------------
+    assert (layout.base / "wav_original" / "dry" / "target" / "1_S-1.wav").exists()
+    assert (layout.base / "wav_original" / "dry" / "noise" / "1_S-2_ssn.wav").exists()
+    for ch in (1, 16):
+        assert (layout.base / "wav_original" / "cnv" / "target" / f"1_S-1_Ch-{ch}.wav").exists()
+        assert (layout.base / "wav_original" / "cnv" / "noise" / f"1_S-2_ssn_Ch-{ch}.wav").exists()
+        assert (layout.base / "wav_original" / "cnv" / "noise" / f"1_S-2_fs_Ch-{ch}.wav").exists()
+    assert layout.infos(1).exists()
+    infos = np.load(layout.infos(1), allow_pickle=True).item()
+    assert infos["rirs"].shape[0] == 2 and infos["rirs"].shape[1] == 16
+
+    # Train clips padded to 11 s (duration_range[-1] + 1).
+    x, fs = read_wav(layout.base / "wav_original" / "cnv" / "target" / "1_S-1_Ch-1.wav")
+    assert len(x) == 11 * FS
+
+    # Idempotency: re-run generates nothing.
+    assert generate_disco_rirs(
+        "random", "train", 1, 1, signal_setup, layout, rng=np.random.default_rng(5), max_order=6
+    ) == []
+
+    # --- mixing pass (rename noise images to the ssn tag the mixer expects) --
+    pg = PostGenerator(1, 1, "random", "ssn", [0, 6], root, rng=np.random.default_rng(7))
+    assert pg.post_process() == [1]
+    assert pg.post_process() == []  # idempotent
+
+    mix, _ = read_wav(layout.wav_processed([0, 6], "mixture", 1, 1, noise="ssn"))
+    tar, _ = read_wav(layout.wav_processed([0, 6], "target", 1, 1))
+    noi, _ = read_wav(layout.wav_processed([0, 6], "noise", 1, 1, noise="ssn"))
+    np.testing.assert_allclose(mix, tar + noi, atol=1e-6)
+    mask = np.load(layout.mask_processed([0, 6], 1, 1, "ssn"))
+    assert mask.shape[0] == 257 and 0 <= mask.min() and mask.max() <= 1
+    spec = np.load(layout.stft_processed([0, 6], "mixture", 1, 1, noise="ssn"))
+    assert spec.shape[0] == 257 and np.iscomplexobj(spec)
+
+    # --- consume with TANGO: the corpus feeds the enhancement pipeline ------
+    from disco_tpu.core.dsp import stft
+    from disco_tpu.enhance import oracle_masks, tango
+
+    def load_stack(kind, noise):
+        chans = []
+        for ch in range(1, 17):
+            x, _ = read_wav(layout.wav_processed([0, 6], kind, 1, ch, noise=noise))
+            chans.append(x)
+        return np.array(chans).reshape(4, 4, -1)
+
+    y = load_stack("mixture", "ssn")
+    s = load_stack("target", None)
+    n = load_stack("noise", "ssn")
+    Y, S, N = stft(y), stft(s), stft(n)
+    masks = oracle_masks(S, N, "irm1")
+    res = tango(Y, S, N, masks, masks, policy="local")
+    assert np.isfinite(np.asarray(res.yf)).all()
+
+
+def test_snr_at_mics_shapes(rng):
+    from disco_tpu.datagen import snr_at_mics
+
+    s = rng.standard_normal((8, 16000))
+    n = 0.1 * rng.standard_normal((8, 16000))
+    snrs, node_snrs, dmin = snr_at_mics(s, n, [4, 4])
+    assert snrs.shape == (8,) and node_snrs.shape == (2,)
+    assert np.all(snrs > 10)  # ~20 dB white-on-white
+    assert dmin >= 0

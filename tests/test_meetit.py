@@ -1,0 +1,98 @@
+"""Tests for the MEETIT generator (gen_meetit parity)."""
+import numpy as np
+import pytest
+
+from disco_tpu.datagen import (
+    check_sir_validity,
+    get_masks,
+    get_value_range,
+    simulate_meetit_room,
+)
+from disco_tpu.datagen.meetit import save_meetit_scene, sir_at_node
+from disco_tpu.io import DatasetLayout, write_wav
+from disco_tpu.sim import InterferentSpeakersSetup, make_setup
+
+FS = 16000
+
+
+def test_get_value_range():
+    np.testing.assert_allclose(get_value_range(0, 100, 0, 20, 5), [0, 4])
+    np.testing.assert_allclose(get_value_range(99, 100, 0, 20, 5), [16, 20])
+
+
+def test_sir_at_node_known_ratio(rng):
+    s = rng.standard_normal((4, 16000))
+    n = 0.1 * rng.standard_normal((4, 16000))
+    assert sir_at_node(s, n) == pytest.approx(20.0, abs=0.5)
+
+
+def test_check_sir_validity():
+    # Inter-node spread > 2 dB -> reject.
+    assert not check_sir_validity([10.0, 5.0], [], bin_level=5)
+    # Out of [2, 14] range -> reject.
+    assert not check_sir_validity([1.0, 1.0], [], bin_level=5)
+    assert not check_sir_validity([15.0, 15.0], [], bin_level=5)
+    # Valid and empty history -> accept.
+    assert check_sir_validity([5.0, 5.0], [], bin_level=2)
+    # Class already full -> reject.
+    past = [[5.1, 5.0], [5.2, 5.0]]
+    assert not check_sir_validity([5.0, 5.0], past, bin_level=2)
+    # Another class still open -> accept.
+    assert check_sir_validity([12.0, 12.0], past, bin_level=2)
+
+
+@pytest.fixture
+def speakers(tmp_path):
+    rng = np.random.default_rng(0)
+    files = []
+    for spk in ("201", "202", "203", "204", "205"):
+        d = tmp_path / "speech" / spk / "1"
+        d.mkdir(parents=True)
+        f = d / f"{spk}-1-0001.wav"
+        t = np.arange(7 * FS) / FS
+        env = (np.sin(2 * np.pi * (1.0 + 0.1 * int(spk[-1])) * t) > -0.3).astype(np.float64)
+        write_wav(f, 0.3 * env * rng.standard_normal(len(t)), FS)
+        files.append(str(f))
+    return files
+
+
+def test_simulate_meetit_room_end_to_end(tmp_path, speakers):
+    rng = np.random.default_rng(1)
+    setup = make_setup("meetit", rng=rng, n_sensors_per_node=(2, 2, 2, 2))
+    sig = InterferentSpeakersSetup(
+        speakers_list=speakers,
+        duration_range=(5, 6),
+        var_tar=10 ** (-23 / 10),
+        snr_dry_range=[[0, 0]],
+        snr_cnv_range=(-10, 15),
+        min_delta_snr=0,
+        rng=rng,
+    )
+    mics_per_node = (2, 2, 2, 2)
+    scene = None
+    for _ in range(20):
+        cfg = setup.create_room_setup()
+        # Wide accept gate for the tiny test: vmin/vmax via monkey bin level.
+        out = simulate_meetit_room(
+            cfg, sig, "train", mics_per_node, past_sirs=[], n_rirs_per_proc=1000,
+            max_order=4, rng=rng, sir_vmin=-10.0, sir_vmax=10.0,
+        )
+        if out != "redraw_room_setup":
+            scene = out
+            break
+    assert scene is not None, "no valid meetit room in 20 draws"
+    n_src = len(cfg.source_positions)
+    assert scene.images.shape[0] == n_src and scene.images.shape[1] == 8
+    assert scene.sirs.shape == (4,)
+
+    # Masks: per source, per channel, in [0, 1], summing to ~1 across sources
+    # where there is energy.
+    mix, masks = get_masks(scene.images, mics_per_node)
+    assert mix.shape[0] == 8 and masks.shape[0] == n_src
+    assert masks.min() >= 0 and masks.max() <= 1
+
+    lay = DatasetLayout(str(tmp_path / "out"), "meetit", "train")
+    save_meetit_scene(scene, {"sirs": scene.sirs}, 3, lay)
+    assert (lay.base / "wav" / "clean" / "dry" / "3_S-1.wav").exists()
+    assert (lay.base / "wav" / "clean" / "cnv" / f"3_S-{n_src}_Ch-8.wav").exists()
+    assert (lay.base / "log" / "infos" / "3.npy").exists()

@@ -1,0 +1,129 @@
+"""Tests for disco_tpu.sim.signals on a tiny synthetic wav corpus."""
+import numpy as np
+import pytest
+
+from disco_tpu.io import write_wav
+from disco_tpu.sim import InterferentSpeakersSetup, SpeechAndNoiseSetup, normalize_to_var
+
+FS = 16000
+
+
+@pytest.fixture
+def corpus(tmp_path):
+    """LibriSpeech-shaped corpus: {speaker}/{chapter}/{utt}.wav + noises."""
+    rng = np.random.default_rng(0)
+    speech_files = []
+    for spk in ("101", "102", "103"):
+        d = tmp_path / "speech" / spk / "1"
+        d.mkdir(parents=True)
+        f = d / f"{spk}-1-0001.wav"
+        # 6 s of modulated noise (speech-like energy bursts)
+        t = np.arange(6 * FS) / FS
+        env = (np.sin(2 * np.pi * 1.3 * t) > 0).astype(np.float64)
+        write_wav(f, 0.3 * env * rng.standard_normal(len(t)), FS)
+        speech_files.append(str(f))
+    noise_dir = tmp_path / "noise"
+    noise_dir.mkdir()
+    noise_files = []
+    for i in range(2):
+        f = noise_dir / f"n{i}.wav"
+        write_wav(f, 0.2 * rng.standard_normal(8 * FS), FS)
+        noise_files.append(str(f))
+    return speech_files, noise_files
+
+
+def _setup(corpus, rng=None):
+    speech, noise = corpus
+    return SpeechAndNoiseSetup(
+        target_list=speech,
+        talkers_list=speech,
+        noises_dict={"fs": noise},
+        duration_range=(5, 10),
+        var_tar=10 ** (-23 / 10),
+        snr_dry_range=[[0, 0]],
+        snr_cnv_range=(-10, 15),
+        min_delta_snr=0,
+        rng=rng or np.random.default_rng(1),
+    )
+
+
+def test_normalize_to_var(corpus):
+    rng = np.random.default_rng(0)
+    x = np.concatenate([np.zeros(FS), rng.standard_normal(2 * FS)])
+    var_tar = 0.005
+    y, vad = normalize_to_var(x, var_tar)
+    assert np.var(y[vad == 1]) == pytest.approx(var_tar, rel=0.1)
+
+
+def test_get_target_segment(corpus):
+    setup = _setup(corpus)
+    sig, vad, fs = setup.get_target_segment(corpus[0][0])
+    assert fs == FS
+    # 1 s lead silence
+    np.testing.assert_array_equal(sig[:FS], 0)
+    np.testing.assert_array_equal(vad[:FS], 0)
+    assert len(sig) == len(vad)
+    # active-sample variance == var_tar
+    assert np.var(sig[vad == 1]) == pytest.approx(setup.var_tar, rel=0.15)
+    assert setup.target_duration == pytest.approx(7.0, abs=0.1)
+
+
+def test_short_target_rejected(corpus, tmp_path):
+    f = tmp_path / "short.wav"
+    write_wav(f, np.random.default_rng(0).standard_normal(FS), FS)  # 1 s < 5 s min
+    setup = _setup(corpus)
+    sig, vad, fs = setup.get_target_segment(str(f))
+    assert sig is None and vad is None
+
+
+def test_noise_segment_category(corpus):
+    setup = _setup(corpus)
+    n, f, start, vad, fs = setup.get_noise_segment("fs", 4.0)
+    assert len(n) == 4 * FS
+    assert f in corpus[1]
+    assert abs(np.mean(n)) < 1e-9
+    assert vad is None
+
+
+def test_noise_segment_ssn(corpus):
+    setup = _setup(corpus)
+    n, f, start, vad, fs = setup.get_noise_segment("SSN", 5.0)
+    assert len(n) == 5 * FS and f is None
+
+
+def test_noise_too_long_raises(corpus):
+    setup = _setup(corpus)
+    with pytest.raises(ValueError):
+        setup.get_noise_segment("fs", 100.0)
+    with pytest.raises(ValueError):
+        setup.get_noise_segment("bogus", 1.0)
+
+
+def test_random_dry_snr_in_range(corpus):
+    setup = _setup(corpus)
+    setup.snr_dry_range = np.array([[0, 6], [3, 9]])
+    setup.source_snr = np.zeros(2)
+    snrs = setup.get_random_dry_snr()
+    assert 0 <= snrs[0] <= 6 and 3 <= snrs[1] <= 9
+
+
+def test_interferent_speakers_no_repeat(corpus):
+    speech, _ = corpus
+    setup = InterferentSpeakersSetup(
+        speakers_list=speech,
+        duration_range=(5, 10),
+        var_tar=10 ** (-23 / 10),
+        snr_dry_range=[[0, 0]],
+        snr_cnv_range=(-10, 15),
+        min_delta_snr=0,
+        rng=np.random.default_rng(0),
+    )
+    y1, v1 = setup.get_signal(5.0)
+    y2, v2 = setup.get_signal(5.0)
+    y3, v3 = setup.get_signal(5.0)
+    assert len(set(setup.speakers_ids)) == 3
+    with pytest.raises(ValueError):
+        setup.get_signal(5.0)  # only 3 speakers exist
+    setup.reset()
+    y4, _ = setup.get_signal(5.0)
+    assert len(setup.speakers_ids) == 1

@@ -1,0 +1,209 @@
+"""Tests for disco_tpu.sim: image lattice, ISM RIR physics + oracle parity,
+FFT convolution, and the scenario-sampling constraints."""
+import numpy as np
+import pytest
+
+from disco_tpu.sim import (
+    LivingRoomSetup,
+    MeetingRoomSetup,
+    MeetitSetup,
+    RoomDefaults,
+    circular_array_2d,
+    eyring_absorption,
+    fft_convolve,
+    image_lattice,
+    make_setup,
+    rir_length_for,
+    shoebox_rir,
+    shoebox_rirs,
+)
+from tests.reference_impls import shoebox_rir_np
+
+FS = 16000
+C = 343.0
+
+
+# ------------------------------------------------------------------- lattice
+def test_image_lattice_counts():
+    lat, par = image_lattice(0)
+    assert len(lat) == 1  # direct path only
+    lat1, _ = image_lattice(1)
+    assert len(lat1) == 7  # direct + 6 first-order walls
+    lat2, _ = image_lattice(2)
+    # order 2: octahedral numbers — 1, 7, 25, ...
+    assert len(lat2) == 25
+
+
+def test_image_lattice_orders_bounded():
+    lat, par = image_lattice(3)
+    n_refl = np.abs(lat - par).sum(-1) + np.abs(lat).sum(-1)
+    assert n_refl.max() == 3
+    assert n_refl.min() == 0
+
+
+# ----------------------------------------------------------------------- rir
+def test_direct_path_physics():
+    """Anechoic room (alpha=1): single peak at d/c with 1/(4 pi d) amplitude."""
+    room = np.array([6.0, 4.0, 3.0])
+    src = np.array([2.0, 2.0, 1.5])
+    mic = np.array([4.0, 2.0, 1.5])  # d = 2 m
+    rir = np.asarray(shoebox_rir(room, src, mic[None], 1.0, max_order=0, rir_len=2048))
+    d = 2.0
+    peak = int(round(d * FS / C))
+    assert abs(int(np.argmax(rir[0])) - peak) <= 1
+    # The windowed sinc spreads a fractional-delay impulse over taps; its DC
+    # gain (tap sum) carries the 1/(4 pi d) spreading amplitude.
+    assert np.sum(rir[0]) == pytest.approx(1 / (4 * np.pi * d), rel=0.02)
+
+
+def test_amplitude_decays_with_distance():
+    room = np.array([10.0, 6.0, 3.0])
+    src = np.array([1.0, 3.0, 1.5])
+    mics = np.array([[2.0, 3.0, 1.5], [5.0, 3.0, 1.5]])  # 1 m and 4 m
+    rir = np.asarray(shoebox_rir(room, src, mics, 1.0, max_order=0, rir_len=2048))
+    assert np.sum(rir[0]) == pytest.approx(4 * np.sum(rir[1]), rel=0.05)
+
+
+def test_oracle_parity_small_room():
+    room = np.array([4.0, 3.0, 2.5])
+    src = np.array([1.0, 1.2, 1.1])
+    mic = np.array([2.5, 2.0, 1.3])
+    alpha = eyring_absorption(0.4, *room)
+    got = np.asarray(shoebox_rir(room, src, mic[None], alpha, max_order=3, rir_len=2048))[0]
+    want = shoebox_rir_np(room, src, mic, alpha, max_order=3, rir_len=2048)
+    err = np.linalg.norm(got - want) / np.linalg.norm(want)
+    assert err < 1e-4, err
+
+
+def test_reverberant_energy_decay():
+    """Schroeder decay of a reverberant RIR: energy must drop by tens of dB
+    over the RT60 horizon."""
+    room = np.array([5.0, 4.0, 3.0])
+    src = np.array([1.0, 1.0, 1.5])
+    mic = np.array([3.5, 2.5, 1.5])
+    rt60 = 0.4
+    alpha = eyring_absorption(rt60, *room)
+    L = rir_length_for(rt60)
+    rir = np.asarray(shoebox_rir(room, src, mic[None], alpha, max_order=20, rir_len=L))[0]
+    e = np.cumsum(rir[::-1] ** 2)[::-1]
+    edc = 10 * np.log10(np.maximum(e / e[0], 1e-12))
+    i0 = int(np.argmax(np.abs(rir)))
+    # At ~rt60 after the direct path the decay curve should be well below -30 dB.
+    i1 = min(int(i0 + rt60 * FS), L - 1)
+    assert edc[i1] < -30, edc[i1]
+
+
+def test_shoebox_rirs_batched_sources():
+    room = np.array([4.0, 3.0, 2.5])
+    srcs = np.array([[1.0, 1.0, 1.0], [3.0, 2.0, 1.5]])
+    mics = np.array([[2.0, 1.5, 1.2], [2.2, 1.5, 1.2], [2.4, 1.5, 1.2]])
+    out = np.asarray(shoebox_rirs(room, srcs, mics, 0.3, max_order=2, rir_len=1024))
+    assert out.shape == (2, 3, 1024)
+    single = np.asarray(shoebox_rir(room, srcs[1], mics, 0.3, max_order=2, rir_len=1024))
+    np.testing.assert_allclose(out[1], single, atol=1e-6)
+
+
+# ------------------------------------------------------------------ convolve
+def test_fft_convolve_matches_np(rng):
+    x = rng.standard_normal((2, 3, 1000)).astype(np.float32)
+    h = rng.standard_normal((2, 3, 200)).astype(np.float32)
+    got = np.asarray(fft_convolve(x, h, out_len=1000))
+    for i in range(2):
+        for j in range(3):
+            want = np.convolve(x[i, j], h[i, j])[:1000]
+            np.testing.assert_allclose(got[i, j], want, atol=2e-3)
+
+
+# ------------------------------------------------------------------ geometry
+def test_eyring_absorption_formula():
+    a = eyring_absorption(0.5, 6.0, 4.0, 3.0)
+    vol, sur = 72.0, 2 * (24 + 18 + 12)
+    want = 1 - np.exp((1.7e-5 * 0.5 - 0.1611) * vol / (0.5 * sur))
+    assert a == pytest.approx(want)
+    assert 0 < a < 1
+
+
+def test_circular_array():
+    arr = circular_array_2d([1.0, 2.0], 4, 0.0, 0.05)
+    assert arr.shape == (2, 4)
+    np.testing.assert_allclose(np.linalg.norm(arr - [[1.0], [2.0]], axis=0), 0.05, atol=1e-12)
+
+
+@pytest.mark.parametrize("scenario", ["random", "living", "meeting", "meetit"])
+def test_scenarios_sample_valid_configs(scenario):
+    rng = np.random.default_rng(11)
+    setup = make_setup(scenario, rng=rng)
+    d = RoomDefaults()
+    for _ in range(5):
+        cfg = setup.create_room_setup()
+        # Room in range
+        assert d.l_range[0] <= cfg.length <= d.l_range[1]
+        assert d.beta_range[0] <= cfg.beta <= d.beta_range[1]
+        assert 0 < cfg.alpha < 1
+        # All mics strictly inside the room
+        assert np.all(cfg.mic_positions[0] > 0) and np.all(cfg.mic_positions[0] < cfg.length)
+        assert np.all(cfg.mic_positions[1] > 0) and np.all(cfg.mic_positions[1] < cfg.width)
+        # Sub-arrays: every mic at d_mn from its node center
+        at = 0
+        for k, m in enumerate(d.n_sensors_per_node):
+            sub = cfg.mic_positions[:2, at : at + m]
+            r = np.linalg.norm(sub - cfg.nodes_centers[k][:2, None], axis=0)
+            np.testing.assert_allclose(r, d.d_mn, atol=1e-9)
+            at += m
+        # Sources inside the room, away from walls
+        assert np.all(cfg.source_positions[:, 0] > 0) and np.all(
+            cfg.source_positions[:, 0] < cfg.length
+        )
+
+
+def test_random_scenario_min_distances():
+    rng = np.random.default_rng(5)
+    setup = make_setup("random", rng=rng)
+    d = RoomDefaults()
+    for _ in range(5):
+        cfg = setup.create_room_setup()
+        cc = cfg.nodes_centers[:, :2]
+        for i in range(len(cc)):
+            for j in range(i + 1, len(cc)):
+                assert np.linalg.norm(cc[i] - cc[j]) >= d.d_nn - 1e-9
+        for s in cfg.source_positions[:, :2]:
+            for c in cc:
+                assert np.linalg.norm(s - c) >= d.d_sn - 1e-9
+
+
+def test_living_room_nodes_near_walls():
+    rng = np.random.default_rng(2)
+    setup = make_setup("living", rng=rng)
+    cfg = setup.create_room_setup()
+    d = RoomDefaults()
+    d_nw_max = d.d_mw - d.d_mn  # LivingRoom: d_mw is the MAX wall distance
+    near_wall = 0
+    for c in cfg.nodes_centers[:3]:
+        dist_wall = min(c[0], cfg.length - c[0], c[1], cfg.width - c[1])
+        if dist_wall <= d_nw_max + 1e-9:
+            near_wall += 1
+    assert near_wall == 3
+
+
+def test_meetit_sources_face_nodes():
+    rng = np.random.default_rng(8)
+    setup = make_setup("meetit", rng=rng)
+    cfg = setup.create_room_setup()
+    # Each source shares its angular position with its node: the (source -
+    # table center) and (node - table center) directions are parallel.
+    tc = np.asarray(setup.table_center[:2])
+    for k in range(len(cfg.nodes_centers)):
+        v_node = cfg.nodes_centers[k][:2] - tc
+        v_src = cfg.source_positions[k][:2] - tc
+        cos = np.dot(v_node, v_src) / (np.linalg.norm(v_node) * np.linalg.norm(v_src))
+        assert cos > 0.9, (k, cos)
+
+
+def test_meeting_nodes_on_table():
+    rng = np.random.default_rng(4)
+    setup = make_setup("meeting", rng=rng)
+    cfg = setup.create_room_setup()
+    tc = np.asarray(setup.table_center[:2])
+    for c in cfg.nodes_centers:
+        assert np.linalg.norm(c[:2] - tc) <= setup.table_radius + 1e-9
+        assert c[2] == pytest.approx(setup.table_center[2])
