@@ -279,15 +279,20 @@ def generate_disco_rirs(
         if scene is None:
             raise RuntimeError(f"RIR {rir_id}: no valid configuration after {max_redraws} redraws")
         extra_dry, extra_rev, files, starts = reverb_other_noises(scene, signal_setup, dset, fs)
+        # Keys follow the reference infos contract (convolve_signals.py:438-446)
+        # so plot_conf and reference-side tooling read these files unchanged.
+        dims = np.asarray(scene.setup.room_dim)
         infos = {
             "room": {
-                "dims": np.asarray(scene.setup.room_dim),
+                "length": float(dims[0]),
+                "width": float(dims[1]),
+                "height": float(dims[2]),
                 "alpha": scene.setup.alpha,
                 "rt60": scene.setup.beta,
             },
+            "mics": np.asarray(scene.setup.mic_positions),
+            "sources": np.asarray(scene.setup.source_positions),
             "nodes_centers": scene.setup.nodes_centers,
-            "source_positions": scene.setup.source_positions,
-            "mic_positions": scene.setup.mic_positions,
             "rirs": scene.rirs,
             "snr_images": scene.snr_images,
             "noise_files": files,

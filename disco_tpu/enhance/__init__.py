@@ -1,11 +1,23 @@
+from disco_tpu.enhance.inference import (
+    crnn_mask,
+    get_frames_to_pad,
+    get_z_for_mask,
+    normalization,
+    pcen,
+    plot_conf,
+    prepare_data,
+    reshape_mask,
+    vad_mask,
+)
 from disco_tpu.enhance.tango import (
     TangoResult,
     oracle_masks,
+    others_index,
     tango,
     tango_step1,
     tango_step2,
-    others_index,
 )
+from disco_tpu.enhance.zexport import compute_z_signals, export_z
 
 __all__ = [
     "TangoResult",
@@ -14,4 +26,15 @@ __all__ = [
     "tango_step1",
     "tango_step2",
     "others_index",
+    "crnn_mask",
+    "get_frames_to_pad",
+    "get_z_for_mask",
+    "normalization",
+    "pcen",
+    "plot_conf",
+    "prepare_data",
+    "reshape_mask",
+    "vad_mask",
+    "compute_z_signals",
+    "export_z",
 ]

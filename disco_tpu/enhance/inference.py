@@ -1,0 +1,221 @@
+"""Inference glue: shape STFT streams into CRNN batches and back into masks
+(reference speech_enhancement/utils.py:13-138, tango.py:158-249).
+
+Host-side numpy prep (windowing, normalization) feeding ONE batched jitted
+forward pass — the reference's per-window torch loop
+(speech_enhancement/utils.py:118-131) becomes a single
+``sliding_window_view`` + one model.apply over all windows.
+
+PCEN is implemented natively (the reference calls librosa.pcen,
+speech_enhancement/utils.py:61-64): per-channel IIR smoothing with the
+standard librosa coefficient mapping from ``time_constant``, then the
+(E/(eps+M)^gain + bias)^power − bias^power compression.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import scipy.signal
+
+import jax
+import jax.numpy as jnp
+
+from disco_tpu.core.masks import vad_oracle_batch
+
+STFT_MIN, STFT_MAX = 1e-6, 1e3  # utils.py:7
+FS = 16000
+N_FFT = 512
+N_HOP = 256
+FRAMES_LOST = 6  # utils.py:10 — conv-cropped frames of the canonical CRNN
+
+
+def get_frames_to_pad(in_len: int, output_frames: str, out_len: int | None = None) -> tuple[int, int]:
+    """(left, right) zero-frames so the selected output frame lines up with
+    the first input frame (reference utils.py:13-33)."""
+    out_len = in_len if out_len is None else out_len
+    if output_frames == "mid":
+        return int(np.floor(in_len / 2)), int(np.floor(in_len / 2))
+    if output_frames == "last":
+        selected = (in_len + out_len) // 2
+        return selected - 1, in_len - selected
+    if output_frames == "all":
+        return 0, 0
+    raise ValueError("output_frames should be 'mid', 'last' or 'all'")
+
+
+def pcen(
+    S,
+    sr: int = FS,
+    hop_length: int = N_HOP,
+    gain: float = 0.98,
+    bias: float = 2.0,
+    power: float = 0.5,
+    time_constant: float = 0.400,
+    eps: float = 1e-6,
+    axis: int = -1,
+):
+    """Per-channel energy normalization over the frame axis — native
+    equivalent of the librosa.pcen call at reference utils.py:61-64."""
+    S = np.asarray(S, dtype=np.float64)
+    t_frames = time_constant * sr / float(hop_length)
+    b = (np.sqrt(1 + 4 * t_frames**2) - 1) / (2 * t_frames**2)
+    zi = (1 - b) * np.expand_dims(S.take(0, axis=axis), axis)
+    M, _ = scipy.signal.lfilter([b], [1, b - 1], S, axis=axis, zi=zi)
+    smooth = np.exp(-gain * (np.log(eps) + np.log1p(M / eps)))
+    return (S * smooth + bias) ** power - bias**power
+
+
+def normalization(x, norm_type: str | None = None, axis: int = 0):
+    """Inference-time feature normalization (reference utils.py:36-66):
+    None | 'scale_to_unit_norm' | 'scale_to_1' (q99) | 'center_and_scale'
+    | 'pcen'.  Input may be complex; output is a normalized magnitude."""
+    x = np.clip(np.abs(x), STFT_MIN, STFT_MAX)
+    if norm_type == "pcen":
+        return pcen(x * 2**31)
+    if norm_type == "scale_to_unit_norm":
+        x_norm = np.linalg.norm(x, axis=axis, keepdims=True)
+    elif norm_type == "scale_to_1":
+        x_norm = np.quantile(x, 0.99, axis=axis, keepdims=True)
+    elif norm_type == "center_and_scale":
+        x = x - np.mean(x, axis=axis, keepdims=True)
+        x_norm = np.std(x, axis=axis, keepdims=True)
+    else:
+        return x
+    return x / x_norm
+
+
+def prepare_data(
+    y_data,
+    three_d_tensor: bool,
+    z_data=None,
+    win_len: int = 21,
+    win_hop: int = 1,
+    frame_to_pred: str = "last",
+    norm_type: str | None = None,
+    frames_lost: int = FRAMES_LOST,
+):
+    """(F, T) stream(s) → (n_windows, …) model input batch
+    (reference utils.py:69-138): normalize, pad so the predicted frame
+    covers every original frame, slide ``win_len`` windows with hop
+    ``win_hop``, stack z channels on the channel axis (3-D CRNN) or the
+    frequency axis (2-D RNN).  Vectorized: no Python loop over windows."""
+    chans = [normalization(y_data, norm_type=norm_type, axis=1)]
+    if z_data is not None:
+        chans += [normalization(z, norm_type=norm_type, axis=1) for z in z_data]
+
+    pad = get_frames_to_pad(win_len, frame_to_pred, out_len=win_len - frames_lost)
+    stacked = np.stack([np.pad(c, ((0, 0), pad)) for c in chans])  # (C, F, Tp)
+    # (C, F, Tp) → windows (n, C, T=win_len, F)
+    wins = np.lib.stride_tricks.sliding_window_view(stacked, win_len, axis=-1)
+    wins = wins[:, :, ::win_hop]  # (C, F, n, win_len)
+    out = np.ascontiguousarray(np.transpose(wins, (2, 0, 3, 1)), dtype=np.float32)
+    if not three_d_tensor:
+        n, c, t, f = out.shape
+        out = np.ascontiguousarray(np.transpose(out, (0, 2, 1, 3))).reshape(n, t, c * f)
+    return out
+
+
+def reshape_mask(mask_stack, output_frame: str = "last"):
+    """Stacked per-window model outputs → one (F, T) mask
+    (reference tango.py:228-240)."""
+    if output_frame == "last":
+        out = mask_stack[:, -1, :]
+    elif output_frame == "mid":
+        win_len = mask_stack.shape[1]
+        out = mask_stack[:, int(np.floor(win_len / 2)), :]
+    elif output_frame == "all":
+        raise NotImplementedError("'all' inference reshaping is not implemented (as in the reference)")
+    else:
+        raise ValueError("output_frame should be 'last' or 'mid'")
+    return np.squeeze(out).T
+
+
+def get_z_for_mask(z_s, z_n, k: int, nb_nodes: int = 4, z_sigs="zs_hat"):
+    """Select/reorder exchanged z streams for the NN input at node k
+    (reference tango.py:158-186): a single z kind drops the local node; the
+    zs&zn pair interleaves [zs_j, zn_j, …] then drops the local pair."""
+    if z_sigs in ("zs_hat", "zn_hat"):
+        z_in = np.asarray(z_s if z_sigs == "zs_hat" else z_n)
+        keep = [j for j in range(nb_nodes) if j != k]
+        return z_in[keep]
+    z_s, z_n = np.asarray(z_s), np.asarray(z_n)
+    inter = np.empty((2 * nb_nodes,) + z_s.shape[1:], z_s.dtype)
+    inter[0::2] = z_s
+    inter[1::2] = z_n
+    keep = [j for j in range(2 * nb_nodes) if j not in (2 * k, 2 * k + 1)]
+    return inter[keep]
+
+
+def crnn_mask(
+    Y,
+    model,
+    variables,
+    z=None,
+    win_len: int = 21,
+    frame_to_pred: str = "last",
+    norm_type: str | None = None,
+    three_d_tensor: bool = True,
+):
+    """CRNN inference path of reference get_mask (tango.py:211-215): one
+    batched jitted forward over all sliding windows → (F, T) mask.
+
+    Args:
+      Y: (F, T) complex mixture STFT at the node's reference mic.
+      model / variables: flax CRNN and its params/batch_stats.
+      z: optional list/array of (F, T) compressed streams from other nodes.
+    """
+    frames_lost = win_len - model.conv_output_hw()[0]
+    x = prepare_data(
+        np.asarray(Y),
+        three_d_tensor,
+        z_data=None if z is None else list(z),
+        win_len=win_len,
+        win_hop=1,
+        frame_to_pred=frame_to_pred,
+        norm_type=norm_type,
+        frames_lost=frames_lost,
+    )
+    m_stack = _jitted_apply(model)(variables, jnp.asarray(x))
+    return reshape_mask(np.asarray(m_stack), frame_to_pred)
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_apply(model):
+    """One compiled forward per model instance (flax modules are hashable) —
+    keeps repeated per-node/per-clip crnn_mask calls on a cached XLA
+    executable instead of op-by-op dispatch."""
+    return jax.jit(lambda variables, x: model.apply(variables, x, train=False))
+
+
+def vad_mask(ts, n_freq: int, n_frames: int):
+    """'ivad' mask: oracle VAD spread across frequencies
+    (reference tango.py:216-222)."""
+    vad = np.asarray(vad_oracle_batch(jnp.asarray(ts), win_len=N_FFT, win_hop=N_HOP))
+    vad = vad[::N_HOP]
+    m = np.zeros((n_freq, n_frames), "float32")
+    m[:, : len(vad)] = np.tile(vad[: n_frames], (n_freq, 1))
+    return m
+
+
+def plot_conf(infos, mics_per_node=(4, 4, 4, 4), return_fig=False):
+    """Room top-view plot from saved generation infos
+    (reference utils.py:141-172).  Built on the object-oriented matplotlib
+    API so the process-global pyplot backend is never touched."""
+    from matplotlib.figure import Figure
+    from matplotlib.patches import Rectangle
+
+    f = Figure()
+    ax = f.add_subplot()
+    ax.add_patch(Rectangle((0, 0), infos["room"]["length"], infos["room"]["width"], fill=False, linewidth=3))
+    ax.plot(infos["mics"][0, :], infos["mics"][1, :], "x")
+    ax.plot(infos["sources"][:, 0], infos["sources"][:, 1], "x")
+    ax.axis("equal")
+    cums = np.cumsum([0] + list(mics_per_node))
+    for i_n in range(len(mics_per_node)):
+        ax.text(1.05 * infos["mics"][0, cums[i_n]], 1.05 * infos["mics"][1, cums[i_n]], f"Node {i_n + 1}", fontsize=10)
+    for i_s in range(np.shape(infos["sources"])[0]):
+        ax.text(1.05 * infos["sources"][i_s, 0], 1.05 * infos["sources"][i_s, 1], f"Source {i_s + 1}", fontsize=10)
+    ax.set(xlim=(-1, infos["room"]["length"] + 1), ylim=(-1, infos["room"]["width"] + 1))
+    if return_fig:
+        return f

@@ -1,0 +1,116 @@
+"""Z-signal export — step 1 only, batch-producing the compressed signals
+that become CRNN training inputs (reference
+speech_enhancement/get_z_signals.py:213-359).
+
+The reference re-runs tango's step 1 per node in Python loops and saves, per
+node, ``zs_hat`` (the compressed mixture estimate z) and ``zn_hat``
+(y_ref − z), each raw + |·| "normed", under
+``stft_z/{zfile}/{raw,normed/abs}/{snrdir}/...`` — idempotently per RIR.
+Here step 1 is the jitted ``vmap``ed :func:`disco_tpu.enhance.tango_step1`;
+the file contract is identical.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from disco_tpu.core.dsp import stft
+from disco_tpu.enhance.tango import oracle_masks, tango_step1
+from disco_tpu.io.audio import read_wav
+from disco_tpu.io.layout import DatasetLayout, case_of_rir
+
+
+def compute_z_signals(y, s, n, masks_z=None, mask_type: str = "irm1", mu: float = 1.0, oracle_stats: bool = False):
+    """Step 1 over all nodes: (K, C, L) time signals → dict of (K, F, T)
+    z streams (reference get_z_signals.py:213-317, vectorized).
+
+    ``masks_z`` may be given explicitly (K, F, T) — e.g. CRNN-estimated —
+    else oracle masks of ``mask_type`` are computed from S and N.  With
+    explicit masks, ``s``/``n`` may be None (the clean-component streams
+    z_s/z_n then come out zero; export_z does not save them).
+    """
+    Y = stft(jnp.asarray(y))
+    S = stft(jnp.asarray(s)) if s is not None else jnp.zeros_like(Y)
+    N = stft(jnp.asarray(n)) if n is not None else jnp.zeros_like(Y)
+    if masks_z is None:
+        if s is None or n is None:
+            raise ValueError("either pass masks_z explicitly or provide s and n for oracle masks")
+        masks_z = oracle_masks(S, N, mask_type)
+    step1 = jax.vmap(lambda yk, sk, nk, mk: tango_step1(yk, sk, nk, mk, mu=mu, oracle_stats=oracle_stats))
+    out = step1(Y, S, N, jnp.asarray(masks_z))
+    out["masks_z"] = masks_z
+    return out
+
+
+def load_node_signals(layout: DatasetLayout, rir: int, noise: str, snr_range, n_nodes: int = 4, mics_per_node: int = 4):
+    """Read processed mixture/target/noise wavs into (K, C, L) arrays
+    (reference get_z_signals.py:44-92)."""
+    def read_all(source, noise_tag):
+        chans = []
+        for node in range(n_nodes):
+            node_ch = []
+            for c in range(mics_per_node):
+                ch = 1 + node * mics_per_node + c
+                x, _ = read_wav(layout.wav_processed(snr_range, source, rir, ch, noise=noise_tag))
+                node_ch.append(x)
+            chans.append(np.stack(node_ch))
+        return np.stack(chans)
+
+    # targets are saved without a noise tag; mixture/noise carry it
+    # (postgen.save_data, reference post_generator.py:133-150)
+    return read_all("mixture", noise), read_all("target", None), read_all("noise", noise)
+
+
+def load_mixture_signals(layout: DatasetLayout, rir: int, noise: str, snr_range, n_nodes: int = 4, mics_per_node: int = 4):
+    """Mixture-only variant of :func:`load_node_signals` for mask-supplied
+    exports (no oracle masks needed → no target/noise reads)."""
+    chans = []
+    for node in range(n_nodes):
+        node_ch = []
+        for c in range(mics_per_node):
+            ch = 1 + node * mics_per_node + c
+            x, _ = read_wav(layout.wav_processed(snr_range, "mixture", rir, ch, noise=noise))
+            node_ch.append(x)
+        chans.append(np.stack(node_ch))
+    return np.stack(chans)
+
+
+def export_z(
+    root: str,
+    scenario: str,
+    rir: int,
+    noise: str,
+    snr_range=(0, 6),
+    zfile: str = "oracle",
+    mask_type: str = "irm1",
+    masks_z=None,
+    n_nodes: int = 4,
+    mics_per_node: int = 4,
+    force: bool = False,
+) -> bool:
+    """Export z's for one RIR; returns False if already done (idempotency
+    guard of reference get_z_signals.py:328-331, with the reference's
+    missing-'.npy' stale-check bug fixed per SURVEY.md §7).
+    """
+    layout = DatasetLayout(root, scenario, case_of_rir(rir))
+    done_marker = layout.stft_z(zfile, snr_range, "zn_hat", rir, n_nodes, noise, normed=True)
+    if done_marker.exists() and not force:
+        return False
+
+    if masks_z is None:
+        y, s, n = load_node_signals(layout, rir, noise, snr_range, n_nodes, mics_per_node)
+    else:  # explicit masks: the 32 target/noise wav reads are not needed
+        y, s, n = load_mixture_signals(layout, rir, noise, snr_range, n_nodes, mics_per_node), None, None
+    out = compute_z_signals(y, s, n, masks_z=masks_z, mask_type=mask_type)
+    zs = np.asarray(out["z_y"]).astype("complex64")  # zs_hat = compressed mixture
+    zn = np.asarray(out["zn"]).astype("complex64")  # zn_hat = y_ref − z
+
+    for k in range(n_nodes):
+        for zsig, arr in (("zs_hat", zs[k]), ("zn_hat", zn[k])):
+            raw = layout.stft_z(zfile, snr_range, zsig, rir, k + 1, noise, normed=False)
+            np.save(layout.ensure_dir(raw), arr)
+            normed = layout.stft_z(zfile, snr_range, zsig, rir, k + 1, noise, normed=True)
+            np.save(layout.ensure_dir(normed), np.abs(arr))
+    return True

@@ -1,0 +1,35 @@
+"""The DNN stack: Flax CRNN mask estimator, data pipeline, training engine
+(TPU-native counterpart of reference disco_theque/dnn/)."""
+from disco_tpu.nn.bricks import CNN2d, FF, RNN, cnn_output_dim
+from disco_tpu.nn.crnn import CRNN, build_crnn, loss_frame_bounds
+from disco_tpu.nn.data import (
+    DiscoDataset,
+    DiscoPartialDataset,
+    RandomDataset,
+    batch_iterator,
+    get_input_lists,
+    load_input_lists,
+    write_input_lists,
+)
+from disco_tpu.nn.losses import nanmean, reconstruction_loss
+from disco_tpu.nn.training import (
+    SaveAndStop,
+    TrainState,
+    create_train_state,
+    fit,
+    get_model_name,
+    load_checkpoint,
+    load_params_for_inference,
+    make_step_fns,
+    save_checkpoint,
+)
+
+__all__ = [
+    "CNN2d", "FF", "RNN", "cnn_output_dim",
+    "CRNN", "build_crnn", "loss_frame_bounds",
+    "DiscoDataset", "DiscoPartialDataset", "RandomDataset",
+    "batch_iterator", "get_input_lists", "load_input_lists", "write_input_lists",
+    "nanmean", "reconstruction_loss",
+    "SaveAndStop", "TrainState", "create_train_state", "fit", "get_model_name",
+    "load_checkpoint", "load_params_for_inference", "make_step_fns", "save_checkpoint",
+]

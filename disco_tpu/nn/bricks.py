@@ -1,0 +1,241 @@
+"""Composable NN bricks — the TPU-native counterpart of the reference's
+generic torch modules (reference dnn/models/nn_structures.py:39-245).
+
+Same three building blocks (FF / RNN / CNN2d) with the same knobs, written
+as Flax linen modules so the whole model jits into one XLA program:
+
+* ``FF`` — linear stack with per-layer activations fetched by name
+  (nn_structures.py:39-76).
+* ``RNN`` — stacked RNN/LSTM/GRU cells with per-layer dropout and optional
+  bidirectionality; hidden state handled by ``flax.linen.RNN`` scan
+  (nn_structures.py:80-158).  ``lax.scan`` under the hood — no Python loop
+  over time frames.
+* ``CNN2d`` — Conv + BatchNorm + pool per layer (nn_structures.py:162-217),
+  plus the analytic output-shape computation ``cnn_output_dim``
+  (nn_structures.py:219-245) as a pure function.
+
+Layout note: torch is NCHW; TPU conv wants NHWC.  These bricks take
+``(batch, time, freq, channels)`` and treat time as H, frequency as W, so
+XLA can tile the convs onto the MXU without layout transposes.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+_ACTIVATIONS = {
+    "sigmoid": jax.nn.sigmoid,
+    "relu": jax.nn.relu,
+    "tanh": jnp.tanh,
+    "elu": jax.nn.elu,
+    "softplus": jax.nn.softplus,
+    "identity": lambda x: x,
+    "linear": lambda x: x,
+    None: lambda x: x,
+}
+
+
+def activation_by_name(name):
+    """Fetch an activation by (torch-style, lowercase) name — the counterpart
+    of ``getattr(torch, activation)`` at nn_structures.py:75."""
+    if callable(name):
+        return name
+    key = name.lower() if isinstance(name, str) else name
+    if key in _ACTIVATIONS:
+        return _ACTIVATIONS[key]
+    fn = getattr(jax.nn, key, None)
+    if fn is None:
+        raise ValueError(f"Unknown activation {name!r}")
+    return fn
+
+
+def broadcast_arg(arg, n: int) -> list:
+    """Scalar → n-list; pair-tuple → repeated n times; list (or tuple of
+    per-layer tuples) → as-is.  Reference ``multiply_argument_to_list``
+    (nn_structures.py:14-35), extended so flax-friendly tuple-of-tuples
+    defaults read as per-layer lists."""
+    if isinstance(arg, list):
+        if len(arg) == 1:
+            return arg * n
+        assert len(arg) == n, f"expected 1 or {n} values, got {len(arg)}"
+        return arg
+    if isinstance(arg, tuple):
+        if len(arg) == n and all(e is None or isinstance(e, (tuple, list)) for e in arg):
+            return list(arg)  # explicit per-layer spec written as a tuple
+        return [arg] * n  # a (h, w) pair, repeated per layer
+    return [arg] * n
+
+
+def spec_per_layer(arg, n: int) -> list:
+    """Per-layer structural spec (kernels/strides/pools): sequences are
+    indexed per layer as-is (the reference stores these unexpanded,
+    nn_structures.py:188-191); scalars broadcast."""
+    if arg is None or not isinstance(arg, (tuple, list)):
+        return [arg] * n
+    assert len(arg) == n, f"expected {n} per-layer values, got {len(arg)}"
+    return list(arg)
+
+
+def _pair(v) -> tuple:
+    """int → (int, int); tuples/lists pass through."""
+    if v is None:
+        return v
+    if isinstance(v, (tuple, list)):
+        return tuple(v)
+    return (v, v)
+
+
+def _freeze(v):
+    """Recursively lists → tuples so module fields stay hashable (flax
+    modules must hash to be jit statics / lru_cache keys)."""
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(e) for e in v)
+    return v
+
+
+class _HashableFields:
+    """Mixin: convert list-typed dataclass fields to tuples at init."""
+
+    def __post_init__(self):
+        for f in self.__dataclass_fields__:
+            v = getattr(self, f)
+            if isinstance(v, list):
+                object.__setattr__(self, f, _freeze(v))
+        super().__post_init__()
+
+
+class FF(_HashableFields, nn.Module):
+    """Feed-forward stack: Dense layers with named activations
+    (nn_structures.py:39-76)."""
+
+    features: Sequence[int]
+    activations: Any = "sigmoid"
+
+    @nn.compact
+    def __call__(self, x):
+        feats = self.features if isinstance(self.features, (tuple, list)) else (self.features,)
+        acts = broadcast_arg(
+            list(self.activations) if isinstance(self.activations, (tuple, list)) else self.activations,
+            len(feats),
+        )
+        for units, act in zip(feats, acts):
+            x = activation_by_name(act)(nn.Dense(units)(x))
+        return x
+
+
+_CELLS = {"rnn": nn.SimpleCell, "lstm": nn.OptimizedLSTMCell, "gru": nn.GRUCell}
+
+
+class RNN(_HashableFields, nn.Module):
+    """Stacked recurrent layers over the time axis (batch, time, features),
+    with per-layer dropout (forced to 0 on the last layer, matching
+    nn_structures.py:122-126) and optional per-layer bidirectionality.
+    """
+
+    features: Sequence[int]
+    cell_type: str = "gru"
+    dropouts: Any = 0.0
+    bidirectional: Any = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        n = len(self.features)
+        drops = broadcast_arg(
+            list(self.dropouts) if isinstance(self.dropouts, (tuple, list)) else self.dropouts, n
+        )
+        drops = list(drops)
+        drops[-1] = 0.0  # no dropout after the last layer (nn_structures.py:126)
+        bidis = broadcast_arg(self.bidirectional, n)
+        cell_cls = _CELLS[self.cell_type.lower()]
+        for units, drop, bidi in zip(self.features, drops, bidis):
+            fwd = nn.RNN(cell_cls(features=units))
+            if bidi:
+                bwd = nn.RNN(cell_cls(features=units), reverse=True, keep_order=True)
+                x = jnp.concatenate([fwd(x), bwd(x)], axis=-1)
+            else:
+                x = fwd(x)
+            if drop:
+                x = nn.Dropout(rate=float(drop), deterministic=not train)(x)
+        return x
+
+
+class CNN2d(_HashableFields, nn.Module):
+    """Conv2d → BatchNorm → pool stack over (batch, time, freq, channels)
+    (nn_structures.py:162-217).  Integer paddings follow torch semantics:
+    explicit zero-pad of (pad_t, pad_f) on both sides, VALID conv/pool.
+    ``pool_strides`` entries of None default to the pool kernel (torch
+    MaxPool2d behavior)."""
+
+    features: Sequence[int]
+    conv_kernels: Any = 3
+    conv_strides: Any = 1
+    pool_kernels: Any = None
+    pool_strides: Any = None
+    conv_padding: Any = 0
+    pool_types: Any = "max"
+    conv_bias: Any = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        n = len(self.features)
+        kernels = [_pair(k) for k in spec_per_layer(self.conv_kernels, n)]
+        strides = [_pair(s) for s in spec_per_layer(self.conv_strides, n)]
+        pads = [_pair(p) for p in broadcast_arg(self.conv_padding, n)]
+        pools = [_pair(p) for p in spec_per_layer(self.pool_kernels, n)]
+        pstrides = [_pair(s) for s in spec_per_layer(self.pool_strides, n)]
+        ptypes = broadcast_arg(self.pool_types, n)
+        biases = broadcast_arg(self.conv_bias, n)
+
+        for i in range(n):
+            x = nn.Conv(
+                self.features[i],
+                kernel_size=kernels[i],
+                strides=strides[i],
+                padding=[(pads[i][0],) * 2, (pads[i][1],) * 2],
+                use_bias=biases[i],
+            )(x)
+            x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+            if pools[i] is not None:
+                window = pools[i]
+                stride = pstrides[i] if pstrides[i] is not None else window
+                pool = nn.max_pool if str(ptypes[i]).lower().startswith("max") else nn.avg_pool
+                x = pool(x, window_shape=window, strides=stride, padding="VALID")
+        return x
+
+
+def cnn_output_dim(
+    input_hw,
+    conv_kernels,
+    conv_strides,
+    pool_kernels,
+    pool_strides,
+    conv_padding=0,
+    n_layers: int | None = None,
+) -> tuple[int, int]:
+    """Analytic (time, freq) output shape of the conv stack — the pure-
+    function equivalent of ``CNN2d.get_output_dim`` (nn_structures.py:219-245,
+    torch Conv2d/MaxPool2d floor formulas)."""
+    if n_layers is None:
+        n_layers = len(conv_kernels) if isinstance(conv_kernels, (list, tuple)) else 1
+    kernels = [_pair(k) for k in spec_per_layer(conv_kernels, n_layers)]
+    strides = [_pair(s) for s in spec_per_layer(conv_strides, n_layers)]
+    pads = [_pair(p) for p in broadcast_arg(conv_padding, n_layers)]
+    pools = [_pair(p) for p in spec_per_layer(pool_kernels, n_layers)]
+    pstrides = [_pair(s) for s in spec_per_layer(pool_strides, n_layers)]
+
+    h, w = input_hw
+    for i in range(n_layers):
+        # None conv stride means stride 1 (the flax nn.Conv default CNN2d
+        # actually runs with); pool stride None means stride = pool kernel.
+        cs = (1, 1) if strides[i] is None else strides[i]
+        h = math.floor((h + 2 * pads[i][0] - (kernels[i][0] - 1) - 1) / cs[0] + 1)
+        w = math.floor((w + 2 * pads[i][1] - (kernels[i][1] - 1) - 1) / cs[1] + 1)
+        if pools[i] is not None:
+            ps = pools[i] if pstrides[i] is None else pstrides[i]
+            h = math.floor((h - (pools[i][0] - 1) - 1) / ps[0] + 1)
+            w = math.floor((w - (pools[i][1] - 1) - 1) / ps[1] + 1)
+    return int(h), int(w)

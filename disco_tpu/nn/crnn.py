@@ -1,0 +1,144 @@
+"""The CRNN mask-estimation network (reference dnn/models/crnn.py:9-108).
+
+CNN2d feature extractor → reshape keeping the time axis → GRU → FF(sigmoid),
+predicting a per-frame mask over ``n_freq`` bins.  The canonical DISCO
+instantiation (reference dnn/utils.py:143-152, tango.py:127-132) is
+
+    input (n_ch, 21, 257) → conv filters (32, 64, 64), 3×3, stride 1,
+    freq-only pooling (1, 4), conv padding (0, 1) → GRU(256) → FF(257,
+    sigmoid)
+
+which yields conv output frames 15 for input window 21 — the frame-
+alignment bookkeeping lives in :func:`loss_frame_bounds` / the model's
+:meth:`CRNN.loss_frames` (crnn.py:65-87, dnn/utils.py:189-209).
+
+Inputs follow the reference's (batch, channels, time, freq) convention —
+3-D inputs get a singleton channel axis (crnn.py:56-57) — and are
+transposed once to TPU-friendly NHWC internally.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+
+from disco_tpu.nn.bricks import CNN2d, FF, RNN, _HashableFields, cnn_output_dim
+
+
+def loss_frame_bounds(win_len: int, part) -> tuple[int, int]:
+    """(first, last) frame selecting which of ``win_len`` frames enter the
+    loss: 'all' | 'mid' | 'last' | an explicit index
+    (reference dnn/utils.py:189-209)."""
+    if part == "all":
+        return 0, win_len
+    if part == "mid":
+        first = int(math.ceil(win_len) / 2)
+        return first, first + 1
+    if part == "last":
+        return win_len - 1, win_len
+    if isinstance(part, int):
+        return part, part + 1
+    raise ValueError(f"Unknown output_frames value {part!r}; use 'all', 'mid', 'last' or an int")
+
+
+class CRNN(_HashableFields, nn.Module):
+    """CRNN mask estimator (reference crnn.py:9-87)."""
+
+    input_shape: Sequence[int]  # (n_ch, win_len, n_freq)
+    cnn_filters: Sequence[int] = (32, 64, 64)
+    conv_kernels: Any = 3
+    conv_strides: Any = 1
+    pool_kernels: Any = ((1, 4), (1, 4), (1, 4))
+    pool_strides: Any = None
+    conv_padding: Any = ((0, 1), (0, 1), (0, 1))
+    pool_types: Any = "max"
+    rnn_units: Sequence[int] = (256,)
+    rnn_cell: str = "gru"
+    rnn_dropouts: Any = 0.0
+    rnn_bi: Any = False
+    ff_units: Any = (257,)
+    ff_activation: Any = "sigmoid"
+
+    def conv_output_hw(self) -> tuple[int, int]:
+        """Analytic (time, freq) shape after the conv stack
+        (reference crnn.py:50)."""
+        return cnn_output_dim(
+            (self.input_shape[1], self.input_shape[2]),
+            self.conv_kernels,
+            self.conv_strides,
+            self.pool_kernels,
+            self.pool_strides,
+            conv_padding=self.conv_padding,
+            n_layers=len(self.cnn_filters),
+        )
+
+    def loss_frames(self, output_frames) -> tuple[tuple[int, int], tuple[int, int]]:
+        """((ff_in, lf_in), (ff_out, lf_out)): which input frames line up
+        with which output frames, accounting for the frames the VALID convs
+        crop (reference crnn.py:65-87)."""
+        win_in = self.input_shape[1]
+        win_out = self.conv_output_hw()[0]
+        if output_frames == "last":
+            new_len = (win_in + win_out) // 2
+            ff_in, lf_in = new_len - 1, new_len
+        elif output_frames == "mid":
+            ff_in = int(math.ceil(win_in) / 2)
+            lf_in = ff_in + 1
+        elif output_frames == "all":
+            ff_in = (win_in - win_out) // 2
+            lf_in = (win_in + win_out) // 2
+        else:
+            raise ValueError(f"Unknown output_frames value {output_frames!r}")
+        return (ff_in, lf_in), loss_frame_bounds(win_out, output_frames)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        # (B, T, F) → (B, 1, T, F)  (reference crnn.py:56-57)
+        if x.ndim == 3:
+            x = x[:, None]
+        x = jnp.transpose(x, (0, 2, 3, 1))  # NCHW → NHWC, once
+        x = CNN2d(
+            features=tuple(self.cnn_filters),
+            conv_kernels=self.conv_kernels,
+            conv_strides=self.conv_strides,
+            pool_kernels=self.pool_kernels,
+            pool_strides=self.pool_strides,
+            conv_padding=self.conv_padding,
+            pool_types=self.pool_types,
+        )(x, train=train)
+        # keep time, merge (freq, channels) into features (crnn.py:59)
+        b, t, f, c = x.shape
+        x = x.reshape(b, t, f * c)
+        x = RNN(
+            features=tuple(self.rnn_units),
+            cell_type=self.rnn_cell,
+            dropouts=self.rnn_dropouts,
+            bidirectional=self.rnn_bi,
+        )(x, train=train)
+        return FF(features=self.ff_units, activations=self.ff_activation)(x)
+
+
+def build_crnn(
+    n_ch: int = 1,
+    win_len: int = 21,
+    n_freq: int = 257,
+    learning_rate: float = 1e-3,
+    clip_grad_norm: float | None = None,
+    rnn_dropouts: Any = 0.5,
+    **overrides,
+):
+    """(model, optax tx) in the canonical DISCO configuration — conv
+    (32, 64, 64) 3×3 / pool (1, 4) / GRU 256 / FF 257 sigmoid, RMSprop
+    lr 1e-3 without grad clipping (reference crnn.py:90-108,
+    dnn/utils.py:143-152).  Note the reference's rnn_dropouts=0.5 is a
+    no-op for the single-layer GRU (last-layer dropout is forced to 0) —
+    preserved here.
+    """
+    model = CRNN(input_shape=(n_ch, win_len, n_freq), rnn_dropouts=rnn_dropouts, **overrides)
+    tx = optax.rmsprop(learning_rate, decay=0.99, eps=1e-8)
+    if clip_grad_norm:
+        tx = optax.chain(optax.clip_by_global_norm(clip_grad_norm), tx)
+    return model, tx

@@ -1,0 +1,20 @@
+"""Training losses (reference dnn/engine/losses.py).
+
+``reconstruction_loss`` is the reference's masked-MSE: the squared mask
+error *weighted by the input magnitude STFT*, so loud TF bins dominate
+(losses.py:15-25).  NaN-robust via a mean that ignores NaNs
+(losses.py:4-12).
+"""
+import jax.numpy as jnp
+
+
+def nanmean(v):
+    """Mean ignoring NaNs (reference losses.py:4-12)."""
+    mask = ~jnp.isnan(v)
+    return jnp.where(mask, v, 0.0).sum() / mask.sum()
+
+
+def reconstruction_loss(y_true, y_pred, y_in):
+    """MSE of the predicted mask applied on the input STFT:
+    ``nanmean(((y_pred - y_true) * y_in)**2)`` (reference losses.py:15-25)."""
+    return nanmean(((y_pred - y_true) * y_in) ** 2)

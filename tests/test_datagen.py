@@ -66,6 +66,14 @@ def test_generate_then_mix_then_enhance(tmp_path, signal_setup):
     assert layout.infos(1).exists()
     infos = np.load(layout.infos(1), allow_pickle=True).item()
     assert infos["rirs"].shape[0] == 2 and infos["rirs"].shape[1] == 16
+    # reference infos contract (convolve_signals.py:438-446): plot_conf-ready
+    assert {"length", "width", "height", "alpha"} <= set(infos["room"])
+    assert infos["mics"].shape[0] == 3  # (3, n_mics) positions
+    assert infos["sources"].ndim == 2
+    from disco_tpu.enhance import plot_conf
+
+    fig = plot_conf(infos, return_fig=True)
+    assert fig is not None
 
     # Train clips padded to 11 s (duration_range[-1] + 1).
     x, fs = read_wav(layout.base / "wav_original" / "cnv" / "target" / "1_S-1_Ch-1.wav")

@@ -1,0 +1,213 @@
+"""L5 inference-glue tests: frame padding, normalization (incl. native
+PCEN), window prep, mask reshaping, z-channel selection, CRNN mask path,
+and z-export file contract (reference speech_enhancement/utils.py,
+tango.py:158-249, get_z_signals.py)."""
+import numpy as np
+import pytest
+
+import jax
+
+from disco_tpu.core.dsp import n_stft_frames, stft
+from disco_tpu.enhance import (
+    compute_z_signals,
+    crnn_mask,
+    export_z,
+    get_frames_to_pad,
+    get_z_for_mask,
+    normalization,
+    oracle_masks,
+    pcen,
+    prepare_data,
+    reshape_mask,
+    vad_mask,
+)
+from disco_tpu.io.audio import write_wav
+from disco_tpu.io.layout import DatasetLayout
+from disco_tpu.nn import build_crnn, create_train_state
+
+
+# -- frame padding ----------------------------------------------------------
+def test_get_frames_to_pad():
+    # reference utils.py:13-33 with win 21 / out 15
+    assert get_frames_to_pad(21, "mid") == (10, 10)
+    assert get_frames_to_pad(21, "last", out_len=15) == (17, 3)
+    assert get_frames_to_pad(21, "all") == (0, 0)
+    with pytest.raises(ValueError):
+        get_frames_to_pad(21, "bogus")
+
+
+# -- normalization ----------------------------------------------------------
+def test_normalization_modes(rng):
+    x = (rng.random((257, 50)) + 0.01).astype("float32")
+    assert np.allclose(normalization(x, None), np.clip(x, 1e-6, 1e3))
+    un = normalization(x, "scale_to_unit_norm", axis=1)
+    np.testing.assert_allclose(np.linalg.norm(un, axis=1), 1.0, rtol=1e-5)
+    q = normalization(x, "scale_to_1", axis=1)
+    assert np.quantile(q, 0.99, axis=1) == pytest.approx(1.0, rel=1e-5)
+    cs = normalization(x, "center_and_scale", axis=1)
+    np.testing.assert_allclose(np.mean(cs, axis=1), 0.0, atol=1e-5)
+    np.testing.assert_allclose(np.std(cs, axis=1), 1.0, rtol=1e-4)
+
+
+def test_normalization_accepts_complex(rng):
+    x = (rng.random((10, 20)) + 1j * rng.random((10, 20))).astype("complex64")
+    out = normalization(x, "scale_to_unit_norm", axis=1)
+    assert np.isrealobj(out)
+
+
+def test_pcen_properties(rng):
+    """PCEN of a constant signal ≈ (1 + bias)^power − bias^power; AGC makes
+    output level nearly independent of input gain."""
+    S = np.full((5, 200), 100.0)
+    out = pcen(S, eps=1e-6)
+    expect = (100.0 / (1e-6 + 100.0) ** 0.98 + 2.0) ** 0.5 - 2.0**0.5
+    np.testing.assert_allclose(out[:, 50:], expect, rtol=1e-2)
+    x = rng.random((5, 300)) + 0.5
+    a, b = pcen(x), pcen(100.0 * x)
+    assert np.abs(np.median(a[:, 50:]) - np.median(b[:, 50:])) < 0.3
+
+
+# -- prepare_data -----------------------------------------------------------
+def test_prepare_data_3d_shapes(rng):
+    F, T = 33, 60
+    y = rng.random((F, T)).astype("float32")
+    z = [rng.random((F, T)).astype("float32") for _ in range(3)]
+    out = prepare_data(y, True, z_data=z, win_len=21, win_hop=1, frame_to_pred="last", frames_lost=6)
+    assert out.shape == (T, 4, 21, F)  # one window per original frame
+    # window i ends at padded frame i+20; unpadded content is y[:, :i+4]
+    np.testing.assert_allclose(out[0, 0, :17, :], 0.0)
+    np.testing.assert_allclose(out[0, 0, 17:, :], y[:, :4].T, rtol=1e-6)
+
+
+def test_prepare_data_2d_stacks_freq(rng):
+    F, T = 33, 40
+    y = rng.random((F, T)).astype("float32")
+    z = [rng.random((F, T)).astype("float32")]
+    out = prepare_data(y, False, z_data=z, win_len=21, win_hop=1, frame_to_pred="last", frames_lost=6)
+    assert out.shape == (T, 21, 2 * F)
+
+
+def test_prepare_data_matches_reference_loop(rng):
+    """Vectorized windowing must equal the reference's per-window loop
+    (utils.py:107-131)."""
+    F, T, win_len, frames_lost = 9, 30, 21, 6
+    y = rng.random((F, T)).astype("float32")
+    pad = get_frames_to_pad(win_len, "last", out_len=win_len - frames_lost)
+    y_pad = np.pad(y, ((0, 0), pad))
+    n_samples = int(1 + np.floor((T + sum(pad) - win_len) / 1))
+    expected = np.zeros((n_samples, 1, win_len, F), "float32")
+    for i in range(n_samples):
+        expected[i, 0] = y_pad[:, i : i + win_len].T
+    got = prepare_data(y, True, win_len=win_len, frame_to_pred="last", frames_lost=frames_lost)
+    np.testing.assert_allclose(got, expected, rtol=1e-6)
+
+
+# -- reshape_mask -----------------------------------------------------------
+def test_reshape_mask(rng):
+    stack = rng.random((40, 15, 257)).astype("float32")
+    last = reshape_mask(stack, "last")
+    assert last.shape == (257, 40)
+    np.testing.assert_allclose(last, stack[:, -1, :].T)
+    mid = reshape_mask(stack, "mid")
+    np.testing.assert_allclose(mid, stack[:, 7, :].T)
+    with pytest.raises(NotImplementedError):
+        reshape_mask(stack, "all")
+
+
+# -- z selection ------------------------------------------------------------
+def test_get_z_for_mask_single_kind(rng):
+    z_s = rng.random((4, 5, 6))
+    z_n = rng.random((4, 5, 6))
+    out = get_z_for_mask(z_s, z_n, k=1, z_sigs="zs_hat")
+    np.testing.assert_allclose(out, z_s[[0, 2, 3]])
+    out_n = get_z_for_mask(z_s, z_n, k=3, z_sigs="zn_hat")
+    np.testing.assert_allclose(out_n, z_n[[0, 1, 2]])
+
+
+def test_get_z_for_mask_interleaved(rng):
+    z_s = rng.random((4, 5, 6))
+    z_n = rng.random((4, 5, 6))
+    out = get_z_for_mask(z_s, z_n, k=0, z_sigs=["zs_hat", "zn_hat"])
+    assert out.shape == (6, 5, 6)
+    # local pair (zs_0, zn_0) dropped; order zs_1, zn_1, zs_2, zn_2, ...
+    np.testing.assert_allclose(out[0], z_s[1])
+    np.testing.assert_allclose(out[1], z_n[1])
+    np.testing.assert_allclose(out[4], z_s[3])
+
+
+# -- CRNN mask path ---------------------------------------------------------
+def _small_crnn(n_ch):
+    return build_crnn(
+        n_ch=n_ch, n_freq=33,
+        cnn_filters=(4, 4), conv_kernels=3, conv_strides=1,
+        pool_kernels=[(1, 2)] * 2, pool_strides=None, conv_padding=[(0, 1)] * 2,
+        rnn_units=(8,), ff_units=(33,),
+    )
+
+
+@pytest.mark.parametrize("with_z", [False, True])
+def test_crnn_mask_shapes(rng, with_z):
+    F, T = 33, 30
+    Y = (rng.random((F, T)) + 1j * rng.random((F, T))).astype("complex64")
+    model, tx = _small_crnn(4 if with_z else 1)
+    n_ch = 4 if with_z else 1
+    state = create_train_state(model, tx, np.zeros((1, n_ch, 21, F), "float32"))
+    z = [Y * 0.5] * 3 if with_z else None
+    m = crnn_mask(Y, model, {"params": state.params, "batch_stats": state.batch_stats}, z=z)
+    assert m.shape == (F, T)
+    assert (m >= 0).all() and (m <= 1).all()
+
+
+def test_vad_mask(rng):
+    fs = 16000
+    t = np.arange(fs) / fs
+    x = np.concatenate([0.001 * rng.standard_normal(fs), np.sin(2 * np.pi * 440 * t)]).astype("float32")
+    m = vad_mask(x, n_freq=5, n_frames=n_stft_frames(len(x)))
+    assert set(np.unique(m)).issubset({0.0, 1.0})
+    assert m[:, -20:].mean() > 0.9  # active speech at the end
+    assert (m == m[0:1]).all()  # constant across freq
+
+
+# -- z export ---------------------------------------------------------------
+def _write_processed(root, rir, noise="ssn", snr=(0, 6), K=4, C=4, L=8192, seed=0):
+    rng = np.random.default_rng(seed)
+    lay = DatasetLayout(str(root), "random", "train")
+    sigs = {}
+    # target saved WITHOUT noise tag, mixture/noise with it (postgen.save_data)
+    for source, tag in (("mixture", noise), ("target", None), ("noise", noise)):
+        sig = rng.standard_normal((K, C, L)).astype("float32") * 0.1
+        sigs[source] = sig
+        for node in range(K):
+            for c in range(C):
+                ch = 1 + node * C + c
+                write_wav(lay.ensure_dir(lay.wav_processed(snr, source, rir, ch, noise=tag)), sig[node, c], 16000)
+    return lay, sigs
+
+
+def test_compute_z_signals_matches_step1(rng):
+    K, C, L = 2, 3, 4096
+    s = rng.standard_normal((K, C, L)).astype("float32")
+    n = 0.3 * rng.standard_normal((K, C, L)).astype("float32")
+    y = s + n
+    out = compute_z_signals(y, s, n, mask_type="irm1")
+    F, T = 257, n_stft_frames(L)
+    assert out["z_y"].shape == (K, F, T)
+    # zn = y_ref − z
+    Y = stft(y)
+    np.testing.assert_allclose(
+        np.asarray(out["zn"]), np.asarray(Y[:, 0] - out["z_y"]), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_export_z_files_and_idempotency(tmp_path):
+    lay, _ = _write_processed(tmp_path, rir=1)
+    assert export_z(str(tmp_path), "random", 1, "ssn") is True
+    for k in range(1, 5):
+        for zsig in ("zs_hat", "zn_hat"):
+            raw = lay.stft_z("oracle", (0, 6), zsig, 1, k, "ssn", normed=False)
+            nrm = lay.stft_z("oracle", (0, 6), zsig, 1, k, "ssn", normed=True)
+            assert raw.exists() and nrm.exists()
+            assert np.iscomplexobj(np.load(raw))
+            assert not np.iscomplexobj(np.load(nrm))
+    # second call is a no-op (idempotency guard)
+    assert export_z(str(tmp_path), "random", 1, "ssn") is False

@@ -1,0 +1,205 @@
+"""L4 DNN stack tests: bricks shape math (cross-checked against torch),
+CRNN forward, masked-MSE loss, training step convergence, SaveAndStop,
+checkpoint/resume (reference dnn/ — SURVEY.md §2.5)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from disco_tpu.nn import (
+    CRNN,
+    RandomDataset,
+    SaveAndStop,
+    batch_iterator,
+    build_crnn,
+    cnn_output_dim,
+    create_train_state,
+    fit,
+    get_model_name,
+    load_checkpoint,
+    loss_frame_bounds,
+    make_step_fns,
+    nanmean,
+    reconstruction_loss,
+    save_checkpoint,
+)
+
+CANON = dict(
+    conv_kernels=3,
+    conv_strides=1,
+    pool_kernels=[(1, 4)] * 3,
+    pool_strides=None,
+    conv_padding=[(0, 1)] * 3,
+)
+
+
+# -- analytic shape math ----------------------------------------------------
+def test_cnn_output_dim_canonical():
+    # (21, 257) → (15, 4) for the canonical DISCO conv stack
+    assert cnn_output_dim((21, 257), **CANON, n_layers=3) == (15, 4)
+
+
+def test_cnn_output_dim_matches_torch():
+    """The pure-function shape math must agree with an actual torch conv
+    stack (the reference's get_output_dim ground truth)."""
+    torch = pytest.importorskip("torch")
+    nn_t = torch.nn
+
+    layers = []
+    chans = [1, 32, 64, 64]
+    for i in range(3):
+        layers += [
+            nn_t.Conv2d(chans[i], chans[i + 1], 3, stride=1, padding=(0, 1)),
+            nn_t.MaxPool2d((1, 4)),
+        ]
+    with torch.no_grad():
+        out = nn_t.Sequential(*layers)(torch.zeros(1, 1, 21, 257))
+    assert cnn_output_dim((21, 257), **CANON, n_layers=3) == tuple(out.shape[-2:])
+
+
+@pytest.mark.parametrize(
+    "hw,kern,pad,pool,expect_torch",
+    [((30, 100), 5, 0, (2, 2), True), ((16, 64), (3, 5), (1, 2), (2, 4), True)],
+)
+def test_cnn_output_dim_matches_torch_other_configs(hw, kern, pad, pool, expect_torch):
+    torch = pytest.importorskip("torch")
+    conv = torch.nn.Conv2d(1, 4, kern, stride=1, padding=pad)
+    pool_l = torch.nn.MaxPool2d(pool)
+    with torch.no_grad():
+        out = pool_l(conv(torch.zeros(1, 1, *hw)))
+    got = cnn_output_dim(hw, [kern], [1], [pool], [None], conv_padding=[pad], n_layers=1)
+    assert got == tuple(out.shape[-2:])
+
+
+def test_loss_frame_bounds():
+    # reference dnn/utils.py:189-209 semantics
+    assert loss_frame_bounds(21, "all") == (0, 21)
+    assert loss_frame_bounds(21, "mid") == (10, 11)
+    assert loss_frame_bounds(21, "last") == (20, 21)
+    assert loss_frame_bounds(21, 5) == (5, 6)
+
+
+def test_crnn_loss_frames_all():
+    model = CRNN(input_shape=(1, 21, 257))
+    (ff_in, lf_in), (ff_out, lf_out) = model.loss_frames("all")
+    assert (ff_in, lf_in) == (3, 18)  # (21-15)//2 .. (21+15)//2
+    assert (ff_out, lf_out) == (0, 15)
+
+
+# -- CRNN forward -----------------------------------------------------------
+@pytest.mark.parametrize("n_ch", [1, 4])
+def test_crnn_forward_shapes(n_ch):
+    model, _ = build_crnn(n_ch=n_ch)
+    x = jnp.ones((2, n_ch, 21, 257)) if n_ch > 1 else jnp.ones((2, 21, 257))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    out = model.apply(variables, x)
+    assert out.shape == (2, 15, 257)  # 15 conv-cropped frames, 257-bin mask
+    assert float(out.min()) >= 0.0 and float(out.max()) <= 1.0  # sigmoid
+
+
+# -- loss -------------------------------------------------------------------
+def test_nanmean_ignores_nans():
+    v = jnp.array([1.0, jnp.nan, 3.0])
+    assert float(nanmean(v)) == pytest.approx(2.0)
+
+
+def test_reconstruction_loss_is_input_weighted_mse(rng):
+    y_true = jnp.asarray(rng.random((4, 5)))
+    y_pred = jnp.asarray(rng.random((4, 5)))
+    x_in = jnp.asarray(rng.random((4, 5)))
+    expected = np.mean(((np.asarray(y_pred) - np.asarray(y_true)) * np.asarray(x_in)) ** 2)
+    assert float(reconstruction_loss(y_true, y_pred, x_in)) == pytest.approx(expected, rel=1e-6)
+
+
+# -- training ---------------------------------------------------------------
+def _tiny_model():
+    return build_crnn(
+        n_ch=1,
+        n_freq=33,
+        cnn_filters=(4, 4),
+        conv_kernels=3,
+        conv_strides=1,
+        pool_kernels=[(1, 2)] * 2,
+        pool_strides=None,
+        conv_padding=[(0, 1)] * 2,
+        rnn_units=(8,),
+        ff_units=(33,),
+    )
+
+
+def test_train_step_reduces_loss(rng):
+    model, tx = _tiny_model()
+    x = rng.random((8, 21, 33)).astype("float32")
+    y = (rng.random((8, 21, 33)) > 0.5).astype("float32")
+    state = create_train_state(model, tx, x[:1])
+    train_step, eval_step = make_step_fns(model, "all", n_freq=33)
+    first = float(eval_step(state, jnp.asarray(x), jnp.asarray(y)))
+    for _ in range(30):
+        state, loss = train_step(state, jnp.asarray(x), jnp.asarray(y))
+    assert float(loss) < first
+
+
+def test_save_and_stop_gate():
+    gate = SaveAndStop(patience=2, mode="min")
+    assert gate.save_model_query(1.0)
+    assert not gate.save_model_query(1.5)
+    assert not gate.save_model_query(1.4)
+    assert not gate.early_stop_query()
+    assert not gate.save_model_query(1.3)
+    assert gate.early_stop_query()
+    with pytest.raises(ValueError):
+        SaveAndStop(mode="other")
+
+
+def test_checkpoint_roundtrip_and_resume(tmp_path, rng):
+    model, tx = _tiny_model()
+    x = rng.random((4, 21, 33)).astype("float32")
+    state = create_train_state(model, tx, x[:1])
+    train_step, _ = make_step_fns(model, "all", n_freq=33)
+    y = rng.random((4, 21, 33)).astype("float32")
+    state, _ = train_step(state, jnp.asarray(x), jnp.asarray(y))
+
+    losses = np.array([0.5, 0.4, 0.0, 0.0])  # zero-padded history
+    save_checkpoint(tmp_path / "ck.msgpack", state, losses, losses)
+    fresh = create_train_state(model, tx, x[:1], seed=7)
+    restored, tr, va = load_checkpoint(tmp_path / "ck.msgpack", fresh)
+    assert list(tr) == [0.5, 0.4]  # trailing zeros trimmed (trim_zeros)
+    np.testing.assert_allclose(
+        np.asarray(jax.tree.leaves(restored.params)[0]),
+        np.asarray(jax.tree.leaves(state.params)[0]),
+    )
+
+
+def test_fit_smoke_with_random_dataset(tmp_path):
+    """End-to-end epoch loop on the corpus-free fake dataset
+    (reference RandomDataset, datasets.py:13-36)."""
+    model, tx = _tiny_model()
+    ds = RandomDataset((21, 33), (33, 21), length=12, rng=np.random.default_rng(0))
+
+    def batches():
+        # labels arrive (F, T) like saved masks; transpose to (T, F)
+        for x, y in batch_iterator(ds, 6, rng=np.random.default_rng(1)):
+            yield x, np.swapaxes(y, -2, -1)
+
+    state = create_train_state(model, tx, next(batches())[0])
+    state, tr, va, name = fit(
+        model, state, batches, batches, n_epochs=2, save_path=tmp_path, verbose=False
+    )
+    assert (tmp_path / f"{name}_losses.npz").exists()
+    assert (tmp_path / f"{name}_model.msgpack").exists()
+    assert len(tr) == 2 and tr[0] > 0
+
+    # resume: loss history splices
+    state2 = create_train_state(model, tx, next(batches())[0])
+    _, tr2, _, name2 = fit(
+        model, state2, batches, batches, n_epochs=1,
+        save_path=tmp_path, resume_from=tmp_path / f"{name}_model.msgpack", verbose=False,
+    )
+    assert name2.endswith("_retrain")
+    assert len(tr2) >= 3
+
+
+def test_get_model_name():
+    assert len(get_model_name()) == 4
+    assert get_model_name("models/ab3X_model.msgpack") == "ab3X_retrain"

@@ -1,0 +1,124 @@
+"""Data-pipeline tests: input-list construction against the file layout,
+DiscoDataset windowing/jitter/stacking semantics, RAM-partial equivalence
+(reference dnn/data/datasets.py, dnn/utils.py:74-140)."""
+import numpy as np
+import pytest
+
+from disco_tpu.io.layout import DatasetLayout
+from disco_tpu.nn.data import (
+    FS,
+    TRAIN_DUR,
+    DiscoDataset,
+    DiscoPartialDataset,
+    batch_iterator,
+    get_input_lists,
+    load_input_lists,
+    write_input_lists,
+)
+
+N_FREQ = 257
+SNR = [0, 6]
+
+
+def _make_corpus(root, rirs=(1, 2), n_nodes=4, z_sigs=("zs_hat",), seed=0):
+    """Synthetic corpus matching the generated-file layout: full-length
+    train STFTs (11 s → 684 centered frames) with recognizable content."""
+    rng = np.random.default_rng(seed)
+    lay = DatasetLayout(str(root), "random", "train")
+    n_frames = (TRAIN_DUR * FS - 512) // 256 + 3
+    for rir in rirs:
+        for node in range(n_nodes):
+            ch = 1 + n_nodes * node
+            stft = (rng.random((N_FREQ, n_frames)) + 0.1).astype("complex64")
+            mask = rng.random((N_FREQ, n_frames)).astype("float32")
+            p = lay.stft_processed(SNR, "mixture", rir, ch, noise="ssn", normed=True)
+            np.save(lay.ensure_dir(p), stft)
+            np.save(lay.ensure_dir(lay.mask_processed(SNR, rir, ch, "ssn")), mask)
+            for zsig in z_sigs:
+                z = (rng.random((N_FREQ, n_frames)) + 0.1).astype("complex64")
+                np.save(lay.ensure_dir(lay.stft_z("oracle", SNR, zsig, rir, node + 1, "ssn", normed=True)), z)
+    return lay
+
+
+def test_get_input_lists_layout(tmp_path):
+    _make_corpus(tmp_path, rirs=(1, 2))
+    lists = get_input_lists(str(tmp_path), [1, 2], scenes="random", z_sigs=["zs_hat"])
+    # [4 refs | 4 z | 4 masks] rows, one entry per rir
+    assert len(lists) == 12 and all(len(row) == 2 for row in lists)
+    assert "stft_processed" in lists[0][0] and "Ch-1.npy" in lists[0][0]
+    assert "stft_z" in lists[4][0] and "Node-1" in lists[4][0]
+    assert "mask_processed" in lists[-1][0] and "Ch-13" in lists[-1][0]
+    for row in lists:
+        for p in row:
+            assert np.load(p) is not None  # every path exists
+
+
+def test_write_and_load_input_lists(tmp_path):
+    _make_corpus(tmp_path, rirs=(1,))
+    lists = get_input_lists(str(tmp_path), [1], scenes="random", z_sigs=["zs_hat"])
+    write_input_lists(lists, tmp_path / "lists")
+    assert load_input_lists(tmp_path / "lists") == [list(map(str, row)) for row in lists]
+
+
+def test_disco_dataset_windows(tmp_path):
+    _make_corpus(tmp_path, rirs=(1, 2))
+    lists = get_input_lists(str(tmp_path), [1, 2], scenes="random", z_sigs=["zs_hat"])
+    ds = DiscoDataset(lists, stack_axis=2, rng=np.random.default_rng(3))
+    # 684 total frames − 63 (first second) = 621 usable → (621−21)//8+1 windows
+    n_usable = (TRAIN_DUR * FS - 512) // 256 + 3 - int(np.ceil(FS / 256))
+    assert ds.win_per_seg[0] == (n_usable - 21) // 8 + 1
+    assert len(ds) == 2 * ds.win_per_seg[0]
+
+    x, y = ds[0]
+    # local ref + 3 z channels, (C, T, F) after the swap; label (T, F)
+    assert x.shape == (4, 21, N_FREQ)
+    assert y.shape == (21, N_FREQ)
+    assert x.dtype == np.float32 and (x >= 0).all()  # magnitudes
+
+
+def test_disco_dataset_single_channel(tmp_path):
+    _make_corpus(tmp_path, rirs=(1,), z_sigs=())
+    lists = get_input_lists(str(tmp_path), [1], scenes="random", z_sigs=None)
+    ds = DiscoDataset(lists, stack_axis=0, rng=np.random.default_rng(0))
+    x, y = ds[5]
+    assert x.shape == (21, N_FREQ) and y.shape == (21, N_FREQ)
+
+
+def test_disco_dataset_freq_stacked(tmp_path):
+    _make_corpus(tmp_path, rirs=(1,))
+    lists = get_input_lists(str(tmp_path), [1], scenes="random", z_sigs=["zs_hat"])
+    ds = DiscoDataset(lists, stack_axis=1, rng=np.random.default_rng(0))
+    x, y = ds[0]
+    assert x.shape == (21, 4 * N_FREQ)  # ref ‖ 3 z's on the freq axis
+    assert y.shape == (21, N_FREQ)
+
+
+def test_partial_dataset_matches_full(tmp_path):
+    """DiscoPartialDataset (lazy ref/mask loads) must produce the same item
+    as DiscoDataset given identical random draws (datasets.py:165-221)."""
+    _make_corpus(tmp_path, rirs=(1,))
+    lists = get_input_lists(str(tmp_path), [1], scenes="random", z_sigs=["zs_hat"])
+    full = DiscoDataset(lists, stack_axis=2, rng=np.random.default_rng(11))
+    part = DiscoPartialDataset(lists, stack_axis=2, rng=np.random.default_rng(11))
+    xf, yf = full[7]
+    xp, yp = part[7]
+    np.testing.assert_allclose(xp, xf, rtol=1e-6)
+    np.testing.assert_allclose(yp, yf, rtol=1e-6)
+
+
+def test_jitter_stays_in_bounds(tmp_path):
+    _make_corpus(tmp_path, rirs=(1,))
+    lists = get_input_lists(str(tmp_path), [1], scenes="random", z_sigs=["zs_hat"])
+    ds = DiscoDataset(lists, stack_axis=2, rng=np.random.default_rng(0))
+    last = len(ds) - 1
+    for _ in range(5):  # random jitter at the last window must clamp
+        k, m = ds.get_item_indices(last)
+        assert m + ds.win_len <= ds.n_frames[k]
+
+
+def test_batch_iterator_shapes(tmp_path):
+    _make_corpus(tmp_path, rirs=(1,))
+    lists = get_input_lists(str(tmp_path), [1], scenes="random", z_sigs=["zs_hat"])
+    ds = DiscoDataset(lists, stack_axis=2, rng=np.random.default_rng(0))
+    x, y = next(batch_iterator(ds, 8, rng=np.random.default_rng(1)))
+    assert x.shape == (8, 4, 21, N_FREQ) and y.shape == (8, 21, N_FREQ)
