@@ -11,7 +11,7 @@ from disco_tpu.core.mathx import (
 )
 from disco_tpu.core.dsp import stft, istft, n_stft_frames, N_FFT, N_HOP, N_FREQ
 from disco_tpu.core.masks import tf_mask, vad_oracle_batch, vad_to_mask
-from disco_tpu.core import metrics, sigproc
+from disco_tpu.core import metrics, miscx, sigproc
 
 __all__ = [
     "db2lin",

@@ -213,3 +213,122 @@ def si_sdr_jax(reference: jnp.ndarray, estimation: jnp.ndarray) -> jnp.ndarray:
     noise = estimation - projection
     ratio = jnp.sum(projection**2, axis=-1) / jnp.sum(noise**2, axis=-1)
     return 10.0 * jnp.log10(ratio)
+
+
+# --------------------------------------------------------------------- STOI
+# The reference evaluates intelligibility with pystoi (tango.py:569-578).
+# pystoi is a CPython/NumPy package; here the algorithm (Taal et al., "An
+# Algorithm for Intelligibility Prediction of Time-Frequency Weighted Noisy
+# Speech", IEEE TASLP 2011) is implemented natively so the framework owns
+# the capability without the undeclared dependency.
+
+_STOI_FS = 10000  # internal rate
+_STOI_NFFT = 512
+_STOI_WIN = 256
+_STOI_HOP = 128
+_STOI_NBANDS = 15
+_STOI_MINFREQ = 150.0
+_STOI_SEG = 30  # analysis segment: 30 frames = 384 ms
+_STOI_BETA = -15.0  # clipping SDR bound, dB
+_STOI_DYN = 40.0  # silent-frame energy range, dB
+
+
+def _stoi_third_octaves(fs=_STOI_FS, nfft=_STOI_NFFT, n_bands=_STOI_NBANDS, min_freq=_STOI_MINFREQ):
+    """Rectangular one-third-octave band matrix (n_bands, nfft//2+1)."""
+    f = np.linspace(0, fs, nfft + 1)[: nfft // 2 + 1]
+    k = np.arange(n_bands, dtype=np.float64)
+    cf = 2.0 ** (k / 3.0) * min_freq
+    lo = cf * 2.0 ** (-1.0 / 6.0)
+    hi = cf * 2.0 ** (1.0 / 6.0)
+    obm = np.zeros((n_bands, len(f)))
+    for i in range(n_bands):
+        lo_i = int(np.argmin((f - lo[i]) ** 2))
+        hi_i = int(np.argmin((f - hi[i]) ** 2))
+        obm[i, lo_i:hi_i] = 1.0
+    return obm
+
+
+def _stoi_frames(x, win=_STOI_WIN, hop=_STOI_HOP):
+    n = 1 + max(0, (len(x) - win)) // hop
+    idx = np.arange(win)[None, :] + hop * np.arange(n)[:, None]
+    return x[idx] * np.hanning(win + 2)[1:-1]
+
+
+def _remove_silent_frames(x, y, dyn_range=_STOI_DYN, win=_STOI_WIN, hop=_STOI_HOP):
+    """Drop frames of x whose energy is > dyn_range dB below the loudest
+    frame; apply the same selection to y; overlap-add back to time."""
+    xf, yf = _stoi_frames(x, win, hop), _stoi_frames(y, win, hop)
+    energies = 20 * np.log10(np.linalg.norm(xf, axis=1) + np.finfo(np.float64).eps)
+    keep = energies > (np.max(energies) - dyn_range)
+    xf, yf = xf[keep], yf[keep]
+    n_kept = xf.shape[0]
+    out_len = (n_kept - 1) * hop + win if n_kept else 0
+    xs, ys, wsum = np.zeros(out_len), np.zeros(out_len), np.zeros(out_len)
+    w = np.hanning(win + 2)[1:-1]
+    for i in range(n_kept):
+        sl = slice(i * hop, i * hop + win)
+        xs[sl] += xf[i]
+        ys[sl] += yf[i]
+        wsum[sl] += w
+    wsum[wsum == 0] = 1.0
+    return xs / wsum * 1.0, ys / wsum * 1.0
+
+
+def _resample_to_10k(x, fs):
+    from scipy.signal import resample_poly
+
+    if fs == _STOI_FS:
+        return np.asarray(x, np.float64)
+    g = np.gcd(int(fs), _STOI_FS)
+    return resample_poly(np.asarray(x, np.float64), _STOI_FS // g, int(fs) // g)
+
+
+def stoi(x, y, fs_sig, extended: bool = False):
+    """Short-Time Objective Intelligibility of degraded signal ``y`` against
+    clean ``x`` (Taal et al. 2011), in [~0, 1].  Drop-in for
+    ``pystoi.stoi`` as the reference uses it (tango.py:569-574)."""
+    x, y = np.asarray(x, np.float64), np.asarray(y, np.float64)
+    assert x.shape == y.shape, "x and y must have the same length"
+    x, y = _resample_to_10k(x, fs_sig), _resample_to_10k(y, fs_sig)
+    x, y = _remove_silent_frames(x, y)
+    if len(x) < _STOI_WIN:
+        return np.nan
+
+    xf, yf = _stoi_frames(x), _stoi_frames(y)
+    X = np.fft.rfft(xf, _STOI_NFFT, axis=1)
+    Y = np.fft.rfft(yf, _STOI_NFFT, axis=1)
+    obm = _stoi_third_octaves()
+    # (frames, bands) band magnitudes
+    Xb = np.sqrt(np.maximum(np.abs(X) ** 2 @ obm.T, 0.0)).T
+    Yb = np.sqrt(np.maximum(np.abs(Y) ** 2 @ obm.T, 0.0)).T
+    n_frames = Xb.shape[1]
+    if n_frames < _STOI_SEG:
+        return np.nan
+
+    eps = np.finfo(np.float64).eps
+    if extended:
+        d_sum, n_seg = 0.0, 0
+        for m in range(_STOI_SEG, n_frames + 1):
+            Xs = Xb[:, m - _STOI_SEG : m]
+            Ys = Yb[:, m - _STOI_SEG : m]
+            Xs = (Xs - Xs.mean(axis=1, keepdims=True)) / (np.linalg.norm(Xs - Xs.mean(axis=1, keepdims=True), axis=1, keepdims=True) + eps)
+            Ys = (Ys - Ys.mean(axis=1, keepdims=True)) / (np.linalg.norm(Ys - Ys.mean(axis=1, keepdims=True), axis=1, keepdims=True) + eps)
+            Xs = (Xs - Xs.mean(axis=0, keepdims=True)) / (np.linalg.norm(Xs - Xs.mean(axis=0, keepdims=True), axis=0, keepdims=True) + eps)
+            Ys = (Ys - Ys.mean(axis=0, keepdims=True)) / (np.linalg.norm(Ys - Ys.mean(axis=0, keepdims=True), axis=0, keepdims=True) + eps)
+            d_sum += np.sum(Xs * Ys) / _STOI_SEG
+            n_seg += 1
+        return d_sum / n_seg
+
+    beta_clip = 10.0 ** (-_STOI_BETA / 20.0)
+    d_sum, n_seg = 0.0, 0
+    for m in range(_STOI_SEG, n_frames + 1):
+        Xs = Xb[:, m - _STOI_SEG : m]
+        Ys = Yb[:, m - _STOI_SEG : m]
+        alpha = np.linalg.norm(Xs, axis=1, keepdims=True) / (np.linalg.norm(Ys, axis=1, keepdims=True) + eps)
+        Yp = np.minimum(Ys * alpha, Xs * (1.0 + beta_clip))
+        xm = Xs - Xs.mean(axis=1, keepdims=True)
+        ym = Yp - Yp.mean(axis=1, keepdims=True)
+        corr = np.sum(xm * ym, axis=1) / (np.linalg.norm(xm, axis=1) * np.linalg.norm(ym, axis=1) + eps)
+        d_sum += corr.sum()
+        n_seg += 1
+    return d_sum / (n_seg * _STOI_NBANDS)

@@ -1,0 +1,117 @@
+"""Array / dict / string / YAML helpers.
+
+Capability parity with the reference's ``disco_theque/misc_utils.py``
+(/root/reference/disco_theque/misc_utils.py:7-160): node<->channel mapping
+for heterogeneous array geometries, dict-of-arrays concatenation, selector
+matrices used by the beamformer glue, zero-trimming, histogram/CI plotting
+helpers, run naming and the space-separated-ints YAML convention.
+
+Everything is host-side numpy (these are corpus/plot/config helpers, not
+device code).
+"""
+from __future__ import annotations
+
+import secrets
+import string
+
+import numpy as np
+import yaml
+
+
+def get_node_from_channel(ch: int, arr_geo) -> int:
+    """Node index owning flat channel ``ch`` for a mics-per-node geometry
+    (misc_utils.py:7-16).  E.g. geometry [4, 4, 4, 4], ch 5 -> node 1."""
+    mics_cum = np.cumsum(arr_geo)
+    return int(np.argmax(ch < mics_cum))
+
+
+def channel_range_of_node(node: int, arr_geo) -> tuple[int, int]:
+    """Half-open flat-channel range [start, stop) of ``node`` — the inverse
+    mapping of :func:`get_node_from_channel`."""
+    cum = np.concatenate(([0], np.cumsum(arr_geo)))
+    return int(cum[node]), int(cum[node + 1])
+
+
+def find_unmatched_dim(arr1, arr2):
+    """Indices of axes where the two (equal-ndim) arrays' shapes differ
+    (misc_utils.py:19-27)."""
+    return (np.array(arr1.shape) - np.array(arr2.shape) != 0).nonzero()
+
+
+def concatenate_dicts(dict_list):
+    """Concatenate same-keyed dicts of arrays; each key is concatenated along
+    its first mismatching axis, or axis 0 when shapes fully match
+    (misc_utils.py:30-46)."""
+    out = dict_list[0].copy()
+    for other in dict_list[1:]:
+        for k in out:
+            mism = np.array(find_unmatched_dim(out[k], other[k]))
+            axis = int(mism[0][0]) if mism.size else 0
+            out[k] = np.concatenate((out[k], other[k]), axis=axis)
+    return out
+
+
+def repeat_matrix(a, nb_repeats: int):
+    """Stack a 2-D matrix with itself ``nb_repeats`` times along a new third
+    axis (misc_utils.py:49-57; Fortran-order reshape semantics)."""
+    return np.tile(a, (1, nb_repeats)).reshape((a.shape[0], a.shape[1], -1), order="F")
+
+
+def truncated_eye(N: int, j: int, k: int = 0):
+    """N x N matrix with ``j`` consecutive ones on diagonal ``k``
+    (misc_utils.py:60-72) — the channel-selector used by the beamformer glue."""
+    return np.diag(np.concatenate((np.ones(j), np.zeros(N - j))), k=k)
+
+
+def trim_2d_array(mat, axis: int = 0, trim: str = "fb"):
+    """Drop all-zero leading ('f') / trailing ('b') slices of a 2-D array
+    along the *other* axis (misc_utils.py:75-100)."""
+    assert trim in ("f", "b", "fb"), "`trim` can only be 'f', 'b' or 'fb'."
+    nonzero = ~(mat == 0).all(axis=axis)
+    start = int(np.argmax(nonzero)) if "f" in trim else 0
+    stop = len(nonzero) - int(np.argmax(nonzero[::-1])) if "b" in trim else mat.shape[1 - axis]
+    return mat[start:stop, :] if axis else mat[:, start:stop]
+
+
+def bar_data(x_edges, x, y):
+    """Bin ``y`` by ``x`` against bin upper edges; per-bin nan-mean and 95% CI
+    for bar plots (misc_utils.py:103-115)."""
+    from disco_tpu.core.metrics import ci_wp
+
+    bins = [[] for _ in range(len(x_edges))]
+    for xi, yi in zip(x, y):
+        bins[int(np.argmax(~(xi > np.asarray(x_edges))))].append(yi)
+    means = np.array([np.nanmean(b) if b else np.nan for b in bins])
+    cis = np.array([ci_wp(np.asarray(b)) if b else np.nan for b in bins])
+    return means, cis
+
+
+def get_random_string(length: int) -> str:
+    """Random [A-Za-z0-9] run-name string (misc_utils.py:118-128)."""
+    chars = string.ascii_letters + string.digits
+    return "".join(secrets.choice(chars) for _ in range(length))
+
+
+def integerize(values):
+    """The reference's YAML convention (misc_utils.py:144-160): strings of
+    space-separated ints become int arrays, 'None' becomes None, other spaced
+    strings split into lists; applied recursively to dicts."""
+    if isinstance(values, dict):
+        return {k: integerize(v) for k, v in values.items()}
+    if isinstance(values, str):
+        try:
+            return np.array(values.split(" "), dtype=int)
+        except ValueError:
+            if values == "None":
+                return None
+            if " " in values:
+                return values.split(" ")
+    return values
+
+
+def yaml2dict(yaml_file):
+    """Load a YAML file and :func:`integerize` every value
+    (misc_utils.py:131-141)."""
+    with open(yaml_file) as fh:
+        params = yaml.safe_load(fh)
+    return {k: integerize(v) for k, v in params.items()}

@@ -1,0 +1,235 @@
+"""The flagship per-RIR enhancement entry point: load a dataset sample, run
+the two-step TANGO pipeline on device, evaluate, save.
+
+Capability parity with reference ``speech_enhancement/tango.py:460-641``
+(``main``): same idempotency guard, same results-directory layout
+(``results/{scenario}/{dset}/{save_dir}/{WAV,MASK,OIM,STFT/z,FIG}``), same
+pickled ``results_tango_* / results_mwf_*`` dicts with the same keys, so
+reference-side aggregation scripts read the outputs unchanged.
+
+Metric substitutions (documented, deliberate): BSS-eval SDR/SIR/SAR are the
+scale-invariant Le Roux decompositions of ``core.metrics.si_bss`` (the
+reference calls mir_eval's bss_eval_sources, an undeclared dependency);
+STOI is the native implementation in ``core.metrics.stoi``.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from pathlib import Path
+
+import numpy as np
+
+from disco_tpu.core.dsp import istft
+from disco_tpu.core.metrics import fw_sd, fw_snr, si_bss, stoi
+from disco_tpu.enhance.tango import oracle_masks, tango
+from disco_tpu.enhance.zexport import load_node_signals
+from disco_tpu.io.audio import read_wav, write_wav
+from disco_tpu.io.layout import DatasetLayout, case_of_rir, snr_dirname
+
+
+def load_input_signals(layout: DatasetLayout, rir: int, noise: str, snr_range, n_nodes=4, mics_per_node=4):
+    """Processed node signals + dry references + logged SNRs (reference
+    tango.py:55-111)."""
+    y, s, n = load_node_signals(layout, rir, noise, snr_range, n_nodes, mics_per_node)
+    s_dry, fs = read_wav(layout.dry_source("target", rir, 1))
+    n_dry, _ = read_wav(layout.dry_source("noise", rir, 2, noise=noise))
+    snr_path = layout.snr_log(snr_range, rir, noise)
+    rnd_snrs = np.load(snr_path) if snr_path.exists() else np.zeros(n_nodes)
+    return y, s, n, s_dry, n_dry, fs, rnd_snrs
+
+
+def results_root(scenario: str, dset: str, save_dir: str) -> Path:
+    return Path("results") / scenario / dset / save_dir
+
+
+def _node_metrics(y0, s0, n0, sh_t, s_dry, n_dry, sf_t, nf_t, fs):
+    """All metric variants for one node's enhanced output ``sh_t``
+    (tango.py:545-593): vs dry and convolved references, inputs and outputs."""
+    min_len = min(len(y0), len(sh_t), len(s_dry), len(n_dry))
+    sl = slice(fs, min_len)  # first second (lead silence) skipped
+    refs_dry = np.stack((s_dry[sl], n_dry[sl]), axis=1)
+    refs_cnv = np.stack((s0[sl], n0[sl]), axis=1)
+
+    sdr_dry, sir_dry, sar_dry = si_bss(sh_t[sl], refs_dry, 0)
+    sdr_cnv, sir_cnv, sar_cnv = si_bss(sh_t[sl], refs_cnv, 0)
+    sdr_in_dry, sir_in_dry, sar_in_dry = si_bss(y0[sl], refs_dry, 0)
+    sdr_in_cnv, sir_in_cnv, _ = si_bss(y0[sl], refs_cnv, 0)
+
+    stoi_in = stoi(s0[sl], y0[sl], fs)
+    stoi_in_dry = stoi(s_dry[sl], y0[sl], fs)
+    stoi_out = stoi(s0[sl], sh_t[sl], fs)
+    stoi_out_dry = stoi(s_dry[sl], sh_t[sl], fs)
+
+    _, fw_snr_out, _ = fw_snr(sf_t[sl], nf_t[sl], fs)
+    _, fw_snr_in_cnv, _ = fw_snr(s0[sl], n0[sl], fs)
+    _, fw_snr_in_dry, _ = fw_snr(s_dry[sl], n_dry[sl], fs)
+    _, fsd_cnv, _ = fw_sd(sf_t[sl], s0[sl], fs)
+    _, fsd_dry, _ = fw_sd(sf_t[sl], s_dry[sl], fs)
+
+    return {
+        "sdr_cnv": sdr_cnv, "sir_cnv": sir_cnv, "sar_cnv": sar_cnv,
+        "sdr_dry": sdr_dry, "sir_dry": sir_dry, "sar_dry": sar_dry,
+        "sdr_in_cnv": sdr_in_cnv, "sir_in_cnv": sir_in_cnv,
+        "sdr_in_dry": sdr_in_dry, "sir_in_dry": sir_in_dry, "sar_in_dry": sar_in_dry,
+        "delta_stoi_cnv": stoi_out - stoi_in, "delta_stoi_dry": stoi_out_dry - stoi_in_dry,
+        "snr_out": fw_snr_out, "snr_in_cnv": fw_snr_in_cnv, "snr_in_dry": fw_snr_in_dry,
+        "fw_sd_cnv": fsd_cnv, "fw_sd_dry": fsd_dry,
+    }
+
+
+def estimate_masks(Y, S, N, models, mask_type: str, n_nodes: int, mu: float = 1.0, z_sigs: str = "zs_hat"):
+    """Step-1 and step-2 masks, oracle or CRNN (reference tango.py:189-225,
+    387-394).  ``models`` is a 2-list; each entry is None (oracle) or a
+    ``(flax_module, variables)`` pair.  The step-2 CRNN consumes the local
+    reference channel plus the exchanged z streams, so step 1 runs first to
+    produce them (the staged flow of reference main:497-503)."""
+    import jax.numpy as jnp
+
+    oracle = oracle_masks(S, N, mask_type)
+    if models[0] is None:
+        masks_z = oracle
+    else:
+        from disco_tpu.enhance.inference import crnn_mask
+
+        model, variables = models[0]
+        masks_z = jnp.stack([jnp.asarray(crnn_mask(np.asarray(Y[k, 0]), model, variables)) for k in range(n_nodes)])
+    if models[1] is None:
+        mask_w = oracle
+    else:
+        from disco_tpu.enhance.inference import crnn_mask, get_z_for_mask
+        from disco_tpu.enhance.zexport import compute_z_signals
+
+        out = compute_z_signals(None, None, None, Y=Y, S=S, N=N, masks_z=masks_z, mu=mu)
+        model, variables = models[1]
+        mask_w = jnp.stack(
+            [
+                jnp.asarray(
+                    crnn_mask(
+                        np.asarray(Y[k, 0]), model, variables,
+                        z=get_z_for_mask(np.asarray(out["z_y"]), np.asarray(out["zn"]), k, n_nodes, z_sigs),
+                    )
+                )
+                for k in range(n_nodes)
+            ]
+        )
+    return masks_z, mask_w
+
+
+def enhance_rir(
+    root: str,
+    scenario: str,
+    rir: int,
+    noise: str,
+    save_dir: str = "tango",
+    snr_range=(0, 6),
+    mask_type: str = "irm1",
+    policy: str = "local",
+    models=(None, None),
+    mu: float = 1.0,
+    n_nodes: int = 4,
+    mics_per_node: int = 4,
+    out_root: str | None = None,
+    force: bool = False,
+    save_fig: bool = True,
+):
+    """Enhance one RIR end-to-end and persist everything (reference
+    tango.py:460-641).  ``models``: per-step CRNN params or None for the
+    oracle masks of ``mask_type``.  Returns the tango results dict, or None
+    when the RIR was already processed (idempotency)."""
+    import jax.numpy as jnp
+
+    from disco_tpu.core.dsp import stft
+
+    dset = "train" if rir <= 11000 else "test"  # tango.py:41-45 split
+    out = Path(out_root) if out_root is not None else results_root(scenario, dset, save_dir)
+    oim_marker = out / "OIM" / f"results_mwf_{rir}_{noise}.p"
+    if oim_marker.exists() and not force:
+        return None
+
+    layout = DatasetLayout(root, scenario, case_of_rir(rir))
+    y, s, n, s_dry, n_dry, fs, rnd_snrs = load_input_signals(
+        layout, rir, noise, snr_range, n_nodes, mics_per_node
+    )
+    L = y.shape[-1]
+
+    Y, S, N = stft(jnp.asarray(y)), stft(jnp.asarray(s)), stft(jnp.asarray(n))
+    masks_z, mask_w = estimate_masks(Y, S, N, models, mask_type, n_nodes, mu=mu)
+    res = tango(Y, S, N, masks_z, mask_w, mu=mu, policy=policy, mask_type=mask_type)
+
+    # Back to time domain (tango.py:528-539), trimmed to the input length.
+    sh_t = np.asarray(istft(res.yf, length=L))
+    szh_t = np.asarray(istft(res.z_y, length=L))
+    sf_t = np.asarray(istft(res.sf, length=L))
+    nf_t = np.asarray(istft(res.nf, length=L))
+    szf_t = np.asarray(istft(res.z_s, length=L))
+    nzf_t = np.asarray(istft(res.z_n, length=L))
+
+    for sub in ("WAV", "MASK", "OIM", "FIG"):
+        os.makedirs(out / sub, exist_ok=True)
+    (out / "WAV" / str(rir)).mkdir(exist_ok=True)
+    (out / "MASK" / str(rir)).mkdir(exist_ok=True)
+    zdir = out / "STFT" / "z" / "raw" / snr_dirname(snr_range)
+    os.makedirs(zdir, exist_ok=True)
+
+    per_node_tango, per_node_mwf = [], []
+    for k in range(n_nodes):
+        y0, s0, n0 = y[k, 0], s[k, 0], n[k, 0]
+        per_node_tango.append(_node_metrics(y0, s0, n0, sh_t[k], s_dry, n_dry, sf_t[k], nf_t[k], fs))
+        per_node_mwf.append(_node_metrics(y0, s0, n0, szh_t[k], s_dry, n_dry, szf_t[k], nzf_t[k], fs))
+
+        tag = f"{noise}_Node-{k + 1}"
+        write_wav(out / "WAV" / str(rir) / f"in_mix-{tag}.wav", y0, fs)
+        write_wav(out / "WAV" / str(rir) / f"out_mix-{tag}.wav", sh_t[k], fs)
+        write_wav(out / "WAV" / str(rir) / f"mid_z-{tag}.wav", szh_t[k], fs)
+        write_wav(out / "WAV" / str(rir) / f"in_noi-{tag}.wav", n0, fs)
+        write_wav(out / "WAV" / str(rir) / f"out_noi-{tag}.wav", nf_t[k], fs)
+        write_wav(out / "WAV" / str(rir) / f"in_tar-{tag}.wav", s0, fs)
+        write_wav(out / "WAV" / str(rir) / f"out_tar-{tag}.wav", sf_t[k], fs)
+        np.save(out / "MASK" / str(rir) / f"step1_{tag}", np.asarray(res.masks_z[k]))
+        np.save(out / "MASK" / str(rir) / f"step2_{tag}", np.asarray(res.mask_w[k]))
+        np.save(zdir / f"{rir}_{tag}", np.asarray(res.z_y[k]))
+
+    def stack_keys(dicts):
+        return {k: np.array([d[k] for d in dicts]) for k in dicts[0]}
+
+    results = {"snr_in_raw": rnd_snrs, **stack_keys(per_node_tango)}
+    resultsz = {"snr_in_raw": rnd_snrs, **stack_keys(per_node_mwf)}
+    with open(out / "OIM" / f"results_tango_{rir}_{noise}.p", "wb") as fh:
+        pickle.dump(results, fh)
+    with open(out / "OIM" / f"results_mwf_{rir}_{noise}.p", "wb") as fh:
+        pickle.dump(resultsz, fh)
+
+    if save_fig:
+        infos_path = layout.infos(rir)
+        if infos_path.exists():
+            try:
+                from disco_tpu.enhance.inference import plot_conf
+
+                fig = plot_conf(np.load(infos_path, allow_pickle=True).item(), return_fig=True)
+                fig.savefig(out / "FIG" / f"{rir}.png")
+                import matplotlib.pyplot as plt
+
+                plt.close(fig)
+            except Exception:
+                pass  # plotting is best-effort observability, never fatal
+    return results
+
+
+def aggregate_results(oim_dir, kind: str = "tango", noise: str | None = None):
+    """Collect per-RIR pickles into one dict of stacked arrays — the
+    aggregation the reference leaves to the user (SURVEY.md §5.5)."""
+    from disco_tpu.core.miscx import concatenate_dicts
+
+    oim_dir = Path(oim_dir)
+    pattern = f"results_{kind}_*"
+    dicts = []
+    for p in sorted(oim_dir.glob(pattern)):
+        if noise is not None and not p.stem.endswith(f"_{noise}"):
+            continue
+        with open(p, "rb") as fh:
+            d = pickle.load(fh)
+        dicts.append({k: np.atleast_1d(v) for k, v in d.items()})
+    if not dicts:
+        return {}
+    return concatenate_dicts(dicts)

@@ -22,18 +22,24 @@ from disco_tpu.io.audio import read_wav
 from disco_tpu.io.layout import DatasetLayout, case_of_rir
 
 
-def compute_z_signals(y, s, n, masks_z=None, mask_type: str = "irm1", mu: float = 1.0, oracle_stats: bool = False):
+def compute_z_signals(
+    y, s, n, masks_z=None, mask_type: str = "irm1", mu: float = 1.0, oracle_stats: bool = False,
+    Y=None, S=None, N=None,
+):
     """Step 1 over all nodes: (K, C, L) time signals → dict of (K, F, T)
     z streams (reference get_z_signals.py:213-317, vectorized).
 
     ``masks_z`` may be given explicitly (K, F, T) — e.g. CRNN-estimated —
     else oracle masks of ``mask_type`` are computed from S and N.  With
     explicit masks, ``s``/``n`` may be None (the clean-component streams
-    z_s/z_n then come out zero; export_z does not save them).
+    z_s/z_n then come out zero; export_z does not save them).  Precomputed
+    STFTs may be passed as ``Y``/``S``/``N`` to skip the transform.
     """
-    Y = stft(jnp.asarray(y))
-    S = stft(jnp.asarray(s)) if s is not None else jnp.zeros_like(Y)
-    N = stft(jnp.asarray(n)) if n is not None else jnp.zeros_like(Y)
+    Y = stft(jnp.asarray(y)) if Y is None else jnp.asarray(Y)
+    if S is None:
+        S = stft(jnp.asarray(s)) if s is not None else jnp.zeros_like(Y)
+    if N is None:
+        N = stft(jnp.asarray(n)) if n is not None else jnp.zeros_like(Y)
     if masks_z is None:
         if s is None or n is None:
             raise ValueError("either pass masks_z explicitly or provide s and n for oracle masks")

@@ -1,0 +1,54 @@
+"""Tests for the typed config tree (SURVEY.md §5.6 consolidation)."""
+import dataclasses
+
+import pytest
+
+from disco_tpu.config import (
+    ArrayConfig,
+    DiscoConfig,
+    StftConfig,
+    config_from_dict,
+    load_config,
+    save_config,
+)
+
+
+def test_defaults_match_reference_constants():
+    cfg = DiscoConfig()
+    assert cfg.stft.n_fft == 512 and cfg.stft.hop == 256 and cfg.stft.n_freq == 257
+    assert cfg.array.mics_per_node == (4, 4, 4, 4) and cfg.array.n_channels == 16
+    assert cfg.enhance.win_len == 21 and cfg.enhance.snr_range == ((0, 6),)
+    assert cfg.train.batch_size == 500 and cfg.train.lr == 1e-3
+    assert cfg.corpus.splits == (10000, 1000, 1000)
+    assert cfg.room.max_order == 20
+
+
+def test_yaml_roundtrip(tmp_path):
+    cfg = DiscoConfig(
+        root="/data/disco",
+        stft=StftConfig(n_fft=1024, hop=512),
+        array=ArrayConfig(mics_per_node=(2, 2)),
+    )
+    p = save_config(cfg, tmp_path / "cfg.yaml")
+    back = load_config(p)
+    assert back == cfg  # frozen dataclasses compare structurally
+
+
+def test_partial_dict_applies_defaults():
+    cfg = config_from_dict({"stft": {"n_fft": 256}})
+    assert cfg.stft.n_fft == 256
+    assert cfg.stft.hop == 256  # default preserved
+    assert cfg.array.n_nodes == 4
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(ValueError, match="unknown keys"):
+        config_from_dict({"stft": {"nfft": 256}})
+    with pytest.raises(ValueError, match="unknown config section"):
+        config_from_dict({"sftf": {}})
+
+
+def test_frozen():
+    cfg = DiscoConfig()
+    with pytest.raises(dataclasses.FrozenInstanceError):
+        cfg.root = "x"

@@ -272,3 +272,42 @@ def test_case_of_rir_split():
     assert case_of_rir(12000) == "test"
     with pytest.raises(AssertionError):
         case_of_rir(12001)
+
+
+# ----------------------------------------------------------------- STOI
+def test_stoi_identity_is_one():
+    from disco_tpu.core.metrics import stoi
+
+    rng = np.random.default_rng(0)
+    fs = 16000
+    t = np.arange(3 * fs) / fs
+    # speech-like: broadband noise with slow envelope modulation
+    s = rng.standard_normal(len(t)) * (1 + 0.8 * np.sin(2 * np.pi * 4 * t))
+    assert stoi(s, s, fs) == pytest.approx(1.0, abs=1e-6)
+
+
+def test_stoi_monotonic_in_snr():
+    from disco_tpu.core.metrics import stoi
+
+    rng = np.random.default_rng(1)
+    fs = 16000
+    t = np.arange(3 * fs) / fs
+    s = rng.standard_normal(len(t)) * (1 + 0.8 * np.sin(2 * np.pi * 4 * t))
+    n = rng.standard_normal(len(s))
+    vals = []
+    for snr_db in (20, 5, -10):
+        y = s + n * np.sqrt(np.var(s) / np.var(n)) * 10 ** (-snr_db / 20)
+        vals.append(stoi(s, y, fs))
+    assert vals[0] > vals[1] > vals[2]
+    assert 0.0 <= vals[2] < vals[0] <= 1.0
+
+
+def test_stoi_extended_mode():
+    from disco_tpu.core.metrics import stoi
+
+    rng = np.random.default_rng(2)
+    fs = 10000  # no resampling path
+    s = rng.standard_normal(3 * fs)
+    y = s + 0.3 * rng.standard_normal(len(s))
+    d = stoi(s, y, fs, extended=True)
+    assert 0.0 < d <= 1.0

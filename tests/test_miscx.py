@@ -1,0 +1,106 @@
+"""Tests for disco_tpu.core.miscx (reference misc_utils.py parity)."""
+import numpy as np
+import pytest
+
+from disco_tpu.core.miscx import (
+    bar_data,
+    channel_range_of_node,
+    concatenate_dicts,
+    find_unmatched_dim,
+    get_node_from_channel,
+    get_random_string,
+    integerize,
+    repeat_matrix,
+    trim_2d_array,
+    truncated_eye,
+    yaml2dict,
+)
+
+
+@pytest.mark.parametrize(
+    "ch,geo,node",
+    [(0, [4, 4, 4, 4], 0), (3, [4, 4, 4, 4], 0), (4, [4, 4, 4, 4], 1), (15, [4, 4, 4, 4], 3), (2, [1, 2, 3], 1)],
+)
+def test_get_node_from_channel(ch, geo, node):
+    assert get_node_from_channel(ch, geo) == node
+
+
+def test_channel_range_roundtrip():
+    geo = [4, 2, 4, 6]
+    for node in range(len(geo)):
+        start, stop = channel_range_of_node(node, geo)
+        assert stop - start == geo[node]
+        for ch in range(start, stop):
+            assert get_node_from_channel(ch, geo) == node
+
+
+def test_find_unmatched_dim():
+    a, b = np.zeros((3, 5, 2)), np.zeros((3, 7, 2))
+    (dims,) = find_unmatched_dim(a, b)
+    assert list(dims) == [1]
+
+
+def test_concatenate_dicts_mismatched_axis():
+    d1 = {"x": np.ones((2, 3)), "y": np.zeros((4,))}
+    d2 = {"x": np.ones((2, 5)), "y": np.zeros((4,))}
+    out = concatenate_dicts([d1, d2])
+    assert out["x"].shape == (2, 8)
+    assert out["y"].shape == (8,)
+
+
+def test_repeat_matrix_fortran_order():
+    a = np.arange(6).reshape(2, 3)
+    b = repeat_matrix(a, 4)
+    assert b.shape == (2, 3, 4)
+    for r in range(4):
+        np.testing.assert_array_equal(b[:, :, r], a)
+
+
+@pytest.mark.parametrize("N,j,k", [(5, 3, 0), (4, 2, 1), (6, 6, 0)])
+def test_truncated_eye(N, j, k):
+    m = truncated_eye(N, j, k)
+    assert m.shape == (N + abs(k), N + abs(k)) if k else (N, N)
+    assert m.sum() == j
+    assert np.all(np.diag(m, k=k)[:j] == 1)
+
+
+def test_trim_2d_array():
+    m = np.zeros((3, 7))
+    m[:, 2:5] = 1.0
+    np.testing.assert_array_equal(trim_2d_array(m, axis=0, trim="fb"), m[:, 2:5])
+    np.testing.assert_array_equal(trim_2d_array(m, axis=0, trim="f"), m[:, 2:])
+    np.testing.assert_array_equal(trim_2d_array(m, axis=0, trim="b"), m[:, :5])
+    mt = m.T
+    np.testing.assert_array_equal(trim_2d_array(mt, axis=1, trim="fb"), mt[2:5, :])
+
+
+def test_bar_data():
+    x_edges = np.array([1.0, 2.0, 3.0])
+    x = np.array([0.5, 1.5, 1.7, 2.5])
+    y = np.array([10.0, 20.0, 30.0, 40.0])
+    means, cis = bar_data(x_edges, x, y)
+    assert means[0] == 10.0
+    assert means[1] == 25.0
+    assert means[2] == 40.0
+
+
+def test_get_random_string():
+    s = get_random_string(12)
+    assert len(s) == 12 and s.isalnum()
+
+
+def test_integerize_conventions():
+    np.testing.assert_array_equal(integerize("4 4 4 4"), np.array([4, 4, 4, 4]))
+    assert integerize("None") is None
+    assert integerize("a b") == ["a", "b"]
+    assert integerize("plain") == "plain"
+    assert integerize({"n": "1 2"})["n"].tolist() == [1, 2]
+
+
+def test_yaml2dict(tmp_path):
+    p = tmp_path / "c.yaml"
+    p.write_text("geo: 4 4 4 4\nname: run\nnothing: None\n")
+    d = yaml2dict(p)
+    assert d["geo"].tolist() == [4, 4, 4, 4]
+    assert d["name"] == "run"
+    assert d["nothing"] is None
