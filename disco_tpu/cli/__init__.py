@@ -1,0 +1,20 @@
+"""Command-line entry points — the L3/L5 argparse surface of the reference
+(SURVEY.md §3), one module per reference CLI:
+
+=====================  ===============================================
+``disco-gen``          gen_disco/convolve_signals.py (room simulation)
+``disco-gen-meetit``   gen_meetit/convolve_signals.py
+``disco-mix``          gen_disco/mix_convolved_signals.py (PostGenerator)
+``disco-tango``        speech_enhancement/tango.py (enhancement)
+``disco-get-z``        speech_enhancement/get_z_signals.py (z export)
+``disco-train``        dnn/engine/train.py (CRNN training)
+``disco-lists``        dnn/data/lists_to_load.py (input lists)
+=====================  ===============================================
+
+Every corpus-scale CLI takes ``--rirs start count`` and is idempotent, so
+cluster job arrays shard the corpus exactly as the reference does
+(SURVEY.md §2.9 data-parallel row).
+"""
+from disco_tpu.cli import gen_disco, gen_meetit, get_z, lists, mix, tango, train
+
+__all__ = ["gen_disco", "gen_meetit", "get_z", "lists", "mix", "tango", "train"]

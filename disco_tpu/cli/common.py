@@ -1,0 +1,35 @@
+"""Shared CLI conventions of the reference's argparse entry points:
+the ``'None'``-string -> None convention (tango.py:682-688, train.py:63-65)
+and the ``--rirs start count`` pair every corpus-scale CLI takes for
+embarrassingly-parallel job arrays (SURVEY.md §2.9 DP row)."""
+from __future__ import annotations
+
+
+def none_str(v):
+    """argparse type honoring the reference's 'None' string convention."""
+    return None if v in (None, "None", "none") else v
+
+
+def add_rirs_arg(parser, default=(1, 1)):
+    parser.add_argument(
+        "--rirs", "-r", nargs=2, type=int, default=list(default),
+        help="First RIR id and number of RIRs to process (job-array sharding)",
+    )
+
+
+def add_scenario_arg(parser, default="random", choices=("random", "living", "meeting")):
+    parser.add_argument(
+        "--scenario", "-s", type=str, choices=list(choices), default=default,
+        help="Spatial configuration",
+    )
+
+
+def add_noise_arg(parser, default="ssn", choices=("ssn", "fs", "it")):
+    parser.add_argument("--noise", "-n", type=str, choices=list(choices), default=default)
+
+
+def snr_value(v: str):
+    """SNR bound argparse type: int when integral so snr directory names
+    match the reference's '0-6' convention (post_generator.py:66-68)."""
+    f = float(v)
+    return int(f) if f == int(f) else f

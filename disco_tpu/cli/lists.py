@@ -1,0 +1,46 @@
+"""Input-lists CLI — build and persist the per-signal .npy path lists used
+for training and for rsync-style dataset staging.
+
+Mirrors reference ``dnn/data/lists_to_load.py:43-89`` (write txt lists
+consumable by ``rsync --files-from``, reference exp/ex1/oar_train.sh:28-45).
+"""
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+from disco_tpu.nn.data import get_input_lists, write_input_lists
+
+
+def build_parser():
+    p = argparse.ArgumentParser(description="Write training input file lists")
+    p.add_argument("--scene", nargs="+", default=["living"])
+    p.add_argument("--noise", default="ssn")
+    p.add_argument("--zsigs", "-zs", nargs="+", default=["zs_hat"])
+    p.add_argument("--zfile", "-zf", default="oracle")
+    p.add_argument("--n_files", "-n", type=int, default=11001)
+    p.add_argument("--path_data", "-path", default="dataset/disco/")
+    p.add_argument("--out", "-o", default="lists/", help="folder for the txt lists")
+    p.add_argument("--seed", type=int, default=26)
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    lists = get_input_lists(
+        args.path_data,
+        rirs_to_get=range(1, args.n_files),
+        scenes=args.scene,
+        noise_to_get=args.noise,
+        z_sigs=args.zsigs,
+        z_file=args.zfile,
+        rng=np.random.default_rng(args.seed),
+    )
+    write_input_lists(lists, args.out)
+    print(f"wrote {len(lists)} lists ({len(lists[0])} entries each) to {args.out}")
+    return lists
+
+
+if __name__ == "__main__":
+    main()

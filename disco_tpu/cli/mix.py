@@ -1,0 +1,38 @@
+"""Mixing-pass CLI (PostGenerator) — target + noise at random SNR, STFTs,
+ideal masks.
+
+Mirrors reference ``gen_disco/mix_convolved_signals.py:9-33`` (the
+``args.scene`` vs ``--scenario`` flag-mismatch bug is not reproduced,
+SURVEY.md §7)."""
+from __future__ import annotations
+
+import argparse
+
+from disco_tpu.cli.common import add_noise_arg, add_rirs_arg, add_scenario_arg, snr_value
+from disco_tpu.datagen.postgen import PostGenerator
+
+
+def build_parser():
+    p = argparse.ArgumentParser(description="Mix convolved signals into the processed corpus")
+    add_rirs_arg(p)
+    add_scenario_arg(p)
+    add_noise_arg(p)
+    p.add_argument("--dir", "-d", dest="root", default="dataset/disco/", help="corpus root")
+    p.add_argument("--snr", nargs=2, type=snr_value, default=[0, 6], help="mixture SNR range (tango.py:37)")
+    p.add_argument("--no_target", action="store_true", help="skip saving clean target outputs")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    rir_start, n_rirs = args.rirs
+    pg = PostGenerator(
+        rir_start, n_rirs, args.scenario, args.noise, args.snr, args.root,
+        save_target=not args.no_target,
+    )
+    pg.post_process()
+    return pg
+
+
+if __name__ == "__main__":
+    main()

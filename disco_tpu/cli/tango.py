@@ -1,0 +1,69 @@
+"""TANGO enhancement CLI — the flagship per-RIR entry point.
+
+Mirrors reference ``speech_enhancement/tango.py:644-692`` (flags
+--vad_type/--sav_dir/--rir/--scenario/--noise/--mask_z/--mods/--zsigs and
+the 'None'-string convention).  Unlike the reference module — unimportable
+as shipped due to ``heymann``/``ipdb`` imports (SURVEY.md §7) — this one
+imports and runs."""
+from __future__ import annotations
+
+import argparse
+
+from disco_tpu.cli.common import none_str, snr_value
+from disco_tpu.enhance.driver import enhance_rir
+
+_POLICIES = ["None", "local", "distant", "compressed", "use_oracle_refs", "use_oracle_zs"]
+
+
+def build_parser():
+    p = argparse.ArgumentParser(description="Two-step distributed GEVD-MWF (TANGO) enhancement")
+    p.add_argument("--vad_type", "-vt", nargs=2, default=["irm1", "irm1"],
+                   help="mask type per step: irm1/ibm1/iam/... (tango.py:189-225)")
+    p.add_argument("--sav_dir", "-sd", default="tango", help="results subfolder")
+    p.add_argument("--rir", type=int, required=True, help="RIR id of the sample to filter")
+    p.add_argument("--scenario", "-scene", choices=["living", "meeting", "random"], default="living")
+    p.add_argument("--noise", choices=["ssn", "it", "fs"], default="fs")
+    p.add_argument("--mask_z", "-mz", choices=_POLICIES, default="local",
+                   help="mask applied to the exchanged z's in step 2")
+    p.add_argument("--mods", "-m", nargs=2, default=["None", "None"],
+                   help="paths to trained CRNN checkpoints per step, or None for oracle")
+    p.add_argument("--zsigs", "-zs", nargs="+", default=["zs_hat"])
+    p.add_argument("--dataset", default="dataset/disco/", help="corpus root")
+    p.add_argument("--snr", nargs=2, type=snr_value, default=[0, 6])
+    p.add_argument("--out_root", default=None, help="override results directory")
+    return p
+
+
+def _load_model(path):
+    if none_str(path) is None:
+        return None
+    from disco_tpu.nn.crnn import build_crnn
+    from disco_tpu.nn.training import TrainState, create_train_state, load_params_for_inference
+
+    model, tx = build_crnn(n_ch=1)
+    import numpy as np
+
+    state = create_train_state(model, tx, np.zeros((1, 1, 21, 257), "float32"))
+    state = load_params_for_inference(path, state)
+    return (model, {"params": state.params, "batch_stats": state.batch_stats})
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    policy = none_str(args.mask_z) or "none"
+    models = (_load_model(args.mods[0]), _load_model(args.mods[1]))
+    results = enhance_rir(
+        args.dataset, args.scenario, args.rir, args.noise,
+        save_dir=args.sav_dir, snr_range=tuple(args.snr),
+        mask_type=args.vad_type[0], policy=policy, models=models,
+        out_root=args.out_root,
+    )
+    if results is None:
+        print(f"Conf {args.rir} with {args.noise} noise already processed")
+    else:
+        print(f"{args.rir} done")
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,105 @@
+"""CRNN training CLI.
+
+Mirrors reference ``dnn/engine/train.py:19-158`` (flags --scene/--noise/
+--zsigs/--weights/--files_to_load/--zfile/--n_files/--n_epochs/--path_data,
+hard-coded hyperparameters train.py:66-85), with the flax/optax training
+stack: jitted train/eval steps, SaveAndStop best-checkpoint gating, early
+stop and resume."""
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+from disco_tpu.cli.common import none_str
+from disco_tpu.config import TrainConfig
+from disco_tpu.nn.crnn import build_crnn
+from disco_tpu.nn.data import (
+    DiscoDataset,
+    batch_iterator,
+    get_input_lists,
+    load_input_lists,
+)
+from disco_tpu.nn.training import create_train_state, fit
+
+
+def build_parser():
+    p = argparse.ArgumentParser(description="Train the mask-estimation CRNN")
+    p.add_argument("--scene", default="living")
+    p.add_argument("--noise", choices=["ssn", "it", "fs", "noit", "all"], default="ssn")
+    p.add_argument("--zsigs", "-zs", nargs="+", default=["zs_hat"])
+    p.add_argument("--weights", "-w", default="None", help="resume checkpoint path")
+    p.add_argument("--files_to_load", "-f2l", default="None", help="folder of persisted input lists")
+    p.add_argument("--zfile", "-zf", default="oracle", help="z export name under stft_z/")
+    p.add_argument("--n_files", "-n", type=int, default=11001, help="number of training sequences")
+    p.add_argument("--n_epochs", "-epo", type=int, default=150)
+    p.add_argument("--path_data", "-path", default="dataset/disco/")
+    p.add_argument("--save_path", default="models/")
+    p.add_argument("--batch_size", type=int, default=None, help="override the canonical 500")
+    p.add_argument("--single_channel", "-sc", action="store_true",
+                   help="train the step-1 single-channel model (no z inputs)")
+    p.add_argument("--seed", type=int, default=26, help="train.py:20 seed")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    cfg = TrainConfig()
+    rng = np.random.default_rng(args.seed)
+
+    z_sigs = None if args.single_channel else args.zsigs
+    if none_str(args.files_to_load) is not None:
+        lists = load_input_lists(args.files_to_load)
+    else:
+        lists = get_input_lists(
+            args.path_data,
+            rirs_to_get=range(1, args.n_files),
+            scenes=[args.scene],
+            noise_to_get=args.noise,
+            z_sigs=z_sigs,
+            z_file=args.zfile,
+            rng=rng,
+        )
+
+    # single-channel: stack_axis 0; multichannel: z's on the channel axis
+    # (3-D CRNN input, reference train.py:73-74)
+    stack_axis = 0 if args.single_channel else 2
+    dataset = DiscoDataset(
+        lists, stack_axis=stack_axis, win_len=cfg.win_len, win_hop=cfg.win_hop, rng=rng
+    )
+    n_val = max(1, int(cfg.val_split * len(dataset)))
+    idx = rng.permutation(len(dataset))
+    val_idx, train_idx = idx[:n_val], idx[n_val:]
+    batch = args.batch_size or cfg.batch_size
+
+    def subset_batches(indices, shuffle):
+        def gen():
+            order = rng.permutation(indices) if shuffle else indices
+            for start in range(0, len(order), batch):
+                sel = order[start : start + batch]
+                xs, ys = zip(*(dataset[int(i)] for i in sel))
+                yield np.stack(xs), np.stack(ys)
+
+        return gen
+
+    n_ch = 1 if args.single_channel else 1 + dataset.z_nodes
+    model, tx = build_crnn(n_ch=n_ch, win_len=cfg.win_len, n_freq=cfg.ff_units, learning_rate=cfg.lr)
+    x0, _ = dataset[0]
+    state = create_train_state(model, tx, x0[None], seed=args.seed)
+
+    state, train_losses, val_losses, run_name = fit(
+        model, state,
+        subset_batches(train_idx, shuffle=True),
+        subset_batches(val_idx, shuffle=False),
+        n_epochs=args.n_epochs,
+        save_path=args.save_path,
+        output_frames=cfg.output_frames,
+        resume_from=none_str(args.weights),
+        patience=cfg.early_stop_patience,
+    )
+    print(f"run {run_name}: best val loss {np.nanmin(val_losses):.6f}")
+    return run_name
+
+
+if __name__ == "__main__":
+    main()

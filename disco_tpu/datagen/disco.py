@@ -305,3 +305,49 @@ def generate_disco_rirs(
         generated.append(rir_id)
         i_file += 1
     return generated
+
+
+def get_wavs_list(librispeech_root, freesound_root=None, dset="train", cache_dir=None, seed=30):
+    """Deterministically shuffled corpus file lists (convolve_signals.py:32-81):
+    train targets from train-clean-100, SSN talkers from train-clean-360,
+    test targets from test-clean; optional freesound noise files.  The fixed
+    seed makes every parallel process see the same order (SURVEY.md §5.2);
+    lists are cached as txt so restarts and job arrays agree.
+
+    Returns (target_list, talkers_list, noises_dict).
+    """
+    import glob
+    import os
+
+    def listing(name, subdir):
+        if cache_dir is not None:
+            cache = os.path.join(cache_dir, f"{name}.txt")
+            if os.path.isfile(cache):
+                with open(cache) as fh:
+                    return [ln.strip() for ln in fh if ln.strip()]
+        pats = ("*.wav", "*.flac")
+        files = sorted(
+            f for pat in pats for f in glob.glob(os.path.join(subdir, "**", pat), recursive=True)
+        )
+        np.random.default_rng(seed).shuffle(files)
+        if cache_dir is not None and files:
+            os.makedirs(cache_dir, exist_ok=True)
+            with open(os.path.join(cache_dir, f"{name}.txt"), "w") as fh:
+                fh.write("\n".join(files))
+        return files
+
+    if dset in ("train", "val"):  # val RIRs live inside the train corpus
+        targets = listing("train_targets", os.path.join(librispeech_root, "train-clean-100"))
+        talkers = listing("train_talkers", os.path.join(librispeech_root, "train-clean-360"))
+    else:
+        targets = listing("test_targets", os.path.join(librispeech_root, "test-clean"))
+        talkers = listing("test_talkers", os.path.join(librispeech_root, "train-clean-360"))
+    if not targets:  # flat directory fallback (synthetic/test corpora)
+        targets = listing("targets_flat", str(librispeech_root))
+    talkers = talkers or targets  # SSN needs talker material even without train-clean-360
+    noises = {}
+    if freesound_root is not None:
+        fs_files = listing("freesound", str(freesound_root))
+        if fs_files:
+            noises["fs"] = fs_files
+    return targets, talkers, noises

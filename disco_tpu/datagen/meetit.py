@@ -167,6 +167,76 @@ def save_meetit_scene(scene: MeetitScene, infos, rir_id, layout: DatasetLayout, 
             p = base / "wav" / "clean" / "cnv" / f"{rir_id}_S-{i_s + 1}_Ch-{ch + 1}.wav"
             layout.ensure_dir(p)
             write_wav(p, scene.images[i_s, ch], fs)
-    info_path = base / "log" / "infos" / f"{rir_id}.npy"
+    # infos is written LAST: it doubles as the idempotency marker, so a
+    # crash mid-save leaves a restartable (not silently-skipped) RIR.
+    info_path = layout.infos(rir_id)
     layout.ensure_dir(info_path)
     np.save(info_path, infos, allow_pickle=True)
+
+
+def generate_meetit_rirs(
+    n_sources: int,
+    dset: str,
+    rir_start: int,
+    n_rirs: int,
+    signal_setup,
+    layout: DatasetLayout,
+    rng=None,
+    max_order: int = 20,
+    fs: int = 16000,
+    max_redraws: int = 200,
+):
+    """The per-RIR-range MEETIT generation driver (gen_meetit:210-302):
+    idempotent per RIR, SIR-histogram-balanced redraw loop, node count ==
+    source count.  Returns the list of RIR ids actually generated."""
+    from disco_tpu.sim import make_setup
+
+    rng = np.random.default_rng() if rng is None else rng
+    mics_per_node = [4] * n_sources
+    sampler = make_setup("meetit", rng=rng, n_sensors_per_node=tuple(mics_per_node), n_sources=n_sources)
+    generated, past_sirs = [], []
+
+    for rir_id in range(rir_start, rir_start + n_rirs):
+        if layout.infos(rir_id).exists():
+            continue  # idempotency guard (gen_meetit:272, SURVEY.md §5.3)
+        scene = None
+        for _ in range(max_redraws):
+            cfg = sampler.create_room_setup()
+            out = simulate_meetit_room(
+                cfg, signal_setup, dset, mics_per_node,
+                past_sirs=past_sirs, n_rirs_per_proc=n_rirs,
+                max_order=max_order, fs=fs, rng=rng,
+            )
+            if out == "redraw_room_setup":
+                continue
+            scene = out
+            break
+        if scene is None:
+            raise RuntimeError(f"RIR {rir_id}: no valid room after {max_redraws} redraws")
+        past_sirs.append(scene.sirs)
+        infos = {
+            "room": {
+                "length": float(scene.setup.room_dim[0]),
+                "width": float(scene.setup.room_dim[1]),
+                "height": float(scene.setup.room_dim[2]),
+                "alpha": scene.setup.alpha,
+                "rt60": scene.setup.beta,
+            },
+            "mics": np.asarray(scene.setup.mic_positions),
+            "sources": np.asarray(scene.setup.source_positions),
+            "sirs": scene.sirs,
+        }
+        # masks/STFTs first, then save_meetit_scene (whose infos write is the
+        # idempotency marker) — a crash mid-RIR stays restartable.
+        mix, masks = get_masks(scene.images, mics_per_node)
+        for ch in range(mix.shape[0]):
+            p = layout.base / "stft" / "mix" / f"{rir_id}_Ch-{ch + 1}.npy"
+            layout.ensure_dir(p)
+            np.save(p, mix[ch].astype("complex64"))
+            for i_s in range(masks.shape[0]):
+                p = layout.base / "mask" / f"{rir_id}_S-{i_s + 1}_Ch-{ch + 1}.npy"
+                layout.ensure_dir(p)
+                np.save(p, masks[i_s, ch].astype("float32"))
+        save_meetit_scene(scene, infos, rir_id, layout, fs=fs)
+        generated.append(rir_id)
+    return generated

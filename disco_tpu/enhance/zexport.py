@@ -92,6 +92,7 @@ def export_z(
     zfile: str = "oracle",
     mask_type: str = "irm1",
     masks_z=None,
+    masks_fn=None,
     n_nodes: int = 4,
     mics_per_node: int = 4,
     force: bool = False,
@@ -99,17 +100,26 @@ def export_z(
     """Export z's for one RIR; returns False if already done (idempotency
     guard of reference get_z_signals.py:328-331, with the reference's
     missing-'.npy' stale-check bug fixed per SURVEY.md §7).
+
+    ``masks_fn``: optional callable (K, C, F, T) mixture STFT -> (K, F, T)
+    step-1 masks (the CRNN path of reference get_z_signals.py:95-120);
+    ``masks_z`` passes them precomputed.  With neither, oracle masks of
+    ``mask_type`` are used.
     """
     layout = DatasetLayout(root, scenario, case_of_rir(rir))
     done_marker = layout.stft_z(zfile, snr_range, "zn_hat", rir, n_nodes, noise, normed=True)
     if done_marker.exists() and not force:
         return False
 
-    if masks_z is None:
+    if masks_z is None and masks_fn is None:
         y, s, n = load_node_signals(layout, rir, noise, snr_range, n_nodes, mics_per_node)
     else:  # explicit masks: the 32 target/noise wav reads are not needed
         y, s, n = load_mixture_signals(layout, rir, noise, snr_range, n_nodes, mics_per_node), None, None
-    out = compute_z_signals(y, s, n, masks_z=masks_z, mask_type=mask_type)
+    Y = None
+    if masks_fn is not None and masks_z is None:
+        Y = stft(jnp.asarray(y))
+        masks_z = masks_fn(Y)
+    out = compute_z_signals(y, s, n, masks_z=masks_z, mask_type=mask_type, Y=Y)
     zs = np.asarray(out["z_y"]).astype("complex64")  # zs_hat = compressed mixture
     zn = np.asarray(out["zn"]).astype("complex64")  # zn_hat = y_ref − z
 

@@ -1,0 +1,127 @@
+"""End-to-end CLI tests: the argparse mains drive the same three-stage
+filesystem pipeline as the reference's job arrays (generate → mix →
+enhance / export-z), on a tiny synthetic corpus."""
+import numpy as np
+import pytest
+
+from disco_tpu.cli import gen_disco, gen_meetit, get_z, lists, mix, tango
+from disco_tpu.io import DatasetLayout, read_wav, write_wav
+
+FS = 16000
+
+
+@pytest.fixture(scope="module")
+def speech_corpus(tmp_path_factory):
+    """Flat LibriSpeech-style folder with speaker/chapter structure."""
+    root = tmp_path_factory.mktemp("libri")
+    rng = np.random.default_rng(0)
+    files = []
+    for spk in ("19", "26", "32"):
+        d = root / "train-clean-100" / spk / "1"
+        d.mkdir(parents=True)
+        f = d / f"{spk}-1-0001.wav"
+        t = np.arange(6 * FS) / FS
+        env = (np.sin(2 * np.pi * 1.3 * t + float(spk)) > -0.3).astype(np.float64)
+        write_wav(f, 0.3 * env * rng.standard_normal(len(t)), FS)
+        files.append(f)
+        # mirror into the other splits so train/test globs both find speech
+        for split in ("train-clean-360", "test-clean"):
+            d2 = root / split / spk / "1"
+            d2.mkdir(parents=True)
+            write_wav(d2 / f"{spk}-1-0001.wav", 0.3 * env * rng.standard_normal(len(t)), FS)
+    return root
+
+
+@pytest.fixture(scope="module")
+def generated(tmp_path_factory, speech_corpus):
+    """disco-gen then disco-mix over one RIR — module-scoped: CLI pipeline
+    state shared by the dependent tests."""
+    out = tmp_path_factory.mktemp("dataset")
+    done = gen_disco.main([
+        "--dset", "train", "--scenario", "random", "--rirs", "1", "1",
+        "--dir_out", str(out), "--librispeech", str(speech_corpus),
+        "--max_order", "6",
+    ])
+    assert done == [1]
+    mix.main([
+        "--rirs", "1", "1", "--scenario", "random", "--noise", "ssn",
+        "--dir", str(out), "--snr", "0", "6",
+    ])
+    return out
+
+
+def test_gen_and_mix_outputs(generated):
+    lay = DatasetLayout(str(generated), "random", "train")
+    assert (lay.base / "wav_original" / "dry" / "target" / "1_S-1.wav").exists()
+    mix_wav, _ = read_wav(lay.wav_processed([0, 6], "mixture", 1, 1, noise="ssn"))
+    assert len(mix_wav) > FS
+
+
+def test_gen_idempotent(generated, speech_corpus):
+    # second run must skip the existing RIR
+    done = gen_disco.main([
+        "--dset", "train", "--scenario", "random", "--rirs", "1", "1",
+        "--dir_out", str(generated), "--librispeech", str(speech_corpus),
+        "--max_order", "6",
+    ])
+    assert done == []
+
+
+def test_get_z_cli(generated):
+    n = get_z.main([
+        "--rir", "1", "--scenario", "random", "--noise", "ssn",
+        "--dataset", str(generated), "--sav_dir", "oracle",
+    ])
+    assert n == 1
+    lay = DatasetLayout(str(generated), "random", "train")
+    z = np.load(lay.stft_z("oracle", [0, 6], "zs_hat", 1, 1, "ssn"))
+    assert z.dtype == np.complex64 and z.ndim == 2
+    # idempotent second run
+    assert get_z.main([
+        "--rir", "1", "--scenario", "random", "--noise", "ssn",
+        "--dataset", str(generated), "--sav_dir", "oracle",
+    ]) == 0
+
+
+def test_tango_cli(generated, tmp_path):
+    results = tango.main([
+        "--rir", "1", "--scenario", "random", "--noise", "ssn",
+        "--dataset", str(generated), "--sav_dir", "t1",
+        "--out_root", str(tmp_path / "results"),
+    ])
+    assert results is not None and "sdr_cnv" in results
+    assert (tmp_path / "results" / "OIM" / "results_tango_1_ssn.p").exists()
+
+
+def test_lists_cli(generated, tmp_path):
+    out = lists.main([
+        "--scene", "random", "--noise", "ssn", "--n_files", "2",
+        "--path_data", str(generated), "--out", str(tmp_path / "lists"),
+    ])
+    assert len(out) == 12  # 4 refs + 4 z + 4 masks
+    assert (tmp_path / "lists" / "list_0.txt").exists()
+
+
+def test_gen_meetit_cli(tmp_path, speech_corpus):
+    out = tmp_path / "meetit"
+    done = gen_meetit.main([
+        "--dset", "train", "--rirs", "3", "1", "--n_src", "2",
+        "--dir_out", str(out), "--librispeech", str(speech_corpus),
+        "--max_order", "4", "--duration", "3", "5",
+    ])
+    assert done == [3]
+    lay = DatasetLayout(str(out), "meetit", "train")
+    assert (lay.base / "wav" / "clean" / "dry" / "3_S-1.wav").exists()
+    assert (lay.base / "mask" / "3_S-2_Ch-8.npy").exists()
+
+
+def test_train_cli_single_channel(generated, tmp_path):
+    from disco_tpu.cli import train
+
+    run_name = train.main([
+        "--scene", "random", "--noise", "ssn", "--n_files", "2",
+        "--path_data", str(generated), "--save_path", str(tmp_path / "models"),
+        "--n_epochs", "1", "--batch_size", "16", "--single_channel",
+    ])
+    assert isinstance(run_name, str) and len(run_name) >= 4
+    assert any((tmp_path / "models").iterdir())
