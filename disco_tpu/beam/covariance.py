@@ -12,13 +12,21 @@ import jax
 import jax.numpy as jnp
 
 
-@jax.jit
-def frame_mean_covariance(a: jnp.ndarray, b: jnp.ndarray | None = None) -> jnp.ndarray:
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("axis_name",))
+def frame_mean_covariance(
+    a: jnp.ndarray, b: jnp.ndarray | None = None, axis_name: str | None = None
+) -> jnp.ndarray:
     """Frame-averaged spatial covariance.
 
     Args:
       a: STFT stack, shape (..., C, F, T).
       b: optional second stack for cross-covariance (defaults to ``a``).
+      axis_name: when the frame axis is sharded over a mesh axis (sequence
+        parallelism, SURVEY.md §5.7), pass that axis name — local partial
+        sums are ``psum``-reduced so every shard gets the global mean.
 
     Returns:
       (..., F, C, C) complex covariance: ``mean_t a[...,c,f,t] conj(b[...,d,f,t])``
@@ -26,7 +34,11 @@ def frame_mean_covariance(a: jnp.ndarray, b: jnp.ndarray | None = None) -> jnp.n
     """
     b = a if b is None else b
     T = a.shape[-1]
-    return jnp.einsum("...cft,...dft->...fcd", a, jnp.conj(b)) / T
+    cov = jnp.einsum("...cft,...dft->...fcd", a, jnp.conj(b))
+    if axis_name is not None:
+        cov = jax.lax.psum(cov, axis_name)
+        T = T * jax.lax.psum(1, axis_name)
+    return cov / T
 
 
 @jax.jit

@@ -73,6 +73,15 @@ def gevd_mwf(Rxx: jnp.ndarray, Rnn: jnp.ndarray, mu: float = 1.0, rank=1):
       ``t1 = Q[:, 0] * (Q⁻¹)[0, 0]`` (..., C).
     """
     C = Rxx.shape[-1]
+    # Joint scale normalization: (Rxx, Rnn) -> (sRxx, sRnn) leaves W and t1
+    # exactly invariant (L scales by sqrt(s), Q by 1/sqrt(s), qinv0 by
+    # sqrt(s); the generalized eigenvalues are unchanged), but keeps the
+    # Cholesky/eigh iterations in float32 range for near-zero covariances —
+    # required on TPU where warm-up-phase streaming covariances are ~1e-12.
+    tr_n = jnp.trace(Rnn, axis1=-2, axis2=-1).real[..., None, None] / C
+    scale = 1.0 / jnp.maximum(tr_n, jnp.finfo(Rnn.real.dtype).smallest_normal)
+    Rxx = Rxx * scale
+    Rnn = Rnn * scale
     L = jnp.linalg.cholesky(_load_diag(Rnn))
     # A = L⁻¹ Rxx L⁻ᴴ
     Li_Rxx = solve_triangular(L, Rxx, lower=True)

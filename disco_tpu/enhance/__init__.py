@@ -17,6 +17,7 @@ from disco_tpu.enhance.tango import (
     tango_step1,
     tango_step2,
 )
+from disco_tpu.enhance.streaming import streaming_step1, streaming_tango
 from disco_tpu.enhance.zexport import compute_z_signals, export_z
 
 __all__ = [
@@ -37,4 +38,6 @@ __all__ = [
     "vad_mask",
     "compute_z_signals",
     "export_z",
+    "streaming_step1",
+    "streaming_tango",
 ]

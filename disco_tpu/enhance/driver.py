@@ -26,6 +26,7 @@ from disco_tpu.enhance.tango import oracle_masks, tango
 from disco_tpu.enhance.zexport import load_node_signals
 from disco_tpu.io.audio import read_wav, write_wav
 from disco_tpu.io.layout import DatasetLayout, case_of_rir, snr_dirname
+from disco_tpu.utils import to_host
 
 
 def load_input_signals(layout: DatasetLayout, rir: int, noise: str, snr_range, n_nodes=4, mics_per_node=4):
@@ -188,7 +189,7 @@ def enhance_rir(
         write_wav(out / "WAV" / str(rir) / f"out_tar-{tag}.wav", sf_t[k], fs)
         np.save(out / "MASK" / str(rir) / f"step1_{tag}", np.asarray(res.masks_z[k]))
         np.save(out / "MASK" / str(rir) / f"step2_{tag}", np.asarray(res.mask_w[k]))
-        np.save(zdir / f"{rir}_{tag}", np.asarray(res.z_y[k]))
+        np.save(zdir / f"{rir}_{tag}", to_host(res.z_y[k]))
 
     def stack_keys(dicts):
         return {k: np.array([d[k] for d in dicts]) for k in dicts[0]}

@@ -78,8 +78,11 @@ def oracle_masks(S: jnp.ndarray, N: jnp.ndarray, mask_type: str = "irm1", ref_mi
 
 
 # ------------------------------------------------------------------ step 1
-@partial(jax.jit, static_argnames=("oracle_stats", "ref_mic"))
-def tango_step1(Y, S, N, mask_z, mu: float = 1.0, oracle_stats: bool = False, ref_mic: int = 0):
+@partial(jax.jit, static_argnames=("oracle_stats", "ref_mic", "frame_axis"))
+def tango_step1(
+    Y, S, N, mask_z, mu: float = 1.0, oracle_stats: bool = False, ref_mic: int = 0,
+    frame_axis: str | None = None,
+):
     """Step 1 at ONE node: local rank-1 GEVD-MWF -> compressed signals.
 
     This is the per-node unit that ``vmap``s over the node axis on one device
@@ -98,8 +101,8 @@ def tango_step1(Y, S, N, mask_z, mu: float = 1.0, oracle_stats: bool = False, re
     m = mask_z[None]
     s_hat = S if oracle_stats else m * Y
     n_hat = N if oracle_stats else (1.0 - m) * Y
-    Rss = frame_mean_covariance(s_hat)  # (F, C, C)
-    Rnn = frame_mean_covariance(n_hat)
+    Rss = frame_mean_covariance(s_hat, axis_name=frame_axis)  # (F, C, C)
+    Rnn = frame_mean_covariance(n_hat, axis_name=frame_axis)
     w, t1 = gevd_mwf(Rss, Rnn, mu=mu, rank=1)  # (F, C) each
     z_y = jnp.einsum("fc,cft->ft", jnp.conj(w), Y)
     z_s = jnp.einsum("fc,cft->ft", jnp.conj(w), S)
@@ -141,7 +144,7 @@ def _z_stats(policy: Policy, mask_w_k, all_z, all_masks_w, all_S_ref, all_N_ref,
     raise ValueError(f"unknown mask_for_z policy {policy!r}; expected one of {_POLICIES}")
 
 
-@partial(jax.jit, static_argnames=("policy", "ref_mic", "mask_type"))
+@partial(jax.jit, static_argnames=("policy", "ref_mic", "mask_type", "frame_axis"))
 def tango_step2(
     Y,
     S,
@@ -156,6 +159,7 @@ def tango_step2(
     policy: Policy = "local",
     ref_mic: int = 0,
     mask_type: str = "irm1",
+    frame_axis: str | None = None,
 ):
     """Step 2 at ONE node k: global rank-1 GEVD-MWF on ``[y_k ‖ z_{j≠k}]``
     (tango.py:380-455).
@@ -183,8 +187,8 @@ def tango_step2(
     m = mask_w_k[None]
     stat_s = jnp.concatenate([m * Y, zs_stat_all[oth]], axis=0)  # (C+K-1, F, T)
     stat_n = jnp.concatenate([(1.0 - m) * Y, zn_stat_all[oth]], axis=0)
-    Rss = frame_mean_covariance(stat_s)
-    Rnn = frame_mean_covariance(stat_n)
+    Rss = frame_mean_covariance(stat_s, axis_name=frame_axis)
+    Rnn = frame_mean_covariance(stat_n, axis_name=frame_axis)
     w, _ = gevd_mwf(Rss, Rnn, mu=mu, rank=1)  # (F, C+K-1)
 
     in_y = jnp.concatenate([Y, all_z["z_y"][oth]], axis=0)

@@ -1,3 +1,18 @@
-from disco_tpu.parallel.mesh import make_mesh, node_sharding, tango_sharded
+from disco_tpu.parallel.mesh import (
+    make_mesh,
+    make_mesh_2d,
+    node_sharding,
+    tango_frame_sharded,
+    tango_sharded,
+)
+from disco_tpu.parallel.multihost import distributed_init, hybrid_mesh
 
-__all__ = ["make_mesh", "node_sharding", "tango_sharded"]
+__all__ = [
+    "make_mesh",
+    "make_mesh_2d",
+    "node_sharding",
+    "tango_sharded",
+    "tango_frame_sharded",
+    "distributed_init",
+    "hybrid_mesh",
+]

@@ -1,5 +1,5 @@
-"""The distributed communication backend: node-parallel TANGO over a device
-mesh.
+"""The distributed communication backend: node-parallel (and optionally
+frame-parallel) TANGO over a device mesh.
 
 The reference's "distributed" processing is logically distributed but
 physically one process — nodes are list indices, and inter-node communication
@@ -10,12 +10,19 @@ stream to all others — is exactly one ``jax.lax.all_gather`` over the 'node'
 axis, riding ICI on TPU.  This preserves DISCO's bandwidth semantics: one
 compressed channel per node crosses the interconnect, never the raw mics.
 
+The STFT frame axis can additionally be sharded over a 'frame' mesh axis —
+the framework's sequence parallelism (SURVEY.md §5.7).  Frames are
+embarrassingly parallel except for the covariance frame-means, which become
+local partial sums + one ``psum`` over 'frame' (see
+``disco_tpu.beam.frame_mean_covariance``); filters come out identical on
+every frame shard and apply to local frames only.
+
 A 'batch' mesh axis shards rooms/clips (the reference's process-level
 ``--rirs start n`` data parallelism, SURVEY.md §2.9) — corpus-scale jobs lay
 rooms over 'batch' and nodes over 'node' in the same jitted program.
 
-Contract (tested in tests/test_parallel.py): ``tango_sharded`` on an
-N-device mesh produces results identical to the single-device ``vmap`` path
+Contract (tested in tests/test_parallel.py): the sharded pipelines on an
+N-device mesh produce results identical to the single-device ``vmap`` path
 ``disco_tpu.enhance.tango`` — same math, different placement.
 """
 from __future__ import annotations
@@ -40,10 +47,80 @@ def make_mesh(n_node: int | None = None, n_batch: int = 1, devices=None) -> Mesh
     return Mesh(devices, axis_names=("batch", "node"))
 
 
+def make_mesh_2d(n_node: int, n_frame: int, devices=None) -> Mesh:
+    """A (node, frame) mesh: nodes over one axis, STFT frames (sequence
+    parallelism, SURVEY.md §5.7) over the other."""
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    assert len(devices) >= n_node * n_frame, (len(devices), n_node, n_frame)
+    return Mesh(devices[: n_node * n_frame].reshape(n_node, n_frame), axis_names=("node", "frame"))
+
+
 def node_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding that lays the leading (node) axis of a (K, ...) array over the
     'node' mesh axis."""
     return NamedSharding(mesh, P("node"))
+
+
+def _tango_on_mesh(
+    Y, S, N, masks_z, mask_w, mesh, frame_axis, mu, policy, ref_mic, mask_type,
+    oracle_step1_stats,
+) -> TangoResult:
+    """Shared shard_map body for the node-sharded and node+frame-sharded
+    pipelines — identical math, different partition specs."""
+    K = Y.shape[0]
+    assert K % mesh.shape["node"] == 0, (K, dict(mesh.shape))
+    if frame_axis is not None:
+        T = Y.shape[-1]
+        assert T % mesh.shape[frame_axis] == 0, (T, dict(mesh.shape))
+
+    spec4 = P("node", None, None, frame_axis)
+    spec3 = P("node", None, frame_axis)
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(spec4, spec4, spec4, spec3, spec3),
+        out_specs=(spec3,) * 7,
+    )
+    def _run(Yk, Sk, Nk, mzk, mwk):
+        # Local shard shapes: (K_local, C, F, T_local).
+        step1 = jax.vmap(
+            lambda y, s, n, m: tango_step1(
+                y, s, n, m, mu=mu, oracle_stats=oracle_step1_stats, ref_mic=ref_mic,
+                frame_axis=frame_axis,
+            )
+        )
+        local_z = step1(Yk, Sk, Nk, mzk)
+
+        # THE z-exchange: one compressed stream per node over ICI (per frame
+        # shard when the frame axis is sharded).
+        all_z = {
+            key: jax.lax.all_gather(val, "node", axis=0, tiled=True)
+            for key, val in local_z.items()
+        }
+        all_masks_w = jax.lax.all_gather(mwk, "node", axis=0, tiled=True)
+        all_S_ref = jax.lax.all_gather(Sk[:, ref_mic], "node", axis=0, tiled=True)
+        all_N_ref = jax.lax.all_gather(Nk[:, ref_mic], "node", axis=0, tiled=True)
+
+        k = jax.lax.axis_index("node")
+        n_local = Yk.shape[0]  # nodes per device (1 when K == n_devices)
+        ks = k * n_local + jnp.arange(n_local)
+        step2 = jax.vmap(
+            lambda y, s, n, mw, kk: tango_step2(
+                y, s, n, mw, kk, all_z, all_masks_w, all_S_ref, all_N_ref,
+                mu=mu, policy=policy, ref_mic=ref_mic, mask_type=mask_type,
+                frame_axis=frame_axis,
+            ),
+            in_axes=(0, 0, 0, 0, 0),
+        )
+        yf, sf, nf = step2(Yk, Sk, Nk, mwk, ks)
+        return yf, sf, nf, local_z["z_y"], local_z["z_s"], local_z["z_n"], local_z["zn"]
+
+    yf, sf, nf, z_y, z_s, z_n, zn = _run(Y, S, N, masks_z, mask_w)
+    return TangoResult(
+        yf=yf, sf=sf, nf=nf, z_y=z_y, z_s=z_s, z_n=z_n, zn=zn,
+        masks_z=masks_z, mask_w=mask_w,
+    )
 
 
 @partial(
@@ -66,59 +143,48 @@ def tango_sharded(
     """Two-step TANGO with the node axis sharded over ``mesh``'s 'node' axis.
 
     Args:
-      Y, S, N: (K, C, F, T) STFT stacks, K == mesh.shape['node'].
+      Y, S, N: (K, C, F, T) STFT stacks, K divisible by the 'node' size.
       masks_z, mask_w: (K, F, T) step-1/step-2 masks.
 
     Step 1 is embarrassingly node-parallel; the only cross-device collective
     is the all_gather of the compressed streams (+ masks / oracle refs needed
     by the chosen policy) before step 2 — DANSE's communication pattern.
     """
-    K = Y.shape[0]
-    assert K % mesh.shape["node"] == 0, (K, dict(mesh.shape))
-
-    shard_map = jax.shard_map
-
-    spec_node = P("node")
-
-    @partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=(spec_node,) * 5,
-        out_specs=(spec_node,) * 7,
+    return _tango_on_mesh(
+        Y, S, N, masks_z, mask_w, mesh, None, mu, policy, ref_mic, mask_type,
+        oracle_step1_stats,
     )
-    def _run(Yk, Sk, Nk, mzk, mwk):
-        # Local shard shapes: (1, C, F, T) / (1, F, T) — one node per device.
-        step1 = jax.vmap(
-            lambda y, s, n, m: tango_step1(
-                y, s, n, m, mu=mu, oracle_stats=oracle_step1_stats, ref_mic=ref_mic
-            )
-        )
-        local_z = step1(Yk, Sk, Nk, mzk)
 
-        # THE z-exchange: one compressed stream per node over ICI.
-        all_z = {
-            key: jax.lax.all_gather(val, "node", axis=0, tiled=True)
-            for key, val in local_z.items()
-        }
-        all_masks_w = jax.lax.all_gather(mwk, "node", axis=0, tiled=True)
-        all_S_ref = jax.lax.all_gather(Sk[:, ref_mic], "node", axis=0, tiled=True)
-        all_N_ref = jax.lax.all_gather(Nk[:, ref_mic], "node", axis=0, tiled=True)
 
-        k = jax.lax.axis_index("node")
-        n_local = Yk.shape[0]  # nodes per device (1 when K == n_devices)
-        ks = k * n_local + jnp.arange(n_local)
-        step2 = jax.vmap(
-            lambda y, s, n, mw, kk: tango_step2(
-                y, s, n, mw, kk, all_z, all_masks_w, all_S_ref, all_N_ref,
-                mu=mu, policy=policy, ref_mic=ref_mic, mask_type=mask_type,
-            ),
-            in_axes=(0, 0, 0, 0, 0),
-        )
-        yf, sf, nf = step2(Yk, Sk, Nk, mwk, ks)
-        return yf, sf, nf, local_z["z_y"], local_z["z_s"], local_z["z_n"], local_z["zn"]
+@partial(
+    jax.jit,
+    static_argnames=("mesh", "policy", "ref_mic", "mask_type", "oracle_step1_stats"),
+)
+def tango_frame_sharded(
+    Y,
+    S,
+    N,
+    masks_z,
+    mask_w,
+    mesh: Mesh,
+    mu: float = 1.0,
+    policy="local",
+    ref_mic: int = 0,
+    mask_type: str = "irm1",
+    oracle_step1_stats: bool = False,
+) -> TangoResult:
+    """Two-step TANGO sharded over BOTH the node axis and the STFT frame
+    axis — the framework's sequence-parallel mode (SURVEY.md §5.7).
 
-    yf, sf, nf, z_y, z_s, z_n, zn = _run(Y, S, N, masks_z, mask_w)
-    return TangoResult(
-        yf=yf, sf=sf, nf=nf, z_y=z_y, z_s=z_s, z_n=z_n, zn=zn,
-        masks_z=masks_z, mask_w=mask_w,
+    Args:
+      Y, S, N: (K, C, F, T) STFT stacks; K divisible by mesh 'node' size,
+        T divisible by mesh 'frame' size.
+      masks_z, mask_w: (K, F, T).
+
+    Contract (tests/test_parallel.py): bit-compatible with the single-device
+    ``disco_tpu.enhance.tango`` for every policy.
+    """
+    return _tango_on_mesh(
+        Y, S, N, masks_z, mask_w, mesh, "frame", mu, policy, ref_mic, mask_type,
+        oracle_step1_stats,
     )

@@ -1,0 +1,102 @@
+"""Multi-host (ICI + DCN) mesh construction and distributed runtime init.
+
+The reference's cluster story is rsync staging + single-GPU job arrays
+(reference exp/ex1/oar_train.sh:28-45; SURVEY.md §2.7/§5.8).  The TPU-native
+equivalent is a JAX multi-process runtime: every host runs the same program,
+``jax.distributed`` wires the global device view, and the mesh is laid out so
+that the chatty axes (node z-exchange, frame psum) ride ICI within a slice
+while only corpus/batch sharding crosses DCN between slices — the
+scaling-book recipe.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Environment signals that this process is part of a multi-process job.
+# Checked WITHOUT touching the jax backend: any jax query (process_count,
+# devices) would initialise the single-process runtime and make a later
+# jax.distributed.initialize impossible.
+_ADDRESS_ENV = (
+    "JAX_COORDINATOR_ADDRESS",
+    "COORDINATOR_ADDRESS",
+    "MEGASCALE_COORDINATOR_ADDRESS",
+)
+_COUNT_ENV = ("SLURM_NTASKS", "OMPI_COMM_WORLD_SIZE")
+_HOSTLIST_ENV = ("TPU_WORKER_HOSTNAMES",)  # single-host plugins set 'localhost'
+_MULTIPROC_ENV = _ADDRESS_ENV + _COUNT_ENV + _HOSTLIST_ENV
+
+
+def _env_says_multiprocess() -> bool:
+    if any(os.environ.get(v) for v in _ADDRESS_ENV):
+        return True
+    for var in _COUNT_ENV:
+        try:
+            if int(os.environ.get(var, "1")) > 1:
+                return True
+        except ValueError:
+            pass
+    # a hostname LIST (comma-separated) means a real multi-worker pod
+    return any("," in os.environ.get(v, "") for v in _HOSTLIST_ENV)
+
+
+def distributed_init(coordinator_address=None, num_processes=None, process_id=None) -> bool:
+    """Initialise the multi-process JAX runtime.
+
+    Must be called BEFORE any other jax API touches the backend.  With no
+    arguments it initialises only when the environment indicates a
+    multi-process job (TPU pod / SLURM / OpenMPI autodetect); single-process
+    runs return False without touching the backend at all.
+    """
+    explicit = coordinator_address is not None or (num_processes or 0) > 1
+    if not explicit and not _env_says_multiprocess():
+        return False
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError:
+        pass  # already initialised
+    return True
+
+
+def hybrid_mesh(n_batch_dcn: int | None = None, n_node: int = 4, n_frame: int = 1, devices=None) -> Mesh:
+    """A (batch, node, frame) mesh with 'batch' over DCN (one or more shards
+    per host/slice) and 'node'/'frame' over ICI within a slice.
+
+    With ``n_batch_dcn=None`` the batch axis absorbs all remaining devices:
+    ``n_devices // (n_node * n_frame)``.  On a true multi-slice TPU this uses
+    ``mesh_utils.create_hybrid_device_mesh`` so the axis-to-link assignment is
+    physical, not just logical (requires ``n_batch_dcn`` divisible by the
+    slice count); single-slice (or CPU test) runs fall back to a plain
+    reshape with identical semantics.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    per_batch = n_node * n_frame
+    if n_batch_dcn is None:
+        n_batch_dcn = max(1, len(devices) // per_batch)
+    need = n_batch_dcn * per_batch
+    assert len(devices) >= need, (len(devices), n_batch_dcn, n_node, n_frame)
+    devices = devices[:need]
+
+    n_slices = len({getattr(d, "slice_index", 0) for d in devices})
+    if n_slices > 1:
+        assert n_batch_dcn % n_slices == 0, (
+            f"batch axis ({n_batch_dcn}) must be divisible by the slice count "
+            f"({n_slices}) so DCN only carries the batch dimension"
+        )
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(n_batch_dcn // n_slices, n_node, n_frame),
+            dcn_mesh_shape=(n_slices, 1, 1),
+            devices=devices,
+        )
+    else:
+        arr = np.asarray(devices).reshape(n_batch_dcn, n_node, n_frame)
+    return Mesh(arr, axis_names=("batch", "node", "frame"))

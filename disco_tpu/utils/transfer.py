@@ -1,0 +1,40 @@
+"""Host <-> device transfer helpers.
+
+Some TPU attachment paths (notably the tunneled single-chip dev backend this
+framework is developed against) do not implement complex-dtype host<->device
+transfers, while complex math ON device is fully supported.  These helpers
+split complex arrays into two real transfers (the real/imag extraction and
+the recombination run on the side that supports them), and pass real arrays
+straight through.  On standard TPU/CPU backends they are equivalent to
+``np.asarray`` / ``jnp.asarray``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def to_host(x) -> np.ndarray:
+    """Device array -> numpy, complex-safe (two real transfers if needed)."""
+    if not isinstance(x, jax.Array):
+        return np.asarray(x)
+    if jnp.iscomplexobj(x):
+        re = np.asarray(jnp.real(x))
+        return re + 1j * np.asarray(jnp.imag(x)).astype(re.dtype)
+    return np.asarray(x)
+
+
+@jax.jit
+def _combine(re, im):
+    return jax.lax.complex(re, im)
+
+
+def to_device(x) -> jax.Array:
+    """Numpy -> device array, complex-safe (combined on device)."""
+    x = np.asarray(x)
+    if np.iscomplexobj(x):
+        re = np.ascontiguousarray(x.real, dtype=np.float32)
+        im = np.ascontiguousarray(x.imag, dtype=np.float32)
+        return _combine(jnp.asarray(re), jnp.asarray(im))
+    return jnp.asarray(x)

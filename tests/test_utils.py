@@ -1,0 +1,40 @@
+"""Tests for transfer helpers and the profiling utility."""
+import numpy as np
+
+import jax.numpy as jnp
+
+from disco_tpu.utils import StageTimer, to_device, to_host, trace_to
+
+
+def test_to_host_complex_roundtrip():
+    x = (np.arange(6).reshape(2, 3) + 1j * np.ones((2, 3))).astype("complex64")
+    d = to_device(x)
+    assert jnp.iscomplexobj(d)
+    back = to_host(d)
+    np.testing.assert_allclose(back, x)
+
+
+def test_to_host_real_passthrough():
+    x = np.ones((4,), "float32")
+    np.testing.assert_array_equal(to_host(jnp.asarray(x)), x)
+    np.testing.assert_array_equal(to_host(x), x)  # numpy in, numpy out
+
+
+def test_stage_timer():
+    t = StageTimer()
+    with t.stage("a"):
+        pass
+    with t.stage("a"):
+        pass
+    with t.stage("b", block_on=jnp.ones(())):
+        pass
+    rep = t.report()
+    assert rep["a"]["calls"] == 2 and rep["b"]["calls"] == 1
+    assert "a" in t.pretty()
+
+
+def test_trace_to_noop_on_failure(tmp_path):
+    # nested trace (or unavailable backend) must not raise
+    with trace_to(str(tmp_path / "t1")):
+        with trace_to(str(tmp_path / "t2")):
+            pass
