@@ -1,0 +1,141 @@
+"""TPU-native STFT kernels.
+
+The analysis filterbank is the second-hottest op of the whole framework
+(SURVEY.md §3 hot-loop summary: ~60 librosa STFT/ISTFT calls per clip in the
+reference).  On TPU the rFFT lowering is not the fast path for a 512-point
+transform — the MXU is.  Two implementations:
+
+* :func:`stft_matmul` — XLA formulation: the 50%-overlap framing is two
+  shifted views of the hop-chunked signal (no gather), and the DFT is two
+  (T, 512) @ (512, 257) real matmuls against precomputed cos/sin matrices
+  with ``precision='float32'``.  ~1.5x faster than ``jnp.fft.rfft`` on TPU
+  at 3e-7 relative error (exact integer-mod angles).
+* :func:`stft_pallas` — the same computation as one fused pallas kernel:
+  signal chunks are DMA'd HBM->VMEM per frame tile, frames/window/DFT all
+  happen in VMEM, and the framed intermediate never exists in HBM.
+
+``disco_tpu.core.dsp.stft`` dispatches to the matmul path on TPU backends
+automatically; the pallas kernel is opt-in (``impl='pallas'``).
+"""
+from __future__ import annotations
+
+import functools
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N_FFT, N_HOP = 512, 256
+
+
+@functools.lru_cache(maxsize=8)
+def dft_matrices(n_fft: int = N_FFT):
+    """(n_fft, n_fft//2+1) cos/sin DFT matrices with exact integer-mod
+    angles (float64 host precompute, cast to f32).  Returned as NUMPY so the
+    cache never holds trace-bound constants (safe to call under any jit)."""
+    k = np.arange(n_fft // 2 + 1, dtype=np.int64)[:, None]
+    n = np.arange(n_fft, dtype=np.int64)[None, :]
+    ang = -2.0 * np.pi * ((k * n) % n_fft) / n_fft
+    return np.cos(ang).T.astype(np.float32), np.sin(ang).T.astype(np.float32)
+
+
+def _hann(n_fft, dtype=jnp.float32):
+    k = jnp.arange(n_fft, dtype=dtype)
+    return 0.5 - 0.5 * jnp.cos(2.0 * jnp.pi * k / n_fft)
+
+
+def _chunked(x, n_fft, hop):
+    """Reflect-pad for a centered STFT and return (chunks (B, T+1, hop),
+    n_frames, batch_shape).  Requires hop == n_fft // 2 (the framework's
+    512/256 convention): frame t is then [chunk_t ‖ chunk_{t+1}]."""
+    assert n_fft == 2 * hop, "matmul/pallas STFT assumes 50% overlap (n_fft == 2*hop)"
+    x = jnp.asarray(x)
+    pad = n_fft // 2
+    bs = x.shape[:-1]
+    L = x.shape[-1]
+    xp = jnp.pad(x.reshape((-1, L)), ((0, 0), (pad, pad)), mode="reflect")
+    n_frames = 1 + (xp.shape[-1] - n_fft) // hop
+    A = xp[:, : (n_frames + 1) * hop].reshape(xp.shape[0], -1, hop)
+    return A, n_frames, bs
+
+
+@partial(jax.jit, static_argnames=("n_fft", "hop"))
+def stft_matmul(x: jnp.ndarray, n_fft: int = N_FFT, hop: int = N_HOP) -> jnp.ndarray:
+    """Centered STFT as two MXU matmuls (see module docstring).  Identical
+    conventions and output layout to ``disco_tpu.core.dsp.stft``."""
+    A, n_frames, bs = _chunked(x, n_fft, hop)
+    frames = jnp.concatenate([A[:, :-1], A[:, 1:]], axis=-1)  # (B, T, n_fft)
+    wf = frames * _hann(n_fft, frames.dtype)
+    Dre, Dim = (jnp.asarray(d) for d in dft_matrices(n_fft))
+    spec = jax.lax.complex(
+        jnp.matmul(wf, Dre, precision="float32"),
+        jnp.matmul(wf, Dim, precision="float32"),
+    )
+    return jnp.swapaxes(spec, -1, -2).reshape(bs + (n_fft // 2 + 1, n_frames))
+
+
+# --------------------------------------------------------------- pallas path
+def _stft_kernel(a0_ref, a1_ref, dre_ref, dim_ref, win_ref, re_ref, im_ref):
+    """One (batch, frame-tile) program: frames assembled from the two
+    shifted chunk views in VMEM, windowed, DFT'd on the MXU."""
+    frames = jnp.concatenate([a0_ref[0], a1_ref[0]], axis=-1)  # (TILE_T, n_fft)
+    wf = frames * win_ref[:]
+    re_ref[0] = jnp.dot(wf, dre_ref[:], precision="float32", preferred_element_type=jnp.float32)
+    im_ref[0] = jnp.dot(wf, dim_ref[:], precision="float32", preferred_element_type=jnp.float32)
+
+
+@partial(jax.jit, static_argnames=("n_fft", "hop", "tile_t", "interpret"))
+def stft_pallas(
+    x: jnp.ndarray,
+    n_fft: int = N_FFT,
+    hop: int = N_HOP,
+    tile_t: int = 128,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Fused pallas STFT (frame + window + DFT in VMEM, grid over
+    (batch, frame tiles)).  Same output as :func:`stft_matmul`.
+
+    The framed (B, T, 512) intermediate never touches HBM: each grid step
+    reads a (tile_t + 1, hop) chunk strip and writes (tile_t, 257) re/im.
+    ``interpret=True`` runs the kernel in the pallas interpreter (CPU
+    correctness tests).
+    """
+    from jax.experimental import pallas as pl
+
+    A, n_frames, bs = _chunked(x, n_fft, hop)
+    B = A.shape[0]
+    n_freq = n_fft // 2 + 1
+    # pad frame count to a tile multiple; the two 50%-shifted chunk views
+    # (frame t = [chunk_t ‖ chunk_{t+1}]) are passed separately because
+    # BlockSpec index maps address whole blocks (no overlapping strips).
+    n_tiles = -(-n_frames // tile_t)
+    rows_needed = n_tiles * tile_t + 1
+    A = jnp.pad(A, ((0, 0), (0, rows_needed - A.shape[1]), (0, 0)))
+    A0 = A[:, :-1]
+    A1 = A[:, 1:]
+    Dre, Dim = (jnp.asarray(d) for d in dft_matrices(n_fft))
+    win = _hann(n_fft)
+
+    re, im = pl.pallas_call(
+        _stft_kernel,
+        grid=(B, n_tiles),
+        in_specs=[
+            pl.BlockSpec((1, tile_t, hop), lambda b, t: (b, t, 0)),
+            pl.BlockSpec((1, tile_t, hop), lambda b, t: (b, t, 0)),
+            pl.BlockSpec((n_fft, n_freq), lambda b, t: (0, 0)),
+            pl.BlockSpec((n_fft, n_freq), lambda b, t: (0, 0)),
+            pl.BlockSpec((n_fft,), lambda b, t: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, tile_t, n_freq), lambda b, t: (b, t, 0)),
+            pl.BlockSpec((1, tile_t, n_freq), lambda b, t: (b, t, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, n_tiles * tile_t, n_freq), jnp.float32),
+            jax.ShapeDtypeStruct((B, n_tiles * tile_t, n_freq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(A0, A1, Dre, Dim, win)
+    spec = jax.lax.complex(re, im)[:, :n_frames]
+    return jnp.swapaxes(spec, -1, -2).reshape(bs + (n_freq, n_frames))
