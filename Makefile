@@ -1,0 +1,31 @@
+# Capability parity with the reference Makefile (test/coverage/doc/install)
+# plus the native-library build.
+
+PYTHON ?= python
+
+.PHONY: test coverage doc install native clean bench
+
+test:
+	$(PYTHON) -m pytest tests/ -q
+
+coverage:
+	$(PYTHON) -m coverage run --branch --source=disco_tpu -m pytest tests/ -q
+	$(PYTHON) -m coverage html
+
+doc:
+	$(PYTHON) -m sphinx -b html doc/source doc/build/html
+
+install:
+	$(PYTHON) -m pip install -e .
+
+native:
+	g++ -O3 -shared -fPIC -pthread disco_tpu/native/fastloader.cpp \
+	    -o disco_tpu/native/libfastloader.so
+
+bench:
+	$(PYTHON) bench.py
+
+clean:
+	rm -rf build dist *.egg-info htmlcov .coverage doc/build
+	rm -f disco_tpu/native/libfastloader.so
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -9,12 +9,13 @@
 ``disco-get-z``        speech_enhancement/get_z_signals.py (z export)
 ``disco-train``        dnn/engine/train.py (CRNN training)
 ``disco-lists``        dnn/data/lists_to_load.py (input lists)
+``disco-download``     pre_generation downloaders (freesound/csv clean)
 =====================  ===============================================
 
 Every corpus-scale CLI takes ``--rirs start count`` and is idempotent, so
 cluster job arrays shard the corpus exactly as the reference does
 (SURVEY.md §2.9 data-parallel row).
 """
-from disco_tpu.cli import gen_disco, gen_meetit, get_z, lists, mix, tango, train
+from disco_tpu.cli import download, gen_disco, gen_meetit, get_z, lists, mix, tango, train
 
-__all__ = ["gen_disco", "gen_meetit", "get_z", "lists", "mix", "tango", "train"]
+__all__ = ["download", "gen_disco", "gen_meetit", "get_z", "lists", "mix", "tango", "train"]

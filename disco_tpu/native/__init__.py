@@ -1,0 +1,2 @@
+"""Native (C++) components, built on demand with g++ — see fastloader.cpp
+and disco_tpu/nn/fastload.py for the bindings."""

@@ -1,0 +1,17 @@
+"""Sphinx configuration (reference doc/source/conf.py parity: autodoc +
+napoleon over the package)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath("../.."))
+
+project = "disco_tpu"
+author = "disco_tpu developers"
+extensions = [
+    "sphinx.ext.autodoc",
+    "sphinx.ext.napoleon",
+    "sphinx.ext.viewcode",
+]
+autodoc_mock_imports = ["jax", "flax", "optax", "orbax", "chex", "matplotlib"]
+html_theme = "alabaster"
+exclude_patterns = []

@@ -1,0 +1,39 @@
+#!/bin/bash
+# Cluster training recipe — capability parity with reference
+# exp/ex1/oar_train.sh: write the per-signal file lists, rsync-stage the
+# corpus onto node-local scratch, rewrite paths, launch training.  Works
+# under any scheduler (OAR/SLURM/...) that gives a local scratch dir.
+set -euo pipefail
+
+scene=${1:?usage: cluster_train.sh scene noise zsigs [n_files]}
+noise=${2}
+zsigs=${3}
+n_files=${4:-11001}
+
+DATA_ROOT=${DATA_ROOT:-dataset/disco}
+SCRATCH=${SCRATCH:-/tmp/$USER/disco_stage}
+LISTS=${LISTS:-lists/${scene}_${noise}}
+
+# 1. Build the lists of .npy inputs (deterministic across relaunches).
+python -m disco_tpu.cli.lists --scene "${scene}" --noise "${noise}" \
+    --zsigs ${zsigs} --n_files "${n_files}" --path_data "${DATA_ROOT}" --out "${LISTS}"
+
+# 2. Stage every list to node-local scratch, one rsync per list in parallel
+#    (the reference's --files-from trick, oar_train.sh:28-45).
+mkdir -p "${SCRATCH}"
+for f in "${LISTS}"/list_*.txt; do
+    sed "s|^${DATA_ROOT}/||" "$f" > "${f}.rel"
+    rsync -a --files-from="${f}.rel" "${DATA_ROOT}/" "${SCRATCH}/" &
+done
+wait
+
+# 3. Rewrite list paths to the staged copies.
+staged=${LISTS}_staged
+mkdir -p "${staged}"
+for f in "${LISTS}"/list_*.txt; do
+    sed "s|^${DATA_ROOT}|${SCRATCH}|" "$f" > "${staged}/$(basename "$f")"
+done
+
+# 4. Train from the staged lists.
+python -m disco_tpu.cli.train --scene "${scene}" --noise "${noise}" --zsigs ${zsigs} \
+    --files_to_load "${staged}" --n_files "${n_files}" --path_data "${SCRATCH}"

@@ -1,0 +1,27 @@
+#!/bin/bash
+# Run TANGO enhancement for one (scene, noise, model_sc, model_mc, rir) tuple
+# — capability parity with reference exp/ex1/loop_tango.sh (whose last line
+# passes the undefined ${model} ${mod_mc}; fixed here per SURVEY.md §7).
+# Loop or job-array over RIR ids for corpus-scale runs; every invocation is
+# idempotent (already-processed RIRs are skipped).
+set -euo pipefail
+
+scene=${1:?usage: loop_tango.sh scene noise model_sc model_mc rir}   # meeting/living/random
+noise=${2}      # it/fs/ssn
+model_sc=${3}   # single-node CRNN run name, or None for oracle masks
+model_mc=${4}   # multi-node CRNN run name, or None
+k=${5}          # RIR id to process
+
+path_to_models=${MODELS_DIR:-models}
+vad1=${VAD1:-irm1}
+vad2=${VAD2:-irm1}
+sav_dir=${model_sc}_${model_mc}
+zsigs=${ZSIGS:-zs_hat}
+
+msc=None
+mmc=None
+[ "${model_sc}" != "None" ] && msc=${path_to_models}/${model_sc}_model.ckpt
+[ "${model_mc}" != "None" ] && mmc=${path_to_models}/${model_mc}_model.ckpt
+
+python -m disco_tpu.cli.tango -vt "${vad1}" "${vad2}" -sd "${sav_dir}" --rir "${k}" \
+    -scene "${scene}" --noise "${noise}" --zsigs ${zsigs} -m "${msc}" "${mmc}"

@@ -1,0 +1,212 @@
+"""Tests for the corpus-acquisition subsystem (reference pre_generation/),
+driven entirely by a fake Freesound client — zero network."""
+import csv
+import logging
+import os
+
+import pytest
+
+from disco_tpu.datagen.download import (
+    DownloadConfig,
+    FreesoundInquirer,
+    clean_info,
+    download_freesound,
+    extract_category_ids,
+    get_missing,
+    limit_exec,
+    serial_exec,
+    set_up_log,
+    update_csv,
+)
+
+
+class FakeSound:
+    def __init__(self, sid, name="snd"):
+        self.id = sid
+        self.name = name
+        self.retrieved = []
+
+    def retrieve(self, output_dir, name=None):
+        path = os.path.join(output_dir, name)
+        with open(path, "wb") as fh:
+            fh.write(b"RIFFfake")
+        self.retrieved.append(path)
+
+
+class FakePage:
+    def __init__(self, sounds, has_next):
+        self.sounds = sounds
+        self._next = "url" if has_next else None
+
+    def as_dict(self):
+        return {"next": self._next}
+
+    def __iter__(self):
+        return iter(self.sounds)
+
+
+class FakeClient:
+    """freesound.FreesoundClient-shaped test double; serves 2 pages then
+    stops (the reference's pagination-until-no-next loop)."""
+
+    def __init__(self, per_page=3):
+        self.calls = []
+        self.per_page = per_page
+
+    def text_search(self, **kwargs):
+        self.calls.append(kwargs)
+        page = kwargs.get("page", 1)
+        base = 100 * page
+        return FakePage([FakeSound(str(base + i)) for i in range(self.per_page)], has_next=page < 2)
+
+
+def test_config_promotes_string_queries():
+    cfg = DownloadConfig(queries={"fan": "fan vent", "baby": ["baby cry", "infant"]})
+    assert cfg.queries["fan"] == ["fan vent"]
+    assert cfg.queries["baby"] == ["baby cry", "infant"]
+
+
+def test_config_requires_source():
+    with pytest.raises(ValueError):
+        DownloadConfig()
+
+
+def test_config_from_yaml(tmp_path):
+    p = tmp_path / "c.yaml"
+    p.write_text("queries:\n  fan: fan vent\nfields_to_save: ['id']\nmin_duration: 3\n")
+    cfg = DownloadConfig.from_yaml(p)
+    assert cfg.min_duration == 3 and cfg.fields_to_save == ("id",)
+
+
+def test_queries_pagination():
+    client = FakeClient()
+    inq = FreesoundInquirer(client)
+    pages = list(inq.queries_to_files(["vacuum"], ["id"], min_duration=3))
+    # ALL pages yielded, including the final one (the reference drops it —
+    # not reproduced, SURVEY.md §7)
+    assert len(pages) == 2
+    assert client.calls[0]["filter"] == "duration:[3 TO *]"
+    assert client.calls[0]["page_size"] == 150
+
+
+def test_ids_batched_200():
+    client = FakeClient()
+    inq = FreesoundInquirer(client)
+    ids = [str(i) for i in range(450)]
+    pages = list(inq.ids_to_files(ids, ["id"]))
+    assert len(pages) == 6  # 3 id batches (200+200+50) x 2 pages each
+    assert "id:(0 OR 1" in client.calls[0]["filter"]
+    assert client.calls[0]["page_size"] == 150  # batches are paginated
+
+
+def test_extract_category_ids(tmp_path):
+    p = tmp_path / "ids.csv"
+    p.write_text(",fan,baby\n0,11,21\n1,12,22\n2,13,\n")
+    out = extract_category_ids(p)
+    assert out == {"fan": ["11", "12"], "baby": ["21", "22"]}  # dropna row 2
+
+
+def test_update_csv_dedup_and_sort(tmp_path):
+    p = tmp_path / "info.csv"
+    update_csv({"id": ["3", "1"], "name": ["c", "a"]}, p, sort_label="id", sep="\t")
+    update_csv({"id": ["2", "1"], "name": ["b", "a"]}, p, sort_label="id", sep="\t")
+    with open(p) as fh:
+        rows = list(csv.reader(fh, delimiter="\t"))
+    assert rows[0] == ["id", "name"]
+    assert [r[0] for r in rows[1:]] == ["1", "2", "3"]  # deduped + sorted
+
+
+def test_limit_exec_sleeps_after_quota():
+    sleeps = []
+    t = [0.0]
+
+    def clock():
+        t[0] += 0.1
+        return t[0]
+
+    @limit_exec(max_per_minute=3, sleep=sleeps.append, clock=clock)
+    def f():
+        return 1
+
+    for _ in range(7):
+        f()
+    # two full quotas of 3 -> two sleeps of just under 60 s
+    assert len(sleeps) == 2 and all(55 < s < 60 for s in sleeps)
+
+
+def test_download_freesound_end_to_end(tmp_path):
+    cfg = DownloadConfig(queries={"fan": "fan vent"}, fields_to_save=["id"], min_duration=3)
+    client = FakeClient()
+    n = download_freesound(cfg, FreesoundInquirer(client), str(tmp_path), num_jobs=1)
+    assert n == 6  # both pages downloaded
+    wavs = sorted(os.listdir(tmp_path / "fan"))
+    assert "100.wav" in wavs and "200.wav" in wavs and "fan.csv" in wavs
+
+
+def test_download_freesound_by_ids(tmp_path):
+    ids_csv = tmp_path / "ids.csv"
+    ids_csv.write_text(",fan\n0,11\n1,12\n")
+    cfg = DownloadConfig(id_file=str(ids_csv), fields_to_save=["id"])
+    n = download_freesound(cfg, FreesoundInquirer(FakeClient()), str(tmp_path / "out"))
+    assert n == 6
+    assert (tmp_path / "out" / "fan" / "fan.csv").exists()
+
+
+def test_csv_disk_reconciliation(tmp_path):
+    d = tmp_path / "fan"
+    d.mkdir()
+    (d / "11.wav").write_bytes(b"x")
+    (d / "12.wav").write_bytes(b"x")
+    (d / "99.wav").write_bytes(b"x")  # on disk, not in csv
+    p = d / "fan.csv"
+    p.write_text("id\tname\n11\ta\n12\tb\n13\tc\n", )  # 13 in csv, not on disk
+    assert get_missing(p) == ["99.wav"]
+    dropped = clean_info(p)
+    assert dropped == 1
+    with open(p) as fh:
+        rows = [r.split("\t")[0] for r in fh.read().splitlines()[1:]]
+    assert rows == ["11", "12"]
+
+
+def test_set_up_log_file(tmp_path):
+    log = set_up_log(str(tmp_path / "x" / "run.log"), level=1)
+    log.info("hello")
+    logging.shutdown()
+    assert "hello" in (tmp_path / "x" / "run.log").read_text()
+
+
+def test_serial_exec():
+    assert serial_exec(lambda a, b: a + b, [(1, 2), (3, 4)]) == [3, 7]
+
+
+def test_download_cli_list_urls(capsys):
+    from disco_tpu.cli.download import main
+
+    assert main(["--list-urls"]) == 0
+    out = capsys.readouterr().out
+    assert "openslr.org" in out and "zenodo.org" in out
+
+
+def test_download_cli_clean(tmp_path, capsys):
+    from disco_tpu.cli.download import main
+
+    d = tmp_path / "fan"
+    d.mkdir()
+    (d / "11.wav").write_bytes(b"x")
+    (d / "fan.csv").write_text("id\tname\n11\ta\n13\tc\n")
+    assert main(["--clean", str(tmp_path)]) == 0  # exit code, not count
+    assert "dropped 1 stale csv rows" in capsys.readouterr().out
+
+
+def test_download_dispatcher_rate_limits(tmp_path):
+    """Rate limiting is enforced at the dispatcher: one sleep per full batch
+    of max_per_minute downloads, regardless of worker count."""
+    sleeps = []
+    cfg = DownloadConfig(queries={"fan": "fan vent"}, fields_to_save=["id"])
+    client = FakeClient(per_page=5)
+    download_freesound(
+        cfg, FreesoundInquirer(client), str(tmp_path),
+        max_per_minute=2, sleep=sleeps.append, clock=lambda: 0.0,
+    )
+    # 5 sounds per page -> batches of 2: sleeps between batches (2 per page)
+    assert len(sleeps) == 4 and all(s == 60 for s in sleeps)

@@ -1,0 +1,69 @@
+"""Tests for the native threaded npy loader (C++ fastloader) vs numpy."""
+import numpy as np
+import pytest
+
+from disco_tpu.nn import fastload
+
+
+@pytest.fixture
+def npy_dir(tmp_path):
+    rng = np.random.default_rng(0)
+    paths, refs = [], []
+    for i, T in enumerate((100, 80, 120)):
+        a = (rng.standard_normal((257, T)) + 1j * rng.standard_normal((257, T))).astype("complex64")
+        p = tmp_path / f"c{i}.npy"
+        np.save(p, a)
+        paths.append(p)
+        refs.append(np.abs(a))
+    f = rng.standard_normal((257, 90)).astype("float32")
+    pf = tmp_path / "f.npy"
+    np.save(pf, f)
+    paths.append(pf)
+    refs.append(np.abs(f))
+    return paths, refs
+
+
+def test_native_lib_builds():
+    assert fastload.available(), "g++ is in the image; the native loader must build"
+
+
+def test_load_abs_batch_matches_numpy(npy_dir):
+    paths, refs = npy_dir
+    out, frames = fastload.load_abs_batch(paths, 257, 110)
+    assert out.shape == (4, 257, 110)
+    for i, ref in enumerate(refs):
+        t = min(ref.shape[1], 110)
+        assert frames[i] == t
+        np.testing.assert_allclose(out[i, :, :t], ref[:, :t], rtol=1e-6)
+        assert np.all(out[i, :, t:] == 0.0)
+
+
+def test_load_abs_batch_skip_cols(npy_dir):
+    paths, refs = npy_dir
+    out, frames = fastload.load_abs_batch(paths, 257, 110, skip_cols=30)
+    for i, ref in enumerate(refs):
+        t = min(ref.shape[1] - 30, 110)
+        assert frames[i] == t
+        np.testing.assert_allclose(out[i, :, :t], ref[:, 30:30 + t], rtol=1e-6)
+
+
+def test_load_abs_batch_bad_file(tmp_path, npy_dir):
+    paths, _ = npy_dir
+    bad = tmp_path / "bad.npy"
+    bad.write_bytes(b"not a npy file")
+    with pytest.raises(RuntimeError, match="bad.npy"):
+        fastload.load_abs_batch([paths[0], bad], 257, 110)
+
+
+def test_load_abs_batch_missing_file(npy_dir, tmp_path):
+    paths, _ = npy_dir
+    with pytest.raises(RuntimeError):
+        fastload.load_abs_batch([tmp_path / "nope.npy"], 257, 110)
+
+
+def test_numpy_fallback_matches(npy_dir, monkeypatch):
+    paths, refs = npy_dir
+    native, _ = fastload.load_abs_batch(paths, 257, 110, skip_cols=10)
+    monkeypatch.setattr(fastload, "get_lib", lambda: None)
+    fallback, _ = fastload.load_abs_batch(paths, 257, 110, skip_cols=10)
+    np.testing.assert_allclose(native, fallback, rtol=1e-6)
