@@ -13,21 +13,13 @@ import time
 
 import numpy as np
 
+from disco_tpu.milestones import _fence, _scene
+
 FS = 16000
 K, C = 8, 4  # 8-node, 4 mics per node (north-star config)
 
 
-def _scene(K, C, L, seed=0):
-    rng = np.random.default_rng(seed)
-    src = rng.standard_normal(L)
-    s = np.stack(
-        [np.stack([np.convolve(src, rng.standard_normal(8), mode="same") for _ in range(C)]) for _ in range(K)]
-    ).astype(np.float32)
-    n = 0.5 * rng.standard_normal((K, C, L)).astype(np.float32)
-    return s + n, s, n
-
-
-def bench_jax(batch=4, dur_s=10.0, iters=5):
+def bench_jax(batch=16, dur_s=10.0, iters=5):
     import jax
     import jax.numpy as jnp
 
@@ -35,7 +27,7 @@ def bench_jax(batch=4, dur_s=10.0, iters=5):
     from disco_tpu.enhance import oracle_masks, tango
 
     L = int(dur_s * FS)
-    y, s, n = _scene(K, C, L)
+    y, s, n = _scene(K, C, L, noise_scale=0.5)
     yb = jnp.asarray(np.stack([y] * batch))
     sb = jnp.asarray(np.stack([s] * batch))
     nb = jnp.asarray(np.stack([n] * batch))
@@ -51,13 +43,7 @@ def bench_jax(batch=4, dur_s=10.0, iters=5):
         # so the timed program is exactly the production program.
         return jax.vmap(one)(yb, sb, nb)
 
-    def fence(out):
-        # Transfer one output-dependent element to host.  On tunneled/async
-        # device attachments block_until_ready() was measured returning in
-        # ~20us for a >100ms program; a host readback of the result is the
-        # only reliable execution fence there.  (jnp.real: the tunnel cannot
-        # transfer complex dtypes.)
-        return float(jnp.real(out[0, 0, 0, 0]))
+    fence = _fence  # shared tunnel-safe host-readback execution fence
 
     fence(run(yb, sb, nb))  # compile + warm up
     times = []
@@ -74,7 +60,7 @@ def bench_numpy(dur_s=1.0):
     from tests.reference_impls import tango_np
 
     L = int(dur_s * FS)
-    y, s, n = _scene(K, C, L)
+    y, s, n = _scene(K, C, L, noise_scale=0.5)
     t0 = time.perf_counter()
     tango_np(np.asarray(y, np.float64), np.asarray(s, np.float64), np.asarray(n, np.float64))
     dt = time.perf_counter() - t0

@@ -31,6 +31,8 @@ def build_parser():
     p.add_argument("--dataset", default="dataset/disco/", help="corpus root")
     p.add_argument("--snr", nargs=2, type=snr_value, default=[0, 6])
     p.add_argument("--out_root", default=None, help="override results directory")
+    p.add_argument("--streaming", action="store_true",
+                   help="frame-recursive online pipeline (smoothed covariances)")
     return p
 
 
@@ -56,7 +58,7 @@ def main(argv=None):
         args.dataset, args.scenario, args.rir, args.noise,
         save_dir=args.sav_dir, snr_range=tuple(args.snr),
         mask_type=args.vad_type[0], policy=policy, models=models,
-        out_root=args.out_root,
+        out_root=args.out_root, streaming=args.streaming,
     )
     if results is None:
         print(f"Conf {args.rir} with {args.noise} noise already processed")

@@ -89,12 +89,12 @@ def _stft_rfft(x: jnp.ndarray, n_fft: int = N_FFT, hop: int = N_HOP) -> jnp.ndar
     return spec.reshape(batch_shape + spec.shape[-2:]).astype(jnp.complex64)
 
 
-@partial(jax.jit, static_argnames=("length", "n_fft", "hop"))
 def istft(
     spec: jnp.ndarray,
     length: int,
     n_fft: int = N_FFT,
     hop: int = N_HOP,
+    impl: str = "auto",
 ) -> jnp.ndarray:
     """Inverse centered STFT by windowed overlap-add with squared-window
     normalization (librosa istft semantics, reference tango.py:528-539).
@@ -102,10 +102,30 @@ def istft(
     Args:
       spec: complex STFT, shape (..., n_freq, n_frames).
       length: output signal length in samples (required — static under jit).
+      impl: 'auto' (MXU inverse-DFT matmuls + chunked OLA on TPU, irfft +
+        scatter-add elsewhere), or explicitly 'irfft' | 'matmul'.
 
     Returns:
       real signal(s) of shape (..., length), float32.
     """
+    if impl == "auto":
+        impl = "matmul" if (n_fft == 2 * hop and jax.default_backend() == "tpu") else "irfft"
+    if impl == "matmul":
+        from disco_tpu.ops.stft_ops import istft_matmul
+
+        return istft_matmul(spec, length, n_fft, hop)
+    if impl != "irfft":
+        raise ValueError(f"unknown istft impl {impl!r}; expected 'auto', 'irfft' or 'matmul'")
+    return _istft_ola(spec, length, n_fft, hop)
+
+
+@partial(jax.jit, static_argnames=("length", "n_fft", "hop"))
+def _istft_ola(
+    spec: jnp.ndarray,
+    length: int,
+    n_fft: int = N_FFT,
+    hop: int = N_HOP,
+) -> jnp.ndarray:
     spec = jnp.asarray(spec)
     batch_shape = spec.shape[:-2]
     n_freq, n_frames = spec.shape[-2:]

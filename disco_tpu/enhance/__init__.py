@@ -17,6 +17,7 @@ from disco_tpu.enhance.tango import (
     tango_step1,
     tango_step2,
 )
+from disco_tpu.enhance.separation import separate_sources, separate_with_masks
 from disco_tpu.enhance.streaming import streaming_step1, streaming_tango
 from disco_tpu.enhance.zexport import compute_z_signals, export_z
 
@@ -40,4 +41,6 @@ __all__ = [
     "export_z",
     "streaming_step1",
     "streaming_tango",
+    "separate_sources",
+    "separate_with_masks",
 ]

@@ -133,11 +133,15 @@ def enhance_rir(
     out_root: str | None = None,
     force: bool = False,
     save_fig: bool = True,
+    streaming: bool = False,
 ):
     """Enhance one RIR end-to-end and persist everything (reference
     tango.py:460-641).  ``models``: per-step CRNN params or None for the
-    oracle masks of ``mask_type``.  Returns the tango results dict, or None
-    when the RIR was already processed (idempotency)."""
+    oracle masks of ``mask_type``.  ``streaming=True`` runs the
+    frame-recursive online pipeline (exponential-smoothing covariances,
+    block filter refresh) instead of the offline frame-mean one.  Returns
+    the tango results dict, or None when the RIR was already processed
+    (idempotency)."""
     import jax.numpy as jnp
 
     from disco_tpu.core.dsp import stft
@@ -156,7 +160,27 @@ def enhance_rir(
 
     Y, S, N = stft(jnp.asarray(y)), stft(jnp.asarray(s)), stft(jnp.asarray(n))
     masks_z, mask_w = estimate_masks(Y, S, N, models, mask_type, n_nodes, mu=mu)
-    res = tango(Y, S, N, masks_z, mask_w, mu=mu, policy=policy, mask_type=mask_type)
+    if streaming:
+        # The online pipeline implements the 'local' mask-for-z policy only
+        # (consumer-side masks); other policies are offline-only.
+        if policy not in ("local",):
+            raise ValueError(
+                f"streaming mode implements the 'local' mask-for-z policy; got {policy!r}"
+            )
+        from disco_tpu.enhance.tango import TangoResult
+        from disco_tpu.enhance.streaming import streaming_tango
+
+        st = streaming_tango(Y, masks_z, mask_w, mu=mu, S=S, N=N, with_diagnostics=True)
+        # ONE filter everywhere: every saved wav, mask, z and metric below
+        # describes the online beamformer (sf/nf come from the same
+        # per-block filters applied to the clean components).
+        res = TangoResult(
+            yf=st["yf"], sf=st["sf"], nf=st["nf"],
+            z_y=st["z_y"], z_s=st["z_s"], z_n=st["z_n"], zn=st["zn"],
+            masks_z=masks_z, mask_w=mask_w,
+        )
+    else:
+        res = tango(Y, S, N, masks_z, mask_w, mu=mu, policy=policy, mask_type=mask_type)
 
     # Back to time domain (tango.py:528-539), trimmed to the input length.
     sh_t = np.asarray(istft(res.yf, length=L))

@@ -1,0 +1,64 @@
+"""Source separation over the distributed array — the MEETIT use case
+(reference gen_meetit + ICASSP 2021 setup, SURVEY.md §0 pillar 3).
+
+The reference generates per-node per-source IRMs (gen_meetit
+convolve_signals.py:166-189) and separates by running the same two-step
+MWF machinery once per source.  Here that is a first-class API: one
+``vmap`` over the source axis of the jitted TANGO pipeline — sources,
+nodes, frequencies and frames are all array axes.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from disco_tpu.core.masks import tf_mask
+from disco_tpu.enhance.tango import tango
+
+
+@partial(jax.jit, static_argnames=("policy", "mask_type", "ref_mic"))
+def separate_sources(Y, S_imgs, mu: float = 1.0, policy="distant", mask_type: str = "irm1", ref_mic: int = 0):
+    """Oracle-mask separation: extract every source at every node.
+
+    Args:
+      Y: (K, C, F, T) mixture STFTs.
+      S_imgs: (n_src, K, C, F, T) per-source image STFTs (sum = Y's signal
+        part); source s's interference is ``Y - S_imgs[s]``.
+
+    Returns:
+      (n_src, K, F, T) complex estimates: source s as extracted by node k.
+    """
+    def one(S):
+        N = Y - S
+        m = tf_mask(S[:, ref_mic], N[:, ref_mic], mask_type)
+        return tango(Y, S, N, m, m, mu=mu, policy=policy, ref_mic=ref_mic, mask_type=mask_type).yf
+
+    return jax.vmap(one)(S_imgs)
+
+
+@partial(jax.jit, static_argnames=("policy", "mask_type", "ref_mic"))
+def separate_with_masks(Y, masks, mu: float = 1.0, policy="distant", mask_type: str = "irm1", ref_mic: int = 0):
+    """Mask-driven separation (deployment path — no oracle images needed).
+
+    Args:
+      Y: (K, C, F, T) mixture STFTs.
+      masks: (n_src, K, F, T) per-source per-node TF masks (e.g. CRNN
+        estimates, or the saved MEETIT IRMs).
+
+    Returns:
+      (n_src, K, F, T) complex per-source estimates.
+    """
+    if policy not in ("local", "none", "distant", None):
+        # oracle/compressed policies need clean components, which the
+        # mask-only path replaces with zeros (-> NaN/degenerate statistics)
+        raise ValueError(
+            f"separate_with_masks supports policies 'local'/'none'/'distant'; got {policy!r}"
+        )
+    Z = jnp.zeros_like(Y)
+
+    def one(m):
+        return tango(Y, Z, Z, m, m, mu=mu, policy=policy, ref_mic=ref_mic, mask_type=mask_type).yf
+
+    return jax.vmap(one)(masks)

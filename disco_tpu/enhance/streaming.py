@@ -77,13 +77,15 @@ def _block_covariances(Xb, Mb, lam):
     return jax.lax.scan(body, (R0, R0), (Xb, Mb))
 
 
-def _stream_filter(X, M, lam, u, mu, ref: int = 0):
+def _stream_filter(X, M, lam, u, mu, ref: int = 0, extras=None):
     """One node's streaming filter over a (T, F, D) frame stream.
 
     ``ref``: channel selected by the warm-up / skipped-refresh fallback
-    filter (the node's reference mic).
+    filter (the node's reference mic).  ``extras``: optional list of
+    (T, F, D) streams filtered with the same per-block filters (clean-
+    component diagnostics).
 
-    Returns (out (T, F), w_last (F, D), Rss_end, Rnn_end).
+    Returns (out (T, F), w_last (F, D), Rss_end, Rnn_end, filtered_extras).
     """
     T, F, D = X.shape
     pad = (-T) % u
@@ -118,10 +120,20 @@ def _stream_filter(X, M, lam, u, mu, ref: int = 0):
 
     _, w = jax.lax.scan(ffill, e_ref, w)
     out = jnp.einsum("bfd,bufd->buf", jnp.conj(w), Xb).reshape(B * u, F)[:T]
-    return out, w[-1], Rss_e, Rnn_e
+    if extras is not None:
+        # Apply the SAME per-block filters to auxiliary streams (clean
+        # speech/noise components) — the diagnostics of the offline path
+        # (sf/nf), produced by the one online filter.
+        filtered = []
+        for E in extras:
+            Ep = jnp.concatenate([E, jnp.zeros((pad, F, D), E.dtype)]) if pad else E
+            Eb = Ep.reshape(B, u, F, D)
+            filtered.append(jnp.einsum("bfd,bufd->buf", jnp.conj(w), Eb).reshape(B * u, F)[:T])
+        return out, w[-1], Rss_e, Rnn_e, filtered
+    return out, w[-1], Rss_e, Rnn_e, []
 
 
-@partial(jax.jit, static_argnames=("update_every", "ref_mic"))
+@partial(jax.jit, static_argnames=("update_every", "ref_mic", "with_diagnostics"))
 def streaming_step1(
     Y,
     mask_z,
@@ -129,6 +141,9 @@ def streaming_step1(
     update_every: int = 4,
     mu: float = 1.0,
     ref_mic: int = 0,
+    S=None,
+    N=None,
+    with_diagnostics: bool = False,
 ):
     """Streaming local MWF at one node: recursive covariance smoothing with a
     filter refresh every ``update_every`` frames.
@@ -136,18 +151,30 @@ def streaming_step1(
     Args:
       Y: (C, F, T) complex mixture STFT.
       mask_z: (F, T) step-1 mask.
+      S, N: optional clean component STFTs — with ``with_diagnostics=True``
+        the same online filter is applied to them, yielding z_s/z_n (the
+        filter-on-clean diagnostics of the offline path).
 
     Returns:
-      dict with z_y (F, T) compressed stream, zn (F, T) = y_ref - z, and the
-      final (Rss, Rnn, w) state for continuation.
+      dict with z_y (F, T) compressed stream, zn (F, T) = y_ref - z, the
+      final (Rss, Rnn, w) state for continuation, and z_s/z_n when
+      diagnostics are requested.
     """
-    X = jnp.moveaxis(Y, -1, 0).swapaxes(-1, -2)  # (T, F, C)
-    z, w, Rss, Rnn = _stream_filter(X, mask_z.T, lambda_cor, update_every, mu, ref=ref_mic)
+    def tfc(a):
+        return jnp.moveaxis(a, -1, 0).swapaxes(-1, -2)  # (C,F,T) -> (T,F,C)
+
+    extras = [tfc(S), tfc(N)] if with_diagnostics else None
+    z, w, Rss, Rnn, extra_out = _stream_filter(
+        tfc(Y), mask_z.T, lambda_cor, update_every, mu, ref=ref_mic, extras=extras
+    )
     z_y = z.T
-    return {"z_y": z_y, "zn": Y[ref_mic] - z_y, "Rss": Rss, "Rnn": Rnn, "w": w}
+    out = {"z_y": z_y, "zn": Y[ref_mic] - z_y, "Rss": Rss, "Rnn": Rnn, "w": w}
+    if with_diagnostics:
+        out["z_s"], out["z_n"] = extra_out[0].T, extra_out[1].T
+    return out
 
 
-@partial(jax.jit, static_argnames=("update_every", "ref_mic"))
+@partial(jax.jit, static_argnames=("update_every", "ref_mic", "with_diagnostics"))
 def streaming_tango(
     Y,
     masks_z,
@@ -156,35 +183,71 @@ def streaming_tango(
     update_every: int = 4,
     mu: float = 1.0,
     ref_mic: int = 0,
+    S=None,
+    N=None,
+    with_diagnostics: bool = False,
 ):
-    """Full two-step streaming TANGO over all nodes (mixture-only: the
-    deployment path — no oracle S/N needed).
+    """Full two-step streaming TANGO over all nodes (mixture-only by
+    default: the deployment path needs no oracle S/N).
 
     Step 1 streams per node (vmapped); the z-exchange is array indexing on
     one device (an all_gather over 'node' when mesh-sharded); step 2 streams
     the stacked [y_k ‖ z_{j≠k}] with consumer-side masks — the 'local'
-    policy of the offline pipeline (tango.py:418-420).
+    policy of the offline pipeline (tango.py:418-420).  Other mask-for-z
+    policies are an offline-only feature.
 
     Args:
       Y: (K, C, F, T) mixture STFTs.
       masks_z, mask_w: (K, F, T) step-1 / step-2 masks.
+      S, N: optional (K, C, F, T) clean components; with
+        ``with_diagnostics=True`` the SAME online filters are applied to
+        them, yielding sf/nf/z_s/z_n — every diagnostic then describes the
+        one deployed filter (no second offline pass).
 
     Returns:
-      dict with yf (K, F, T) enhanced outputs and z_y (K, F, T) streams.
+      dict with yf (K, F, T) enhanced outputs, z_y/zn (K, F, T) streams,
+      and sf/nf/z_s/z_n when diagnostics are requested.
     """
     K, C, F, T = Y.shape
     step1 = jax.vmap(
-        lambda y, m: streaming_step1(
-            y, m, lambda_cor=lambda_cor, update_every=update_every, mu=mu, ref_mic=ref_mic
+        lambda y, m, s, n: streaming_step1(
+            y, m, lambda_cor=lambda_cor, update_every=update_every, mu=mu, ref_mic=ref_mic,
+            S=s, N=n, with_diagnostics=with_diagnostics,
         )
     )
-    all_z = step1(Y, masks_z)["z_y"]  # (K, F, T)
+    s_in = S if with_diagnostics else Y
+    n_in = N if with_diagnostics else Y
+    s1 = step1(Y, masks_z, s_in, n_in)
+    all_z = s1["z_y"]  # (K, F, T)
 
     oth = jnp.asarray(others_index(K))  # (K, K-1)
-    stacked = jnp.concatenate([Y, all_z[oth]], axis=1)  # (K, C+K-1, F, T)
 
-    X = jnp.moveaxis(stacked, -1, 1).swapaxes(-1, -2)  # (K, T, F, D)
+    def stack_streams(base, z_streams):
+        return jnp.concatenate([base, z_streams[oth]], axis=1)  # (K, C+K-1, F, T)
+
+    def ktfd(a):
+        return jnp.moveaxis(a, -1, 1).swapaxes(-1, -2)  # (K, D, F, T) -> (K, T, F, D)
+
+    X = ktfd(stack_streams(Y, all_z))
     M = jnp.moveaxis(mask_w, -1, 1)  # (K, T, F)
+    if with_diagnostics:
+        Xs = ktfd(stack_streams(S, s1["z_s"]))
+        Xn = ktfd(stack_streams(N, s1["z_n"]))
+        stream2 = jax.vmap(
+            lambda x, m, xs, xn: _stream_filter(
+                x, m, lambda_cor, update_every, mu, ref=ref_mic, extras=[xs, xn]
+            )
+        )
+        yf, _, _, _, (sf, nf) = stream2(X, M, Xs, Xn)
+        return {
+            "yf": jnp.moveaxis(yf, 1, -1),
+            "sf": jnp.moveaxis(sf, 1, -1),
+            "nf": jnp.moveaxis(nf, 1, -1),
+            "z_y": all_z,
+            "zn": s1["zn"],
+            "z_s": s1["z_s"],
+            "z_n": s1["z_n"],
+        }
     stream2 = jax.vmap(lambda x, m: _stream_filter(x, m, lambda_cor, update_every, mu, ref=ref_mic)[0])
     yf = stream2(X, M)  # (K, T, F)
-    return {"yf": jnp.moveaxis(yf, 1, -1), "z_y": all_z}
+    return {"yf": jnp.moveaxis(yf, 1, -1), "z_y": all_z, "zn": s1["zn"]}

@@ -1,0 +1,244 @@
+"""The five BASELINE.json milestone configurations as executable programs.
+
+Each function builds its scenario, runs the jitted TPU pipeline, and returns
+a metrics dict (real-time factor + SI-SDR deltas).  The scales default to
+the BASELINE spec; every function takes size overrides so the test suite
+exercises all five end-to-end on CPU in seconds.
+
+1. ``mvdr_single_clip``      — 1 node, 4 mics, rank-1 GEVD-MWF, one clip.
+2. ``disco_mwf_4node``       — 4-node DISCO array, local MWF only (step 1).
+3. ``tango_4node``           — 4-node two-step DANSE MWF (TASLP 2021 setup),
+                               oracle or CRNN masks.
+4. ``meetit_separation``     — 8-node array, 2 competing speakers, per-source
+                               extraction (ICASSP 2021 setup).
+5. ``batched_meetit_end_to_end`` — 64 rooms x 8 nodes: ISM RIR simulation +
+                               convolution + enhancement as ONE jitted
+                               program on one mesh.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from disco_tpu.core.dsp import istft, stft
+from disco_tpu.core.metrics import si_sdr
+from disco_tpu.enhance import compute_z_signals, oracle_masks, tango
+from disco_tpu.sim.ism import fft_convolve, shoebox_rirs
+
+FS = 16000
+
+
+def _fence(x) -> float:
+    """Host readback of one element — the reliable execution fence on
+    tunneled device attachments, where block_until_ready() was measured
+    returning in ~20us for a >100ms program.  jnp.real first: the tunnel
+    cannot transfer complex dtypes.  Shared by bench.py."""
+    return float(jnp.real(jnp.ravel(x)[0]))
+
+
+def _scene(K, C, L, seed=0, noise_scale=0.8):
+    rng = np.random.default_rng(seed)
+    src = rng.standard_normal(L)
+    s = np.stack(
+        [np.stack([np.convolve(src, rng.standard_normal(8) * 0.5, mode="same") for _ in range(C)]) for _ in range(K)]
+    ).astype(np.float32)
+    n = noise_scale * rng.standard_normal((K, C, L)).astype(np.float32)
+    return s + n, s, n
+
+
+def _timed(fn, *args, iters=3):
+    out = fn(*args)
+    _fence(jax.tree_util.tree_leaves(out)[0])
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        _fence(jax.tree_util.tree_leaves(out)[0])
+        times.append(time.perf_counter() - t0)
+    return out, sorted(times)[len(times) // 2]
+
+
+def mvdr_single_clip(dur_s=5.0, seed=0, iters=3):
+    """Config 1: single 4-mic node, rank-1 GEVD-MWF on one clip."""
+    from disco_tpu.beam.covariance import masked_covariances
+    from disco_tpu.beam.filters import gevd_mwf
+    from disco_tpu.core.masks import tf_mask
+
+    L = int(dur_s * FS)
+    y, s, n = _scene(1, 4, L, seed)
+
+    @jax.jit
+    def run(y, s, n):
+        Y, S, N = stft(y[0]), stft(s[0]), stft(n[0])
+        mask = tf_mask(S[0], N[0], "irm1")
+        Rss, Rnn = masked_covariances(Y, mask)
+        w, _ = gevd_mwf(Rss, Rnn, mu=1.0, rank=1)
+        yf = jnp.einsum("fc,cft->ft", jnp.conj(w), Y)
+        return istft(yf, length=y.shape[-1])
+
+    enh, dt = _timed(run, y, s, n, iters=iters)
+    enh = np.asarray(enh)
+    return {
+        "config": "mvdr_single_clip",
+        "rtf": dur_s / dt,
+        "si_sdr_in": float(si_sdr(s[0, 0], y[0, 0])),
+        "si_sdr_out": float(si_sdr(s[0, 0], enh)),
+    }
+
+
+def disco_mwf_4node(dur_s=5.0, K=4, C=4, seed=0, iters=3):
+    """Config 2: 4-node DISCO array, local MWF only (TANGO step 1 — each
+    node beamforms its own mics, no z exchange, oracle masks)."""
+    L = int(dur_s * FS)
+    y, s, n = _scene(K, C, L, seed)
+
+    @jax.jit
+    def run(y, s, n):
+        out = compute_z_signals(y, s, n, mask_type="irm1")
+        return istft(out["z_y"], length=y.shape[-1])
+
+    enh, dt = _timed(run, y, s, n, iters=iters)
+    enh = np.asarray(enh)
+    deltas = [float(si_sdr(s[k, 0], enh[k]) - si_sdr(s[k, 0], y[k, 0])) for k in range(K)]
+    return {"config": "disco_mwf_4node", "rtf": K * dur_s / dt, "delta_si_sdr": deltas}
+
+
+def tango_4node(dur_s=5.0, K=4, C=4, seed=0, iters=3, models=(None, None)):
+    """Config 3: the full two-step DANSE-style distributed MWF (TASLP 2021).
+    ``models``: (step1, step2) CRNN (module, variables) pairs or None for
+    oracle masks."""
+    from disco_tpu.enhance.driver import estimate_masks
+
+    L = int(dur_s * FS)
+    y, s, n = _scene(K, C, L, seed)
+    Y, S, N = stft(y), stft(s), stft(n)
+    masks_z, mask_w = estimate_masks(Y, S, N, models, "irm1", K)
+
+    @jax.jit
+    def run(Y, S, N, masks_z, mask_w):
+        res = tango(Y, S, N, masks_z, mask_w, policy="local")
+        return istft(res.yf, length=L)
+
+    enh, dt = _timed(run, Y, S, N, masks_z, mask_w, iters=iters)
+    enh = np.asarray(enh)
+    deltas = [float(si_sdr(s[k, 0], enh[k]) - si_sdr(s[k, 0], y[k, 0])) for k in range(K)]
+    return {"config": "tango_4node", "rtf": K * dur_s / dt, "delta_si_sdr": deltas}
+
+
+def meetit_separation(dur_s=5.0, K=8, C=4, n_src=2, seed=0, iters=3):
+    """Config 4: 8-node array, 2 competing speakers (ICASSP 2021): per-source
+    oracle IRMs drive one TANGO pass per source; each speaker is evaluated at
+    the node facing it (node k attends source k % n_src)."""
+    rng = np.random.default_rng(seed)
+    L = int(dur_s * FS)
+    srcs = [rng.standard_normal(L) for _ in range(n_src)]
+    imgs = np.stack(
+        [
+            np.stack(
+                [np.stack([np.convolve(src, rng.standard_normal(8) * 0.5, mode="same") for _ in range(C)]) for _ in range(K)]
+            )
+            for src in srcs
+        ]
+    ).astype(np.float32)  # (n_src, K, C, L)
+    y = imgs.sum(0)
+
+    from disco_tpu.enhance import separate_sources
+
+    @jax.jit
+    def run(y, imgs):
+        Y = stft(y)
+        S_imgs = stft(imgs)
+        est = separate_sources(Y, S_imgs)  # (n_src, K, F, T)
+        return istft(est, length=y.shape[-1])
+
+    est, dt = _timed(run, y, imgs, iters=iters)
+    est = np.asarray(est)
+    deltas = []
+    for k in range(K):
+        si = k % n_src
+        ref = imgs[si, k, 0]
+        deltas.append(float(si_sdr(ref, est[si, k]) - si_sdr(ref, y[k, 0])))
+    return {"config": "meetit_separation", "rtf": K * dur_s / dt, "delta_si_sdr": deltas}
+
+
+def batched_meetit_end_to_end(
+    n_rooms=64, K=8, C=2, dur_s=2.0, max_order=10, rir_len=2048, seed=0, iters=1
+):
+    """Config 5: ISM room simulation + convolution + two-step enhancement for
+    ``n_rooms`` rooms as ONE jitted program — simulation and enhancement
+    share the mesh/device (the north-star end-to-end config).
+
+    Geometry is sampled host-side (rejection sampling stays out of jit,
+    SURVEY.md §7 hard-part 5); everything after the draw runs on device.
+    """
+    rng = np.random.default_rng(seed)
+    L = int(dur_s * FS)
+    M = K * C
+
+    dims = rng.uniform([4, 4, 2.5], [8, 6, 3], size=(n_rooms, 3)).astype(np.float32)
+    mics = (dims[:, None, :] * rng.uniform(0.2, 0.8, size=(n_rooms, M, 3))).astype(np.float32)
+    srcs = (dims[:, None, :] * rng.uniform(0.2, 0.8, size=(n_rooms, 2, 3))).astype(np.float32)
+    alphas = rng.uniform(0.3, 0.6, size=(n_rooms,)).astype(np.float32)
+    dry = rng.standard_normal((n_rooms, 2, L)).astype(np.float32)
+
+    @jax.jit
+    def run(dims, srcs, mics, alphas, dry):
+        def one_room(dim, src, mic, alpha, sig):
+            rirs = shoebox_rirs(dim, src, mic, alpha, max_order=max_order, rir_len=rir_len)
+            imgs = fft_convolve(sig[:, None, :], rirs, out_len=L)  # (2, M, L)
+            s_img, n_img = imgs[0], imgs[1]
+            y = (s_img + n_img).reshape(K, C, L)
+            s = s_img.reshape(K, C, L)
+            n = n_img.reshape(K, C, L)
+            Y, S, N = stft(y), stft(s), stft(n)
+            m = oracle_masks(S, N, "irm1")
+            res = tango(Y, S, N, m, m, policy="local")
+            return istft(res.yf, length=L), s
+        return jax.vmap(one_room)(dims, srcs, mics, alphas, dry)
+
+    (enh, s_ref), dt = _timed(run, dims, srcs, mics, alphas, dry, iters=iters)
+    enh = np.asarray(enh)
+    s_ref = np.asarray(s_ref)
+    # SI-SDR of the enhanced output vs the clean image at each node's ref mic
+    sdrs = [
+        float(si_sdr(s_ref[r, k, 0], enh[r, k]))
+        for r in range(min(n_rooms, 4))
+        for k in range(K)
+    ]
+    return {
+        "config": "batched_meetit_end_to_end",
+        "rtf": n_rooms * K * dur_s / dt,
+        "rooms": n_rooms,
+        "mean_si_sdr_out": float(np.mean(sdrs)),
+    }
+
+
+def run_all(tiny: bool = False):
+    """All five milestone configs; ``tiny=True`` shrinks every scale for
+    CPU test runs."""
+    if tiny:
+        return [
+            mvdr_single_clip(dur_s=1.0, iters=1),
+            disco_mwf_4node(dur_s=1.0, iters=1),
+            tango_4node(dur_s=1.0, iters=1),
+            meetit_separation(dur_s=1.0, K=4, C=2, iters=1),
+            batched_meetit_end_to_end(n_rooms=2, K=2, C=2, dur_s=0.5, max_order=4, rir_len=1024, iters=1),
+        ]
+    return [
+        mvdr_single_clip(),
+        disco_mwf_4node(),
+        tango_4node(),
+        meetit_separation(),
+        batched_meetit_end_to_end(),
+    ]
+
+
+if __name__ == "__main__":
+    import json
+
+    for res in run_all():
+        print(json.dumps(res))

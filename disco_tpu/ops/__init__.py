@@ -1,3 +1,3 @@
-from disco_tpu.ops.stft_ops import dft_matrices, stft_matmul, stft_pallas
+from disco_tpu.ops.stft_ops import dft_matrices, idft_matrices, istft_matmul, stft_matmul, stft_pallas
 
-__all__ = ["dft_matrices", "stft_matmul", "stft_pallas"]
+__all__ = ["dft_matrices", "idft_matrices", "istft_matmul", "stft_matmul", "stft_pallas"]

@@ -139,3 +139,67 @@ def stft_pallas(
     )(A0, A1, Dre, Dim, win)
     spec = jax.lax.complex(re, im)[:, :n_frames]
     return jnp.swapaxes(spec, -1, -2).reshape(bs + (n_freq, n_frames))
+
+
+@functools.lru_cache(maxsize=8)
+def idft_matrices(n_fft: int = N_FFT):
+    """(n_fft//2+1, n_fft) inverse-rDFT matrices: ``x = re @ A + im @ B``
+    for a conjugate-symmetric spectrum (exact integer-mod angles, float64
+    host precompute).  Returned as numpy (see dft_matrices)."""
+    assert n_fft % 2 == 0, "idft_matrices assumes even n_fft (real Nyquist bin)"
+    n_freq = n_fft // 2 + 1
+    k = np.arange(n_freq, dtype=np.int64)[:, None]
+    n = np.arange(n_fft, dtype=np.int64)[None, :]
+    ang = 2.0 * np.pi * ((k * n) % n_fft) / n_fft
+    # weights: DC and Nyquist count once, middle bins twice (conj symmetry)
+    w = np.full((n_freq, 1), 2.0)
+    w[0] = w[-1] = 1.0
+    A = (w * np.cos(ang) / n_fft).astype(np.float32)
+    B = (-w * np.sin(ang) / n_fft).astype(np.float32)
+    return A, B
+
+
+@partial(jax.jit, static_argnames=("length", "n_fft", "hop"))
+def istft_matmul(spec: jnp.ndarray, length: int, n_fft: int = N_FFT, hop: int = N_HOP) -> jnp.ndarray:
+    """Inverse centered STFT as two MXU matmuls + the 50%-overlap chunk-add
+    (no scatter): the synthesis dual of :func:`stft_matmul`, with squared-
+    window OLA normalization identical to ``disco_tpu.core.dsp.istft``.
+    """
+    assert n_fft == 2 * hop, "matmul ISTFT assumes 50% overlap (n_fft == 2*hop)"
+    spec = jnp.asarray(spec)
+    batch_shape = spec.shape[:-2]
+    n_freq, n_frames = spec.shape[-2:]
+    assert n_freq == n_fft // 2 + 1, (n_freq, n_fft)
+    pad = n_fft // 2
+
+    A, B = (jnp.asarray(d) for d in idft_matrices(n_fft))
+    sp = jnp.swapaxes(spec.reshape((-1, n_freq, n_frames)), -1, -2)  # (B, T, F)
+    frames = (
+        jnp.matmul(jnp.real(sp), A, precision="float32")
+        + jnp.matmul(jnp.imag(sp), B, precision="float32")
+    )  # (B, T, n_fft)
+    win = _hann(n_fft, frames.dtype)
+    frames = frames * win
+
+    # OLA via the chunk trick: output chunk c = frames[c][:hop] + frames[c-1][hop:]
+    first = frames[..., :hop]  # (B, T, hop)
+    second = frames[..., hop:]
+    total_chunks = n_frames + 1
+    y = jnp.zeros((frames.shape[0], total_chunks, hop), frames.dtype)
+    y = y.at[:, :n_frames].add(first)
+    y = y.at[:, 1:].add(second)
+    y = y.reshape(frames.shape[0], total_chunks * hop)
+
+    # squared-window normalization (identical accumulation in chunk form)
+    w2_first = (win**2)[:hop]
+    w2_second = (win**2)[hop:]
+    wss = jnp.zeros(total_chunks * hop, frames.dtype)
+    wss = wss.reshape(total_chunks, hop).at[:n_frames].add(w2_first).at[1:].add(w2_second).reshape(-1)
+    tiny = jnp.finfo(frames.dtype).tiny
+    y = jnp.where(wss > tiny, y / jnp.where(wss > tiny, wss, 1.0), y)
+
+    y = y[:, pad : pad + length]
+    out_pad = length - y.shape[-1]
+    if out_pad > 0:
+        y = jnp.pad(y, ((0, 0), (0, out_pad)))
+    return y.reshape(batch_shape + (length,)).astype(jnp.float32)

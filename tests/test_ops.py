@@ -56,3 +56,35 @@ def test_stft_dispatch_explicit(sig):
 def test_stft_matmul_requires_half_overlap():
     with pytest.raises(AssertionError, match="50%"):
         stft_matmul(np.zeros((1, 4096), "float32"), n_fft=512, hop=128)
+
+
+def test_istft_matmul_matches_ola(sig):
+    from disco_tpu.core.dsp import _istft_ola
+    from disco_tpu.ops import istft_matmul
+
+    S = np.asarray(_stft_rfft(sig))
+    a = np.asarray(_istft_ola(S, length=sig.shape[-1]))
+    b = np.asarray(istft_matmul(S, length=sig.shape[-1]))
+    assert np.max(np.abs(a - b)) < 1e-4
+    # perfect reconstruction of the original signal
+    assert np.max(np.abs(b - sig)) < 1e-4
+
+
+def test_istft_matmul_length_padding(sig):
+    from disco_tpu.ops import istft_matmul
+
+    S = np.asarray(_stft_rfft(sig[:1]))
+    longer = np.asarray(istft_matmul(S, length=sig.shape[-1] + 3000))
+    assert longer.shape[-1] == sig.shape[-1] + 3000
+    assert np.all(longer[:, -2000:] == 0.0)
+
+
+def test_istft_dispatch_explicit(sig):
+    from disco_tpu.core.dsp import istft
+
+    S = np.asarray(_stft_rfft(sig))
+    a = np.asarray(istft(S, length=sig.shape[-1], impl="irfft"))
+    b = np.asarray(istft(S, length=sig.shape[-1], impl="matmul"))
+    assert np.max(np.abs(a - b)) < 1e-4
+    with pytest.raises(ValueError, match="unknown istft impl"):
+        istft(S, length=100, impl="bogus")

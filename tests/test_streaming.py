@@ -64,3 +64,20 @@ def test_streaming_state_is_finite(scene):
     out = streaming_step1(Y, mask)
     for key in ("Rss", "Rnn", "w", "z_y", "zn"):
         assert np.isfinite(np.asarray(out[key])).all(), key
+
+
+def test_streaming_diagnostics_single_filter(scene):
+    """with_diagnostics: sf/nf come from the SAME per-block filters as yf —
+    linearity check: filter(S) + filter(N) == filter(Y) when Y = S + N."""
+    from disco_tpu.enhance.streaming import streaming_tango
+
+    y, s, n, L = scene
+    Y, S, N = stft(y), stft(s), stft(n)
+    masks = oracle_masks(S, N, "irm1")
+    out = streaming_tango(Y, masks, masks, S=S, N=N, with_diagnostics=True)
+    for key in ("yf", "sf", "nf", "z_s", "z_n", "zn"):
+        assert key in out
+    lhs = np.asarray(out["sf"] + out["nf"])
+    rhs = np.asarray(out["yf"])
+    err = np.max(np.abs(lhs - rhs)) / (np.max(np.abs(rhs)) + 1e-30)
+    assert err < 1e-3, err
