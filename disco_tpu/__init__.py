@@ -9,13 +9,23 @@ treated as array axes on a TPU mesh instead of Python loops.
 
 Subpackages
 -----------
-core      DSP kernels: STFT/ISTFT filterbank, TF masks, VAD, math utilities, metrics
-beam      spatial covariance estimation + MWF / rank-1 MWF / GEVD-MWF filters
-enhance   the TANGO two-step distributed enhancement pipeline
-parallel  mesh topology + shard_map node-parallel execution (z = all_gather over ICI)
-nn        Flax CRNN mask estimator + training engine
-sim       room geometry sampling, batched image-source RIRs, FFT convolution
-io        wav / npy I/O and the dataset file layout
+core       DSP kernels: STFT/ISTFT filterbank, TF masks, VAD, math utilities,
+           metrics (incl. native STOI), misc/yaml helpers
+ops        MXU matmul STFT/ISTFT kernels + fused pallas STFT
+beam       spatial covariance estimation + MWF / rank-1 MWF / GEVD-MWF filters
+enhance    TANGO two-step pipeline (offline + streaming), separation, z export,
+           the per-RIR results driver
+parallel   mesh topology + shard_map node/frame-parallel execution
+           (z = all_gather over ICI; psum'd covariances for frame sharding),
+           multi-host hybrid ICI/DCN meshes
+nn         Flax CRNN mask estimator, training engine, corpus datasets,
+           native C++ fast loader
+sim        room geometry sampling, batched image-source RIRs, FFT convolution
+datagen    DISCO/MEETIT corpus generation, mixing pass, downloaders
+io         wav / npy I/O and the dataset file layout
+cli        argparse entry points (disco-gen / -mix / -tango / -train / ...)
+utils      complex-safe host<->device transfer, profiling
+milestones the five BASELINE benchmark configurations
 """
 
 __version__ = "0.1.0"

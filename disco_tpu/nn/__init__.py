@@ -33,3 +33,4 @@ __all__ = [
     "SaveAndStop", "TrainState", "create_train_state", "fit", "get_model_name",
     "load_checkpoint", "load_params_for_inference", "make_step_fns", "save_checkpoint",
 ]
+from disco_tpu.nn import fastload

@@ -1,4 +1,5 @@
 from disco_tpu.parallel.mesh import (
+    ring_all_gather,
     make_mesh,
     make_mesh_2d,
     node_sharding,
@@ -8,6 +9,7 @@ from disco_tpu.parallel.mesh import (
 from disco_tpu.parallel.multihost import distributed_init, hybrid_mesh
 
 __all__ = [
+    "ring_all_gather",
     "make_mesh",
     "make_mesh_2d",
     "node_sharding",

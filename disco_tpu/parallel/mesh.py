@@ -61,12 +61,47 @@ def node_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P("node"))
 
 
+def ring_all_gather(x, axis_name: str):
+    """All-gather over ``axis_name`` built from K-1 ``ppermute`` ring hops —
+    the explicit ring-collective formulation (scaling-book style): each
+    device forwards what it last received to its ring neighbour, so every
+    step moves one shard over one ICI link and compute can overlap
+    communication.  Semantically identical to ``jax.lax.all_gather(...,
+    tiled=True)`` with the shard's leading axis concatenated in node order.
+
+    Args:
+      x: per-device shard, leading axis = local shard rows.
+      axis_name: mesh axis to gather over.
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]  # send to the next device
+
+    def hop(carry, _):
+        received = jax.lax.ppermute(carry, axis_name, perm)
+        return received, received
+
+    _, hops = jax.lax.scan(hop, x, None, length=n - 1)  # (n-1, rows, ...)
+    # hops[j] on device idx is the shard of device (idx - 1 - j) mod n;
+    # scatter all pieces (own + received) into node order.
+    pieces = jnp.concatenate([x[None], hops], axis=0)  # (n, rows, ...)
+    src_dev = jnp.mod(idx - jnp.arange(n), n)  # piece j came from src_dev[j]
+    order = jnp.argsort(src_dev)
+    pieces = jnp.take(pieces, order, axis=0)
+    return pieces.reshape((-1,) + x.shape[1:])
+
+
 def _tango_on_mesh(
     Y, S, N, masks_z, mask_w, mesh, frame_axis, mu, policy, ref_mic, mask_type,
-    oracle_step1_stats,
+    oracle_step1_stats, z_exchange: str = "all_gather",
 ) -> TangoResult:
     """Shared shard_map body for the node-sharded and node+frame-sharded
-    pipelines — identical math, different partition specs."""
+    pipelines — identical math, different partition specs.
+
+    ``z_exchange``: 'all_gather' (one XLA collective) or 'ring' (explicit
+    K-1 ppermute hops, see :func:`ring_all_gather`) — bit-identical results,
+    different collective schedules.
+    """
     K = Y.shape[0]
     assert K % mesh.shape["node"] == 0, (K, dict(mesh.shape))
     if frame_axis is not None:
@@ -75,6 +110,12 @@ def _tango_on_mesh(
 
     spec4 = P("node", None, None, frame_axis)
     spec3 = P("node", None, frame_axis)
+
+    gather = (
+        (lambda v: ring_all_gather(v, "node"))
+        if z_exchange == "ring"
+        else (lambda v: jax.lax.all_gather(v, "node", axis=0, tiled=True))
+    )
 
     @partial(
         jax.shard_map,
@@ -94,13 +135,10 @@ def _tango_on_mesh(
 
         # THE z-exchange: one compressed stream per node over ICI (per frame
         # shard when the frame axis is sharded).
-        all_z = {
-            key: jax.lax.all_gather(val, "node", axis=0, tiled=True)
-            for key, val in local_z.items()
-        }
-        all_masks_w = jax.lax.all_gather(mwk, "node", axis=0, tiled=True)
-        all_S_ref = jax.lax.all_gather(Sk[:, ref_mic], "node", axis=0, tiled=True)
-        all_N_ref = jax.lax.all_gather(Nk[:, ref_mic], "node", axis=0, tiled=True)
+        all_z = {key: gather(val) for key, val in local_z.items()}
+        all_masks_w = gather(mwk)
+        all_S_ref = gather(Sk[:, ref_mic])
+        all_N_ref = gather(Nk[:, ref_mic])
 
         k = jax.lax.axis_index("node")
         n_local = Yk.shape[0]  # nodes per device (1 when K == n_devices)
@@ -125,7 +163,7 @@ def _tango_on_mesh(
 
 @partial(
     jax.jit,
-    static_argnames=("mesh", "policy", "ref_mic", "mask_type", "oracle_step1_stats"),
+    static_argnames=("mesh", "policy", "ref_mic", "mask_type", "oracle_step1_stats", "z_exchange"),
 )
 def tango_sharded(
     Y,
@@ -139,6 +177,7 @@ def tango_sharded(
     ref_mic: int = 0,
     mask_type: str = "irm1",
     oracle_step1_stats: bool = False,
+    z_exchange: str = "all_gather",
 ) -> TangoResult:
     """Two-step TANGO with the node axis sharded over ``mesh``'s 'node' axis.
 
@@ -152,7 +191,7 @@ def tango_sharded(
     """
     return _tango_on_mesh(
         Y, S, N, masks_z, mask_w, mesh, None, mu, policy, ref_mic, mask_type,
-        oracle_step1_stats,
+        oracle_step1_stats, z_exchange,
     )
 
 
