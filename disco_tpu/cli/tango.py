@@ -33,6 +33,9 @@ def build_parser():
     p.add_argument("--out_root", default=None, help="override results directory")
     p.add_argument("--streaming", action="store_true",
                    help="frame-recursive online pipeline (smoothed covariances)")
+    p.add_argument("--bucket", type=int, default=0,
+                   help="round clip lengths up to this many samples to cap "
+                        "recompiles on ragged corpora (0 = off; ~2 dB boundary effect)")
     return p
 
 
@@ -58,7 +61,7 @@ def main(argv=None):
         args.dataset, args.scenario, args.rir, args.noise,
         save_dir=args.sav_dir, snr_range=tuple(args.snr),
         mask_type=args.vad_type[0], policy=policy, models=models,
-        out_root=args.out_root, streaming=args.streaming,
+        out_root=args.out_root, streaming=args.streaming, bucket=args.bucket,
     )
     if results is None:
         print(f"Conf {args.rir} with {args.noise} noise already processed")

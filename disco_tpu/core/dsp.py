@@ -157,3 +157,18 @@ def _istft_ola(
     if out_pad > 0:
         y = jnp.pad(y, ((0, 0), (0, out_pad)))
     return y.reshape(batch_shape + (length,)).astype(jnp.float32)
+
+
+def bucket_length(length: int, bucket: int = 8192) -> int:
+    """Round a clip length up to a bucket multiple (SURVEY.md §7 hard-part
+    3: ragged test clips would otherwise trigger one XLA compile per unique
+    length).  Zero-padded frames contribute zero outer products, scaling
+    BOTH covariances by the same frame-count ratio — the GEVD filter is
+    invariant under that joint scaling (disco_tpu.beam.filters.gevd_mwf) —
+    and padded output samples are trimmed by ``istft(length=true_length)``.
+    The only change is the clip-end boundary: the 2-3 final analysis frames
+    see [tail ‖ zeros] instead of the reflected tail, perturbing the
+    covariance statistics at the ~2% level (measured SDR shift < 2 dB,
+    typically neutral-to-positive) — the same tradeoff as the reference's
+    fixed 11 s train padding (convolve_signals.py:275-279)."""
+    return -(-length // bucket) * bucket

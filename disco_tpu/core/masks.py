@@ -32,7 +32,9 @@ def tf_mask(s: jnp.ndarray, n: jnp.ndarray, mask_type: str = "irm1", bin_thr: fl
         xi = (jnp.abs(s) / jnp.maximum(jnp.abs(n), _EPS)) ** power
         return (xi >= db2lin(bin_thr)).astype(s.real.dtype)
     if family == "iam":
-        return (jnp.abs(s) / jnp.abs(s + n)) ** power
+        # eps floor: all-silent bins (|s+n| = 0, e.g. zero-padded frames)
+        # must yield 0, not 0/0 = NaN
+        return (jnp.abs(s) / jnp.maximum(jnp.abs(s + n), _EPS)) ** power
     raise ValueError('Unknown mask type. Should be "irmX", "ibmX" or "iamX"')
 
 

@@ -134,6 +134,7 @@ def enhance_rir(
     force: bool = False,
     save_fig: bool = True,
     streaming: bool = False,
+    bucket: int = 0,
 ):
     """Enhance one RIR end-to-end and persist everything (reference
     tango.py:460-641).  ``models``: per-step CRNN params or None for the
@@ -157,8 +158,19 @@ def enhance_rir(
         layout, rir, noise, snr_range, n_nodes, mics_per_node
     )
     L = y.shape[-1]
+    if bucket:
+        from disco_tpu.core.dsp import bucket_length
 
-    Y, S, N = stft(jnp.asarray(y)), stft(jnp.asarray(s)), stft(jnp.asarray(n))
+        Lp = bucket_length(L, bucket)
+        pad = ((0, 0), (0, 0), (0, Lp - L))
+        y_in, s_in, n_in = np.pad(y, pad), np.pad(s, pad), np.pad(n, pad)
+    else:
+        y_in, s_in, n_in = y, s, n
+
+    from disco_tpu.core.dsp import n_stft_frames
+
+    T_true = n_stft_frames(L)  # saved masks/z trimmed to the true frames
+    Y, S, N = stft(jnp.asarray(y_in)), stft(jnp.asarray(s_in)), stft(jnp.asarray(n_in))
     masks_z, mask_w = estimate_masks(Y, S, N, models, mask_type, n_nodes, mu=mu)
     if streaming:
         # The online pipeline implements the 'local' mask-for-z policy only
@@ -211,9 +223,9 @@ def enhance_rir(
         write_wav(out / "WAV" / str(rir) / f"out_noi-{tag}.wav", nf_t[k], fs)
         write_wav(out / "WAV" / str(rir) / f"in_tar-{tag}.wav", s0, fs)
         write_wav(out / "WAV" / str(rir) / f"out_tar-{tag}.wav", sf_t[k], fs)
-        np.save(out / "MASK" / str(rir) / f"step1_{tag}", np.asarray(res.masks_z[k]))
-        np.save(out / "MASK" / str(rir) / f"step2_{tag}", np.asarray(res.mask_w[k]))
-        np.save(zdir / f"{rir}_{tag}", to_host(res.z_y[k]))
+        np.save(out / "MASK" / str(rir) / f"step1_{tag}", np.asarray(res.masks_z[k, :, :T_true]))
+        np.save(out / "MASK" / str(rir) / f"step2_{tag}", np.asarray(res.mask_w[k, :, :T_true]))
+        np.save(zdir / f"{rir}_{tag}", to_host(res.z_y[k, :, :T_true]))
 
     def stack_keys(dicts):
         return {k: np.array([d[k] for d in dicts]) for k in dicts[0]}
