@@ -25,6 +25,8 @@ from disco_tpu.nn.training import create_train_state, fit
 
 def build_parser():
     p = argparse.ArgumentParser(description="Train the mask-estimation CRNN")
+    p.add_argument("--archi", choices=["crnn", "rnn"], default="crnn",
+                   help="mask estimator: CRNN (3-D windows) or 2-D RNN (freq-stacked)")
     p.add_argument("--scene", default="living")
     p.add_argument("--noise", choices=["ssn", "it", "fs", "noit", "all"], default="ssn")
     p.add_argument("--zsigs", "-zs", nargs="+", default=["zs_hat"])
@@ -62,8 +64,12 @@ def main(argv=None):
         )
 
     # single-channel: stack_axis 0; multichannel: z's on the channel axis
-    # (3-D CRNN input, reference train.py:73-74)
-    stack_axis = 0 if args.single_channel else 2
+    # for the CRNN (3-D input) or on the freq axis for the 2-D RNN
+    # (reference train.py:73-74)
+    if args.single_channel:
+        stack_axis = 0
+    else:
+        stack_axis = 2 if args.archi == "crnn" else 1
     dataset = DiscoDataset(
         lists, stack_axis=stack_axis, win_len=cfg.win_len, win_hop=cfg.win_hop, rng=rng
     )
@@ -83,7 +89,12 @@ def main(argv=None):
         return gen
 
     n_ch = 1 if args.single_channel else 1 + dataset.z_nodes
-    model, tx = build_crnn(n_ch=n_ch, win_len=cfg.win_len, n_freq=cfg.ff_units, learning_rate=cfg.lr)
+    if args.archi == "crnn":
+        model, tx = build_crnn(n_ch=n_ch, win_len=cfg.win_len, n_freq=cfg.ff_units, learning_rate=cfg.lr)
+    else:
+        from disco_tpu.nn.crnn import build_rnn
+
+        model, tx = build_rnn(n_ch=n_ch, win_len=cfg.win_len, n_freq=cfg.ff_units, learning_rate=cfg.lr)
     x0, _ = dataset[0]
     state = create_train_state(model, tx, x0[None], seed=args.seed)
 

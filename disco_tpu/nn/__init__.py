@@ -1,7 +1,7 @@
 """The DNN stack: Flax CRNN mask estimator, data pipeline, training engine
 (TPU-native counterpart of reference disco_theque/dnn/)."""
 from disco_tpu.nn.bricks import CNN2d, FF, RNN, cnn_output_dim
-from disco_tpu.nn.crnn import CRNN, build_crnn, loss_frame_bounds
+from disco_tpu.nn.crnn import RNNMask, build_rnn, CRNN, build_crnn, loss_frame_bounds
 from disco_tpu.nn.data import (
     DiscoDataset,
     DiscoPartialDataset,

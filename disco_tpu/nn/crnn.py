@@ -142,3 +142,60 @@ def build_crnn(
     if clip_grad_norm:
         tx = optax.chain(optax.clip_by_global_norm(clip_grad_norm), tx)
     return model, tx
+
+
+class RNNMask(_HashableFields, nn.Module):
+    """2-D RNN mask estimator — the reference's 'rnn' architecture path
+    (freq-stacked inputs, ``stack_axis=1`` in datasets.py:120-151 and the
+    2-D branch of speech_enhancement/utils.py prepare_data:100-120): a
+    recurrent stack straight over (B, T, n_ch*n_freq) windows, no convs, so
+    every input frame maps to an output frame (no conv cropping)."""
+
+    input_shape: Sequence[int]  # (win_len, n_ch * n_freq)
+    rnn_units: Sequence[int] = (256, 256)
+    rnn_cell: str = "gru"
+    rnn_dropouts: Any = 0.0
+    rnn_bi: Any = False
+    ff_units: Any = (257,)
+    ff_activation: Any = "sigmoid"
+
+    def conv_output_hw(self) -> tuple[int, int]:
+        """No conv cropping: output frames == input frames (for the shared
+        frames_lost bookkeeping of enhance/inference.py)."""
+        return self.input_shape[0], self.input_shape[1]
+
+    def loss_frames(self, output_frames) -> tuple[tuple[int, int], tuple[int, int]]:
+        win = self.input_shape[0]
+        return (loss_frame_bounds(win, output_frames), loss_frame_bounds(win, output_frames))
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 4:  # (B, C, T, F) → freq-stack the channels
+            b, c, t, f = x.shape
+            x = jnp.transpose(x, (0, 2, 1, 3)).reshape(b, t, c * f)
+        x = RNN(
+            features=tuple(self.rnn_units),
+            cell_type=self.rnn_cell,
+            dropouts=self.rnn_dropouts,
+            bidirectional=self.rnn_bi,
+        )(x, train=train)
+        return FF(features=self.ff_units, activations=self.ff_activation)(x)
+
+
+def build_rnn(
+    n_ch: int = 1,
+    win_len: int = 21,
+    n_freq: int = 257,
+    learning_rate: float = 1e-3,
+    clip_grad_norm: float | None = None,
+    **overrides,
+):
+    """(model, optax tx) for the 2-D RNN architecture — the 'rnn' branch the
+    reference selects with archi != 'crnn' (train.py:73-74 stack_axis=1,
+    utils.py 2-D tensors)."""
+    overrides.setdefault("ff_units", (n_freq,))
+    model = RNNMask(input_shape=(win_len, n_ch * n_freq), **overrides)
+    tx = optax.rmsprop(learning_rate, decay=0.99, eps=1e-8)
+    if clip_grad_norm:
+        tx = optax.chain(optax.clip_by_global_norm(clip_grad_norm), tx)
+    return model, tx

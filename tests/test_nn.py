@@ -203,3 +203,39 @@ def test_fit_smoke_with_random_dataset(tmp_path):
 def test_get_model_name():
     assert len(get_model_name()) == 4
     assert get_model_name("models/ab3X_model.msgpack") == "ab3X_retrain"
+
+
+# ------------------------------------------------------- 2-D RNN architecture
+def test_rnn_mask_forward_shapes():
+    from disco_tpu.nn.crnn import build_rnn
+
+    model, tx = build_rnn(n_ch=1, win_len=21, n_freq=33)
+    x = np.random.default_rng(0).random((2, 21, 33)).astype("float32")
+    state = create_train_state(model, tx, x[:1])
+    out = model.apply({"params": state.params, "batch_stats": state.batch_stats}, jnp.asarray(x))
+    assert out.shape == (2, 21, 33)  # no conv cropping: frame-per-frame
+
+
+def test_rnn_mask_freq_stacks_channels():
+    from disco_tpu.nn.crnn import build_rnn
+
+    model, tx = build_rnn(n_ch=4, win_len=21, n_freq=33)
+    x = np.random.default_rng(0).random((2, 4, 21, 33)).astype("float32")
+    state = create_train_state(model, tx, x[:1])
+    out = model.apply({"params": state.params, "batch_stats": state.batch_stats}, jnp.asarray(x))
+    assert out.shape == (2, 21, 33)
+
+
+def test_rnn_mask_trains():
+    from disco_tpu.nn.crnn import build_rnn
+
+    rng = np.random.default_rng(1)
+    model, tx = build_rnn(n_ch=1, win_len=11, n_freq=17, rnn_units=(16,), ff_units=(17,))
+    x = rng.random((8, 11, 17)).astype("float32")
+    y = (rng.random((8, 11, 17)) > 0.5).astype("float32")
+    state = create_train_state(model, tx, x[:1])
+    train_step, eval_step = make_step_fns(model, "all", n_freq=17)
+    first = float(eval_step(state, jnp.asarray(x), jnp.asarray(y)))
+    for _ in range(30):
+        state, loss = train_step(state, jnp.asarray(x), jnp.asarray(y))
+    assert float(loss) < first
