@@ -211,3 +211,23 @@ def test_export_z_files_and_idempotency(tmp_path):
             assert not np.iscomplexobj(np.load(nrm))
     # second call is a no-op (idempotency guard)
     assert export_z(str(tmp_path), "random", 1, "ssn") is False
+
+
+def test_crnn_mask_with_rnn_architecture():
+    """The inference path also serves the 2-D RNN family (freq-stacked
+    windows, three_d_tensor=False — the reference's 2-D branch of
+    prepare_data, utils.py:100-120)."""
+    import numpy as np
+
+    from disco_tpu.enhance.inference import crnn_mask
+    from disco_tpu.nn.crnn import build_rnn
+    from disco_tpu.nn.training import create_train_state
+
+    rng = np.random.default_rng(4)
+    model, tx = build_rnn(n_ch=1, win_len=21, n_freq=257, rnn_units=(32,))
+    state = create_train_state(model, tx, np.zeros((1, 21, 257), "float32"))
+    variables = {"params": state.params, "batch_stats": state.batch_stats}
+    Y = (rng.standard_normal((257, 80)) + 1j * rng.standard_normal((257, 80))).astype("complex64")
+    mask = crnn_mask(Y, model, variables, three_d_tensor=False)
+    assert mask.shape == (257, 80)
+    assert np.all(mask >= 0) and np.all(mask <= 1)
