@@ -33,7 +33,7 @@ def main(argv=None):
     if none_str(args.mod_sc) is not None:
         from disco_tpu.cli.tango import _load_model
 
-        model, variables = _load_model(args.mod_sc)
+        model, variables = _load_model(args.mod_sc, archi="crnn")
 
         def masks_fn(Y):
             import numpy as np

@@ -28,6 +28,8 @@ def build_parser():
     p.add_argument("--mods", "-m", nargs=2, default=["None", "None"],
                    help="paths to trained CRNN checkpoints per step, or None for oracle")
     p.add_argument("--zsigs", "-zs", nargs="+", default=["zs_hat"])
+    p.add_argument("--archi", choices=["crnn", "rnn"], default="crnn",
+                   help="architecture of the checkpoints passed via --mods")
     p.add_argument("--dataset", default="dataset/disco/", help="corpus root")
     p.add_argument("--snr", nargs=2, type=snr_value, default=[0, 6])
     p.add_argument("--out_root", default=None, help="override results directory")
@@ -39,16 +41,21 @@ def build_parser():
     return p
 
 
-def _load_model(path):
+def _load_model(path, archi: str = "crnn", n_ch: int = 1):
     if none_str(path) is None:
         return None
-    from disco_tpu.nn.crnn import build_crnn
-    from disco_tpu.nn.training import TrainState, create_train_state, load_params_for_inference
-
-    model, tx = build_crnn(n_ch=1)
     import numpy as np
 
-    state = create_train_state(model, tx, np.zeros((1, 1, 21, 257), "float32"))
+    from disco_tpu.nn.crnn import build_crnn, build_rnn
+    from disco_tpu.nn.training import create_train_state, load_params_for_inference
+
+    if archi == "crnn":
+        model, tx = build_crnn(n_ch=n_ch)
+        x0 = np.zeros((1, n_ch, 21, 257), "float32")
+    else:
+        model, tx = build_rnn(n_ch=n_ch)
+        x0 = np.zeros((1, 21, n_ch * 257), "float32")
+    state = create_train_state(model, tx, x0)
     state = load_params_for_inference(path, state)
     return (model, {"params": state.params, "batch_stats": state.batch_stats})
 
@@ -56,7 +63,11 @@ def _load_model(path):
 def main(argv=None):
     args = build_parser().parse_args(argv)
     policy = none_str(args.mask_z) or "none"
-    models = (_load_model(args.mods[0]), _load_model(args.mods[1]))
+    # step-2 model consumes [y_ref ‖ z_{j≠k}] = 4 channels (tango.py:492)
+    models = (
+        _load_model(args.mods[0], archi=args.archi),
+        _load_model(args.mods[1], archi=args.archi, n_ch=4),
+    )
     results = enhance_rir(
         args.dataset, args.scenario, args.rir, args.noise,
         save_dir=args.sav_dir, snr_range=tuple(args.snr),
