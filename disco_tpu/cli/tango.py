@@ -63,10 +63,12 @@ def _load_model(path, archi: str = "crnn", n_ch: int = 1):
 def main(argv=None):
     args = build_parser().parse_args(argv)
     policy = none_str(args.mask_z) or "none"
-    # step-2 model consumes [y_ref ‖ z_{j≠k}] = 4 channels (tango.py:492)
+    # step-2 model consumes [y_ref ‖ z exchanges]: 1 + (K-1)*len(zsigs)
+    # channels (reference nodes_nbs, tango.py:492-494)
+    n_ch2 = 1 + 3 * len(args.zsigs)
     models = (
         _load_model(args.mods[0], archi=args.archi),
-        _load_model(args.mods[1], archi=args.archi, n_ch=4),
+        _load_model(args.mods[1], archi=args.archi, n_ch=n_ch2),
     )
     results = enhance_rir(
         args.dataset, args.scenario, args.rir, args.noise,
