@@ -125,3 +125,39 @@ def test_train_cli_single_channel(generated, tmp_path):
     ])
     assert isinstance(run_name, str) and len(run_name) >= 4
     assert any((tmp_path / "models").iterdir())
+
+
+def test_full_workflow_with_trained_models(generated, tmp_path):
+    """The complete reference workflow through the CLIs: z export → train a
+    multichannel CRNN on the z-augmented corpus → tango with the trained
+    checkpoints (the loop_tango.sh flow, reference exp/ex1)."""
+    from disco_tpu.cli import train
+
+    # z exports (idempotent if test_get_z_cli already ran)
+    get_z.main([
+        "--rir", "1", "--scenario", "random", "--noise", "ssn",
+        "--dataset", str(generated), "--sav_dir", "oracle",
+    ])
+
+    run_name = train.main([
+        "--scene", "random", "--noise", "ssn", "--n_files", "2",
+        "--path_data", str(generated), "--save_path", str(tmp_path / "models"),
+        "--n_epochs", "1", "--batch_size", "16", "--zsigs", "zs_hat",
+    ])
+    ckpt = tmp_path / "models" / f"{run_name}_model.msgpack"
+    assert ckpt.exists()
+
+    sc_name = train.main([
+        "--scene", "random", "--noise", "ssn", "--n_files", "2",
+        "--path_data", str(generated), "--save_path", str(tmp_path / "models"),
+        "--n_epochs", "1", "--batch_size", "16", "--single_channel",
+    ])
+    sc_ckpt = tmp_path / "models" / f"{sc_name}_model.msgpack"
+
+    results = tango.main([
+        "--rir", "1", "--scenario", "random", "--noise", "ssn",
+        "--dataset", str(generated), "--sav_dir", "trained",
+        "--out_root", str(tmp_path / "results"),
+        "--mods", str(sc_ckpt), str(ckpt),
+    ])
+    assert results is not None and np.all(np.isfinite(results["sdr_cnv"]))
