@@ -75,6 +75,7 @@ def main(argv=None):
         save_dir=args.sav_dir, snr_range=tuple(args.snr),
         mask_type=args.vad_type[0], policy=policy, models=models,
         out_root=args.out_root, streaming=args.streaming, bucket=args.bucket,
+        z_sigs=args.zsigs[0] if len(args.zsigs) == 1 else "zs&zn",
     )
     if results is None:
         print(f"Conf {args.rir} with {args.noise} noise already processed")

@@ -135,6 +135,7 @@ def enhance_rir(
     save_fig: bool = True,
     streaming: bool = False,
     bucket: int = 0,
+    z_sigs: str = "zs_hat",
 ):
     """Enhance one RIR end-to-end and persist everything (reference
     tango.py:460-641).  ``models``: per-step CRNN params or None for the
@@ -171,7 +172,7 @@ def enhance_rir(
 
     T_true = n_stft_frames(L)  # saved masks/z trimmed to the true frames
     Y, S, N = stft(jnp.asarray(y_in)), stft(jnp.asarray(s_in)), stft(jnp.asarray(n_in))
-    masks_z, mask_w = estimate_masks(Y, S, N, models, mask_type, n_nodes, mu=mu)
+    masks_z, mask_w = estimate_masks(Y, S, N, models, mask_type, n_nodes, mu=mu, z_sigs=z_sigs)
     if streaming:
         # The online pipeline implements the 'local' mask-for-z policy only
         # (consumer-side masks); other policies are offline-only.
