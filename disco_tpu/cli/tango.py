@@ -20,7 +20,10 @@ def build_parser():
     p.add_argument("--vad_type", "-vt", nargs=2, default=["irm1", "irm1"],
                    help="mask type per step: irm1/ibm1/iam/... (tango.py:189-225)")
     p.add_argument("--sav_dir", "-sd", default="tango", help="results subfolder")
-    p.add_argument("--rir", type=int, required=True, help="RIR id of the sample to filter")
+    p.add_argument("--rir", type=int, default=None, help="RIR id of the sample to filter")
+    p.add_argument("--rirs", "-r", nargs=2, type=int, default=None,
+                   help="first RIR id and count: batched corpus mode (vmapped launches)")
+    p.add_argument("--batch_size", type=int, default=16, help="clips per jitted launch in --rirs mode")
     p.add_argument("--scenario", "-scene", choices=["living", "meeting", "random"], default="living")
     p.add_argument("--noise", choices=["ssn", "it", "fs"], default="fs")
     p.add_argument("--mask_z", "-mz", choices=_POLICIES, default="local",
@@ -35,9 +38,10 @@ def build_parser():
     p.add_argument("--out_root", default=None, help="override results directory")
     p.add_argument("--streaming", action="store_true",
                    help="frame-recursive online pipeline (smoothed covariances)")
-    p.add_argument("--bucket", type=int, default=0,
+    p.add_argument("--bucket", type=int, default=None,
                    help="round clip lengths up to this many samples to cap "
-                        "recompiles on ragged corpora (0 = off; ~2 dB boundary effect)")
+                        "recompiles on ragged corpora (0 = off; ~2 dB boundary "
+                        "effect; default: off for --rir, 8192 for --rirs)")
     return p
 
 
@@ -62,7 +66,27 @@ def _load_model(path, archi: str = "crnn", n_ch: int = 1):
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
+    if args.rir is None and args.rirs is None:
+        raise SystemExit("one of --rir or --rirs is required")
     policy = none_str(args.mask_z) or "none"
+
+    if args.rirs is not None:
+        if args.mods != ["None", "None"] or args.streaming:
+            raise SystemExit(
+                "--rirs (batched) mode runs oracle masks only; "
+                "--mods/--streaming need per-RIR mode (--rir)"
+            )
+        from disco_tpu.enhance.driver import enhance_rirs_batched
+
+        results = enhance_rirs_batched(
+            args.dataset, args.scenario, range(args.rirs[0], args.rirs[0] + args.rirs[1]),
+            args.noise, save_dir=args.sav_dir, snr_range=tuple(args.snr),
+            mask_type=args.vad_type[0], policy=policy, out_root=args.out_root,
+            bucket=8192 if args.bucket is None else args.bucket,
+            max_batch=args.batch_size,
+        )
+        print(f"{len(results)} RIRs enhanced (batched)")
+        return results
     # step-2 model consumes [y_ref ‖ z exchanges]: 1 + (K-1)*len(zsigs)
     # channels (reference nodes_nbs, tango.py:492-494)
     n_ch2 = 1 + 3 * len(args.zsigs)
@@ -74,7 +98,7 @@ def main(argv=None):
         args.dataset, args.scenario, args.rir, args.noise,
         save_dir=args.sav_dir, snr_range=tuple(args.snr),
         mask_type=args.vad_type[0], policy=policy, models=models,
-        out_root=args.out_root, streaming=args.streaming, bucket=args.bucket,
+        out_root=args.out_root, streaming=args.streaming, bucket=args.bucket or 0,
         z_sigs=args.zsigs[0] if len(args.zsigs) == 1 else "zs&zn",
     )
     if results is None:

@@ -161,3 +161,13 @@ def test_full_workflow_with_trained_models(generated, tmp_path):
         "--mods", str(sc_ckpt), str(ckpt),
     ])
     assert results is not None and np.all(np.isfinite(results["sdr_cnv"]))
+
+
+def test_tango_cli_batched_mode(generated, tmp_path):
+    results = tango.main([
+        "--rirs", "1", "2", "--scenario", "random", "--noise", "ssn",
+        "--dataset", str(generated), "--sav_dir", "batched",
+        "--out_root", str(tmp_path / "res_batched"),
+    ])
+    assert set(results) == {1}  # RIR 2 has no corpus files
+    assert (tmp_path / "res_batched" / "OIM" / "results_tango_1_ssn.p").exists()

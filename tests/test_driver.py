@@ -153,3 +153,25 @@ def test_bucketing_near_invariance(processed_corpus, tmp_path):
     mb = np.load(tmp_path / "rb" / "MASK" / str(RIR) / f"step1_{NOISE}_Node-1.npy")
     mn = np.load(tmp_path / "rn" / "MASK" / str(RIR) / f"step1_{NOISE}_Node-1.npy")
     assert mb.shape == mn.shape
+
+
+def test_enhance_rirs_batched(processed_corpus, tmp_path):
+    """Batched corpus driver: same results contract as the per-RIR path,
+    one vmapped launch per length bucket."""
+    from disco_tpu.enhance.driver import enhance_rirs_batched
+
+    out_root = tmp_path / "batched"
+    results = enhance_rirs_batched(
+        str(processed_corpus), "living", [RIR, RIR + 1], NOISE,
+        snr_range=SNR_RANGE, out_root=str(out_root), save_fig=False,
+    )
+    # RIR+1 has no corpus files -> skipped; RIR processed once
+    assert set(results) == {RIR}
+    assert EXPECTED_KEYS <= set(results[RIR])
+    assert np.all(results[RIR]["sdr_cnv"] > results[RIR]["sdr_in_cnv"])
+    assert (out_root / "OIM" / f"results_tango_{RIR}_{NOISE}.p").exists()
+    # idempotent second call
+    assert enhance_rirs_batched(
+        str(processed_corpus), "living", [RIR], NOISE,
+        snr_range=SNR_RANGE, out_root=str(out_root), save_fig=False,
+    ) == {}
