@@ -16,6 +16,6 @@ Every corpus-scale CLI takes ``--rirs start count`` and is idempotent, so
 cluster job arrays shard the corpus exactly as the reference does
 (SURVEY.md §2.9 data-parallel row).
 """
-from disco_tpu.cli import download, gen_disco, gen_meetit, get_z, lists, mix, tango, train
+from disco_tpu.cli import bench_milestones, download, gen_disco, gen_meetit, get_z, lists, mix, tango, train
 
-__all__ = ["download", "gen_disco", "gen_meetit", "get_z", "lists", "mix", "tango", "train"]
+__all__ = ["bench_milestones", "download", "gen_disco", "gen_meetit", "get_z", "lists", "mix", "tango", "train"]

@@ -1,0 +1,46 @@
+"""Milestone benchmark CLI: run the five BASELINE.json configurations
+(`disco_tpu.milestones`) and print one JSON line per config."""
+from __future__ import annotations
+
+import argparse
+import json
+
+from disco_tpu import milestones
+
+
+def build_parser():
+    p = argparse.ArgumentParser(description="Run the BASELINE milestone benchmark configs")
+    p.add_argument("--tiny", action="store_true", help="small CPU-testable scales")
+    p.add_argument("--configs", nargs="+", type=int, default=None,
+                   help="subset of configs to run (1-5)")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    fns = {
+        1: milestones.mvdr_single_clip,
+        2: milestones.disco_mwf_4node,
+        3: milestones.tango_4node,
+        4: milestones.meetit_separation,
+        5: milestones.batched_meetit_end_to_end,
+    }
+    if args.configs is None and args.tiny:
+        results = milestones.run_all(tiny=True)
+    else:
+        ids = args.configs or sorted(fns)
+        tiny_kwargs = {
+            1: dict(dur_s=1.0, iters=1),
+            2: dict(dur_s=1.0, iters=1),
+            3: dict(dur_s=1.0, iters=1),
+            4: dict(dur_s=1.0, K=4, C=2, iters=1),
+            5: dict(n_rooms=2, K=2, C=2, dur_s=0.5, max_order=4, rir_len=1024, iters=1),
+        }
+        results = [fns[i](**(tiny_kwargs[i] if args.tiny else {})) for i in ids]
+    for res in results:
+        print(json.dumps(res))
+    return results
+
+
+if __name__ == "__main__":
+    main()
