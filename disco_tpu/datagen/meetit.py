@@ -240,3 +240,27 @@ def generate_meetit_rirs(
         save_meetit_scene(scene, infos, rir_id, layout, fs=fs)
         generated.append(rir_id)
     return generated
+
+
+def load_meetit_sample(layout: DatasetLayout, rir_id: int, mics_per_node):
+    """Load one generated MEETIT sample back from disk: the per-channel
+    mixture STFTs and per-source IRMs written by :func:`generate_meetit_rirs`,
+    shaped for :func:`disco_tpu.enhance.separate_with_masks`.
+
+    Returns (Y (K, C, F, T) complex64 node-major mixture STFTs,
+             masks (n_src, K, F, T) float32 at each node's reference mic).
+    """
+    base = layout.base
+    M = int(np.sum(mics_per_node))
+    mix = np.stack([np.load(base / "stft" / "mix" / f"{rir_id}_Ch-{ch + 1}.npy") for ch in range(M)])
+    n_src = len(mics_per_node)
+    bounds = np.concatenate([[0], np.cumsum(mics_per_node)])
+    K = len(mics_per_node)
+    Y = np.stack([mix[bounds[k] : bounds[k + 1]] for k in range(K)])  # (K, C, F, T)
+    masks = np.stack(
+        [
+            np.stack([np.load(base / "mask" / f"{rir_id}_S-{s + 1}_Ch-{bounds[k] + 1}.npy") for k in range(K)])
+            for s in range(n_src)
+        ]
+    )  # (n_src, K, F, T) — ref mic of each node
+    return Y.astype("complex64"), masks.astype("float32")

@@ -96,3 +96,30 @@ def test_simulate_meetit_room_end_to_end(tmp_path, speakers):
     assert (lay.base / "wav" / "clean" / "dry" / "3_S-1.wav").exists()
     assert (lay.base / "wav" / "clean" / "cnv" / f"3_S-{n_src}_Ch-8.wav").exists()
     assert (lay.base / "log" / "infos" / "3.npy").exists()
+
+
+def test_meetit_corpus_feeds_separation(tmp_path, speakers):
+    """Saved MEETIT artifacts (mix STFTs + per-source IRMs) drive
+    separate_with_masks directly — the corpus -> separation bridge of the
+    ICASSP 2021 use case."""
+    from disco_tpu.datagen.meetit import generate_meetit_rirs, load_meetit_sample
+    from disco_tpu.enhance import separate_with_masks
+
+    sig = InterferentSpeakersSetup(
+        speakers_list=speakers,
+        duration_range=(2, 3),
+        var_tar=10 ** (-23 / 10),
+        snr_dry_range=[[0, 0]],
+        snr_cnv_range=(-60, 60),
+        min_delta_snr=-1,
+        rng=np.random.default_rng(3),
+    )
+    lay = DatasetLayout(str(tmp_path), "meetit", "train")
+    done = generate_meetit_rirs(2, "train", 7, 1, sig, lay, rng=np.random.default_rng(1), max_order=4)
+    assert done == [7]
+
+    Y, masks = load_meetit_sample(lay, 7, [4, 4])
+    assert Y.shape[0] == 2 and masks.shape[0] == 2
+    est = np.asarray(separate_with_masks(Y, masks))
+    assert est.shape == (2, 2) + Y.shape[2:]
+    assert np.isfinite(est).all()
