@@ -227,3 +227,17 @@ def tango_frame_sharded(
         Y, S, N, masks_z, mask_w, mesh, "frame", mu, policy, ref_mic, mask_type,
         oracle_step1_stats,
     )
+
+
+def mesh_from_config(cfg) -> Mesh:
+    """Build the mesh described by a :class:`disco_tpu.config.MeshConfig`
+    (or the root config's ``.mesh``): node-only, node x frame, or the
+    hybrid 3-axis layout when a batch axis is requested."""
+    cfg = getattr(cfg, "mesh", cfg)
+    if cfg.n_batch > 1:
+        from disco_tpu.parallel.multihost import hybrid_mesh
+
+        return hybrid_mesh(n_batch_dcn=cfg.n_batch, n_node=cfg.n_node or 1, n_frame=cfg.n_frame)
+    if cfg.n_frame > 1:
+        return make_mesh_2d(n_node=cfg.n_node or 1, n_frame=cfg.n_frame)
+    return make_mesh(n_node=cfg.n_node, n_batch=cfg.n_batch)

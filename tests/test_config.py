@@ -52,3 +52,15 @@ def test_frozen():
     cfg = DiscoConfig()
     with pytest.raises(dataclasses.FrozenInstanceError):
         cfg.root = "x"
+
+
+def test_mesh_from_config():
+    from disco_tpu.config import DiscoConfig, MeshConfig
+    from disco_tpu.parallel.mesh import mesh_from_config
+
+    m = mesh_from_config(DiscoConfig(mesh=MeshConfig(n_node=4)))
+    assert m.shape["node"] == 4
+    m2 = mesh_from_config(MeshConfig(n_node=2, n_frame=4))
+    assert dict(m2.shape) == {"node": 2, "frame": 4}
+    m3 = mesh_from_config(MeshConfig(n_node=2, n_frame=2, n_batch=2))
+    assert dict(m3.shape) == {"batch": 2, "node": 2, "frame": 2}
