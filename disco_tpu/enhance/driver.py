@@ -7,10 +7,14 @@ Capability parity with reference ``speech_enhancement/tango.py:460-641``
 pickled ``results_tango_* / results_mwf_*`` dicts with the same keys, so
 reference-side aggregation scripts read the outputs unchanged.
 
-Metric substitutions (documented, deliberate): BSS-eval SDR/SIR/SAR are the
-scale-invariant Le Roux decompositions of ``core.metrics.si_bss`` (the
-reference calls mir_eval's bss_eval_sources, an undeclared dependency);
-STOI is the native implementation in ``core.metrics.stoi``.
+Both BSS metric families are written to the OIM pickles: the ``sdr_*`` /
+``sir_*`` / ``sar_*`` keys carry the 512-tap filtered-projection values of
+``core.bss.bss_eval_sources`` — the same metric as mir_eval's
+``bss_eval_sources`` that the reference calls (tango.py:552-567), so the
+numbers are paper-table comparable — and the ``si_sdr_*`` / ``si_sir_*`` /
+``si_sar_*`` keys carry the scale-invariant Le Roux decomposition
+(``core.metrics.si_bss``).  STOI is the native implementation in
+``core.metrics.stoi``.
 """
 from __future__ import annotations
 
@@ -21,6 +25,7 @@ from pathlib import Path
 
 import numpy as np
 
+from disco_tpu.core.bss import BssEval
 from disco_tpu.core.dsp import istft
 from disco_tpu.core.metrics import fw_sd, fw_snr, si_bss, stoi
 from disco_tpu.enhance.tango import oracle_masks, tango
@@ -51,39 +56,60 @@ def results_root(scenario: str, dset: str, save_dir: str) -> Path:
     return Path("results") / scenario / dset / save_dir
 
 
-def _node_metrics(y0, s0, n0, sh_t, s_dry, n_dry, sf_t, nf_t, fs):
-    """All metric variants for one node's enhanced output ``sh_t``
-    (tango.py:545-593): vs dry and convolved references, inputs and outputs."""
+def _node_metrics_pair(y0, s0, n0, sh_t, szh_t, s_dry, n_dry, sf_t, nf_t,
+                       szf_t, nzf_t, fs, bss_filt_len=512):
+    """All metric variants for one node's two enhanced outputs — ``sh_t``
+    (full TANGO) and ``szh_t`` (step-1/MWF) — against the dry and convolved
+    references (tango.py:545-593).  Returns (tango_dict, mwf_dict).
+
+    Both outputs share the references, so the two 512-tap BSS projectors
+    (the dominant eval cost: a (2*512)^2 Gram factorization each) are built
+    ONCE here and reused for every estimate, and the input-side metrics are
+    computed once instead of per-output.  The filtered-projection family is
+    emitted under the reference's key names, the scale-invariant family
+    under ``si_*``."""
     min_len = min(len(y0), len(sh_t), len(s_dry), len(n_dry))
     sl = slice(fs, min_len)  # first second (lead silence) skipped
     refs_dry = np.stack((s_dry[sl], n_dry[sl]), axis=1)
     refs_cnv = np.stack((s0[sl], n0[sl]), axis=1)
+    proj_dry = BssEval(refs_dry.T, bss_filt_len)
+    proj_cnv = BssEval(refs_cnv.T, bss_filt_len)
 
-    sdr_dry, sir_dry, sar_dry = si_bss(sh_t[sl], refs_dry, 0)
-    sdr_cnv, sir_cnv, sar_cnv = si_bss(sh_t[sl], refs_cnv, 0)
-    sdr_in_dry, sir_in_dry, sar_in_dry = si_bss(y0[sl], refs_dry, 0)
-    sdr_in_cnv, sir_in_cnv, _ = si_bss(y0[sl], refs_cnv, 0)
-
+    # input-side metrics: identical for both outputs
+    sdr_in_dry, sir_in_dry, sar_in_dry = proj_dry.score(y0[sl])
+    sdr_in_cnv, sir_in_cnv, _ = proj_cnv.score(y0[sl])
+    si_sdr_in_dry, si_sir_in_dry, si_sar_in_dry = si_bss(y0[sl], refs_dry, 0)
+    si_sdr_in_cnv, si_sir_in_cnv, _ = si_bss(y0[sl], refs_cnv, 0)
     stoi_in = stoi(s0[sl], y0[sl], fs)
     stoi_in_dry = stoi(s_dry[sl], y0[sl], fs)
-    stoi_out = stoi(s0[sl], sh_t[sl], fs)
-    stoi_out_dry = stoi(s_dry[sl], sh_t[sl], fs)
-
-    _, fw_snr_out, _ = fw_snr(sf_t[sl], nf_t[sl], fs)
     _, fw_snr_in_cnv, _ = fw_snr(s0[sl], n0[sl], fs)
     _, fw_snr_in_dry, _ = fw_snr(s_dry[sl], n_dry[sl], fs)
-    _, fsd_cnv, _ = fw_sd(sf_t[sl], s0[sl], fs)
-    _, fsd_dry, _ = fw_sd(sf_t[sl], s_dry[sl], fs)
 
-    return {
-        "sdr_cnv": sdr_cnv, "sir_cnv": sir_cnv, "sar_cnv": sar_cnv,
-        "sdr_dry": sdr_dry, "sir_dry": sir_dry, "sar_dry": sar_dry,
-        "sdr_in_cnv": sdr_in_cnv, "sir_in_cnv": sir_in_cnv,
-        "sdr_in_dry": sdr_in_dry, "sir_in_dry": sir_in_dry, "sar_in_dry": sar_in_dry,
-        "delta_stoi_cnv": stoi_out - stoi_in, "delta_stoi_dry": stoi_out_dry - stoi_in_dry,
-        "snr_out": fw_snr_out, "snr_in_cnv": fw_snr_in_cnv, "snr_in_dry": fw_snr_in_dry,
-        "fw_sd_cnv": fsd_cnv, "fw_sd_dry": fsd_dry,
-    }
+    def one_output(est, s_filt, n_filt):
+        sdr_dry, sir_dry, sar_dry = proj_dry.score(est[sl])
+        sdr_cnv, sir_cnv, sar_cnv = proj_cnv.score(est[sl])
+        si_sdr_dry, si_sir_dry, si_sar_dry = si_bss(est[sl], refs_dry, 0)
+        si_sdr_cnv, si_sir_cnv, si_sar_cnv = si_bss(est[sl], refs_cnv, 0)
+        stoi_out = stoi(s0[sl], est[sl], fs)
+        stoi_out_dry = stoi(s_dry[sl], est[sl], fs)
+        _, fw_snr_out, _ = fw_snr(s_filt[sl], n_filt[sl], fs)
+        _, fsd_cnv, _ = fw_sd(s_filt[sl], s0[sl], fs)
+        _, fsd_dry, _ = fw_sd(s_filt[sl], s_dry[sl], fs)
+        return {
+            "sdr_cnv": sdr_cnv, "sir_cnv": sir_cnv, "sar_cnv": sar_cnv,
+            "sdr_dry": sdr_dry, "sir_dry": sir_dry, "sar_dry": sar_dry,
+            "sdr_in_cnv": sdr_in_cnv, "sir_in_cnv": sir_in_cnv,
+            "sdr_in_dry": sdr_in_dry, "sir_in_dry": sir_in_dry, "sar_in_dry": sar_in_dry,
+            "si_sdr_cnv": si_sdr_cnv, "si_sir_cnv": si_sir_cnv, "si_sar_cnv": si_sar_cnv,
+            "si_sdr_dry": si_sdr_dry, "si_sir_dry": si_sir_dry, "si_sar_dry": si_sar_dry,
+            "si_sdr_in_cnv": si_sdr_in_cnv, "si_sir_in_cnv": si_sir_in_cnv,
+            "si_sdr_in_dry": si_sdr_in_dry, "si_sir_in_dry": si_sir_in_dry, "si_sar_in_dry": si_sar_in_dry,
+            "delta_stoi_cnv": stoi_out - stoi_in, "delta_stoi_dry": stoi_out_dry - stoi_in_dry,
+            "snr_out": fw_snr_out, "snr_in_cnv": fw_snr_in_cnv, "snr_in_dry": fw_snr_in_dry,
+            "fw_sd_cnv": fsd_cnv, "fw_sd_dry": fsd_dry,
+        }
+
+    return one_output(sh_t, sf_t, nf_t), one_output(szh_t, szf_t, nzf_t)
 
 
 def estimate_masks(Y, S, N, models, mask_type: str, n_nodes: int, mu: float = 1.0, z_sigs: str = "zs_hat"):
@@ -150,8 +176,12 @@ def _persist_and_score(
     per_node_tango, per_node_mwf = [], []
     for k in range(n_nodes):
         y0, s0, n0 = y[k, 0], s[k, 0], n[k, 0]
-        per_node_tango.append(_node_metrics(y0, s0, n0, sh_t[k], s_dry, n_dry, sf_t[k], nf_t[k], fs))
-        per_node_mwf.append(_node_metrics(y0, s0, n0, szh_t[k], s_dry, n_dry, szf_t[k], nzf_t[k], fs))
+        tango_d, mwf_d = _node_metrics_pair(
+            y0, s0, n0, sh_t[k], szh_t[k], s_dry, n_dry,
+            sf_t[k], nf_t[k], szf_t[k], nzf_t[k], fs,
+        )
+        per_node_tango.append(tango_d)
+        per_node_mwf.append(mwf_d)
 
         tag = f"{noise}_Node-{k + 1}"
         write_wav(out / "WAV" / str(rir) / f"in_mix-{tag}.wav", y0, fs)

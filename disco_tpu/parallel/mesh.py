@@ -232,12 +232,19 @@ def tango_frame_sharded(
 def mesh_from_config(cfg) -> Mesh:
     """Build the mesh described by a :class:`disco_tpu.config.MeshConfig`
     (or the root config's ``.mesh``): node-only, node x frame, or the
-    hybrid 3-axis layout when a batch axis is requested."""
+    hybrid 3-axis layout when a batch axis is requested.
+
+    ``n_node=None`` means "all devices not used by the other axes" on every
+    path, so e.g. ``MeshConfig(n_frame=2)`` on 8 devices yields a 4x2 mesh.
+    """
     cfg = getattr(cfg, "mesh", cfg)
+    n_node = cfg.n_node
+    if n_node is None:
+        n_node = max(1, len(jax.devices()) // (max(cfg.n_batch, 1) * max(cfg.n_frame, 1)))
     if cfg.n_batch > 1:
         from disco_tpu.parallel.multihost import hybrid_mesh
 
-        return hybrid_mesh(n_batch_dcn=cfg.n_batch, n_node=cfg.n_node or 1, n_frame=cfg.n_frame)
+        return hybrid_mesh(n_batch_dcn=cfg.n_batch, n_node=n_node, n_frame=cfg.n_frame)
     if cfg.n_frame > 1:
-        return make_mesh_2d(n_node=cfg.n_node or 1, n_frame=cfg.n_frame)
-    return make_mesh(n_node=cfg.n_node, n_batch=cfg.n_batch)
+        return make_mesh_2d(n_node=n_node, n_frame=cfg.n_frame)
+    return make_mesh(n_node=n_node, n_batch=cfg.n_batch)

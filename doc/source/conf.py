@@ -12,6 +12,6 @@ extensions = [
     "sphinx.ext.napoleon",
     "sphinx.ext.viewcode",
 ]
-autodoc_mock_imports = ["jax", "flax", "optax", "orbax", "chex", "matplotlib"]
+autodoc_mock_imports = ["jax", "flax", "optax", "matplotlib"]
 html_theme = "alabaster"
 exclude_patterns = []

@@ -64,3 +64,20 @@ def test_mesh_from_config():
     assert dict(m2.shape) == {"node": 2, "frame": 4}
     m3 = mesh_from_config(MeshConfig(n_node=2, n_frame=2, n_batch=2))
     assert dict(m3.shape) == {"batch": 2, "node": 2, "frame": 2}
+
+
+def test_mesh_from_config_none_node_uses_all_devices():
+    """n_node=None means 'all remaining devices' on every path, not 1."""
+    import jax
+
+    from disco_tpu.config import MeshConfig
+    from disco_tpu.parallel.mesh import mesh_from_config
+
+    n_dev = len(jax.devices())
+    assert n_dev == 8, "conftest should force an 8-device CPU mesh"
+    m = mesh_from_config(MeshConfig(n_frame=2))
+    assert dict(m.shape) == {"node": 4, "frame": 2}
+    m2 = mesh_from_config(MeshConfig(n_batch=2))
+    assert m2.shape["node"] == 4
+    m3 = mesh_from_config(MeshConfig())
+    assert m3.shape["node"] == 8

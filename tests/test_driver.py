@@ -41,10 +41,15 @@ def processed_corpus(tmp_path):
 
 
 EXPECTED_KEYS = {
+    # reference pickle schema (tango.py:617-635); sdr/sir/sar carry the
+    # mir_eval-compatible 512-tap filtered-projection family
     "snr_in_raw", "sdr_cnv", "sir_cnv", "sar_cnv", "sdr_dry", "sir_dry", "sar_dry",
     "sdr_in_cnv", "sir_in_cnv", "sdr_in_dry", "sir_in_dry", "sar_in_dry",
     "delta_stoi_cnv", "delta_stoi_dry", "snr_out", "snr_in_cnv", "snr_in_dry",
     "fw_sd_cnv", "fw_sd_dry",
+    # scale-invariant (Le Roux) family, written alongside
+    "si_sdr_cnv", "si_sir_cnv", "si_sar_cnv", "si_sdr_dry", "si_sir_dry", "si_sar_dry",
+    "si_sdr_in_cnv", "si_sir_in_cnv", "si_sdr_in_dry", "si_sir_in_dry", "si_sar_in_dry",
 }
 
 
