@@ -1,0 +1,51 @@
+"""Generate the committed golden ISM fixture (golden_rir_order20.npz).
+
+Run from the repo root:  python tests/data/gen_golden_rir.py
+
+pyroomacoustics cannot be installed in the build environment (zero egress),
+so the fixture is produced by the independent float64 NumPy oracle
+``tests.reference_impls.shoebox_rir_np_order20`` — a loop/chunk float64
+implementation of libroom's documented conventions, structurally unrelated
+to the float32 JAX kernel it pins (`disco_tpu.sim.ism.shoebox_rir`).  The
+scene mirrors the DISCO setup: a living-room-sized shoebox, RT60 0.5 s via
+Eyring absorption, one target + one noise source, two 2-mic nodes.
+"""
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from disco_tpu.sim.geometry import eyring_absorption
+from tests.reference_impls import shoebox_rir_np_order20
+
+ROOM = np.array([5.0, 4.0, 3.0])
+SOURCES = np.array([[1.0, 1.0, 1.5], [4.2, 3.1, 1.2]])  # target, noise
+MICS = np.array([
+    [3.50, 2.50, 1.50], [3.55, 2.50, 1.50],   # node 1
+    [1.80, 3.20, 1.40], [1.85, 3.20, 1.40],   # node 2
+])
+RT60 = 0.5
+MAX_ORDER = 20
+RIR_LEN = 12288
+FS = 16000
+
+
+def main():
+    alpha = float(eyring_absorption(RT60, *ROOM))
+    rirs = np.stack([
+        shoebox_rir_np_order20(ROOM, src, MICS, alpha, max_order=MAX_ORDER,
+                               rir_len=RIR_LEN, fs=FS)
+        for src in SOURCES
+    ])  # (S, M, L) float64
+    out = Path(__file__).parent / "golden_rir_order20.npz"
+    np.savez_compressed(
+        out, room_dim=ROOM, sources=SOURCES, mics=MICS, alpha=alpha,
+        rt60=RT60, max_order=MAX_ORDER, rir_len=RIR_LEN, fs=FS, rirs=rirs,
+    )
+    print(f"wrote {out} ({out.stat().st_size/1e6:.2f} MB), alpha={alpha:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,5 +1,7 @@
 """Tests for disco_tpu.sim: image lattice, ISM RIR physics + oracle parity,
 FFT convolution, and the scenario-sampling constraints."""
+from pathlib import Path
+
 import numpy as np
 import pytest
 
@@ -207,3 +209,137 @@ def test_meeting_nodes_on_table():
     for c in cfg.nodes_centers:
         assert np.linalg.norm(c[:2] - tc) <= setup.table_radius + 1e-9
         assert c[2] == pytest.approx(setup.table_center[2])
+
+
+# ------------------------------------------- order-20 fidelity pinning
+# (VERDICT round 1, next-round item 1)
+
+GOLDEN = Path(__file__).parent / "data" / "golden_rir_order20.npz"
+
+
+def test_golden_fixture_parity_order20():
+    """Tap-level parity of the float32 JAX kernel against the committed
+    order-20 multi-mic float64 fixture (generated once by
+    tests/data/gen_golden_rir.py from the independent NumPy oracle —
+    pyroomacoustics is not installable here, so the float64 oracle plays
+    the role of libroom ground truth)."""
+    g = np.load(GOLDEN)
+    got = np.asarray(
+        shoebox_rirs(
+            g["room_dim"].astype(np.float32), g["sources"].astype(np.float32),
+            g["mics"].astype(np.float32), float(g["alpha"]),
+            max_order=int(g["max_order"]), rir_len=int(g["rir_len"]),
+        )
+    ).astype(np.float64)
+    want = g["rirs"]
+    assert got.shape == want.shape == (2, 4, int(g["rir_len"]))
+    rel = np.linalg.norm(got - want, axis=-1) / np.linalg.norm(want, axis=-1)
+    # float32 kernel vs float64 oracle: measured ~8e-5; 5e-4 budgeted
+    assert rel.max() < 5e-4, rel
+
+
+def test_oracle_fast_matches_loop_oracle():
+    """The chunk-vectorized order-20 oracle reproduces the original
+    loop-based oracle exactly where both are feasible (order 3)."""
+    from tests.reference_impls import shoebox_rir_np, shoebox_rir_np_order20
+
+    room = np.array([4.0, 3.0, 2.5])
+    src = np.array([1.0, 1.2, 1.1])
+    mic = np.array([2.5, 2.0, 1.3])
+    a = eyring_absorption(0.4, *room)
+    slow = shoebox_rir_np(room, src, mic, a, max_order=3, rir_len=2048)
+    fast = shoebox_rir_np_order20(room, src, mic[None], a, max_order=3, rir_len=2048)[0]
+    np.testing.assert_allclose(fast, slow, atol=1e-12)
+
+
+def test_rt60_statistics_vs_eyring():
+    """Statistical check over random rooms: the Schroeder-decay RT60 of
+    order-20 kernel RIRs tracks the Eyring design target.  Order truncation
+    caps the late tail (as libroom's finite order does), so the check runs
+    in the regime order 20 covers (small rooms, RT60 <= 0.35 s) and asserts
+    a calibrated band (measured mean ratio ~0.83) rather than exactness."""
+    from tests.reference_impls import rt60_schroeder
+
+    rng = np.random.default_rng(3)
+    ratios = []
+    for _ in range(6):
+        dim = rng.uniform([3.5, 3.0, 2.4], [5.0, 4.5, 2.8])
+        rt = rng.uniform(0.22, 0.35)
+        a = float(eyring_absorption(rt, *dim))
+        src = dim * rng.uniform(0.25, 0.75, 3)
+        mic = dim * rng.uniform(0.25, 0.75, 3)
+        L = rir_length_for(rt * 2.0)
+        r = np.asarray(shoebox_rir(dim, src, mic[None], a, max_order=20, rir_len=L))[0]
+        est = rt60_schroeder(r)
+        assert np.isfinite(est)
+        ratios.append(est / rt)
+    ratios = np.array(ratios)
+    assert 0.65 < ratios.mean() < 1.2, ratios
+    assert np.all((ratios > 0.45) & (ratios < 1.5)), ratios
+
+
+def test_rt60_monotone_in_target():
+    """Same room, higher Eyring RT60 target -> longer measured decay.
+    Compared on the early decay (T15 fit, -5..-20 dB) at targets the
+    order-20 lattice fully covers — beyond ~0.3 s in a room this size the
+    truncated tail makes the Schroeder estimate saturate (a property shared
+    with any finite-order ISM, including libroom's)."""
+    from tests.reference_impls import rt60_schroeder
+
+    dim = np.array([4.5, 3.8, 2.6])
+    src = np.array([1.2, 1.0, 1.3])
+    mic = np.array([3.2, 2.6, 1.5])
+    ests = []
+    for rt in (0.15, 0.3):
+        a = float(eyring_absorption(rt, *dim))
+        L = rir_length_for(0.8)
+        r = np.asarray(shoebox_rir(dim, src, mic[None], a, max_order=20, rir_len=L))[0]
+        ests.append(rt60_schroeder(r, lo_db=-5.0, hi_db=-20.0))
+    assert ests[1] > 1.3 * ests[0], ests
+
+
+def test_config5_sdr_invariant_to_rir_source():
+    """End-to-end SDR parity (VERDICT item 1 'done' bar): the config-5
+    pipeline (simulate + convolve + two-step TANGO) produces the same
+    SI-SDR whether the RIRs come from the float32 kernel or the float64
+    golden fixture — i.e. kernel fidelity is sufficient at the level the
+    framework is judged on."""
+    import jax
+    import jax.numpy as jnp
+
+    from disco_tpu.core.dsp import istft, stft
+    from disco_tpu.core.metrics import si_sdr
+    from disco_tpu.enhance import oracle_masks, tango
+
+    g = np.load(GOLDEN)
+    L = 16000
+    K, Cc = 2, 2
+    rng = np.random.default_rng(0)
+    dry = rng.standard_normal((2, L)).astype(np.float32)
+
+    kernel_rirs = np.asarray(
+        shoebox_rirs(
+            g["room_dim"].astype(np.float32), g["sources"].astype(np.float32),
+            g["mics"].astype(np.float32), float(g["alpha"]),
+            max_order=int(g["max_order"]), rir_len=int(g["rir_len"]),
+        )
+    )
+    golden_rirs = g["rirs"].astype(np.float32)
+
+    @jax.jit
+    def enhance_with(rirs):
+        imgs = fft_convolve(jnp.asarray(dry)[:, None, :], jnp.asarray(rirs), out_len=L)
+        s = imgs[0].reshape(K, Cc, L)
+        n = imgs[1].reshape(K, Cc, L)
+        y = s + n
+        Y, S, N = stft(y), stft(s), stft(n)
+        m = oracle_masks(S, N, "irm1")
+        res = tango(Y, S, N, m, m, policy="local")
+        return istft(res.yf, length=L), s
+
+    out_k, s_k = map(np.asarray, enhance_with(kernel_rirs))
+    out_g, s_g = map(np.asarray, enhance_with(golden_rirs))
+    for k in range(K):
+        sdr_k = float(si_sdr(s_k[k, 0], out_k[k]))
+        sdr_g = float(si_sdr(s_g[k, 0], out_g[k]))
+        assert abs(sdr_k - sdr_g) < 0.1, (k, sdr_k, sdr_g)
