@@ -70,23 +70,6 @@ def main(argv=None):
         raise SystemExit("one of --rir or --rirs is required")
     policy = none_str(args.mask_z) or "none"
 
-    if args.rirs is not None:
-        if args.mods != ["None", "None"] or args.streaming:
-            raise SystemExit(
-                "--rirs (batched) mode runs oracle masks only; "
-                "--mods/--streaming need per-RIR mode (--rir)"
-            )
-        from disco_tpu.enhance.driver import enhance_rirs_batched
-
-        results = enhance_rirs_batched(
-            args.dataset, args.scenario, range(args.rirs[0], args.rirs[0] + args.rirs[1]),
-            args.noise, save_dir=args.sav_dir, snr_range=tuple(args.snr),
-            mask_type=args.vad_type[0], policy=policy, out_root=args.out_root,
-            bucket=8192 if args.bucket is None else args.bucket,
-            max_batch=args.batch_size,
-        )
-        print(f"{len(results)} RIRs enhanced (batched)")
-        return results
     # step-2 model consumes [y_ref ‖ z exchanges]: 1 + (K-1)*len(zsigs)
     # channels (reference nodes_nbs, tango.py:492-494)
     n_ch2 = 1 + 3 * len(args.zsigs)
@@ -94,6 +77,21 @@ def main(argv=None):
         _load_model(args.mods[0], archi=args.archi),
         _load_model(args.mods[1], archi=args.archi, n_ch=n_ch2),
     )
+    if args.rirs is not None:
+        if args.streaming:
+            raise SystemExit("--streaming needs per-RIR mode (--rir)")
+        from disco_tpu.enhance.driver import enhance_rirs_batched
+
+        results = enhance_rirs_batched(
+            args.dataset, args.scenario, range(args.rirs[0], args.rirs[0] + args.rirs[1]),
+            args.noise, save_dir=args.sav_dir, snr_range=tuple(args.snr),
+            mask_type=args.vad_type[0], policy=policy, out_root=args.out_root,
+            bucket=8192 if args.bucket is None else args.bucket,
+            max_batch=args.batch_size, models=models,
+            z_sigs=args.zsigs[0] if len(args.zsigs) == 1 else "zs&zn",
+        )
+        print(f"{len(results)} RIRs enhanced (batched)")
+        return results
     results = enhance_rir(
         args.dataset, args.scenario, args.rir, args.noise,
         save_dir=args.sav_dir, snr_range=tuple(args.snr),

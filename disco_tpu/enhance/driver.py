@@ -57,22 +57,20 @@ def results_root(scenario: str, dset: str, save_dir: str) -> Path:
 
 
 def _node_metrics_pair(y0, s0, n0, sh_t, szh_t, s_dry, n_dry, sf_t, nf_t,
-                       szf_t, nzf_t, fs, bss_filt_len=512):
+                       szf_t, nzf_t, fs, sl, proj_dry, bss_filt_len=512):
     """All metric variants for one node's two enhanced outputs — ``sh_t``
     (full TANGO) and ``szh_t`` (step-1/MWF) — against the dry and convolved
     references (tango.py:545-593).  Returns (tango_dict, mwf_dict).
 
-    Both outputs share the references, so the two 512-tap BSS projectors
-    (the dominant eval cost: a (2*512)^2 Gram factorization each) are built
-    ONCE here and reused for every estimate, and the input-side metrics are
-    computed once instead of per-output.  The filtered-projection family is
-    emitted under the reference's key names, the scale-invariant family
-    under ``si_*``."""
-    min_len = min(len(y0), len(sh_t), len(s_dry), len(n_dry))
-    sl = slice(fs, min_len)  # first second (lead silence) skipped
+    Both outputs share the references, so the 512-tap BSS projectors (the
+    dominant eval cost: a (2*512)^2 Gram factorization each) are reused for
+    every estimate — the dry one (``proj_dry``, node-independent) is built
+    once per RIR by the caller, the convolved one once per node here — and
+    the input-side metrics are computed once instead of per-output.  The
+    filtered-projection family is emitted under the reference's key names,
+    the scale-invariant family under ``si_*``."""
     refs_dry = np.stack((s_dry[sl], n_dry[sl]), axis=1)
     refs_cnv = np.stack((s0[sl], n0[sl]), axis=1)
-    proj_dry = BssEval(refs_dry.T, bss_filt_len)
     proj_cnv = BssEval(refs_cnv.T, bss_filt_len)
 
     # input-side metrics: identical for both outputs
@@ -117,36 +115,30 @@ def estimate_masks(Y, S, N, models, mask_type: str, n_nodes: int, mu: float = 1.
     387-394).  ``models`` is a 2-list; each entry is None (oracle) or a
     ``(flax_module, variables)`` pair.  The step-2 CRNN consumes the local
     reference channel plus the exchanged z streams, so step 1 runs first to
-    produce them (the staged flow of reference main:497-503)."""
+    produce them (the staged flow of reference main:497-503).  All node
+    forwards run as ONE batched device call per step
+    (:func:`disco_tpu.enhance.inference.crnn_masks_batched`)."""
     import jax.numpy as jnp
 
     oracle = oracle_masks(S, N, mask_type)
     if models[0] is None:
         masks_z = oracle
     else:
-        from disco_tpu.enhance.inference import crnn_mask
+        from disco_tpu.enhance.inference import crnn_masks_batched
 
         model, variables = models[0]
-        masks_z = jnp.stack([jnp.asarray(crnn_mask(np.asarray(Y[k, 0]), model, variables)) for k in range(n_nodes)])
+        masks_z = jnp.asarray(crnn_masks_batched(np.asarray(Y[:, 0]), model, variables))
     if models[1] is None:
         mask_w = oracle
     else:
-        from disco_tpu.enhance.inference import crnn_mask, get_z_for_mask
+        from disco_tpu.enhance.inference import crnn_masks_batched, get_z_for_mask
         from disco_tpu.enhance.zexport import compute_z_signals
 
         out = compute_z_signals(None, None, None, Y=Y, S=S, N=N, masks_z=masks_z, mu=mu)
+        z_y, zn = np.asarray(out["z_y"]), np.asarray(out["zn"])
+        zs = np.stack([get_z_for_mask(z_y, zn, k, n_nodes, z_sigs) for k in range(n_nodes)])
         model, variables = models[1]
-        mask_w = jnp.stack(
-            [
-                jnp.asarray(
-                    crnn_mask(
-                        np.asarray(Y[k, 0]), model, variables,
-                        z=get_z_for_mask(np.asarray(out["z_y"]), np.asarray(out["zn"]), k, n_nodes, z_sigs),
-                    )
-                )
-                for k in range(n_nodes)
-            ]
-        )
+        mask_w = jnp.asarray(crnn_masks_batched(np.asarray(Y[:, 0]), model, variables, zs=zs))
     return masks_z, mask_w
 
 
@@ -154,7 +146,7 @@ def estimate_masks(Y, S, N, models, mask_type: str, n_nodes: int, mu: float = 1.
 def _persist_and_score(
     out: Path, layout: DatasetLayout, rir: int, noise: str, snr_range,
     y, s, n, s_dry, n_dry, fs, rnd_snrs, res, L: int, T_true: int,
-    n_nodes: int, save_fig: bool,
+    n_nodes: int, save_fig: bool, bss_filt_len: int = 512,
 ):
     """Per-RIR second half of the reference main (tango.py:528-639): ISTFT
     back to time, every metric variant, and the WAV/MASK/OIM/STFT-z/FIG
@@ -173,12 +165,19 @@ def _persist_and_score(
     zdir = out / "STFT" / "z" / "raw" / snr_dirname(snr_range)
     os.makedirs(zdir, exist_ok=True)
 
+    # first second (lead silence) skipped; lengths are node-independent,
+    # so the slice and the dry-reference projector are per-RIR
+    min_len = min(len(y[0, 0]), sh_t.shape[-1], len(s_dry), len(n_dry))
+    sl = slice(fs, min_len)
+    proj_dry = BssEval(np.stack((s_dry[sl], n_dry[sl])), bss_filt_len)
+
     per_node_tango, per_node_mwf = [], []
     for k in range(n_nodes):
         y0, s0, n0 = y[k, 0], s[k, 0], n[k, 0]
         tango_d, mwf_d = _node_metrics_pair(
             y0, s0, n0, sh_t[k], szh_t[k], s_dry, n_dry,
-            sf_t[k], nf_t[k], szf_t[k], nzf_t[k], fs,
+            sf_t[k], nf_t[k], szf_t[k], nzf_t[k], fs, sl, proj_dry,
+            bss_filt_len=bss_filt_len,
         )
         per_node_tango.append(tango_d)
         per_node_mwf.append(mwf_d)
@@ -323,6 +322,46 @@ def aggregate_results(oim_dir, kind: str = "tango", noise: str | None = None):
     return concatenate_dicts(dicts)
 
 
+def _batched_masks(Yb, Sb, Nb, models, mask_type, mu, n_nodes, z_sigs):
+    """Step-1/step-2 masks for a WHOLE clip batch: the (B, K) node forwards
+    of each CRNN step run as one concatenated device call
+    (:func:`disco_tpu.enhance.inference.crnn_masks_batched`); oracle steps
+    stay vmapped on device.  Returns (Mz, Mw), each (B, K, F, T)."""
+    import jax
+    import jax.numpy as jnp
+
+    from disco_tpu.enhance.inference import crnn_masks_batched, get_z_for_mask
+    from disco_tpu.enhance.tango import tango_step1
+
+    B, K, _, F, T = Yb.shape
+    oracle = jax.vmap(lambda S, N: oracle_masks(S, N, mask_type))(Sb, Nb)
+    refs = None
+    if models[0] is not None or models[1] is not None:
+        refs = np.asarray(Yb[:, :, 0]).reshape(B * K, F, T)
+    if models[0] is None:
+        Mz = oracle
+    else:
+        model, variables = models[0]
+        Mz = jnp.asarray(crnn_masks_batched(refs, model, variables).reshape(B, K, F, T))
+    if models[1] is None:
+        Mw = oracle
+    else:
+        step1 = jax.jit(
+            jax.vmap(jax.vmap(lambda y, s, n, m: tango_step1(y, s, n, m, mu=mu)))
+        )
+        out = step1(Yb, Sb, Nb, Mz)
+        z_y, zn = np.asarray(out["z_y"]), np.asarray(out["zn"])
+        zs = np.stack(
+            [
+                np.stack([get_z_for_mask(z_y[b], zn[b], k, n_nodes, z_sigs) for k in range(K)])
+                for b in range(B)
+            ]
+        ).reshape(B * K, -1, F, T)
+        model, variables = models[1]
+        Mw = jnp.asarray(crnn_masks_batched(refs, model, variables, zs=zs).reshape(B, K, F, T))
+    return Mz, Mw
+
+
 def enhance_rirs_batched(
     root: str,
     scenario: str,
@@ -340,6 +379,8 @@ def enhance_rirs_batched(
     save_fig: bool = True,
     bucket: int = 8192,
     max_batch: int = 16,
+    models=(None, None),
+    z_sigs: str = "zs_hat",
 ):
     """Corpus-scale enhancement: many RIRs per jitted launch.
 
@@ -347,8 +388,11 @@ def enhance_rirs_batched(
     latency that dominates the compute (measured ~70 ms vs ~2 ms of actual
     work per clip); batching 16 clips into one ``vmap``ed program is ~10x
     higher throughput.  RIRs are grouped by bucketed length (one compiled
-    program per bucket), enhanced with oracle masks of ``mask_type``, then
-    scored/persisted per RIR exactly like :func:`enhance_rir`.
+    program per bucket), enhanced with oracle masks of ``mask_type`` or —
+    when ``models`` carries (module, variables) pairs — with CRNN masks
+    whose per-clip, per-node forwards are batched into one device call per
+    step per chunk, then scored/persisted per RIR exactly like
+    :func:`enhance_rir`.
 
     Returns {rir: results dict} for the RIRs actually processed
     (already-done ones are skipped — same idempotency contract).
@@ -384,6 +428,13 @@ def enhance_rirs_batched(
 
         return jax.vmap(one)(Yb, Sb, Nb)
 
+    @partial(jax.jit, static_argnames=())
+    def run_batch_with_masks(Yb, Sb, Nb, Mz, Mw):
+        def one(Y, S, N, mz, mw):
+            return tango(Y, S, N, mz, mw, mu=mu, policy=policy, mask_type=mask_type)
+
+        return jax.vmap(one)(Yb, Sb, Nb, Mz, Mw)
+
     all_results = {}
     for Lp, items in groups.items():
         for start in range(0, len(items), max_batch):
@@ -406,7 +457,11 @@ def enhance_rirs_batched(
             Yb = stft(jnp.asarray(np.stack(ys)))
             Sb = stft(jnp.asarray(np.stack(ss)))
             Nb = stft(jnp.asarray(np.stack(ns)))
-            res_b = run_batch(Yb, Sb, Nb)
+            if models == (None, None):
+                res_b = run_batch(Yb, Sb, Nb)
+            else:
+                Mz, Mw = _batched_masks(Yb, Sb, Nb, models, mask_type, mu, n_nodes, z_sigs)
+                res_b = run_batch_with_masks(Yb, Sb, Nb, Mz, Mw)
             for i in range(n_real):
                 rir, out, layout = chunk[i]
                 y, s, n, s_dry, n_dry, fs, rnd_snrs = sigs[i]
