@@ -1,0 +1,137 @@
+"""Tests for the native WAV codec (io/audio.py) — soundfile-compatible
+semantics incl. the 24-bit PCM support scipy.io.wavfile lacks (VERDICT
+round-1 missing #4)."""
+import struct
+
+import numpy as np
+import pytest
+
+from disco_tpu.io.audio import SUBTYPES, read_wav, write_wav
+
+
+@pytest.fixture
+def sig():
+    rng = np.random.RandomState(0)
+    return (0.8 * rng.randn(1000)).clip(-1, 0.999).astype(np.float32)
+
+
+@pytest.mark.parametrize("subtype,atol", [
+    ("FLOAT", 0.0),
+    ("DOUBLE", 1e-7),          # float32 signal in a float64 container
+    ("PCM_16", 2.0**-15),
+    ("PCM_24", 2.0**-23),
+    ("PCM_32", 2.0**-23),      # quantization below float32 resolution
+])
+def test_round_trip(tmp_path, sig, subtype, atol):
+    p = tmp_path / f"{subtype}.wav"
+    write_wav(p, sig, 16000, subtype=subtype)
+    back, fs = read_wav(p)
+    assert fs == 16000
+    assert back.dtype == np.float32
+    np.testing.assert_allclose(back, sig, atol=atol)
+
+
+def test_round_trip_multichannel(tmp_path):
+    rng = np.random.RandomState(1)
+    x = (0.5 * rng.randn(500, 3)).clip(-1, 0.999).astype(np.float32)
+    p = tmp_path / "mc.wav"
+    write_wav(p, x, 8000, subtype="PCM_24")
+    back, fs = read_wav(p)
+    assert back.shape == (500, 3) and fs == 8000
+    np.testing.assert_allclose(back, x, atol=2.0**-23)
+
+
+def test_pcm24_interleaving_is_little_endian(tmp_path):
+    """One full-scale-ish sample: check the exact 3-byte layout."""
+    p = tmp_path / "one.wav"
+    write_wav(p, np.array([0.5], np.float64), 16000, subtype="PCM_24")
+    raw = p.read_bytes()
+    data_at = raw.index(b"data") + 8
+    assert raw[data_at : data_at + 3] == bytes([0x00, 0x00, 0x40])  # 0x400000 LE
+
+
+def test_negative_pcm24_sign_extension(tmp_path):
+    p = tmp_path / "neg.wav"
+    x = np.array([-0.5, -1.0, 0.25], np.float64)
+    write_wav(p, x, 16000, subtype="PCM_24")
+    back, _ = read_wav(p, dtype=np.float64)
+    np.testing.assert_allclose(back, x, atol=2.0**-22)
+
+
+def test_scipy_interop_reading_our_files(tmp_path, sig):
+    """Files we write in scipy-supported formats load identically there."""
+    import scipy.io.wavfile
+
+    for subtype, scale in (("PCM_16", 2.0**15), ("FLOAT", 1.0)):
+        p = tmp_path / f"interop_{subtype}.wav"
+        write_wav(p, sig, 16000, subtype=subtype)
+        fs, data = scipy.io.wavfile.read(str(p))
+        assert fs == 16000
+        np.testing.assert_allclose(data / scale, sig, atol=2.0 / scale if scale > 1 else 0)
+
+
+def test_reading_scipy_written_files(tmp_path, sig):
+    import scipy.io.wavfile
+
+    p16 = tmp_path / "s16.wav"
+    scipy.io.wavfile.write(str(p16), 16000, (sig * 2**15).astype(np.int16))
+    back, fs = read_wav(p16)
+    np.testing.assert_allclose(back, sig, atol=2.0**-14)
+
+    pf = tmp_path / "sf.wav"
+    scipy.io.wavfile.write(str(pf), 16000, sig)
+    back, _ = read_wav(pf)
+    np.testing.assert_allclose(back, sig, atol=0)
+
+
+def test_extensible_header(tmp_path, sig):
+    """WAVE_FORMAT_EXTENSIBLE (0xFFFE) wrapping PCM is resolved through the
+    sub-format GUID."""
+    pcm = (sig * 2**15).astype("<i2").tobytes()
+    # GUID = {00000001-0000-0010-8000-00aa00389b71}: PCM sub-format
+    guid = struct.pack("<H", 1) + b"\x00\x00" + bytes.fromhex("0000100080000000aa00389b71")
+    # base fmt (16) + cbSize=22 + validBits + channelMask + 16-byte GUID
+    fmt = (struct.pack("<HHIIHH", 0xFFFE, 1, 16000, 32000, 2, 16)
+           + struct.pack("<HHI", 22, 16, 0b1) + guid[:16])
+    body = struct.pack("<4sI", b"fmt ", len(fmt)) + fmt + struct.pack("<4sI", b"data", len(pcm)) + pcm
+    p = tmp_path / "ext.wav"
+    p.write_bytes(struct.pack("<4sI4s", b"RIFF", 4 + len(body), b"WAVE") + body)
+    back, fs = read_wav(p)
+    assert fs == 16000
+    np.testing.assert_allclose(back, sig, atol=2.0**-14)
+
+
+def test_odd_data_chunk_padding(tmp_path):
+    """Odd-byte data chunks (e.g. mono 24-bit with odd sample count) are
+    word-aligned on write and read back fine."""
+    x = np.array([0.1, -0.2, 0.3], np.float64)  # 9 data bytes
+    p = tmp_path / "odd.wav"
+    write_wav(p, x, 16000, subtype="PCM_24")
+    assert p.stat().st_size % 2 == 0
+    back, _ = read_wav(p, dtype=np.float64)
+    np.testing.assert_allclose(back, x, atol=2.0**-22)
+
+
+def test_full_scale_pcm_does_not_wrap(tmp_path):
+    """+1.0 must clip to the positive rail, not wrap to full-scale negative."""
+    for subtype, rail in (("PCM_16", (2**15 - 1) / 2**15),
+                          ("PCM_24", (2**23 - 1) / 2**23),
+                          ("PCM_32", (2**31 - 1) / 2**31)):
+        p = tmp_path / f"rail_{subtype}.wav"
+        write_wav(p, np.array([1.0, -1.0]), 16000, subtype=subtype)
+        back, _ = read_wav(p, dtype=np.float64)
+        assert back[0] == pytest.approx(rail, abs=1e-9), subtype
+        assert back[1] == -1.0, subtype
+
+
+def test_bad_file_raises(tmp_path):
+    p = tmp_path / "bad.wav"
+    p.write_bytes(b"not a wav file at all")
+    with pytest.raises(ValueError, match="RIFF"):
+        read_wav(p)
+
+
+def test_unknown_subtype_raises(tmp_path):
+    with pytest.raises(ValueError, match="subtype"):
+        write_wav(tmp_path / "x.wav", np.zeros(4), 16000, subtype="PCM_8")
+    assert set(SUBTYPES) == {"PCM_16", "PCM_24", "PCM_32", "FLOAT", "DOUBLE"}
