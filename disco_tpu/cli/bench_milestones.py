@@ -1,5 +1,6 @@
-"""Milestone benchmark CLI: run the five BASELINE.json configurations
-(`disco_tpu.milestones`) and print one JSON line per config."""
+"""Milestone benchmark CLI: run the BASELINE.json configurations 1-5 plus
+the streaming-latency config 6 (`disco_tpu.milestones`), and optionally the
+self-generated-corpus pipeline, printing one JSON line per config."""
 from __future__ import annotations
 
 import argparse
@@ -12,18 +13,32 @@ def build_parser():
     p = argparse.ArgumentParser(description="Run the BASELINE milestone benchmark configs")
     p.add_argument("--tiny", action="store_true", help="small CPU-testable scales")
     p.add_argument("--configs", nargs="+", type=int, default=None,
-                   help="subset of configs to run (1-5)")
+                   help="subset of configs to run (1-6; 6 = streaming latency)")
+    p.add_argument("--corpus", action="store_true",
+                   help="also run the self-generated-corpus pipeline milestone "
+                        "(gen→mix→train→tango, disco_tpu.milestones_corpus)")
+    p.add_argument("--workdir", default=None, help="corpus milestone working dir")
     return p
 
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
+    if args.corpus:
+        import tempfile
+
+        from disco_tpu.milestones_corpus import corpus_milestone
+
+        workdir = args.workdir or tempfile.mkdtemp(prefix="disco_corpus_milestone_")
+        kwargs = dict(n_rirs=2, n_epochs=2, max_order=6) if args.tiny else {}
+        res = corpus_milestone(workdir, **kwargs)
+        print(json.dumps(res))  # then the standard configs run as usual
     fns = {
         1: milestones.mvdr_single_clip,
         2: milestones.disco_mwf_4node,
         3: milestones.tango_4node,
         4: milestones.meetit_separation,
         5: milestones.batched_meetit_end_to_end,
+        6: milestones.streaming_latency,
     }
     if args.configs is None and args.tiny:
         results = milestones.run_all(tiny=True)
@@ -35,6 +50,7 @@ def main(argv=None):
             3: dict(dur_s=1.0, iters=1),
             4: dict(dur_s=1.0, K=4, C=2, iters=1),
             5: dict(n_rooms=2, K=2, C=2, dur_s=0.5, max_order=4, rir_len=1024, iters=1),
+            6: dict(dur_s=1.0, K=2, C=2, iters=1),
         }
         results = [fns[i](**(tiny_kwargs[i] if args.tiny else {})) for i in ids]
     for res in results:

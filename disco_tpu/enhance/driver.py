@@ -127,7 +127,7 @@ def estimate_masks(Y, S, N, models, mask_type: str, n_nodes: int, mu: float = 1.
         from disco_tpu.enhance.inference import crnn_masks_batched
 
         model, variables = models[0]
-        masks_z = jnp.asarray(crnn_masks_batched(np.asarray(Y[:, 0]), model, variables))
+        masks_z = jnp.asarray(crnn_masks_batched(to_host(Y[:, 0]), model, variables))
     if models[1] is None:
         mask_w = oracle
     else:
@@ -135,10 +135,10 @@ def estimate_masks(Y, S, N, models, mask_type: str, n_nodes: int, mu: float = 1.
         from disco_tpu.enhance.zexport import compute_z_signals
 
         out = compute_z_signals(None, None, None, Y=Y, S=S, N=N, masks_z=masks_z, mu=mu)
-        z_y, zn = np.asarray(out["z_y"]), np.asarray(out["zn"])
+        z_y, zn = to_host(out["z_y"]), to_host(out["zn"])
         zs = np.stack([get_z_for_mask(z_y, zn, k, n_nodes, z_sigs) for k in range(n_nodes)])
         model, variables = models[1]
-        mask_w = jnp.asarray(crnn_masks_batched(np.asarray(Y[:, 0]), model, variables, zs=zs))
+        mask_w = jnp.asarray(crnn_masks_batched(to_host(Y[:, 0]), model, variables, zs=zs))
     return masks_z, mask_w
 
 
@@ -276,16 +276,18 @@ def enhance_rir(
     Y, S, N = stft(jnp.asarray(y_in)), stft(jnp.asarray(s_in)), stft(jnp.asarray(n_in))
     masks_z, mask_w = estimate_masks(Y, S, N, models, mask_type, n_nodes, mu=mu, z_sigs=z_sigs)
     if streaming:
-        # The online pipeline implements the 'local' mask-for-z policy only
-        # (consumer-side masks); other policies are offline-only.
-        if policy not in ("local",):
+        # The online pipeline implements the 'local'/'distant'/'none'
+        # mask-for-z policies; the oracle policies are offline-only.
+        if policy not in ("local", "distant", "none", None):
             raise ValueError(
-                f"streaming mode implements the 'local' mask-for-z policy; got {policy!r}"
+                f"streaming mode implements the 'local'/'distant'/'none' "
+                f"mask-for-z policies; got {policy!r}"
             )
         from disco_tpu.enhance.tango import TangoResult
         from disco_tpu.enhance.streaming import streaming_tango
 
-        st = streaming_tango(Y, masks_z, mask_w, mu=mu, S=S, N=N, with_diagnostics=True)
+        st = streaming_tango(Y, masks_z, mask_w, mu=mu, S=S, N=N,
+                             with_diagnostics=True, policy=policy)
         # ONE filter everywhere: every saved wav, mask, z and metric below
         # describes the online beamformer (sf/nf come from the same
         # per-block filters applied to the clean components).
@@ -337,7 +339,7 @@ def _batched_masks(Yb, Sb, Nb, models, mask_type, mu, n_nodes, z_sigs):
     oracle = jax.vmap(lambda S, N: oracle_masks(S, N, mask_type))(Sb, Nb)
     refs = None
     if models[0] is not None or models[1] is not None:
-        refs = np.asarray(Yb[:, :, 0]).reshape(B * K, F, T)
+        refs = to_host(Yb[:, :, 0]).reshape(B * K, F, T)
     if models[0] is None:
         Mz = oracle
     else:
@@ -350,7 +352,7 @@ def _batched_masks(Yb, Sb, Nb, models, mask_type, mu, n_nodes, z_sigs):
             jax.vmap(jax.vmap(lambda y, s, n, m: tango_step1(y, s, n, m, mu=mu)))
         )
         out = step1(Yb, Sb, Nb, Mz)
-        z_y, zn = np.asarray(out["z_y"]), np.asarray(out["zn"])
+        z_y, zn = to_host(out["z_y"]), to_host(out["zn"])
         zs = np.stack(
             [
                 np.stack([get_z_for_mask(z_y[b], zn[b], k, n_nodes, z_sigs) for k in range(K)])

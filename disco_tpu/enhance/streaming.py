@@ -7,6 +7,17 @@ The reference ships an exponential-smoothing covariance estimator
 ever uses it (SURVEY.md §2.2/§5.7).  Here it becomes a first-class pipeline
 with fixed per-frame latency and O(1) covariance state.
 
+Warm start: the reference recursion takes "the previous estimation of Rxx"
+as input and — having no caller — never defines the initial state.  This
+module initializes ``R0 = 1e-6 * I`` (a tiny isotropic loading): after t
+frames the state is ``lam^t * R0 + (1-lam) * sum lam^(t-i) x_i x_i^H``,
+i.e. the reference recursion exactly, plus an exponentially-vanishing
+regularizer whose only role is keeping the very first GEVD refreshes
+well-posed (the refresh guard below skips them anyway if ill-conditioned).
+The per-frame update itself — ``R <- lam R + (1-lam) (m x)(m x)^H`` with
+the mask fused into the stream — matches internal_formulas.py:84-103 with
+``M`` pre-multiplied, as its docstring describes.
+
 TPU-first structure: the naive formulation (a ``lax.scan`` over frames with
 the GEVD refresh under ``lax.cond``) is what a line-by-line port would write,
 but complex ``eigh`` inside XLA control flow is unsupported on TPU and
@@ -35,34 +46,35 @@ def _outer(x):
     return jnp.einsum("...fc,...fd->...fcd", x, jnp.conj(x))
 
 
-def _block_covariances(Xb, Mb, lam):
+def _block_covariances(XSb, XNb, lam):
     """Scan over frame blocks, emitting the refresh-point covariances.
 
     The refresh covariance of block b is the smoothed estimate *after the
     block's first frame* — exactly where the naive per-frame recursion
-    ``R <- lam R + (1-lam) x x^H`` refreshes its filter.  The remaining u-1
+    ``R <- lam R + (1-lam) x x^H`` (the reference's
+    ``spatial_correlation_matrix``, internal_formulas.py:84-103, with its
+    mask fused into the stream) refreshes its filter.  The remaining u-1
     frames advance the carry in closed form:
     ``R_end = lam^(u-1) R_refresh + (1-lam) sum_i lam^(u-1-i) x_i x_i^H``.
 
     Args:
-      Xb: (B, u, F, D) frame blocks.
-      Mb: (B, u, F) mask blocks.
+      XSb: (B, u, F, D) speech-statistic frame blocks (already masked /
+        policy-shaped — see ``_stream_stats``).
+      XNb: (B, u, F, D) noise-statistic frame blocks.
       lam: smoothing factor.
 
     Returns:
       ((Rss_end, Rnn_end), (Rss_ref, Rnn_ref)) with ref shapes (B, F, D, D).
     """
-    B, u, F, D = Xb.shape
+    B, u, F, D = XSb.shape
     eps = 1e-6
-    R0 = jnp.broadcast_to(eps * jnp.eye(D, dtype=Xb.dtype), (F, D, D))
+    R0 = jnp.broadcast_to(eps * jnp.eye(D, dtype=XSb.dtype), (F, D, D))
     # weights lam^(u-1-i) for intra-block frames i = 1..u-1
     tail_w = lam ** jnp.arange(u - 2, -1, -1, dtype=jnp.float32) if u > 1 else None
 
     def body(carry, inp):
         Rss, Rnn = carry
-        xb, mb = inp  # (u, F, D), (u, F)
-        xs = mb[..., None] * xb
-        xn = (1.0 - mb)[..., None] * xb
+        xs, xn = inp  # (u, F, D) each
         Rss_r = lam * Rss + (1.0 - lam) * _outer(xs[0])
         Rnn_r = lam * Rnn + (1.0 - lam) * _outer(xn[0])
         if u > 1:
@@ -74,11 +86,16 @@ def _block_covariances(Xb, Mb, lam):
             Rss_e, Rnn_e = Rss_r, Rnn_r
         return (Rss_e, Rnn_e), (Rss_r, Rnn_r)
 
-    return jax.lax.scan(body, (R0, R0), (Xb, Mb))
+    return jax.lax.scan(body, (R0, R0), (XSb, XNb))
 
 
-def _stream_filter(X, M, lam, u, mu, ref: int = 0, extras=None):
+def _stream_filter(X, XS, XN, lam, u, mu, ref: int = 0, extras=None):
     """One node's streaming filter over a (T, F, D) frame stream.
+
+    ``X`` is the stream the filter is APPLIED to; ``XS``/``XN`` are the
+    speech/noise statistic streams driving the smoothed covariances (for the
+    plain 'local' policy these are ``m*X`` and ``(1-m)*X``; other policies
+    shape the z channels differently — see ``_stream_stats``).
 
     ``ref``: channel selected by the warm-up / skipped-refresh fallback
     filter (the node's reference mic).  ``extras``: optional list of
@@ -90,13 +107,16 @@ def _stream_filter(X, M, lam, u, mu, ref: int = 0, extras=None):
     T, F, D = X.shape
     pad = (-T) % u
     if pad:
-        X = jnp.concatenate([X, jnp.zeros((pad, F, D), X.dtype)])
-        M = jnp.concatenate([M, jnp.zeros((pad, F), M.dtype)])
+        zpad = jnp.zeros((pad, F, D), X.dtype)
+        X = jnp.concatenate([X, zpad])
+        XS = jnp.concatenate([XS, zpad])
+        XN = jnp.concatenate([XN, zpad])
     B = X.shape[0] // u
     Xb = X.reshape(B, u, F, D)
-    Mb = M.reshape(B, u, F)
 
-    (Rss_e, Rnn_e), (Rss_ref, Rnn_ref) = _block_covariances(Xb, Mb, lam)
+    (Rss_e, Rnn_e), (Rss_ref, Rnn_ref) = _block_covariances(
+        XS.reshape(B, u, F, D), XN.reshape(B, u, F, D), lam
+    )
     if pad:
         # Padded zero frames only decay the carry (R <- lam R); undo so the
         # returned continuation state is the true end-of-stream estimate.
@@ -164,8 +184,10 @@ def streaming_step1(
         return jnp.moveaxis(a, -1, 0).swapaxes(-1, -2)  # (C,F,T) -> (T,F,C)
 
     extras = [tfc(S), tfc(N)] if with_diagnostics else None
+    X = tfc(Y)
+    M = mask_z.T[..., None]  # (T, F, 1) broadcast over channels
     z, w, Rss, Rnn, extra_out = _stream_filter(
-        tfc(Y), mask_z.T, lambda_cor, update_every, mu, ref=ref_mic, extras=extras
+        X, M * X, (1.0 - M) * X, lambda_cor, update_every, mu, ref=ref_mic, extras=extras
     )
     z_y = z.T
     out = {"z_y": z_y, "zn": Y[ref_mic] - z_y, "Rss": Rss, "Rnn": Rnn, "w": w}
@@ -174,7 +196,43 @@ def streaming_step1(
     return out
 
 
-@partial(jax.jit, static_argnames=("update_every", "ref_mic", "with_diagnostics"))
+def _stream_stats(Y, all_z, zn, mask_w, oth, policy):
+    """Step-2 speech/noise statistic streams per node under the mask-for-z
+    policy — the streaming mirror of the offline ``_z_stats``
+    (tango.py:396-429 semantics):
+
+    - 'local':   consumer mask m_k on local mics AND every incoming z.
+    - 'distant': producer mask m_j on z_j; consumer mask on local mics.
+    - 'none'/None: z unmasked for speech stats, the producer's zn stream
+      (y_ref - z) for noise stats; consumer mask on local mics.
+
+    Returns (XS, XN): (K, C+K-1, F, T) stacked statistic streams.
+    """
+    m = mask_w[:, None]  # (K, 1, F, T)
+    y_s, y_n = m * Y, (1.0 - m) * Y
+    z_oth = all_z[oth]  # (K, K-1, F, T)
+    if policy == "local":
+        zs_stat = mask_w[:, None] * z_oth
+        zn_stat = (1.0 - mask_w)[:, None] * z_oth
+    elif policy is None or policy == "none":
+        zs_stat = z_oth
+        zn_stat = zn[oth]
+    elif policy == "distant":
+        mw_oth = mask_w[oth]  # producer masks, (K, K-1, F, T)
+        zs_stat = mw_oth * z_oth
+        zn_stat = (1.0 - mw_oth) * z_oth
+    else:
+        raise ValueError(
+            f"streaming mask-for-z policy {policy!r} not supported; "
+            "one of 'local', 'distant', 'none' (other policies are offline-only)"
+        )
+    return (
+        jnp.concatenate([y_s, zs_stat], axis=1),
+        jnp.concatenate([y_n, zn_stat], axis=1),
+    )
+
+
+@partial(jax.jit, static_argnames=("update_every", "ref_mic", "with_diagnostics", "policy"))
 def streaming_tango(
     Y,
     masks_z,
@@ -186,15 +244,16 @@ def streaming_tango(
     S=None,
     N=None,
     with_diagnostics: bool = False,
+    policy: str | None = "local",
 ):
     """Full two-step streaming TANGO over all nodes (mixture-only by
     default: the deployment path needs no oracle S/N).
 
     Step 1 streams per node (vmapped); the z-exchange is array indexing on
     one device (an all_gather over 'node' when mesh-sharded); step 2 streams
-    the stacked [y_k ‖ z_{j≠k}] with consumer-side masks — the 'local'
-    policy of the offline pipeline (tango.py:418-420).  Other mask-for-z
-    policies are an offline-only feature.
+    the stacked [y_k ‖ z_{j≠k}] under the 'local', 'distant' or 'none'
+    mask-for-z policy (see :func:`_stream_stats`; the oracle policies are
+    offline-only features).
 
     Args:
       Y: (K, C, F, T) mixture STFTs.
@@ -229,16 +288,17 @@ def streaming_tango(
         return jnp.moveaxis(a, -1, 1).swapaxes(-1, -2)  # (K, D, F, T) -> (K, T, F, D)
 
     X = ktfd(stack_streams(Y, all_z))
-    M = jnp.moveaxis(mask_w, -1, 1)  # (K, T, F)
+    XS, XN = _stream_stats(Y, all_z, s1["zn"], mask_w, oth, policy)
+    XS, XN = ktfd(XS), ktfd(XN)
     if with_diagnostics:
         Xs = ktfd(stack_streams(S, s1["z_s"]))
         Xn = ktfd(stack_streams(N, s1["z_n"]))
         stream2 = jax.vmap(
-            lambda x, m, xs, xn: _stream_filter(
-                x, m, lambda_cor, update_every, mu, ref=ref_mic, extras=[xs, xn]
+            lambda x, xs_st, xn_st, xs, xn: _stream_filter(
+                x, xs_st, xn_st, lambda_cor, update_every, mu, ref=ref_mic, extras=[xs, xn]
             )
         )
-        yf, _, _, _, (sf, nf) = stream2(X, M, Xs, Xn)
+        yf, _, _, _, (sf, nf) = stream2(X, XS, XN, Xs, Xn)
         return {
             "yf": jnp.moveaxis(yf, 1, -1),
             "sf": jnp.moveaxis(sf, 1, -1),
@@ -248,6 +308,8 @@ def streaming_tango(
             "z_s": s1["z_s"],
             "z_n": s1["z_n"],
         }
-    stream2 = jax.vmap(lambda x, m: _stream_filter(x, m, lambda_cor, update_every, mu, ref=ref_mic)[0])
-    yf = stream2(X, M)  # (K, T, F)
+    stream2 = jax.vmap(
+        lambda x, xs_st, xn_st: _stream_filter(x, xs_st, xn_st, lambda_cor, update_every, mu, ref=ref_mic)[0]
+    )
+    yf = stream2(X, XS, XN)  # (K, T, F)
     return {"yf": jnp.moveaxis(yf, 1, -1), "z_y": all_z, "zn": s1["zn"]}
