@@ -21,6 +21,8 @@ import dataclasses
 
 import numpy as np
 
+from disco_tpu.utils import to_host
+
 from disco_tpu.core.dsp import stft
 from disco_tpu.core.masks import tf_mask
 from disco_tpu.core.metrics import si_bss
@@ -147,7 +149,7 @@ def get_masks(images, mics_per_node):
     (gen_meetit:166-189), batched: one STFT over all (sources, mics).
 
     Returns (mix_stfts (M, F, T), masks (n_sources, M, F, T))."""
-    S = np.asarray(stft(images))  # (n_src, M, F, T)
+    S = to_host(stft(images))  # (n_src, M, F, T)
     mix = S.sum(0)  # (M, F, T)
     n_src = S.shape[0]
     masks = np.stack(

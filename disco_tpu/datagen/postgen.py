@@ -12,6 +12,8 @@ import os
 
 import numpy as np
 
+from disco_tpu.utils import to_host
+
 from disco_tpu.core.dsp import stft
 from disco_tpu.core.masks import tf_mask
 from disco_tpu.io import DatasetLayout, read_wav, write_wav
@@ -78,9 +80,11 @@ class PostGenerator:
             tar_list, noi_list = self.get_sig_lists(rir)
             tars, nois, mixs, snr = self.mix_sigs(tar_list, noi_list)
             self.snr_out[rir - self.rir_start, 0] = snr
-            tars_stft = np.asarray(stft(tars, self.n_fft, self.n_hop))
-            nois_stft = np.asarray(stft(nois, self.n_fft, self.n_hop))
-            mixs_stft = np.asarray(stft(mixs, self.n_fft, self.n_hop))
+            # to_host: the tunneled TPU attachment cannot transfer complex
+            # dtypes in one copy (see utils.transfer)
+            tars_stft = to_host(stft(tars, self.n_fft, self.n_hop))
+            nois_stft = to_host(stft(nois, self.n_fft, self.n_hop))
+            mixs_stft = to_host(stft(mixs, self.n_fft, self.n_hop))
             masks = np.asarray(tf_mask(tars_stft, nois_stft, self.mask_type))
             self.save_data(tars, nois, mixs, tars_stft, nois_stft, mixs_stft, masks, rir)
             done.append(rir)

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from disco_tpu.core.masks import vad_oracle_batch
+from disco_tpu.utils import to_host
 
 STFT_MIN, STFT_MAX = 1e-6, 1e3  # utils.py:7
 FS = 16000
@@ -167,7 +168,7 @@ def crnn_mask(
     """
     frames_lost = win_len - model.conv_output_hw()[0]
     x = prepare_data(
-        np.asarray(Y),
+        to_host(Y),
         three_d_tensor,
         z_data=None if z is None else list(z),
         win_len=win_len,
@@ -214,9 +215,9 @@ def crnn_masks_batched(
 
     def prep(i):
         return prepare_data(
-            np.asarray(Ys[i]),
+            to_host(Ys[i]),
             three_d_tensor,
-            z_data=None if zs is None else list(np.asarray(zs[i])),
+            z_data=None if zs is None else list(to_host(zs[i])),
             win_len=win_len,
             win_hop=1,
             frame_to_pred=frame_to_pred,

@@ -1,0 +1,18 @@
+"""The self-generated-corpus milestone (gen → mix → z → train → tango with
+oracle AND trained CRNN masks) runs end-to-end at tiny scale — the config-3/4
+numbers produced from real pipeline data (VERDICT round-1 item 5)."""
+import numpy as np
+
+from disco_tpu.milestones_corpus import corpus_milestone
+
+
+def test_corpus_milestone_tiny(tmp_path):
+    out = corpus_milestone(tmp_path, n_rirs=2, n_epochs=1, max_order=4)
+    assert out["config"] == "corpus_pipeline"
+    assert set(out) >= {"tango_4node_oracle", "tango_4node_crnn"}
+    for entry in (out["tango_4node_oracle"], out["tango_4node_crnn"]):
+        for key in ("delta_sdr_512tap", "delta_si_sdr", "delta_stoi"):
+            assert np.isfinite(entry[key]), (entry, key)
+    # oracle masks on pipeline data must enhance (the CRNN entry is allowed
+    # to be weak at 1 epoch x 2 clips — the full run trains properly)
+    assert out["tango_4node_oracle"]["delta_sdr_512tap"] > 2.0
