@@ -1,0 +1,64 @@
+"""Generate per-module autodoc pages for every module in disco_tpu —
+the equivalent of the reference's ``sphinx-apidoc -fTMe`` step
+(reference doc/Makefile:28-30), implemented without requiring sphinx at
+generation time (the build environment has no sphinx wheel; the pages are
+committed and rebuilt by ``make -C doc apidoc`` wherever sphinx exists).
+
+Run from the repo root:  python doc/gen_apidoc.py
+"""
+from __future__ import annotations
+
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+PKG = ROOT / "disco_tpu"
+OUT = ROOT / "doc" / "source" / "api"
+
+
+def module_name(py: Path) -> str:
+    rel = py.relative_to(ROOT).with_suffix("")
+    parts = list(rel.parts)
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def page(mod: str) -> str:
+    underline = "=" * len(mod)
+    return f"""{mod}
+{underline}
+
+.. automodule:: {mod}
+   :members:
+   :undoc-members:
+   :show-inheritance:
+"""
+
+
+def main():
+    OUT.mkdir(parents=True, exist_ok=True)
+    for old in OUT.glob("*.rst"):
+        old.unlink()
+    mods = sorted(
+        module_name(p)
+        for p in PKG.rglob("*.py")
+        if "__pycache__" not in p.parts
+    )
+    for mod in mods:
+        (OUT / f"{mod}.rst").write_text(page(mod))
+    toc = "\n".join(f"   api/{m}" for m in mods)
+    (OUT.parent / "api_modules.rst").write_text(
+        f"""API reference (per module)
+==========================
+
+.. toctree::
+   :maxdepth: 1
+
+{toc}
+"""
+    )
+    print(f"wrote {len(mods)} module pages under {OUT}")
+
+
+if __name__ == "__main__":
+    main()
