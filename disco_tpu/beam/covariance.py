@@ -34,7 +34,12 @@ def frame_mean_covariance(
     """
     b = a if b is None else b
     T = a.shape[-1]
-    cov = jnp.einsum("...cft,...dft->...fcd", a, jnp.conj(b))
+    # HIGHEST precision: the TPU default (bf16 operands) accumulates ~1e-2
+    # relative error over the frame reduction, which can leave the noise
+    # covariance numerically indefinite — Cholesky in the GEVD then emits
+    # NaN bins (observed on hardware at C+K-1 = 5 stacked channels).
+    cov = jnp.einsum("...cft,...dft->...fcd", a, jnp.conj(b),
+                     precision=jax.lax.Precision.HIGHEST)
     if axis_name is not None:
         cov = jax.lax.psum(cov, axis_name)
         T = T * jax.lax.psum(1, axis_name)

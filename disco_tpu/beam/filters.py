@@ -102,6 +102,15 @@ def gevd_mwf(Rxx: jnp.ndarray, Rnn: jnp.ndarray, mu: float = 1.0, rank=1):
         gains = jnp.where(keep, gains, 0.0)
     W = jnp.einsum("...ci,...i->...c", Q, gains.astype(Q.dtype) * qinv_col0)
     t1 = Q[..., :, 0] * qinv_col0[..., 0:1]
+    # Degenerate-bin guard: if the f32 Cholesky/eigh emitted non-finite
+    # values for a bin (near-singular noise stats survive the diagonal
+    # loading only up to hardware precision), fall back to the e1 selector —
+    # pass the reference channel through rather than poisoning the clip.
+    e1 = jnp.zeros_like(W).at[..., 0].set(1.0)
+    ok = jnp.isfinite(W.real) & jnp.isfinite(W.imag)
+    ok = ok.all(axis=-1, keepdims=True)
+    W = jnp.where(ok, W, e1)
+    t1 = jnp.where(ok, t1, e1)
     return W, t1
 
 

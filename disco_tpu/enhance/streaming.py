@@ -43,7 +43,8 @@ from disco_tpu.enhance.tango import others_index
 
 def _outer(x):
     """(..., F, D) frame -> (..., F, D, D) outer product."""
-    return jnp.einsum("...fc,...fd->...fcd", x, jnp.conj(x))
+    return jnp.einsum("...fc,...fd->...fcd", x, jnp.conj(x),
+                      precision=jax.lax.Precision.HIGHEST)
 
 
 def _block_covariances(XSb, XNb, lam):
@@ -78,8 +79,10 @@ def _block_covariances(XSb, XNb, lam):
         Rss_r = lam * Rss + (1.0 - lam) * _outer(xs[0])
         Rnn_r = lam * Rnn + (1.0 - lam) * _outer(xn[0])
         if u > 1:
-            acc_s = jnp.einsum("t,tfc,tfd->fcd", tail_w, xs[1:], jnp.conj(xs[1:]))
-            acc_n = jnp.einsum("t,tfc,tfd->fcd", tail_w, xn[1:], jnp.conj(xn[1:]))
+            acc_s = jnp.einsum("t,tfc,tfd->fcd", tail_w, xs[1:], jnp.conj(xs[1:]),
+                               precision=jax.lax.Precision.HIGHEST)
+            acc_n = jnp.einsum("t,tfc,tfd->fcd", tail_w, xn[1:], jnp.conj(xn[1:]),
+                               precision=jax.lax.Precision.HIGHEST)
             Rss_e = lam ** (u - 1) * Rss_r + (1.0 - lam) * acc_s
             Rnn_e = lam ** (u - 1) * Rnn_r + (1.0 - lam) * acc_n
         else:

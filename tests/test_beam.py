@@ -123,3 +123,26 @@ def test_gevd_mask_derived_covariances(rng):
     for f in range(F):
         W_ref, _ = intern_filter_np(Rss_ref[f], Rnn_ref[f], 1.0, "gevd", 1)
         np.testing.assert_allclose(np.asarray(W[f]), W_ref, atol=2e-2)
+
+
+def test_gevd_degenerate_bins_stay_finite():
+    """Hardware regression (round 2): on TPU the default bf16 matmul
+    precision could leave frame-mean noise covariances numerically
+    indefinite, so Cholesky emitted NaN bins and step-2 outputs were
+    poisoned.  Two defenses are pinned here: covariance einsums run at
+    HIGHEST precision, and gevd_mwf falls back to the e1 selector on any
+    non-finite bin instead of propagating NaN."""
+    import jax.numpy as jnp
+
+    from disco_tpu.beam.filters import gevd_mwf
+
+    rng = np.random.default_rng(0)
+    C = 5
+    X = rng.standard_normal((257, C, 30)) + 1j * rng.standard_normal((257, C, 30))
+    Rxx = np.einsum("fct,fdt->fcd", X, X.conj()) / 30
+    # indefinite noise covariance: a healthy Gram minus too much diagonal
+    Rnn = Rxx.copy()
+    Rnn[:50] -= 2.0 * np.eye(C)[None]
+    w, t1 = gevd_mwf(jnp.asarray(Rxx, jnp.complex64), jnp.asarray(Rnn, jnp.complex64), rank=1)
+    assert bool(jnp.isfinite(w.real).all() & jnp.isfinite(w.imag).all())
+    assert bool(jnp.isfinite(t1.real).all())
