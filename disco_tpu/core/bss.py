@@ -54,16 +54,23 @@ def _gram(c, srcs, flen):
     return G
 
 
-def _solve_coeffs(G, d_cat):
-    """Projection FIR coefficients from the normal equations; lstsq fallback
-    keeps rank-deficient Grams (e.g. silent or colinear references) finite."""
+def _factor_gram(G):
+    """Factor the (SPD up to rank deficiency) Gram once: a Cholesky factor
+    when it exists, plus the raw matrix for the lstsq fallback (silent or
+    colinear references)."""
     try:
-        coef = np.linalg.solve(G, d_cat)
-        if not np.all(np.isfinite(coef)):
-            raise np.linalg.LinAlgError
-        return coef
-    except np.linalg.LinAlgError:
-        return np.linalg.lstsq(G, d_cat, rcond=None)[0]
+        return (scipy.linalg.cho_factor(G), G)
+    except (np.linalg.LinAlgError, scipy.linalg.LinAlgError):
+        return (None, G)
+
+
+def _solve_coeffs(factor, d_cat):
+    cho, G = factor
+    if cho is not None:
+        coef = scipy.linalg.cho_solve(cho, d_cat)
+        if np.all(np.isfinite(coef)):
+            return coef
+    return np.linalg.lstsq(G, d_cat, rcond=None)[0]
 
 
 class _Projector:
@@ -91,7 +98,7 @@ class _Projector:
         d = np.fft.irfft(np.conj(self._R) * E[None, :], self._n_fft, axis=-1)[:, :flen]
         key = tuple(srcs)
         if key not in self._G:
-            self._G[key] = _gram(self._c, srcs, flen)
+            self._G[key] = _factor_gram(_gram(self._c, srcs, flen))
         d_cat = np.concatenate([d[i] for i in srcs])
         coef = _solve_coeffs(self._G[key], d_cat).reshape(len(srcs), flen)
         proj = np.zeros(self.T + flen - 1)
@@ -177,9 +184,11 @@ def bss_eval_sources(reference_sources, estimated_sources, compute_permutation: 
     for i in range(nsrc):
         for j in range(nsrc):
             table[i, j] = _decompose(proj, ests[i], j)
-    best, best_sir = None, -np.inf
+    best, best_sir = tuple(range(nsrc)), -np.inf
     for perm in itertools.permutations(range(nsrc)):
         mean_sir = np.mean([table[i, perm[i], 1] for i in range(nsrc)])
+        # NaN SIRs (e.g. an all-zero estimate) never beat best_sir, so the
+        # identity initialization keeps the degenerate case well-defined.
         if mean_sir > best_sir:
             best, best_sir = perm, mean_sir
     perm = np.array(best)

@@ -201,8 +201,9 @@ def si_sdr(reference, estimation):
     projection = alpha * reference
     noise = estimation - projection
     # A perfect estimate has zero residual: the ratio is +inf by design (see
-    # the doctest), so only the final divide is silenced — an all-zero
-    # reference still warns on the alpha division above.
+    # the doctest), so the final divide/log are silenced (this also covers
+    # the -inf of a zero projection) — an all-zero reference still warns on
+    # the alpha division above.
     with np.errstate(divide="ignore"):
         ratio = np.sum(projection**2, axis=-1) / np.sum(noise**2, axis=-1)
         return 10 * np.log10(ratio)

@@ -122,6 +122,17 @@ def test_si_vs_filtered_calibration(rng):
     assert sdr_si < 5  # the echo is real distortion for the SI family
 
 
+def test_all_zero_estimates_do_not_crash():
+    """Silent estimates make every permutation's SIR NaN; the identity
+    permutation must come back (not a crash) with NaN scores."""
+    rng = np.random.RandomState(5)
+    refs = rng.randn(2, 500)
+    sdr, sir, sar, perm = bss_eval_sources(refs, np.zeros_like(refs),
+                                           compute_permutation=True, filt_len=8)
+    assert list(perm) == [0, 1]
+    assert np.all(np.isnan(sdr) | np.isinf(sdr))
+
+
 def test_single_source():
     rng = np.random.RandomState(3)
     s = rng.randn(1, 1000)
