@@ -39,13 +39,18 @@ DIAG_LOADING = 1e-6
 
 
 def get_filter_type(name: str):
-    """Parse a filter spec like 'gevd', 'rank2-gevd', 'rank12-gevd', 'r1-mwf',
-    'mwf' (internal_formulas.py:10-28): returns (type, rank)."""
+    """Parse a filter spec like 'gevd', 'rank2-gevd', 'rank12-gevd',
+    'gevd-power', 'r1-mwf', 'mwf' (internal_formulas.py:10-28):
+    returns (type, rank)."""
+    if name == "gevd-power":
+        return "gevd-power", 1
     if "gevd" in name:
         if "-" in name:
             m = re.fullmatch(r"rank(\d+)-gevd", name)
             if m is None:
-                raise ValueError(f"malformed GEVD filter spec {name!r}; expected 'gevd' or 'rankN-gevd'")
+                raise ValueError(
+                    f"malformed GEVD filter spec {name!r}; expected 'gevd', 'rankN-gevd' or 'gevd-power'"
+                )
             return "gevd", int(m.group(1))
         return "gevd", "full"
     return name, None
@@ -56,6 +61,27 @@ def _load_diag(R: jnp.ndarray, rel: float = DIAG_LOADING) -> jnp.ndarray:
     tr = jnp.trace(R, axis1=-2, axis2=-1).real / C
     eye = jnp.eye(C, dtype=R.dtype)
     return R + (rel * tr[..., None, None] + jnp.finfo(R.real.dtype).tiny) * eye
+
+
+def _whitened(Rxx: jnp.ndarray, Rnn: jnp.ndarray):
+    """Shared GEVD prologue: (L, A) with ``L = chol(Rnn + loading)`` and
+    ``A = L^-1 Rxx L^-H`` re-hermitized.
+
+    Joint scale normalization first: (Rxx, Rnn) -> (sRxx, sRnn) leaves the
+    filter and t1 exactly invariant (L scales by sqrt(s), Q by 1/sqrt(s),
+    qinv0 by sqrt(s); the generalized eigenvalues are unchanged), but keeps
+    the Cholesky/eigh iterations in float32 range for near-zero
+    covariances — required on TPU where warm-up-phase streaming
+    covariances are ~1e-12."""
+    C = Rnn.shape[-1]
+    tr_n = jnp.trace(Rnn, axis1=-2, axis2=-1).real[..., None, None] / C
+    scale = 1.0 / jnp.maximum(tr_n, jnp.finfo(Rnn.real.dtype).smallest_normal)
+    Rxx = Rxx * scale
+    Rnn = Rnn * scale
+    L = jnp.linalg.cholesky(_load_diag(Rnn))
+    Li_Rxx = solve_triangular(L, Rxx, lower=True)
+    A = solve_triangular(L, Li_Rxx.conj().swapaxes(-1, -2), lower=True).conj().swapaxes(-1, -2)
+    return L, 0.5 * (A + A.conj().swapaxes(-1, -2))  # re-hermitize vs roundoff
 
 
 @partial(jax.jit, static_argnames=("rank",))
@@ -73,20 +99,7 @@ def gevd_mwf(Rxx: jnp.ndarray, Rnn: jnp.ndarray, mu: float = 1.0, rank=1):
       ``t1 = Q[:, 0] * (Q⁻¹)[0, 0]`` (..., C).
     """
     C = Rxx.shape[-1]
-    # Joint scale normalization: (Rxx, Rnn) -> (sRxx, sRnn) leaves W and t1
-    # exactly invariant (L scales by sqrt(s), Q by 1/sqrt(s), qinv0 by
-    # sqrt(s); the generalized eigenvalues are unchanged), but keeps the
-    # Cholesky/eigh iterations in float32 range for near-zero covariances —
-    # required on TPU where warm-up-phase streaming covariances are ~1e-12.
-    tr_n = jnp.trace(Rnn, axis1=-2, axis2=-1).real[..., None, None] / C
-    scale = 1.0 / jnp.maximum(tr_n, jnp.finfo(Rnn.real.dtype).smallest_normal)
-    Rxx = Rxx * scale
-    Rnn = Rnn * scale
-    L = jnp.linalg.cholesky(_load_diag(Rnn))
-    # A = L⁻¹ Rxx L⁻ᴴ
-    Li_Rxx = solve_triangular(L, Rxx, lower=True)
-    A = solve_triangular(L, Li_Rxx.conj().swapaxes(-1, -2), lower=True).conj().swapaxes(-1, -2)
-    A = 0.5 * (A + A.conj().swapaxes(-1, -2))  # re-hermitize against roundoff
+    L, A = _whitened(Rxx, Rnn)
     lam, U = jnp.linalg.eigh(A)  # ascending
     lam = lam[..., ::-1]
     U = U[..., ::-1]
@@ -126,18 +139,11 @@ def gevd_mwf_power(Rxx: jnp.ndarray, Rnn: jnp.ndarray, mu: float = 1.0, iters: i
     the speech field has a clear dominant direction (measured ~2e-7 on
     rank-1 scenes; bins with a weak eigengap converge more slowly but carry
     small Wiener gains).  Not used by default — select with
-    ``intern_filter(..., ftype='gevd-power')`` or the pipelines' ``solver``
-    options where exposed.
+    ``intern_filter(..., ftype='gevd-power', rank=1)`` or via
+    ``get_filter_type('gevd-power')``.
     """
     C = Rxx.shape[-1]
-    tr_n = jnp.trace(Rnn, axis1=-2, axis2=-1).real[..., None, None] / C
-    scale = 1.0 / jnp.maximum(tr_n, jnp.finfo(Rnn.real.dtype).smallest_normal)
-    Rxx = Rxx * scale
-    Rnn = Rnn * scale
-    L = jnp.linalg.cholesky(_load_diag(Rnn))
-    Li_Rxx = solve_triangular(L, Rxx, lower=True)
-    A = solve_triangular(L, Li_Rxx.conj().swapaxes(-1, -2), lower=True).conj().swapaxes(-1, -2)
-    A = 0.5 * (A + A.conj().swapaxes(-1, -2))
+    L, A = _whitened(Rxx, Rnn)
 
     v = jnp.ones(A.shape[:-1], A.dtype) / jnp.sqrt(C)
 

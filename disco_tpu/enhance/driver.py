@@ -121,25 +121,47 @@ def estimate_masks(Y, S, N, models, mask_type: str, n_nodes: int, mu: float = 1.
     import jax.numpy as jnp
 
     oracle = oracle_masks(S, N, mask_type)
+    Y = jnp.asarray(Y)
     if models[0] is None:
         masks_z = oracle
     else:
         from disco_tpu.enhance.inference import crnn_masks_batched
 
         model, variables = models[0]
-        masks_z = jnp.asarray(crnn_masks_batched(to_host(Y[:, 0]), model, variables))
+        masks_z = jnp.asarray(crnn_masks_batched(Y[:, 0], model, variables))
     if models[1] is None:
         mask_w = oracle
     else:
-        from disco_tpu.enhance.inference import crnn_masks_batched, get_z_for_mask
+        from disco_tpu.enhance.inference import crnn_masks_batched
         from disco_tpu.enhance.zexport import compute_z_signals
 
         out = compute_z_signals(None, None, None, Y=Y, S=S, N=N, masks_z=masks_z, mu=mu)
-        z_y, zn = to_host(out["z_y"]), to_host(out["zn"])
-        zs = np.stack([get_z_for_mask(z_y, zn, k, n_nodes, z_sigs) for k in range(n_nodes)])
+        zs = _z_for_mask_device(out["z_y"], out["zn"], n_nodes, z_sigs)
         model, variables = models[1]
-        mask_w = jnp.asarray(crnn_masks_batched(to_host(Y[:, 0]), model, variables, zs=zs))
+        mask_w = jnp.asarray(crnn_masks_batched(Y[:, 0], model, variables, zs=zs))
     return masks_z, mask_w
+
+
+def _z_for_mask_device(z_y, zn, n_nodes: int, z_sigs: str):
+    """Device-resident mirror of inference.get_z_for_mask for ALL nodes at
+    once: (K, F, T) z streams -> (K, n_z, F, T) per-node NN inputs, with no
+    host round-trip (the tunneled chip moves ~45 MB/s; z streams for a
+    16-clip batch are ~130 MB)."""
+    import jax.numpy as jnp
+
+    from disco_tpu.enhance.tango import others_index
+
+    oth = jnp.asarray(others_index(n_nodes))  # (K, K-1)
+    if z_sigs in ("zs_hat", "zn_hat"):
+        z_in = jnp.asarray(z_y if z_sigs == "zs_hat" else zn)
+        return z_in[oth]
+    z_y, zn = jnp.asarray(z_y), jnp.asarray(zn)
+    inter = jnp.stack([z_y, zn], axis=1).reshape((2 * n_nodes,) + z_y.shape[1:])
+    keep = jnp.asarray([
+        [j for j in range(2 * n_nodes) if j not in (2 * k, 2 * k + 1)]
+        for k in range(n_nodes)
+    ])
+    return inter[keep]
 
 
 
@@ -332,19 +354,19 @@ def _batched_masks(Yb, Sb, Nb, models, mask_type, mu, n_nodes, z_sigs):
     import jax
     import jax.numpy as jnp
 
-    from disco_tpu.enhance.inference import crnn_masks_batched, get_z_for_mask
+    from disco_tpu.enhance.inference import crnn_masks_batched
     from disco_tpu.enhance.tango import tango_step1
 
     B, K, _, F, T = Yb.shape
     oracle = jax.vmap(lambda S, N: oracle_masks(S, N, mask_type))(Sb, Nb)
     refs = None
     if models[0] is not None or models[1] is not None:
-        refs = to_host(Yb[:, :, 0]).reshape(B * K, F, T)
+        refs = jnp.asarray(Yb)[:, :, 0].reshape(B * K, F, T)
     if models[0] is None:
         Mz = oracle
     else:
         model, variables = models[0]
-        Mz = jnp.asarray(crnn_masks_batched(refs, model, variables).reshape(B, K, F, T))
+        Mz = jnp.asarray(crnn_masks_batched(refs, model, variables)).reshape(B, K, F, T)
     if models[1] is None:
         Mw = oracle
     else:
@@ -352,15 +374,11 @@ def _batched_masks(Yb, Sb, Nb, models, mask_type, mu, n_nodes, z_sigs):
             jax.vmap(jax.vmap(lambda y, s, n, m: tango_step1(y, s, n, m, mu=mu)))
         )
         out = step1(Yb, Sb, Nb, Mz)
-        z_y, zn = to_host(out["z_y"]), to_host(out["zn"])
-        zs = np.stack(
-            [
-                np.stack([get_z_for_mask(z_y[b], zn[b], k, n_nodes, z_sigs) for k in range(K)])
-                for b in range(B)
-            ]
+        zs = jax.vmap(lambda zy, zn: _z_for_mask_device(zy, zn, n_nodes, z_sigs))(
+            out["z_y"], out["zn"]
         ).reshape(B * K, -1, F, T)
         model, variables = models[1]
-        Mw = jnp.asarray(crnn_masks_batched(refs, model, variables, zs=zs).reshape(B, K, F, T))
+        Mw = jnp.asarray(crnn_masks_batched(refs, model, variables, zs=zs)).reshape(B, K, F, T)
     return Mz, Mw
 
 

@@ -1,15 +1,24 @@
 """Inference glue: shape STFT streams into CRNN batches and back into masks
 (reference speech_enhancement/utils.py:13-138, tango.py:158-249).
 
-Host-side numpy prep (windowing, normalization) feeding ONE batched jitted
-forward pass — the reference's per-window torch loop
-(speech_enhancement/utils.py:118-131) becomes a single
-``sliding_window_view`` + one model.apply over all windows.
+Two paths replace the reference's per-window torch loop
+(speech_enhancement/utils.py:118-131):
+
+* :func:`crnn_mask` — host-side numpy prep (``sliding_window_view``) + one
+  jitted forward per stream; the simple single-stream entry point.
+* :func:`crnn_masks_batched` — the production path: normalization, window
+  gathering and the model forwards all run ON DEVICE in one jitted program
+  per batch, with the CRNN's conv stack hoisted to the full stream
+  (``CRNN.__call__`` stream mode) so convs run once instead of once per
+  window.  Nothing but the final masks crosses the host boundary — on the
+  tunneled single-chip attachment (~45 MB/s) data movement, not compute,
+  dominates mask estimation.
 
 PCEN is implemented natively (the reference calls librosa.pcen,
 speech_enhancement/utils.py:61-64): per-channel IIR smoothing with the
 standard librosa coefficient mapping from ``time_constant``, then the
-(E/(eps+M)^gain + bias)^power − bias^power compression.
+(E/(eps+M)^gain + bias)^power − bias^power compression.  PCEN normalization
+is host-only; the batched path falls back to per-stream prep for it.
 """
 from __future__ import annotations
 
@@ -181,6 +190,24 @@ def crnn_mask(
     return reshape_mask(np.asarray(m_stack), frame_to_pred)
 
 
+def normalization_device(x, norm_type: str | None = None, axis: int = -1):
+    """Jittable mirror of :func:`normalization` over (..., F, T) arrays —
+    the host version is applied per (F, T) stream with axis=1 (the time
+    axis), so the device default is axis=-1 ('pcen' excluded — its IIR
+    smoother runs host-side)."""
+    x = jnp.clip(jnp.abs(x), STFT_MIN, STFT_MAX)
+    if norm_type is None:
+        return x
+    if norm_type == "scale_to_unit_norm":
+        return x / jnp.linalg.norm(x, axis=axis, keepdims=True)
+    if norm_type == "scale_to_1":
+        return x / jnp.quantile(x, 0.99, axis=axis, keepdims=True)
+    if norm_type == "center_and_scale":
+        x = x - jnp.mean(x, axis=axis, keepdims=True)
+        return x / jnp.std(x, axis=axis, keepdims=True)
+    raise ValueError(f"norm_type {norm_type!r} has no device implementation (pcen is host-only)")
+
+
 def crnn_masks_batched(
     Ys,
     model,
@@ -190,55 +217,117 @@ def crnn_masks_batched(
     frame_to_pred: str = "last",
     norm_type: str | None = None,
     three_d_tensor: bool = True,
-    max_windows_per_call: int = 16384,
 ):
-    """Masks for MANY streams in few large device forwards.
+    """Masks for MANY streams, fully device-resident — one launch.
 
     The per-node Python loop the round-1 driver used (K sequential
-    ``crnn_mask`` calls with host round-trips, VERDICT weak #4) becomes:
-    host-side window prep per stream (cheap numpy), the streams' windows
-    concatenated and pushed through ``model.apply`` in slices of at most
-    ``max_windows_per_call`` (whole streams per slice, so peak host/device
-    memory stays bounded at corpus batch sizes — 16 clips x 4 nodes x 10 s
-    would otherwise materialize ~7 GB of windows at once), then a
-    per-stream reshape.  Streams must share (F, T) — guaranteed within a
-    clip and within a length bucket of the corpus driver.
+    ``crnn_mask`` calls with host round-trips, VERDICT weak #4) becomes one
+    jitted program: normalization, sliding-window gathering, and the model
+    forwards all run on device, with the conv stack hoisted to the full
+    stream for CRNN models (see ``CRNN.__call__`` stream mode).  Nothing
+    but the final (B, F, T) masks crosses the host boundary — on a
+    tunneled chip (~45 MB/s) shipping prepared windows made the batched
+    path *slower* than the per-clip loop; shipping nothing is ~10x better
+    than shipping magnitudes.  Streams must share (F, T) — guaranteed
+    within a clip and within a length bucket of the corpus driver.
 
     Args:
-      Ys: (B, F, T) complex mixture STFTs (B = nodes, or clips x nodes).
+      Ys: (B, F, T) complex mixture STFTs (B = nodes, or clips x nodes) —
+        device or host arrays.
       zs: optional (B, n_z, F, T) exchanged streams per entry.
 
     Returns:
-      (B, F, T) float masks.
+      (B, F, T) float masks, on device (``np.asarray`` them if needed).
     """
+    if frame_to_pred == "all":
+        raise NotImplementedError("'all' inference reshaping is not implemented (as in the reference)")
+    if norm_type == "pcen":  # host-only IIR: fall back to per-stream prep
+        return np.stack([
+            crnn_mask(Ys[i], model, variables,
+                      z=None if zs is None else list(np.asarray(zs[i])),
+                      win_len=win_len, frame_to_pred=frame_to_pred,
+                      norm_type=norm_type, three_d_tensor=three_d_tensor)
+            for i in range(len(Ys))
+        ])
     frames_lost = win_len - model.conv_output_hw()[0]
-
-    def prep(i):
-        return prepare_data(
-            to_host(Ys[i]),
-            three_d_tensor,
-            z_data=None if zs is None else list(to_host(zs[i])),
-            win_len=win_len,
-            win_hop=1,
-            frame_to_pred=frame_to_pred,
-            norm_type=norm_type,
-            frames_lost=frames_lost,
-        )
-
+    pad = get_frames_to_pad(win_len, frame_to_pred, out_len=win_len - frames_lost)
     B = len(Ys)
-    x0 = prep(0)
-    n_win = x0.shape[0]
-    streams_per_call = max(1, max_windows_per_call // n_win)
-    apply_fn = _jitted_apply(model)
-    masks = []
-    for lo in range(0, B, streams_per_call):
-        xs = [x0 if i == 0 else prep(i) for i in range(lo, min(lo + streams_per_call, B))]
-        m_all = np.asarray(apply_fn(variables, jnp.asarray(np.concatenate(xs, 0))))
-        masks += [
-            reshape_mask(m_all[j * n_win : (j + 1) * n_win], frame_to_pred)
-            for j in range(len(xs))
-        ]
-    return np.stack(masks)
+    # group streams per map step: big enough forwards to feed the MXU (a
+    # lone stream's GRU steps are tiny matmuls), small enough that one
+    # group's window tensor bounds memory
+    group = max(1, min(B, 8))
+    padded_B = -(-B // group) * group
+    run = _jitted_sliding_masks(model, win_len, frame_to_pred, group,
+                                tuple(pad), norm_type, padded_B - B, zs is None)
+    Ys = jnp.asarray(Ys)
+    return run(variables, Ys, None if zs is None else jnp.asarray(zs))[:B]
+
+
+def _conv_stream_safe(model) -> bool:
+    """True iff hoisting the model's conv stack to the full stream is exact:
+    the time axis must see no padding, stride 1, and no pooling — then the
+    full-stream conv output is the concatenation of per-window outputs.
+    Non-canonical CRNN configs (time padding/stride/pooling) and conv-free
+    models fall back to the per-window branch."""
+    if not hasattr(model, "cnn_filters"):
+        return False
+    from disco_tpu.nn.bricks import _pair, broadcast_arg, spec_per_layer
+
+    n = len(model.cnn_filters)
+    pads = [_pair(p) for p in broadcast_arg(model.conv_padding, n)]
+    strides = [_pair(s) for s in spec_per_layer(model.conv_strides, n)]
+    pools = [_pair(k) for k in spec_per_layer(model.pool_kernels, n)]
+    return all(p[0] == 0 for p in pads) and all(s[0] == 1 for s in strides) and all(
+        k[0] == 1 for k in pools
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_sliding_masks(model, win_len: int, frame_to_pred: str, group: int,
+                          pad: tuple, norm_type: str | None, n_fill: int,
+                          no_z: bool):
+    """One compiled device-resident mask program per (model, window, group)
+    configuration: normalize the complex streams, pad frames, gather
+    windows, apply the model over ``group`` streams at a time, keep the
+    predicted frame — all inside one jit, with ``lax.map`` over stream
+    groups bounding peak memory.  ``n_fill`` duplicate streams pad B to a
+    multiple of ``group`` (dropped by the caller)."""
+
+    streaming = _conv_stream_safe(model)  # CRNN: convs hoisted to full stream
+
+    @jax.jit
+    def run(variables, Ys, zs):  # Ys (B, F, T) complex, zs (B, n_z, F, T)|None
+        if no_z:
+            chans = Ys[:, None]  # (B, 1, F, T)
+        else:
+            chans = jnp.concatenate([Ys[:, None], zs], axis=1)  # (B, C, F, T)
+        mags = normalization_device(chans, norm_type, axis=-1)
+        mags = jnp.pad(mags, ((0, 0), (0, 0), (0, 0), pad)).astype(jnp.float32)
+        if n_fill:
+            mags = jnp.concatenate([mags, jnp.repeat(mags[-1:], n_fill, axis=0)])
+        Bt, C, F, Tp = mags.shape
+        T = Tp - win_len + 1
+
+        def one(mag_g):  # (group, C, F, Tp)
+            if streaming:
+                # convs once over the full streams, GRU/FF per gathered
+                # post-conv window (exact — the conv stack has no time
+                # padding; see CRNN.__call__ stream mode)
+                out = model.apply(variables, mag_g, train=False, stream=True)
+                # (G, T, win_out, F)
+                sel = out.shape[2] - 1 if frame_to_pred == "last" else out.shape[2] // 2
+                return jnp.transpose(out[:, :, sel, :], (0, 2, 1))  # (G, F, T)
+            idx = jnp.arange(T)[:, None] + jnp.arange(win_len)[None, :]
+            wins = mag_g[:, :, :, idx]  # (G, C, F, T, win)
+            x = jnp.transpose(wins, (0, 3, 1, 4, 2)).reshape(group * T, C, win_len, F)
+            out = model.apply(variables, x, train=False)  # (G*T, win_out, F)
+            sel = out.shape[1] - 1 if frame_to_pred == "last" else out.shape[1] // 2
+            return jnp.transpose(out[:, sel, :].reshape(group, T, F), (0, 2, 1))  # (G, F, T)
+
+        grouped = mags.reshape(Bt // group, group, C, F, Tp)
+        return jax.lax.map(one, grouped).reshape(Bt, F, T)
+
+    return run
 
 
 @functools.lru_cache(maxsize=32)

@@ -175,3 +175,12 @@ def test_gevd_power_matches_eigh_rank1():
     np.testing.assert_allclose(np.asarray(w_d), np.asarray(w_p), atol=1e-7)
     with pytest.raises(ValueError, match="rank-1 only"):
         intern_filter(Rxx, Rnn, ftype="gevd-power", rank=2)
+
+
+def test_get_filter_type_gevd_power():
+    from disco_tpu.beam.filters import get_filter_type
+
+    assert get_filter_type("gevd-power") == ("gevd-power", 1)
+    assert get_filter_type("rank3-gevd") == ("gevd", 3)
+    with pytest.raises(ValueError):
+        get_filter_type("rankX-gevd")

@@ -231,3 +231,41 @@ def test_crnn_mask_with_rnn_architecture():
     mask = crnn_mask(Y, model, variables, three_d_tensor=False)
     assert mask.shape == (257, 80)
     assert np.all(mask >= 0) and np.all(mask <= 1)
+
+
+def test_batched_masks_fall_back_for_noncanonical_conv():
+    """A CRNN with time padding cannot hoist its convs to the full stream;
+    the batched path must fall back to per-window forwards and still match
+    crnn_mask exactly."""
+    import numpy as np
+
+    from disco_tpu.core.dsp import stft
+    from disco_tpu.enhance.inference import _conv_stream_safe, crnn_mask, crnn_masks_batched
+    from disco_tpu.nn.crnn import build_crnn
+    from disco_tpu.nn.training import create_train_state
+
+    model, tx = build_crnn(n_ch=1, conv_padding=((1, 1), (1, 1), (1, 1)))
+    assert not _conv_stream_safe(model)
+    state = create_train_state(model, tx, np.zeros((1, 1, 21, 257), "float32"))
+    variables = {"params": state.params, "batch_stats": state.batch_stats}
+    rng = np.random.default_rng(0)
+    Y = np.asarray(stft(rng.standard_normal((2, 6000)).astype("float32")))
+    batched = crnn_masks_batched(Y, model, variables)
+    for k in range(2):
+        np.testing.assert_allclose(np.asarray(batched[k]), crnn_mask(Y[k], model, variables), atol=1e-6)
+
+
+def test_batched_masks_reject_all_frames():
+    import numpy as np
+    import pytest
+
+    from disco_tpu.enhance.inference import crnn_masks_batched
+    from disco_tpu.nn.crnn import build_crnn
+    from disco_tpu.nn.training import create_train_state
+
+    model, tx = build_crnn(n_ch=1)
+    state = create_train_state(model, tx, np.zeros((1, 1, 21, 257), "float32"))
+    variables = {"params": state.params, "batch_stats": state.batch_stats}
+    with pytest.raises(NotImplementedError):
+        crnn_masks_batched(np.zeros((1, 257, 50), "complex64"), model, variables,
+                           frame_to_pred="all")
