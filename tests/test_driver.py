@@ -233,3 +233,26 @@ def test_enhance_rirs_batched(processed_corpus, tmp_path):
         str(processed_corpus), "living", [RIR], NOISE,
         snr_range=SNR_RANGE, out_root=str(out_root), save_fig=False,
     ) == {}
+
+
+def test_aggregate_cli(processed_corpus, tmp_path, capsys):
+    """disco-aggregate: mean ± CI table and JSON over the OIM pickles."""
+    import json
+
+    from disco_tpu.cli import aggregate
+
+    out_root = tmp_path / "agg_results"
+    enhance_rir(
+        str(processed_corpus), "living", RIR, NOISE,
+        snr_range=SNR_RANGE, out_root=str(out_root), save_fig=False,
+    )
+    summary = aggregate.main([str(out_root / "OIM"), "--json"])
+    printed = capsys.readouterr().out.strip().splitlines()[-1]
+    assert json.loads(printed) == summary
+    assert summary["sdr_cnv"]["n"] == K
+    assert np.isfinite(summary["sdr_cnv"]["mean"])
+    # table mode + key subset
+    sub = aggregate.main([str(out_root / "OIM"), "--keys", "sdr_cnv", "snr_out"])
+    assert set(sub) == {"sdr_cnv", "snr_out"}
+    # empty dir
+    assert aggregate.main([str(tmp_path / "nothing")]) == {}
