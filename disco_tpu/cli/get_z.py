@@ -38,9 +38,10 @@ def main(argv=None):
         def masks_fn(Y):
             import numpy as np
 
-            from disco_tpu.enhance.inference import crnn_mask
+            from disco_tpu.enhance.inference import crnn_masks_batched
 
-            return np.stack([crnn_mask(np.asarray(Y[k, 0]), model, variables) for k in range(Y.shape[0])])
+            # all node forwards in one device-resident launch
+            return np.asarray(crnn_masks_batched(Y[:, 0], model, variables))
 
     n_done = 0
     for rir in rirs:

@@ -171,3 +171,24 @@ def test_tango_cli_batched_mode(generated, tmp_path):
     ])
     assert set(results) == {1}  # RIR 2 has no corpus files
     assert (tmp_path / "res_batched" / "OIM" / "results_tango_1_ssn.p").exists()
+
+
+def test_get_z_cli_with_crnn_model(generated, tmp_path):
+    """z export with a trained single-channel CRNN mask model (--mod_sc):
+    the batched device-resident mask path feeding export_z."""
+    from disco_tpu.cli import train
+
+    sc_name = train.main([
+        "--scene", "random", "--noise", "ssn", "--n_files", "2",
+        "--path_data", str(generated), "--save_path", str(tmp_path / "m"),
+        "--n_epochs", "1", "--batch_size", "16", "--single_channel",
+    ])
+    n = get_z.main([
+        "--rir", "1", "--scenario", "random", "--noise", "ssn",
+        "--dataset", str(generated), "--sav_dir", "crnn_z",
+        "--mod_sc", str(tmp_path / "m" / f"{sc_name}_model.msgpack"),
+    ])
+    assert n == 1
+    lay = DatasetLayout(str(generated), "random", "train")
+    z = np.load(lay.stft_z("crnn_z", [0, 6], "zs_hat", 1, 1, "ssn"))
+    assert z.dtype == np.complex64 and z.ndim == 2 and np.isfinite(z).all()
