@@ -269,3 +269,25 @@ def test_batched_masks_reject_all_frames():
     with pytest.raises(NotImplementedError):
         crnn_masks_batched(np.zeros((1, 257, 50), "complex64"), model, variables,
                            frame_to_pred="all")
+
+
+def test_batched_masks_rnn_architecture():
+    """RNNMask (2-D archi) through the device-resident batched path: the
+    4-D windows are freq-stacked inside the module; must equal the
+    per-stream crnn_mask path."""
+    import numpy as np
+
+    from disco_tpu.core.dsp import stft
+    from disco_tpu.enhance.inference import crnn_mask, crnn_masks_batched
+    from disco_tpu.nn.crnn import build_rnn
+    from disco_tpu.nn.training import create_train_state
+
+    model, tx = build_rnn(n_ch=1)
+    state = create_train_state(model, tx, np.zeros((1, 21, 257), "float32"))
+    variables = {"params": state.params, "batch_stats": state.batch_stats}
+    rng = np.random.default_rng(3)
+    Y = np.asarray(stft(rng.standard_normal((2, 5000)).astype("float32")))
+    batched = crnn_masks_batched(Y, model, variables)
+    for k in range(2):
+        single = crnn_mask(Y[k], model, variables, three_d_tensor=True)
+        np.testing.assert_allclose(np.asarray(batched[k]), single, atol=1e-6)
