@@ -1,0 +1,120 @@
+"""One-shot hardware validation sweep: run everything that needs the real
+chip in a single claim cycle (the tunneled chip's claim/release can take
+minutes, and the service occasionally goes down for hours — see
+tests/conftest.py and the verify skill for the environment contract).
+
+Covers: headline bench (RTF/MFU/stages), the CRNN corpus batched-vs-per-RIR
+A/B, and the milestone configs including streaming latency.  Prints one JSON
+line per section.
+
+Usage:  python exp/tpu_validation.py [--quick]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))  # repo root (for bench.py)
+
+
+def section(name, fn):
+    t0 = time.perf_counter()
+    try:
+        out = fn()
+        out = {"section": name, "ok": True, **(out if isinstance(out, dict) else {"result": out})}
+    except Exception as e:  # keep sweeping: one bad section must not hide the rest
+        out = {"section": name, "ok": False, "error": f"{type(e).__name__}: {e}"[:300]}
+    out["wall_s"] = round(time.perf_counter() - t0, 1)
+    print(json.dumps(out), flush=True)
+    return out
+
+
+def crnn_corpus_ab(B=16, dur_s=4.0):
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from disco_tpu.core.dsp import stft
+    from disco_tpu.enhance.driver import _batched_masks, estimate_masks
+    from disco_tpu.enhance.tango import tango
+    from disco_tpu.milestones import _fence, _scene
+    from disco_tpu.nn.crnn import build_crnn
+    from disco_tpu.nn.training import create_train_state
+
+    FS, K, C = 16000, 4, 4
+    L = int(dur_s * FS)
+
+    def make(n_ch):
+        model, tx = build_crnn(n_ch=n_ch)
+        st = create_train_state(model, tx, np.zeros((1, n_ch, 21, 257), "float32"))
+        return (model, {"params": st.params, "batch_stats": st.batch_stats})
+
+    models = (make(1), make(K))
+    clips = [_scene(K, C, L, seed=i) for i in range(B)]
+    Ys = [stft(jnp.asarray(y)) for y, s, n in clips]
+    Ss = [stft(jnp.asarray(s)) for y, s, n in clips]
+    Ns = [stft(jnp.asarray(n)) for y, s, n in clips]
+
+    run1 = jax.jit(lambda Y, S, N, mz, mw: tango(Y, S, N, mz, mw, policy="local").yf)
+    mz, mw = estimate_masks(Ys[0], Ss[0], Ns[0], models, "irm1", K)
+    _fence(run1(Ys[0], Ss[0], Ns[0], mz, mw))
+    t0 = time.perf_counter()
+    for i in range(B):
+        mz, mw = estimate_masks(Ys[i], Ss[i], Ns[i], models, "irm1", K)
+        _fence(run1(Ys[i], Ss[i], Ns[i], mz, mw))
+    t_per = time.perf_counter() - t0
+
+    Yb, Sb, Nb = jnp.stack(Ys), jnp.stack(Ss), jnp.stack(Ns)
+    runB = jax.jit(
+        lambda Yb, Sb, Nb, Mz, Mw: jax.vmap(
+            lambda Y, S, N, mz, mw: tango(Y, S, N, mz, mw, policy="local").yf
+        )(Yb, Sb, Nb, Mz, Mw)
+    )
+    Mz, Mw = _batched_masks(Yb, Sb, Nb, models, "irm1", 1.0, K, "zs_hat")
+    _fence(runB(Yb, Sb, Nb, Mz, Mw))
+    t0 = time.perf_counter()
+    Mz, Mw = _batched_masks(Yb, Sb, Nb, models, "irm1", 1.0, K, "zs_hat")
+    _fence(runB(Yb, Sb, Nb, Mz, Mw))
+    t_bat = time.perf_counter() - t0
+    return {
+        "per_rir_ms_per_clip": round(t_per / B * 1e3),
+        "batched_ms_per_clip": round(t_bat / B * 1e3),
+        "speedup": round(t_per / t_bat, 2),
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--quick", action="store_true", help="smaller scales")
+    args = p.parse_args(argv)
+
+    import bench as bench_mod
+    from disco_tpu import milestones
+
+    if args.quick:
+        section("bench", lambda: dict(zip(
+            ("rtf", "flops_per_clip", "mfu", "stage_ms"),
+            bench_mod.bench_jax(batch=4, dur_s=4.0, iters=2))))
+        section("crnn_corpus_ab", lambda: crnn_corpus_ab(B=4, dur_s=2.0))
+        section("milestone_separation", lambda: milestones.meetit_separation(dur_s=2.0, K=4, C=2, iters=1))
+        section("streaming_latency", lambda: milestones.streaming_latency(dur_s=2.0, K=2, C=2, iters=1))
+        return
+    section("bench", lambda: dict(zip(
+        ("rtf", "flops_per_clip", "mfu", "stage_ms"), bench_mod.bench_jax())))
+    section("crnn_corpus_ab", crnn_corpus_ab)
+    for name, fn in (
+        ("milestone_1", milestones.mvdr_single_clip),
+        ("milestone_2", milestones.disco_mwf_4node),
+        ("milestone_3", milestones.tango_4node),
+        ("milestone_4", milestones.meetit_separation),
+        ("milestone_5", milestones.batched_meetit_end_to_end),
+        ("milestone_6", milestones.streaming_latency),
+    ):
+        section(name, fn)
+
+
+if __name__ == "__main__":
+    main()
