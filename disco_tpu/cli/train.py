@@ -16,7 +16,6 @@ from disco_tpu.config import TrainConfig
 from disco_tpu.nn.crnn import build_crnn
 from disco_tpu.nn.data import (
     DiscoDataset,
-    batch_iterator,
     get_input_lists,
     load_input_lists,
 )

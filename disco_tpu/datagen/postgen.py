@@ -8,7 +8,6 @@ one batched jitted STFT + mask computation over all 16 channels.
 from __future__ import annotations
 
 import glob
-import os
 
 import numpy as np
 

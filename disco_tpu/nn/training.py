@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import string
 import time
-from functools import partial
 from pathlib import Path
 from typing import Any
 
