@@ -47,8 +47,10 @@ def _outer(x):
                       precision=jax.lax.Precision.HIGHEST)
 
 
-def _block_covariances(XSb, XNb, lam):
+def _block_covariances(XSb, XNb, lam, Rss0=None, Rnn0=None):
     """Scan over frame blocks, emitting the refresh-point covariances.
+    ``Rss0``/``Rnn0`` seed the recursion (continuation state from a previous
+    chunk); default is the documented warm start.
 
     The refresh covariance of block b is the smoothed estimate *after the
     block's first frame* — exactly where the naive per-frame recursion
@@ -69,7 +71,10 @@ def _block_covariances(XSb, XNb, lam):
     """
     B, u, F, D = XSb.shape
     eps = 1e-6
-    R0 = jnp.broadcast_to(eps * jnp.eye(D, dtype=XSb.dtype), (F, D, D))
+    if Rss0 is None:
+        Rss0 = jnp.broadcast_to(eps * jnp.eye(D, dtype=XSb.dtype), (F, D, D))
+    if Rnn0 is None:
+        Rnn0 = jnp.broadcast_to(eps * jnp.eye(D, dtype=XSb.dtype), (F, D, D))
     # weights lam^(u-1-i) for intra-block frames i = 1..u-1
     tail_w = lam ** jnp.arange(u - 2, -1, -1, dtype=jnp.float32) if u > 1 else None
 
@@ -89,10 +94,10 @@ def _block_covariances(XSb, XNb, lam):
             Rss_e, Rnn_e = Rss_r, Rnn_r
         return (Rss_e, Rnn_e), (Rss_r, Rnn_r)
 
-    return jax.lax.scan(body, (R0, R0), (XSb, XNb))
+    return jax.lax.scan(body, (Rss0, Rnn0), (XSb, XNb))
 
 
-def _stream_filter(X, XS, XN, lam, u, mu, ref: int = 0, extras=None):
+def _stream_filter(X, XS, XN, lam, u, mu, ref: int = 0, extras=None, init_state=None):
     """One node's streaming filter over a (T, F, D) frame stream.
 
     ``X`` is the stream the filter is APPLIED to; ``XS``/``XN`` are the
@@ -117,8 +122,9 @@ def _stream_filter(X, XS, XN, lam, u, mu, ref: int = 0, extras=None):
     B = X.shape[0] // u
     Xb = X.reshape(B, u, F, D)
 
+    Rss0, Rnn0, w_seed = (None, None, None) if init_state is None else init_state
     (Rss_e, Rnn_e), (Rss_ref, Rnn_ref) = _block_covariances(
-        XS.reshape(B, u, F, D), XN.reshape(B, u, F, D), lam
+        XS.reshape(B, u, F, D), XN.reshape(B, u, F, D), lam, Rss0, Rnn0
     )
     if pad:
         # Padded zero frames only decay the carry (R <- lam R); undo so the
@@ -132,8 +138,9 @@ def _stream_filter(X, XS, XN, lam, u, mu, ref: int = 0, extras=None):
     # [mics ‖ z] channels nearly dependent; TPU f32 eigh then returns
     # non-finite) is SKIPPED: keep the previous block's filter — the standard
     # adaptive-beamforming guard.  Falls back to the ref-mic selector before
-    # the first good refresh.
-    e_ref = jnp.zeros((F, D), w.dtype).at[:, ref].set(1.0)
+    # the first good refresh (or to the previous chunk's final filter when
+    # continuing).
+    e_ref = jnp.zeros((F, D), w.dtype).at[:, ref].set(1.0) if w_seed is None else w_seed
 
     def ffill(prev, wb):
         ok = jnp.isfinite(wb.real) & jnp.isfinite(wb.imag)
@@ -167,6 +174,7 @@ def streaming_step1(
     S=None,
     N=None,
     with_diagnostics: bool = False,
+    state=None,
 ):
     """Streaming local MWF at one node: recursive covariance smoothing with a
     filter refresh every ``update_every`` frames.
@@ -177,6 +185,11 @@ def streaming_step1(
       S, N: optional clean component STFTs — with ``with_diagnostics=True``
         the same online filter is applied to them, yielding z_s/z_n (the
         filter-on-clean diagnostics of the offline path).
+      state: optional (Rss, Rnn, w) continuation state from a previous
+        chunk's output — true chunk-by-chunk online processing.  When the
+        previous chunk's frame count is a multiple of ``update_every``, the
+        chained result is numerically identical to processing the whole
+        stream at once (pinned in tests/test_streaming.py).
 
     Returns:
       dict with z_y (F, T) compressed stream, zn (F, T) = y_ref - z, the
@@ -190,7 +203,8 @@ def streaming_step1(
     X = tfc(Y)
     M = mask_z.T[..., None]  # (T, F, 1) broadcast over channels
     z, w, Rss, Rnn, extra_out = _stream_filter(
-        X, M * X, (1.0 - M) * X, lambda_cor, update_every, mu, ref=ref_mic, extras=extras
+        X, M * X, (1.0 - M) * X, lambda_cor, update_every, mu, ref=ref_mic, extras=extras,
+        init_state=state,
     )
     z_y = z.T
     out = {"z_y": z_y, "zn": Y[ref_mic] - z_y, "Rss": Rss, "Rnn": Rnn, "w": w}

@@ -130,3 +130,25 @@ def test_streaming_diagnostics_single_filter(scene):
     rhs = np.asarray(out["yf"])
     err = np.max(np.abs(lhs - rhs)) / (np.max(np.abs(rhs)) + 1e-30)
     assert err < 1e-3, err
+
+
+def test_streaming_chunked_continuation_exact(scene):
+    """True online use: process a stream in two chunks carrying the
+    (Rss, Rnn, w) state — identical to one-shot processing when the chunk
+    boundary falls on a filter-refresh block boundary."""
+    y, s, n, L = scene
+    Y = stft(y[0])
+    mask = np.asarray(oracle_masks(stft(s[:1]), stft(n[:1]), "irm1"))[0]
+    u = 4
+    T = Y.shape[-1]
+    T1 = (T // 2 // u) * u  # chunk boundary on a block boundary
+
+    full = streaming_step1(Y, mask, update_every=u)
+    c1 = streaming_step1(Y[..., :T1], mask[..., :T1], update_every=u)
+    c2 = streaming_step1(
+        Y[..., T1:], mask[..., T1:], update_every=u,
+        state=(c1["Rss"], c1["Rnn"], c1["w"]),
+    )
+    chained = np.concatenate([np.asarray(c1["z_y"]), np.asarray(c2["z_y"])], axis=-1)
+    np.testing.assert_allclose(chained, np.asarray(full["z_y"]), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(c2["Rss"]), np.asarray(full["Rss"]), atol=1e-4)
