@@ -262,6 +262,7 @@ def streaming_tango(
     N=None,
     with_diagnostics: bool = False,
     policy: str | None = "local",
+    state=None,
 ):
     """Full two-step streaming TANGO over all nodes (mixture-only by
     default: the deployment path needs no oracle S/N).
@@ -279,13 +280,23 @@ def streaming_tango(
         ``with_diagnostics=True`` the SAME online filters are applied to
         them, yielding sf/nf/z_s/z_n — every diagnostic then describes the
         one deployed filter (no second offline pass).
+      state: optional continuation state (the previous chunk's returned
+        ``state``) — chunk-by-chunk online deployment of BOTH steps; exact
+        across refresh-block-aligned boundaries (tests/test_streaming.py).
 
     Returns:
       dict with yf (K, F, T) enhanced outputs, z_y/zn (K, F, T) streams,
-      and sf/nf/z_s/z_n when diagnostics are requested.
+      a ``state`` entry for continuation, and sf/nf/z_s/z_n when
+      diagnostics are requested.
     """
     K, C, F, T = Y.shape
+    st1_in, st2_in = (None, None) if state is None else (state["step1"], state["step2"])
     step1 = jax.vmap(
+        lambda y, m, s, n, st: streaming_step1(
+            y, m, lambda_cor=lambda_cor, update_every=update_every, mu=mu, ref_mic=ref_mic,
+            S=s, N=n, with_diagnostics=with_diagnostics, state=st,
+        )
+    ) if state is not None else jax.vmap(
         lambda y, m, s, n: streaming_step1(
             y, m, lambda_cor=lambda_cor, update_every=update_every, mu=mu, ref_mic=ref_mic,
             S=s, N=n, with_diagnostics=with_diagnostics,
@@ -293,7 +304,7 @@ def streaming_tango(
     )
     s_in = S if with_diagnostics else Y
     n_in = N if with_diagnostics else Y
-    s1 = step1(Y, masks_z, s_in, n_in)
+    s1 = step1(Y, masks_z, s_in, n_in, st1_in) if state is not None else step1(Y, masks_z, s_in, n_in)
     all_z = s1["z_y"]  # (K, F, T)
 
     oth = jnp.asarray(others_index(K))  # (K, K-1)
@@ -311,11 +322,13 @@ def streaming_tango(
         Xs = ktfd(stack_streams(S, s1["z_s"]))
         Xn = ktfd(stack_streams(N, s1["z_n"]))
         stream2 = jax.vmap(
-            lambda x, xs_st, xn_st, xs, xn: _stream_filter(
-                x, xs_st, xn_st, lambda_cor, update_every, mu, ref=ref_mic, extras=[xs, xn]
-            )
+            lambda x, xs_st, xn_st, xs, xn, st: _stream_filter(
+                x, xs_st, xn_st, lambda_cor, update_every, mu, ref=ref_mic, extras=[xs, xn],
+                init_state=st,
+            ),
+            in_axes=(0, 0, 0, 0, 0, 0 if st2_in is not None else None),
         )
-        yf, _, _, _, (sf, nf) = stream2(X, XS, XN, Xs, Xn)
+        yf, w2, Rss2, Rnn2, (sf, nf) = stream2(X, XS, XN, Xs, Xn, st2_in)
         return {
             "yf": jnp.moveaxis(yf, 1, -1),
             "sf": jnp.moveaxis(sf, 1, -1),
@@ -324,9 +337,20 @@ def streaming_tango(
             "zn": s1["zn"],
             "z_s": s1["z_s"],
             "z_n": s1["z_n"],
+            "state": {"step1": (s1["Rss"], s1["Rnn"], s1["w"]),
+                      "step2": (Rss2, Rnn2, w2)},
         }
     stream2 = jax.vmap(
-        lambda x, xs_st, xn_st: _stream_filter(x, xs_st, xn_st, lambda_cor, update_every, mu, ref=ref_mic)[0]
+        lambda x, xs_st, xn_st, st: _stream_filter(
+            x, xs_st, xn_st, lambda_cor, update_every, mu, ref=ref_mic, init_state=st,
+        )[:4],
+        in_axes=(0, 0, 0, 0 if st2_in is not None else None),
     )
-    yf = stream2(X, XS, XN)  # (K, T, F)
-    return {"yf": jnp.moveaxis(yf, 1, -1), "z_y": all_z, "zn": s1["zn"]}
+    yf, w2, Rss2, Rnn2 = stream2(X, XS, XN, st2_in)  # yf (K, T, F)
+    return {
+        "yf": jnp.moveaxis(yf, 1, -1),
+        "z_y": all_z,
+        "zn": s1["zn"],
+        "state": {"step1": (s1["Rss"], s1["Rnn"], s1["w"]),
+                  "step2": (Rss2, Rnn2, w2)},
+    }

@@ -152,3 +152,21 @@ def test_streaming_chunked_continuation_exact(scene):
     chained = np.concatenate([np.asarray(c1["z_y"]), np.asarray(c2["z_y"])], axis=-1)
     np.testing.assert_allclose(chained, np.asarray(full["z_y"]), atol=1e-4)
     np.testing.assert_allclose(np.asarray(c2["Rss"]), np.asarray(full["Rss"]), atol=1e-4)
+
+
+def test_streaming_tango_chunked_continuation(scene):
+    """Two-step online deployment across chunks: carrying the full state
+    reproduces one-shot streaming_tango on refresh-aligned boundaries."""
+    y, s, n, L = scene
+    Y, S, N = stft(y), stft(s), stft(n)
+    masks = np.asarray(oracle_masks(S, N, "irm1"))
+    u = 4
+    T = Y.shape[-1]
+    T1 = (T // 2 // u) * u
+
+    full = streaming_tango(Y, masks, masks, update_every=u)
+    c1 = streaming_tango(Y[..., :T1], masks[..., :T1], masks[..., :T1], update_every=u)
+    c2 = streaming_tango(Y[..., T1:], masks[..., T1:], masks[..., T1:],
+                         update_every=u, state=c1["state"])
+    chained = np.concatenate([np.asarray(c1["yf"]), np.asarray(c2["yf"])], axis=-1)
+    np.testing.assert_allclose(chained, np.asarray(full["yf"]), atol=1e-4)
