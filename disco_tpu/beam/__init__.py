@@ -8,6 +8,8 @@ from disco_tpu.beam.filters import (
     mwf,
     r1_mwf,
     gevd_mwf,
+    gevd_mwf_power,
+    rank1_gevd,
     intern_filter,
 )
 
@@ -19,5 +21,7 @@ __all__ = [
     "mwf",
     "r1_mwf",
     "gevd_mwf",
+    "gevd_mwf_power",
+    "rank1_gevd",
     "intern_filter",
 ]

@@ -84,8 +84,9 @@ def _whitened(Rxx: jnp.ndarray, Rnn: jnp.ndarray):
     return L, 0.5 * (A + A.conj().swapaxes(-1, -2))  # re-hermitize vs roundoff
 
 
-@partial(jax.jit, static_argnames=("rank",))
-def gevd_mwf(Rxx: jnp.ndarray, Rnn: jnp.ndarray, mu: float = 1.0, rank=1):
+@partial(jax.jit, static_argnames=("rank", "sanitize"))
+def gevd_mwf(Rxx: jnp.ndarray, Rnn: jnp.ndarray, mu: float = 1.0, rank=1,
+             sanitize: bool = True):
     """Rank-``rank`` GEVD-MWF (the 'gevd' branch of internal_formulas.py:56-73).
 
     Args:
@@ -93,6 +94,10 @@ def gevd_mwf(Rxx: jnp.ndarray, Rnn: jnp.ndarray, mu: float = 1.0, rank=1):
       Rnn: noise covariance, (..., C, C) hermitian.
       mu: speech-distortion tradeoff.
       rank: int rank constraint, or 'full'.
+      sanitize: replace non-finite filters (degenerate bins) with the e1
+        pass-through selector.  Pass False when the caller has its own
+        fallback policy (e.g. the streaming pipeline keeps the previous
+        block's filter instead).
 
     Returns:
       (W, t1): filter (..., C) and the GEVD reference-selection vector
@@ -115,6 +120,8 @@ def gevd_mwf(Rxx: jnp.ndarray, Rnn: jnp.ndarray, mu: float = 1.0, rank=1):
         gains = jnp.where(keep, gains, 0.0)
     W = jnp.einsum("...ci,...i->...c", Q, gains.astype(Q.dtype) * qinv_col0)
     t1 = Q[..., :, 0] * qinv_col0[..., 0:1]
+    if not sanitize:
+        return W, t1
     # Degenerate-bin guard: if the f32 Cholesky/eigh emitted non-finite
     # values for a bin (near-singular noise stats survive the diagonal
     # loading only up to hardware precision), fall back to the e1 selector —
@@ -127,8 +134,9 @@ def gevd_mwf(Rxx: jnp.ndarray, Rnn: jnp.ndarray, mu: float = 1.0, rank=1):
     return W, t1
 
 
-@partial(jax.jit, static_argnames=("iters",))
-def gevd_mwf_power(Rxx: jnp.ndarray, Rnn: jnp.ndarray, mu: float = 1.0, iters: int = 12):
+@partial(jax.jit, static_argnames=("iters", "sanitize"))
+def gevd_mwf_power(Rxx: jnp.ndarray, Rnn: jnp.ndarray, mu: float = 1.0, iters: int = 12,
+                   sanitize: bool = True):
     """Rank-1 GEVD-MWF via power iteration on the whitened matrix.
 
     The rank-1 filter needs ONLY the dominant whitened eigenpair:
@@ -145,7 +153,10 @@ def gevd_mwf_power(Rxx: jnp.ndarray, Rnn: jnp.ndarray, mu: float = 1.0, iters: i
     C = Rxx.shape[-1]
     L, A = _whitened(Rxx, Rnn)
 
-    v = jnp.ones(A.shape[:-1], A.dtype) / jnp.sqrt(C)
+    # Derived from A (not a fresh constant) so the scan carry keeps A's
+    # device-varying type under shard_map — a replicated init would fail the
+    # carry typecheck on a node-sharded mesh.
+    v = jnp.zeros_like(A[..., 0]) + 1.0 / jnp.sqrt(C)
 
     def body(v, _):
         w = jnp.einsum("...cd,...d->...c", A, v)
@@ -162,9 +173,38 @@ def gevd_mwf_power(Rxx: jnp.ndarray, Rnn: jnp.ndarray, mu: float = 1.0, iters: i
     g = (lam / (lam + mu)).astype(q1.dtype)
     W = q1 * (g * qinv00)[..., None]
     t1 = q1 * qinv00[..., None]
+    if not sanitize:
+        return W, t1
     e1 = jnp.zeros_like(W).at[..., 0].set(1.0)
     ok = (jnp.isfinite(W.real) & jnp.isfinite(W.imag)).all(-1, keepdims=True)
     return jnp.where(ok, W, e1), jnp.where(ok, t1, e1)
+
+
+RANK1_SOLVERS = ("eigh", "power")
+
+
+def rank1_gevd(Rss, Rnn, mu: float = 1.0, solver: str = "eigh", sanitize: bool = True):
+    """Rank-1 GEVD-MWF by solver spec — THE dispatch table shared by the
+    offline TANGO steps, the streaming refreshes and ``intern_filter``:
+
+    * ``'eigh'`` — batched eigendecomposition (:func:`gevd_mwf` at rank 1);
+      bit-matches the reference semantics.
+    * ``'power'`` / ``'power:N'`` — dominant-eigenpair power iteration
+      (:func:`gevd_mwf_power`, N iterations, default 12).  Same filter to
+      f32 roundoff on offline frame-mean covariances at a fraction of the
+      eigensolve cost; streaming warm-up covariances with weak eigengaps
+      need ``power:N`` with larger N (see tests/test_streaming.py).
+    """
+    if solver == "eigh":
+        return gevd_mwf(Rss, Rnn, mu=mu, rank=1, sanitize=sanitize)
+    if solver == "power":
+        return gevd_mwf_power(Rss, Rnn, mu=mu, sanitize=sanitize)
+    if solver.startswith("power:"):
+        return gevd_mwf_power(Rss, Rnn, mu=mu, iters=int(solver.split(":", 1)[1]),
+                              sanitize=sanitize)
+    raise ValueError(
+        f"unknown GEVD solver {solver!r}; expected one of {RANK1_SOLVERS} or 'power:N'"
+    )
 
 
 @jax.jit
@@ -198,7 +238,7 @@ def intern_filter(Rxx, Rnn, mu: float = 1.0, ftype: str = "r1-mwf", rank="full")
     if ftype == "gevd-power":
         if rank != 1:
             raise ValueError("the 'gevd-power' solver is rank-1 only; pass rank=1")
-        return gevd_mwf_power(Rxx, Rnn, mu=mu)
+        return rank1_gevd(Rxx, Rnn, mu=mu, solver="power")
     C = Rxx.shape[-1]
     t1 = jnp.zeros(Rxx.shape[:-2] + (C,), Rxx.dtype).at[..., 0].set(1.0)
     if ftype == "r1-mwf":

@@ -33,3 +33,23 @@ def snr_value(v: str):
     match the reference's '0-6' convention (post_generator.py:66-68)."""
     f = float(v)
     return int(f) if f == int(f) else f
+
+
+def solver_spec(v: str):
+    """argparse type for rank-1 GEVD solver specs: 'eigh', 'power' or
+    'power:N' (see ``disco_tpu.beam.filters.rank1_gevd``)."""
+    import argparse
+
+    if v in ("eigh", "power"):
+        return v
+    if v.startswith("power:"):
+        try:
+            int(v.split(":", 1)[1])
+        except ValueError:
+            raise argparse.ArgumentTypeError(
+                f"malformed solver spec {v!r}: 'power:N' needs integer N"
+            )
+        return v
+    raise argparse.ArgumentTypeError(
+        f"unknown solver {v!r}; expected 'eigh', 'power' or 'power:N'"
+    )

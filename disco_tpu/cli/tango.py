@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import argparse
 
-from disco_tpu.cli.common import none_str, snr_value
+from disco_tpu.cli.common import none_str, snr_value, solver_spec
 from disco_tpu.enhance.driver import enhance_rir
 
 _POLICIES = ["None", "local", "distant", "compressed", "use_oracle_refs", "use_oracle_zs"]
@@ -42,6 +42,11 @@ def build_parser():
                    help="round clip lengths up to this many samples to cap "
                         "recompiles on ragged corpora (0 = off; ~2 dB boundary "
                         "effect; default: off for --rir, 8192 for --rirs)")
+    p.add_argument("--solver", type=solver_spec, default="eigh",
+                   help="rank-1 GEVD solver: 'eigh' (batched eigendecomposition), "
+                        "'power' (dominant-pair power iteration, faster on TPU) or "
+                        "'power:N' (N iterations — streaming mode needs ~power:96 "
+                        "for eigh-level quality)")
     return p
 
 
@@ -89,6 +94,7 @@ def main(argv=None):
             bucket=8192 if args.bucket is None else args.bucket,
             max_batch=args.batch_size, models=models,
             z_sigs=args.zsigs[0] if len(args.zsigs) == 1 else "zs&zn",
+            solver=args.solver,
         )
         print(f"{len(results)} RIRs enhanced (batched)")
         return results
@@ -98,6 +104,7 @@ def main(argv=None):
         mask_type=args.vad_type[0], policy=policy, models=models,
         out_root=args.out_root, streaming=args.streaming, bucket=args.bucket or 0,
         z_sigs=args.zsigs[0] if len(args.zsigs) == 1 else "zs&zn",
+        solver=args.solver,
     )
     if results is None:
         print(f"Conf {args.rir} with {args.noise} noise already processed")

@@ -62,6 +62,7 @@ class EnhanceConfig:
     mu: float = 1.0
     filter_type: str = "gevd"
     rank: int = 1
+    solver: str = "eigh"  # rank-1 GEVD solver: 'eigh' | 'power'
     stft_clip: tuple = (1e-6, 1e3)
     frames_lost: int = 6  # conv-cropped frames of the CRNN (utils.py:10)
 

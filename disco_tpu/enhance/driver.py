@@ -261,6 +261,7 @@ def enhance_rir(
     streaming: bool = False,
     bucket: int = 0,
     z_sigs: str = "zs_hat",
+    solver: str = "eigh",
 ):
     """Enhance one RIR end-to-end and persist everything (reference
     tango.py:460-641).  ``models``: per-step CRNN params or None for the
@@ -309,7 +310,7 @@ def enhance_rir(
         from disco_tpu.enhance.streaming import streaming_tango
 
         st = streaming_tango(Y, masks_z, mask_w, mu=mu, S=S, N=N,
-                             with_diagnostics=True, policy=policy)
+                             with_diagnostics=True, policy=policy, solver=solver)
         # ONE filter everywhere: every saved wav, mask, z and metric below
         # describes the online beamformer (sf/nf come from the same
         # per-block filters applied to the clean components).
@@ -319,7 +320,8 @@ def enhance_rir(
             masks_z=masks_z, mask_w=mask_w,
         )
     else:
-        res = tango(Y, S, N, masks_z, mask_w, mu=mu, policy=policy, mask_type=mask_type)
+        res = tango(Y, S, N, masks_z, mask_w, mu=mu, policy=policy, mask_type=mask_type,
+                    solver=solver)
 
     return _persist_and_score(
         out, layout, rir, noise, snr_range, y, s, n, s_dry, n_dry, fs,
@@ -401,6 +403,7 @@ def enhance_rirs_batched(
     max_batch: int = 16,
     models=(None, None),
     z_sigs: str = "zs_hat",
+    solver: str = "eigh",
 ):
     """Corpus-scale enhancement: many RIRs per jitted launch.
 
@@ -444,14 +447,16 @@ def enhance_rirs_batched(
     def run_batch(Yb, Sb, Nb):
         def one(Y, S, N):
             m = oracle_masks(S, N, mask_type)
-            return tango(Y, S, N, m, m, mu=mu, policy=policy, mask_type=mask_type)
+            return tango(Y, S, N, m, m, mu=mu, policy=policy, mask_type=mask_type,
+                         solver=solver)
 
         return jax.vmap(one)(Yb, Sb, Nb)
 
     @partial(jax.jit, static_argnames=())
     def run_batch_with_masks(Yb, Sb, Nb, Mz, Mw):
         def one(Y, S, N, mz, mw):
-            return tango(Y, S, N, mz, mw, mu=mu, policy=policy, mask_type=mask_type)
+            return tango(Y, S, N, mz, mw, mu=mu, policy=policy, mask_type=mask_type,
+                         solver=solver)
 
         return jax.vmap(one)(Yb, Sb, Nb, Mz, Mw)
 

@@ -37,7 +37,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from disco_tpu.beam.filters import gevd_mwf
+from disco_tpu.beam.filters import rank1_gevd
 from disco_tpu.enhance.tango import others_index
 
 
@@ -97,7 +97,8 @@ def _block_covariances(XSb, XNb, lam, Rss0=None, Rnn0=None):
     return jax.lax.scan(body, (Rss0, Rnn0), (XSb, XNb))
 
 
-def _stream_filter(X, XS, XN, lam, u, mu, ref: int = 0, extras=None, init_state=None):
+def _stream_filter(X, XS, XN, lam, u, mu, ref: int = 0, extras=None, init_state=None,
+                   solver: str = "eigh"):
     """One node's streaming filter over a (T, F, D) frame stream.
 
     ``X`` is the stream the filter is APPLIED to; ``XS``/``XN`` are the
@@ -132,8 +133,14 @@ def _stream_filter(X, XS, XN, lam, u, mu, ref: int = 0, extras=None, init_state=
         undo = lam ** (-pad)
         Rss_e = Rss_e * undo
         Rnn_e = Rnn_e * undo
-    # ALL refresh GEVDs at once: one batched top-level eigh over (B, F) bins.
-    w = jax.vmap(lambda a, b: gevd_mwf(a, b, mu=mu, rank=1)[0])(Rss_ref, Rnn_ref)  # (B, F, D)
+    # ALL refresh GEVDs at once: one batched top-level solve over (B, F)
+    # bins.  sanitize=False: a degenerate refresh must surface as non-finite
+    # so the ffill guard below keeps the PREVIOUS block's filter (the
+    # adaptive-beamforming fallback) instead of the solvers' e1 selector,
+    # which would silently switch the stream to channel 0.
+    w = jax.vmap(
+        lambda a, b: rank1_gevd(a, b, mu=mu, solver=solver, sanitize=False)[0]
+    )(Rss_ref, Rnn_ref)  # (B, F, D)
     # An ill-conditioned refresh (warm-up covariances can make the stacked
     # [mics ‖ z] channels nearly dependent; TPU f32 eigh then returns
     # non-finite) is SKIPPED: keep the previous block's filter — the standard
@@ -163,7 +170,7 @@ def _stream_filter(X, XS, XN, lam, u, mu, ref: int = 0, extras=None, init_state=
     return out, w[-1], Rss_e, Rnn_e, []
 
 
-@partial(jax.jit, static_argnames=("update_every", "ref_mic", "with_diagnostics"))
+@partial(jax.jit, static_argnames=("update_every", "ref_mic", "with_diagnostics", "solver"))
 def streaming_step1(
     Y,
     mask_z,
@@ -175,6 +182,7 @@ def streaming_step1(
     N=None,
     with_diagnostics: bool = False,
     state=None,
+    solver: str = "eigh",
 ):
     """Streaming local MWF at one node: recursive covariance smoothing with a
     filter refresh every ``update_every`` frames.
@@ -204,7 +212,7 @@ def streaming_step1(
     M = mask_z.T[..., None]  # (T, F, 1) broadcast over channels
     z, w, Rss, Rnn, extra_out = _stream_filter(
         X, M * X, (1.0 - M) * X, lambda_cor, update_every, mu, ref=ref_mic, extras=extras,
-        init_state=state,
+        init_state=state, solver=solver,
     )
     z_y = z.T
     out = {"z_y": z_y, "zn": Y[ref_mic] - z_y, "Rss": Rss, "Rnn": Rnn, "w": w}
@@ -249,7 +257,7 @@ def _stream_stats(Y, all_z, zn, mask_w, oth, policy):
     )
 
 
-@partial(jax.jit, static_argnames=("update_every", "ref_mic", "with_diagnostics", "policy"))
+@partial(jax.jit, static_argnames=("update_every", "ref_mic", "with_diagnostics", "policy", "solver"))
 def streaming_tango(
     Y,
     masks_z,
@@ -263,6 +271,7 @@ def streaming_tango(
     with_diagnostics: bool = False,
     policy: str | None = "local",
     state=None,
+    solver: str = "eigh",
 ):
     """Full two-step streaming TANGO over all nodes (mixture-only by
     default: the deployment path needs no oracle S/N).
@@ -294,17 +303,13 @@ def streaming_tango(
     step1 = jax.vmap(
         lambda y, m, s, n, st: streaming_step1(
             y, m, lambda_cor=lambda_cor, update_every=update_every, mu=mu, ref_mic=ref_mic,
-            S=s, N=n, with_diagnostics=with_diagnostics, state=st,
-        )
-    ) if state is not None else jax.vmap(
-        lambda y, m, s, n: streaming_step1(
-            y, m, lambda_cor=lambda_cor, update_every=update_every, mu=mu, ref_mic=ref_mic,
-            S=s, N=n, with_diagnostics=with_diagnostics,
-        )
+            S=s, N=n, with_diagnostics=with_diagnostics, state=st, solver=solver,
+        ),
+        in_axes=(0, 0, 0, 0, 0 if st1_in is not None else None),
     )
     s_in = S if with_diagnostics else Y
     n_in = N if with_diagnostics else Y
-    s1 = step1(Y, masks_z, s_in, n_in, st1_in) if state is not None else step1(Y, masks_z, s_in, n_in)
+    s1 = step1(Y, masks_z, s_in, n_in, st1_in)
     all_z = s1["z_y"]  # (K, F, T)
 
     oth = jnp.asarray(others_index(K))  # (K, K-1)
@@ -324,7 +329,7 @@ def streaming_tango(
         stream2 = jax.vmap(
             lambda x, xs_st, xn_st, xs, xn, st: _stream_filter(
                 x, xs_st, xn_st, lambda_cor, update_every, mu, ref=ref_mic, extras=[xs, xn],
-                init_state=st,
+                init_state=st, solver=solver,
             ),
             in_axes=(0, 0, 0, 0, 0, 0 if st2_in is not None else None),
         )
@@ -343,6 +348,7 @@ def streaming_tango(
     stream2 = jax.vmap(
         lambda x, xs_st, xn_st, st: _stream_filter(
             x, xs_st, xn_st, lambda_cor, update_every, mu, ref=ref_mic, init_state=st,
+            solver=solver,
         )[:4],
         in_axes=(0, 0, 0, 0 if st2_in is not None else None),
     )

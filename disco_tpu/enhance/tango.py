@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from disco_tpu.beam.covariance import frame_mean_covariance
-from disco_tpu.beam.filters import gevd_mwf
+from disco_tpu.beam.filters import rank1_gevd
 from disco_tpu.core.masks import tf_mask
 
 Policy = str | None
@@ -78,10 +78,10 @@ def oracle_masks(S: jnp.ndarray, N: jnp.ndarray, mask_type: str = "irm1", ref_mi
 
 
 # ------------------------------------------------------------------ step 1
-@partial(jax.jit, static_argnames=("oracle_stats", "ref_mic", "frame_axis"))
+@partial(jax.jit, static_argnames=("oracle_stats", "ref_mic", "frame_axis", "solver"))
 def tango_step1(
     Y, S, N, mask_z, mu: float = 1.0, oracle_stats: bool = False, ref_mic: int = 0,
-    frame_axis: str | None = None,
+    frame_axis: str | None = None, solver: str = "eigh",
 ):
     """Step 1 at ONE node: local rank-1 GEVD-MWF -> compressed signals.
 
@@ -103,7 +103,7 @@ def tango_step1(
     n_hat = N if oracle_stats else (1.0 - m) * Y
     Rss = frame_mean_covariance(s_hat, axis_name=frame_axis)  # (F, C, C)
     Rnn = frame_mean_covariance(n_hat, axis_name=frame_axis)
-    w, t1 = gevd_mwf(Rss, Rnn, mu=mu, rank=1)  # (F, C) each
+    w, t1 = rank1_gevd(Rss, Rnn, mu=mu, solver=solver)  # (F, C) each
     z_y = jnp.einsum("fc,cft->ft", jnp.conj(w), Y)
     z_s = jnp.einsum("fc,cft->ft", jnp.conj(w), S)
     z_n = jnp.einsum("fc,cft->ft", jnp.conj(w), N)
@@ -144,7 +144,7 @@ def _z_stats(policy: Policy, mask_w_k, all_z, all_masks_w, all_S_ref, all_N_ref,
     raise ValueError(f"unknown mask_for_z policy {policy!r}; expected one of {_POLICIES}")
 
 
-@partial(jax.jit, static_argnames=("policy", "ref_mic", "mask_type", "frame_axis"))
+@partial(jax.jit, static_argnames=("policy", "ref_mic", "mask_type", "frame_axis", "solver"))
 def tango_step2(
     Y,
     S,
@@ -160,6 +160,7 @@ def tango_step2(
     ref_mic: int = 0,
     mask_type: str = "irm1",
     frame_axis: str | None = None,
+    solver: str = "eigh",
 ):
     """Step 2 at ONE node k: global rank-1 GEVD-MWF on ``[y_k ‖ z_{j≠k}]``
     (tango.py:380-455).
@@ -189,7 +190,7 @@ def tango_step2(
     stat_n = jnp.concatenate([(1.0 - m) * Y, zn_stat_all[oth]], axis=0)
     Rss = frame_mean_covariance(stat_s, axis_name=frame_axis)
     Rnn = frame_mean_covariance(stat_n, axis_name=frame_axis)
-    w, _ = gevd_mwf(Rss, Rnn, mu=mu, rank=1)  # (F, C+K-1)
+    w, _ = rank1_gevd(Rss, Rnn, mu=mu, solver=solver)  # (F, C+K-1)
 
     in_y = jnp.concatenate([Y, all_z["z_y"][oth]], axis=0)
     in_s = jnp.concatenate([S, all_z["z_s"][oth]], axis=0)
@@ -201,7 +202,7 @@ def tango_step2(
 
 
 # ------------------------------------------------------------- full pipeline
-@partial(jax.jit, static_argnames=("policy", "ref_mic", "mask_type", "oracle_step1_stats"))
+@partial(jax.jit, static_argnames=("policy", "ref_mic", "mask_type", "oracle_step1_stats", "solver"))
 def tango(
     Y,
     S,
@@ -213,6 +214,7 @@ def tango(
     ref_mic: int = 0,
     mask_type: str = "irm1",
     oracle_step1_stats: bool = False,
+    solver: str = "eigh",
 ) -> TangoResult:
     """The full two-step pipeline on one device: ``vmap`` over the node axis,
     z-exchange by plain indexing (the in-process ``concatenate_signals`` of
@@ -227,7 +229,9 @@ def tango(
     axis — rooms, nodes, freq and frames are all array axes.
     """
     step1 = jax.vmap(
-        lambda y, s, n, m: tango_step1(y, s, n, m, mu=mu, oracle_stats=oracle_step1_stats, ref_mic=ref_mic)
+        lambda y, s, n, m: tango_step1(
+            y, s, n, m, mu=mu, oracle_stats=oracle_step1_stats, ref_mic=ref_mic, solver=solver
+        )
     )
     all_z = step1(Y, S, N, masks_z)
 
@@ -235,7 +239,7 @@ def tango(
     step2 = jax.vmap(
         lambda y, s, n, mw, k: tango_step2(
             y, s, n, mw, k, all_z, mask_w, S[:, ref_mic], N[:, ref_mic],
-            mu=mu, policy=policy, ref_mic=ref_mic, mask_type=mask_type,
+            mu=mu, policy=policy, ref_mic=ref_mic, mask_type=mask_type, solver=solver,
         ),
         in_axes=(0, 0, 0, 0, 0),
     )

@@ -93,7 +93,7 @@ def ring_all_gather(x, axis_name: str):
 
 def _tango_on_mesh(
     Y, S, N, masks_z, mask_w, mesh, frame_axis, mu, policy, ref_mic, mask_type,
-    oracle_step1_stats, z_exchange: str = "all_gather",
+    oracle_step1_stats, z_exchange: str = "all_gather", solver: str = "eigh",
 ) -> TangoResult:
     """Shared shard_map body for the node-sharded and node+frame-sharded
     pipelines — identical math, different partition specs.
@@ -128,7 +128,7 @@ def _tango_on_mesh(
         step1 = jax.vmap(
             lambda y, s, n, m: tango_step1(
                 y, s, n, m, mu=mu, oracle_stats=oracle_step1_stats, ref_mic=ref_mic,
-                frame_axis=frame_axis,
+                frame_axis=frame_axis, solver=solver,
             )
         )
         local_z = step1(Yk, Sk, Nk, mzk)
@@ -147,7 +147,7 @@ def _tango_on_mesh(
             lambda y, s, n, mw, kk: tango_step2(
                 y, s, n, mw, kk, all_z, all_masks_w, all_S_ref, all_N_ref,
                 mu=mu, policy=policy, ref_mic=ref_mic, mask_type=mask_type,
-                frame_axis=frame_axis,
+                frame_axis=frame_axis, solver=solver,
             ),
             in_axes=(0, 0, 0, 0, 0),
         )
@@ -163,7 +163,7 @@ def _tango_on_mesh(
 
 @partial(
     jax.jit,
-    static_argnames=("mesh", "policy", "ref_mic", "mask_type", "oracle_step1_stats", "z_exchange"),
+    static_argnames=("mesh", "policy", "ref_mic", "mask_type", "oracle_step1_stats", "z_exchange", "solver"),
 )
 def tango_sharded(
     Y,
@@ -178,6 +178,7 @@ def tango_sharded(
     mask_type: str = "irm1",
     oracle_step1_stats: bool = False,
     z_exchange: str = "all_gather",
+    solver: str = "eigh",
 ) -> TangoResult:
     """Two-step TANGO with the node axis sharded over ``mesh``'s 'node' axis.
 
@@ -191,13 +192,13 @@ def tango_sharded(
     """
     return _tango_on_mesh(
         Y, S, N, masks_z, mask_w, mesh, None, mu, policy, ref_mic, mask_type,
-        oracle_step1_stats, z_exchange,
+        oracle_step1_stats, z_exchange, solver,
     )
 
 
 @partial(
     jax.jit,
-    static_argnames=("mesh", "policy", "ref_mic", "mask_type", "oracle_step1_stats"),
+    static_argnames=("mesh", "policy", "ref_mic", "mask_type", "oracle_step1_stats", "solver"),
 )
 def tango_frame_sharded(
     Y,
@@ -211,6 +212,7 @@ def tango_frame_sharded(
     ref_mic: int = 0,
     mask_type: str = "irm1",
     oracle_step1_stats: bool = False,
+    solver: str = "eigh",
 ) -> TangoResult:
     """Two-step TANGO sharded over BOTH the node axis and the STFT frame
     axis — the framework's sequence-parallel mode (SURVEY.md §5.7).
@@ -225,7 +227,7 @@ def tango_frame_sharded(
     """
     return _tango_on_mesh(
         Y, S, N, masks_z, mask_w, mesh, "frame", mu, policy, ref_mic, mask_type,
-        oracle_step1_stats,
+        oracle_step1_stats, solver=solver,
     )
 
 

@@ -177,6 +177,34 @@ def test_gevd_power_matches_eigh_rank1():
         intern_filter(Rxx, Rnn, ftype="gevd-power", rank=2)
 
 
+def test_rank1_gevd_sanitize_flag():
+    """Degenerate bins (NaN covariances) yield the e1 selector when
+    sanitize=True (default) and surface as non-finite when sanitize=False —
+    the contract the streaming ffill fallback depends on (it must see the
+    failure to keep the previous block's filter)."""
+    import jax.numpy as jnp
+
+    from disco_tpu.beam.filters import rank1_gevd
+
+    rng = np.random.default_rng(2)
+    F, C, T = 8, 3, 50
+    X = rng.standard_normal((C, F, T))
+    Rxx = np.einsum("cft,dft->fcd", X, X) / T
+    Rnn = np.eye(C)[None] * np.ones((F, 1, 1))
+    Rnn = np.array(Rnn)
+    Rnn[2] = np.nan  # poison one bin
+    Rxx_j, Rnn_j = jnp.asarray(Rxx, jnp.complex64), jnp.asarray(Rnn, jnp.complex64)
+    for solver in ("eigh", "power", "power:24"):
+        w_s, t1_s = rank1_gevd(Rxx_j, Rnn_j, solver=solver)
+        assert bool(jnp.isfinite(w_s.real).all()), solver
+        np.testing.assert_allclose(np.asarray(w_s)[2], np.eye(C, 1)[:, 0], atol=0, err_msg=solver)
+        w_r, _ = rank1_gevd(Rxx_j, Rnn_j, solver=solver, sanitize=False)
+        assert not bool(jnp.isfinite(w_r.real)[2].all()), solver
+        assert bool(jnp.isfinite(w_r.real)[:2].all()), solver
+    with pytest.raises(ValueError, match="unknown GEVD solver"):
+        rank1_gevd(Rxx_j, Rnn_j, solver="qr")
+
+
 def test_get_filter_type_gevd_power():
     from disco_tpu.beam.filters import get_filter_type
 

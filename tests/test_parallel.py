@@ -45,6 +45,27 @@ def test_sharded_matches_vmap(scene8, policy):
         assert err < 1e-5, (key, err)
 
 
+def test_sharded_power_solver_matches_vmap(scene8):
+    """solver='power' under shard_map equals the single-device vmap path with
+    the same solver — the z-exchange and the solver compose."""
+    y, s, n = scene8
+    Y, S, N = stft(y), stft(s), stft(n)
+    masks = oracle_masks(S, N, "irm1")
+    want = tango(Y, S, N, masks, masks, policy="local", solver="power")
+
+    mesh = make_mesh(n_node=8)
+    sh = node_sharding(mesh)
+    got = tango_sharded(
+        jax.device_put(Y, sh), jax.device_put(S, sh), jax.device_put(N, sh),
+        jax.device_put(masks, sh), jax.device_put(masks, sh), mesh,
+        policy="local", solver="power",
+    )
+    err = np.linalg.norm(np.asarray(got.yf) - np.asarray(want.yf)) / np.linalg.norm(
+        np.asarray(want.yf)
+    )
+    assert err < 1e-5, err
+
+
 def test_sharded_two_nodes_per_device(scene8):
     """K=8 nodes on 4 devices: two nodes per shard still produces identical
     results (the n_local > 1 path)."""
