@@ -7,8 +7,19 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "mfu",
 wall-second (x realtime) for the jitted batched TPU pipeline; ``vs_baseline``
 is the speedup over the float64 NumPy reference implementation (the
 loop-per-(node,freq) formulas of reference tango.py:252-457) measured on this
-same host at 2 s clip length (long enough to amortize NumPy setup; the
-round-1 1 s extrapolation overstated the NumPy side's startup share).
+same host at 2 s clip length (long enough to amortize NumPy setup).
+
+Timing methodology (round-2 fix): this machine reaches its TPU through a
+tunneled device attachment with a measured ~80 ms fixed RPC round-trip per
+fenced dispatch — a scalar add costs the same ~80 ms as a full STFT batch, so
+single-dispatch timings mostly measure the tunnel, not the chip, and
+``block_until_ready`` returns in ~20 us without waiting (the fence is a
+1-element host readback instead).  Each measurement therefore queues k
+programs asynchronously, fences once, and takes the SLOPE
+``(t_k - t_1) / (k - 1)`` — the true on-device execution time; the intercept
+is reported as ``dispatch_overhead_ms``.  ``value`` uses the slope (the
+number that holds on a directly-attached v5e); ``value_single_dispatch``
+keeps the tunnel-included figure for continuity with BENCH_r01.
 
 FLOPs come from XLA's own cost model (``compiled.cost_analysis()['flops']``)
 over the exact compiled program, not a hand count; MFU divides by the
@@ -17,6 +28,11 @@ pipeline is FFT- and small-hermitian-eig-dominated (257-point spectra,
 C<=11 matrices), so it sits on the memory/latency side of the roofline, not
 the MXU side — a LOW MFU with a HIGH RTF is the expected signature, and the
 stage breakdown shows where the time actually goes.
+
+``rtf_power_solver`` additionally reports the pipeline with
+``solver='power'`` (dominant-eigenpair power iteration, SDR parity pinned at
+0.1 dB in tests/test_tango.py) — the headline ``value`` stays on the default
+eigh path.
 """
 import json
 import os
@@ -53,20 +69,41 @@ def _peak_flops():
     return _PEAK_TFLOPS["cpu"] * 1e12
 
 
-def _time_fn(fn, *args, iters=5):
-    """Median fenced wall time of an already-compiled jitted callable."""
-    fence = _fence
-    fence(fn(*args))
+def _leaf(out):
+    import jax
+
+    return jax.tree_util.tree_leaves(out)[0]
+
+
+def _time_queued(fn, *args, k: int = 1, iters: int = 5):
+    """Median wall time of k async-queued executions under ONE fence."""
+    _fence(_leaf(fn(*args)))  # warm-up / compile
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        fence(fn(*args))
+        outs = [fn(*args) for _ in range(k)]
+        _fence(_leaf(outs[-1]))
         times.append(time.perf_counter() - t0)
     return sorted(times)[len(times) // 2]
 
 
+def _slope_time(fn, *args, k: int = 6, iters: int = 5):
+    """(on-device per-exec seconds, single-dispatch seconds) via the
+    k-queued slope (see module docstring).  When RPC jitter swamps the
+    signal (tk <= t1, i.e. the slope is non-positive), fall back to tk/k —
+    a conservative upper bound that still amortizes the overhead k-fold —
+    rather than reporting an absurdly small time as 'fast'."""
+    t1 = _time_queued(fn, *args, k=1, iters=iters)
+    tk = _time_queued(fn, *args, k=k, iters=iters)
+    slope = (tk - t1) / (k - 1)
+    if slope <= 0:
+        slope = tk / k
+    return slope, t1
+
+
 def bench_jax(batch=16, dur_s=10.0, iters=5):
-    """Returns (rtf, flops_per_clip, mfu, stage_ms)."""
+    """Returns dict with rtf (slope), rtf_single_dispatch, rtf_power,
+    dispatch overhead, flops_per_clip, mfu, stage_ms."""
     import jax
     import jax.numpy as jnp
 
@@ -79,20 +116,30 @@ def bench_jax(batch=16, dur_s=10.0, iters=5):
     sb = jnp.asarray(np.stack([s] * batch))
     nb = jnp.asarray(np.stack([n] * batch))
 
-    @jax.jit
-    def run(yb, sb, nb):
-        def one(y, s, n):
-            Y, S, N = stft(y), stft(s), stft(n)
-            m = oracle_masks(S, N, "irm1")
-            return tango(Y, S, N, m, m, policy="local").yf
+    def make_run(solver):
+        @jax.jit
+        def run(yb, sb, nb):
+            def one(y, s, n):
+                Y, S, N = stft(y), stft(s), stft(n)
+                m = oracle_masks(S, N, "irm1")
+                return tango(Y, S, N, m, m, policy="local", solver=solver).yf
 
-        # Return the full enhanced spectra: jit outputs must be materialized,
-        # so the timed program is exactly the production program.
-        return jax.vmap(one)(yb, sb, nb)
+            # Return the full enhanced spectra: jit outputs must be
+            # materialized, so the timed program is exactly the production
+            # program.
+            return jax.vmap(one)(yb, sb, nb)
 
-    dt = _time_fn(run, yb, sb, nb, iters=iters)
+        return run
+
+    run = make_run("eigh")
+    dt, dt1 = _slope_time(run, yb, sb, nb, iters=iters)
     audio_s = batch * K * dur_s  # per-node enhanced outputs
     rtf = audio_s / dt
+    rtf_single = audio_s / dt1
+
+    run_p = make_run("power")
+    dt_p, _ = _slope_time(run_p, yb, sb, nb, iters=iters)
+    rtf_power = audio_s / dt_p
 
     # ---- FLOP model: XLA's cost analysis of the exact compiled program
     flops_total = None
@@ -105,9 +152,8 @@ def bench_jax(batch=16, dur_s=10.0, iters=5):
     mfu = (flops_total / dt) / _peak_flops() if flops_total else None
     flops_per_clip = flops_total / batch if flops_total else None
 
-    # ---- per-stage breakdown (each stage timed as its own fenced jitted
-    # program on the same data; XLA fuses more aggressively inside the full
-    # pipeline, so stages slightly over-add — noted in the JSON)
+    # ---- per-stage breakdown, each stage's ON-DEVICE time via the slope
+    # (stages slightly over-add vs the full pipeline, which fuses tighter)
     jstft = jax.jit(lambda x: stft(x))
     Yb, Sb, Nb = jstft(yb), jstft(sb), jstft(nb)
     jmask = jax.jit(jax.vmap(lambda S, N: oracle_masks(S, N, "irm1")))
@@ -121,11 +167,11 @@ def bench_jax(batch=16, dur_s=10.0, iters=5):
     yf = jfull(Yb, Sb, Nb, Mb)
     jistft = jax.jit(lambda Z: istft(Z, length=L))
 
-    t_stft = _time_fn(jstft, yb, iters=iters) * 3  # y, s, n streams
-    t_mask = _time_fn(jmask, Sb, Nb, iters=iters)
-    t_step1 = _time_fn(jstep1, Yb, Sb, Nb, Mb, iters=iters)
-    t_full = _time_fn(jfull, Yb, Sb, Nb, Mb, iters=iters)
-    t_istft = _time_fn(jistft, yf, iters=iters)
+    t_stft = _slope_time(jstft, yb, iters=iters)[0] * 3  # y, s, n streams
+    t_mask = _slope_time(jmask, Sb, Nb, iters=iters)[0]
+    t_step1 = _slope_time(jstep1, Yb, Sb, Nb, Mb, iters=iters)[0]
+    t_full = _slope_time(jfull, Yb, Sb, Nb, Mb, iters=iters)[0]
+    t_istft = _slope_time(jistft, yf, iters=iters)[0]
     stage_ms = {
         "stft_x3": round(t_stft * 1e3, 2),
         "masks": round(t_mask * 1e3, 2),
@@ -134,7 +180,15 @@ def bench_jax(batch=16, dur_s=10.0, iters=5):
         "istft": round(t_istft * 1e3, 2),
         "full_pipeline": round(dt * 1e3, 2),
     }
-    return rtf, flops_per_clip, mfu, stage_ms
+    return {
+        "rtf": rtf,
+        "rtf_single_dispatch": rtf_single,
+        "rtf_power": rtf_power,
+        "dispatch_overhead_ms": round(max(dt1 - dt, 0.0) * 1e3, 2),
+        "flops_per_clip": flops_per_clip,
+        "mfu": mfu,
+        "stage_ms": stage_ms,
+    }
 
 
 def bench_numpy(dur_s=2.0):
@@ -149,23 +203,32 @@ def bench_numpy(dur_s=2.0):
 
 
 def main():
-    rtf, flops_per_clip, mfu, stage_ms = bench_jax()
+    # BENCH_BATCH / BENCH_DUR_S / BENCH_ITERS override the workload size
+    # (defaults are the headline config; smaller values for CPU smoke tests).
+    r = bench_jax(
+        batch=int(os.environ.get("BENCH_BATCH", 16)),
+        dur_s=float(os.environ.get("BENCH_DUR_S", 10.0)),
+        iters=int(os.environ.get("BENCH_ITERS", 5)),
+    )
     try:
         rtf_np = bench_numpy()
     except Exception:
         rtf_np = None
-    vs = (rtf / rtf_np) if rtf_np else None
+    vs = (r["rtf"] / rtf_np) if rtf_np else None
     print(
         json.dumps(
             {
                 "metric": "rtf_8node_mwf_enhancement",
-                "value": round(rtf, 2),
+                "value": round(r["rtf"], 2),
                 "unit": "x_realtime",
                 "vs_baseline": round(vs, 2) if vs else None,
-                "mfu": round(mfu, 6) if mfu else None,
-                "flops_per_clip": round(flops_per_clip) if flops_per_clip else None,
-                "stage_ms": stage_ms,
-                "notes": "stages timed as separate fenced programs (full pipeline fuses tighter); numpy baseline at 2s clips; MFU vs dense-f32 peak (pipeline is FFT/small-eig bound by design)",
+                "value_single_dispatch": round(r["rtf_single_dispatch"], 2),
+                "rtf_power_solver": round(r["rtf_power"], 2),
+                "dispatch_overhead_ms": r["dispatch_overhead_ms"],
+                "mfu": round(r["mfu"], 6) if r["mfu"] else None,
+                "flops_per_clip": round(r["flops_per_clip"]) if r["flops_per_clip"] else None,
+                "stage_ms": r["stage_ms"],
+                "notes": "value = on-device RTF via k-queued slope timing (tunnel adds ~80ms/dispatch, reported separately; value_single_dispatch includes it); stages timed as separate fenced programs (full pipeline fuses tighter); numpy baseline at 2s clips; MFU vs dense-f32 peak (pipeline is FFT/small-eig bound by design)",
             }
         )
     )

@@ -200,8 +200,10 @@ def rank1_gevd(Rss, Rnn, mu: float = 1.0, solver: str = "eigh", sanitize: bool =
     if solver == "power":
         return gevd_mwf_power(Rss, Rnn, mu=mu, sanitize=sanitize)
     if solver.startswith("power:"):
-        return gevd_mwf_power(Rss, Rnn, mu=mu, iters=int(solver.split(":", 1)[1]),
-                              sanitize=sanitize)
+        iters = int(solver.split(":", 1)[1])
+        if iters < 1:
+            raise ValueError(f"solver spec {solver!r}: 'power:N' needs N >= 1")
+        return gevd_mwf_power(Rss, Rnn, mu=mu, iters=iters, sanitize=sanitize)
     raise ValueError(
         f"unknown GEVD solver {solver!r}; expected one of {RANK1_SOLVERS} or 'power:N'"
     )

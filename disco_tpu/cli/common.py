@@ -44,10 +44,12 @@ def solver_spec(v: str):
         return v
     if v.startswith("power:"):
         try:
-            int(v.split(":", 1)[1])
+            n = int(v.split(":", 1)[1])
         except ValueError:
+            n = 0
+        if n < 1:
             raise argparse.ArgumentTypeError(
-                f"malformed solver spec {v!r}: 'power:N' needs integer N"
+                f"malformed solver spec {v!r}: 'power:N' needs integer N >= 1"
             )
         return v
     raise argparse.ArgumentTypeError(
