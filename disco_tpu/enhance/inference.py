@@ -350,22 +350,16 @@ def vad_mask(ts, n_freq: int, n_frames: int):
 
 def plot_conf(infos, mics_per_node=(4, 4, 4, 4), return_fig=False):
     """Room top-view plot from saved generation infos
-    (reference utils.py:141-172).  Built on the object-oriented matplotlib
-    API so the process-global pyplot backend is never touched."""
-    from matplotlib.figure import Figure
-    from matplotlib.patches import Rectangle
+    (reference utils.py:141-172) — node labels anchored at each node's
+    first mic.  Shares the renderer with ``sim.geometry.RoomSetup.plot``
+    (``disco_tpu.utils.plotting.draw_room_topview``)."""
+    from disco_tpu.utils.plotting import draw_room_topview
 
-    f = Figure()
-    ax = f.add_subplot()
-    ax.add_patch(Rectangle((0, 0), infos["room"]["length"], infos["room"]["width"], fill=False, linewidth=3))
-    ax.plot(infos["mics"][0, :], infos["mics"][1, :], "x")
-    ax.plot(infos["sources"][:, 0], infos["sources"][:, 1], "x")
-    ax.axis("equal")
-    cums = np.cumsum([0] + list(mics_per_node))
-    for i_n in range(len(mics_per_node)):
-        ax.text(1.05 * infos["mics"][0, cums[i_n]], 1.05 * infos["mics"][1, cums[i_n]], f"Node {i_n + 1}", fontsize=10)
-    for i_s in range(np.shape(infos["sources"])[0]):
-        ax.text(1.05 * infos["sources"][i_s, 0], 1.05 * infos["sources"][i_s, 1], f"Source {i_s + 1}", fontsize=10)
-    ax.set(xlim=(-1, infos["room"]["length"] + 1), ylim=(-1, infos["room"]["width"] + 1))
+    cums = np.cumsum([0] + list(mics_per_node))[:-1]
+    node_anchors = np.asarray(infos["mics"])[:2, cums].T  # (n_nodes, 2)
+    f = draw_room_topview(
+        infos["room"]["length"], infos["room"]["width"], infos["mics"],
+        infos["sources"], node_anchors, label_offset=1.05,
+    )
     if return_fig:
         return f

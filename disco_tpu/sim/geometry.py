@@ -60,6 +60,19 @@ class RoomSetup:
     def room_dim(self) -> np.ndarray:
         return np.array([self.length, self.width, self.height])
 
+    def plot(self):
+        """Top-view Figure of the sampled configuration — room outline, node
+        centers, microphones and sources (the ``plot_room`` observability
+        helper of reference room_setups.py:238-253; the from-saved-infos
+        variant is ``disco_tpu.enhance.inference.plot_conf``).  Returns the
+        matplotlib Figure — save with ``fig.savefig(...)``."""
+        from disco_tpu.utils.plotting import draw_room_topview
+
+        return draw_room_topview(
+            self.length, self.width, self.mic_positions, self.source_positions,
+            self.nodes_centers,
+        )
+
 
 class RandomRoomSetup:
     """Uniformly random nodes + sources under min-distance constraints

@@ -95,15 +95,14 @@ def main(argv=None):
     from disco_tpu import milestones
 
     if args.quick:
-        section("bench", lambda: dict(zip(
-            ("rtf", "flops_per_clip", "mfu", "stage_ms"),
-            bench_mod.bench_jax(batch=4, dur_s=4.0, iters=2))))
+        # bench_jax returns the report dict directly (rtf, rtf_power,
+        # dispatch_overhead_ms, mfu, stage_ms, ...)
+        section("bench", lambda: bench_mod.bench_jax(batch=4, dur_s=4.0, iters=2))
         section("crnn_corpus_ab", lambda: crnn_corpus_ab(B=4, dur_s=2.0))
         section("milestone_separation", lambda: milestones.meetit_separation(dur_s=2.0, K=4, C=2, iters=1))
         section("streaming_latency", lambda: milestones.streaming_latency(dur_s=2.0, K=2, C=2, iters=1))
         return
-    section("bench", lambda: dict(zip(
-        ("rtf", "flops_per_clip", "mfu", "stage_ms"), bench_mod.bench_jax())))
+    section("bench", bench_mod.bench_jax)
     section("crnn_corpus_ab", crnn_corpus_ab)
     for name, fn in (
         ("milestone_1", milestones.mvdr_single_clip),

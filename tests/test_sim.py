@@ -131,6 +131,20 @@ def test_circular_array():
     np.testing.assert_allclose(np.linalg.norm(arr - [[1.0], [2.0]], axis=0), 0.05, atol=1e-12)
 
 
+def test_room_setup_plot():
+    """RoomSetup.plot renders the top-view observability figure (reference
+    plot_room, room_setups.py:238-253) without touching the pyplot state."""
+    rng = np.random.default_rng(3)
+    cfg = make_setup("random", rng=rng).create_room_setup()
+    fig = cfg.plot()
+    assert fig is not None
+    ax = fig.axes[0]
+    assert len(ax.lines) >= 2  # mics + sources scatter
+    labels = [t.get_text() for t in ax.texts]
+    assert f"Node {len(cfg.nodes_centers)}" in labels
+    assert "Source 1" in labels
+
+
 @pytest.mark.parametrize("scenario", ["random", "living", "meeting", "meetit"])
 def test_scenarios_sample_valid_configs(scenario):
     rng = np.random.default_rng(11)
