@@ -21,6 +21,8 @@ install:
 native:
 	g++ -O3 -shared -fPIC -pthread disco_tpu/native/fastloader.cpp \
 	    -o disco_tpu/native/libfastloader.so
+	g++ -O3 -shared -fPIC -pthread disco_tpu/native/fastwav.cpp \
+	    -o disco_tpu/native/libfastwav.so
 
 bench:
 	$(PYTHON) bench.py
@@ -32,5 +34,5 @@ milestone-corpus:
 
 clean:
 	rm -rf build dist *.egg-info htmlcov .coverage doc/build
-	rm -f disco_tpu/native/libfastloader.so
+	rm -f disco_tpu/native/libfastloader.so disco_tpu/native/libfastwav.so
 	find . -name __pycache__ -type d -exec rm -rf {} +

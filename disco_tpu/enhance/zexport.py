@@ -18,7 +18,6 @@ import jax.numpy as jnp
 
 from disco_tpu.core.dsp import stft
 from disco_tpu.enhance.tango import oracle_masks, tango_step1
-from disco_tpu.io.audio import read_wav
 from disco_tpu.utils import to_host
 from disco_tpu.io.layout import DatasetLayout, case_of_rir
 
@@ -51,37 +50,42 @@ def compute_z_signals(
     return out
 
 
+def _node_paths(layout, rir, noise_tag, snr_range, n_nodes, mics_per_node, source):
+    return [
+        layout.wav_processed(snr_range, source, rir, 1 + node * mics_per_node + c, noise=noise_tag)
+        for node in range(n_nodes)
+        for c in range(mics_per_node)
+    ]
+
+
 def load_node_signals(layout: DatasetLayout, rir: int, noise: str, snr_range, n_nodes: int = 4, mics_per_node: int = 4):
     """Read processed mixture/target/noise wavs into (K, C, L) arrays
-    (reference get_z_signals.py:44-92)."""
-    def read_all(source, noise_tag):
-        chans = []
-        for node in range(n_nodes):
-            node_ch = []
-            for c in range(mics_per_node):
-                ch = 1 + node * mics_per_node + c
-                x, _ = read_wav(layout.wav_processed(snr_range, source, rir, ch, noise=noise_tag))
-                node_ch.append(x)
-            chans.append(np.stack(node_ch))
-        return np.stack(chans)
+    (reference get_z_signals.py:44-92).  All 3 x K x C channel files are
+    decoded in ONE threaded native batch (``disco_tpu.io.fastwav``) — the
+    per-RIR ingest that otherwise bounds corpus wall-clock at >1000x
+    real-time enhancement rates."""
+    from disco_tpu.io.fastwav import read_wavs_batch
 
     # targets are saved without a noise tag; mixture/noise carry it
     # (postgen.save_data, reference post_generator.py:133-150)
-    return read_all("mixture", noise), read_all("target", None), read_all("noise", noise)
+    paths = (
+        _node_paths(layout, rir, noise, snr_range, n_nodes, mics_per_node, "mixture")
+        + _node_paths(layout, rir, None, snr_range, n_nodes, mics_per_node, "target")
+        + _node_paths(layout, rir, noise, snr_range, n_nodes, mics_per_node, "noise")
+    )
+    sigs, _fs = read_wavs_batch(paths)
+    y, s, n = sigs.reshape(3, n_nodes, mics_per_node, -1)
+    return y, s, n
 
 
 def load_mixture_signals(layout: DatasetLayout, rir: int, noise: str, snr_range, n_nodes: int = 4, mics_per_node: int = 4):
     """Mixture-only variant of :func:`load_node_signals` for mask-supplied
     exports (no oracle masks needed → no target/noise reads)."""
-    chans = []
-    for node in range(n_nodes):
-        node_ch = []
-        for c in range(mics_per_node):
-            ch = 1 + node * mics_per_node + c
-            x, _ = read_wav(layout.wav_processed(snr_range, "mixture", rir, ch, noise=noise))
-            node_ch.append(x)
-        chans.append(np.stack(node_ch))
-    return np.stack(chans)
+    from disco_tpu.io.fastwav import read_wavs_batch
+
+    paths = _node_paths(layout, rir, noise, snr_range, n_nodes, mics_per_node, "mixture")
+    sigs, _fs = read_wavs_batch(paths)
+    return sigs.reshape(n_nodes, mics_per_node, -1)
 
 
 def export_z(

@@ -1,0 +1,150 @@
+"""ctypes bindings for the native threaded WAV batch reader.
+
+The C++ library (``disco_tpu/native/fastwav.cpp``) decodes a whole batch of
+mono corpus wavs with a thread pool — the per-RIR ~48-file ingest of
+``zexport.load_node_signals`` (reference get_z_signals.py:44-92) in one
+call.  Built on demand with g++ (cached next to the source); degrades
+gracefully to the pure-Python ``disco_tpu.io.audio.read_wav`` loop when no
+compiler is available, with identical decoded samples (same PCM scaling).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+from disco_tpu.io.audio import read_wav
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native", "fastwav.cpp")
+_LIB = os.path.join(os.path.dirname(_SRC), "libfastwav.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", _SRC, "-o", _LIB]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def get_lib():
+    """The loaded shared library, building it on first use; None if
+    unavailable (no compiler / unsupported platform)."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        # Rebuild when the source is newer; a prebuilt .so without the
+        # source (installed package) is used as-is.
+        have_src = os.path.exists(_SRC)
+        stale = (
+            not os.path.exists(_LIB)
+            or (have_src and os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
+        )
+        if stale and (not have_src or not _build()):
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            return None
+        lib.fast_read_wavs.restype = ctypes.c_int
+        lib.fast_read_wavs.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_long),
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def _python_fallback(paths):
+    sigs, fss = [], []
+    for p in paths:
+        x, fs = read_wav(p)
+        if x.ndim != 1:
+            raise RuntimeError(f"fastwav: {p!r} is not mono")
+        sigs.append(np.asarray(x, np.float32))
+        fss.append(fs)
+    lens = {len(x) for x in sigs}
+    if len(lens) != 1:
+        raise RuntimeError(f"fastwav: ragged batch, lengths {sorted(lens)}")
+    if len(set(fss)) != 1:
+        raise RuntimeError(f"fastwav: mixed sample rates {sorted(set(fss))}")
+    return np.stack(sigs), fss[0]
+
+
+def read_wavs_batch(paths, n_threads: int | None = None):
+    """Decode many equal-length mono wavs into one (n, L) float32 array.
+
+    Returns (signals, fs).  All files must be mono, the same length and the
+    same sample rate — the corpus per-RIR contract; a RuntimeError names
+    the offending file otherwise.  Threaded native decode when the library
+    is available, else a sequential Python loop with identical samples.
+    """
+    paths = [os.fspath(p) for p in paths]
+    if not paths:
+        raise ValueError("read_wavs_batch: empty path list")
+    lib = get_lib()
+    if lib is None:
+        return _python_fallback(paths)
+
+    # probe the first file for the batch geometry (python decoder: shares
+    # the failure modes users see on truly broken files)
+    x0, fs0 = read_wav(paths[0])
+    if x0.ndim != 1:
+        raise RuntimeError(f"fastwav: {paths[0]!r} is not mono")
+    L = len(x0)
+    n = len(paths)
+    out = np.empty((n, L), np.float32)
+    lens = np.zeros(n, np.int64)
+    fss = np.zeros(n, np.int32)
+    fail = np.zeros(1, np.int64)
+    if n_threads is None:
+        n_threads = min(32, os.cpu_count() or 4)
+    c_paths = (ctypes.c_char_p * n)(*[p.encode() for p in paths])
+    rc = lib.fast_read_wavs(
+        c_paths,
+        n,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        L,
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        fss.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        n_threads,
+        fail.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+    )
+    if rc != 0:
+        bad = int(fail[0])
+        raise RuntimeError(
+            f"fastwav: failed reading {paths[bad]!r} (unsupported format, "
+            "multichannel, or IO error)"
+        )
+    if not (lens == L).all():
+        bad = int(np.flatnonzero(lens != L)[0])
+        raise RuntimeError(
+            f"fastwav: ragged batch — {paths[bad]!r} has {int(lens[bad])} "
+            f"samples, expected {L}"
+        )
+    if not (fss == fs0).all():
+        bad = int(np.flatnonzero(fss != fs0)[0])
+        raise RuntimeError(
+            f"fastwav: mixed sample rates — {paths[bad]!r} at {int(fss[bad])} Hz, "
+            f"expected {fs0}"
+        )
+    return out, fs0

@@ -40,9 +40,15 @@ def get_lib():
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
-            if not _build():
-                return None
+        # Rebuild when the source is newer; a prebuilt .so without the
+        # source (installed package) is used as-is.
+        have_src = os.path.exists(_SRC)
+        stale = (
+            not os.path.exists(_LIB)
+            or (have_src and os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
+        )
+        if stale and (not have_src or not _build()):
+            return None
         try:
             lib = ctypes.CDLL(_LIB)
         except OSError:
