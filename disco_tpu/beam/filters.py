@@ -84,9 +84,9 @@ def _whitened(Rxx: jnp.ndarray, Rnn: jnp.ndarray):
     return L, 0.5 * (A + A.conj().swapaxes(-1, -2))  # re-hermitize vs roundoff
 
 
-@partial(jax.jit, static_argnames=("rank", "sanitize"))
+@partial(jax.jit, static_argnames=("rank", "sanitize", "eigh_impl"))
 def gevd_mwf(Rxx: jnp.ndarray, Rnn: jnp.ndarray, mu: float = 1.0, rank=1,
-             sanitize: bool = True):
+             sanitize: bool = True, eigh_impl: str = "xla"):
     """Rank-``rank`` GEVD-MWF (the 'gevd' branch of internal_formulas.py:56-73).
 
     Args:
@@ -98,6 +98,10 @@ def gevd_mwf(Rxx: jnp.ndarray, Rnn: jnp.ndarray, mu: float = 1.0, rank=1,
         pass-through selector.  Pass False when the caller has its own
         fallback policy (e.g. the streaming pipeline keeps the previous
         block's filter instead).
+      eigh_impl: the batched hermitian eigensolver — 'xla'
+        (``jnp.linalg.eigh``), 'jacobi' (fixed-sweep cyclic Jacobi,
+        ``disco_tpu.ops.eigh_ops.eigh_jacobi``) or 'jacobi-pallas' (the
+        same schedule as one fused VMEM kernel).
 
     Returns:
       (W, t1): filter (..., C) and the GEVD reference-selection vector
@@ -105,7 +109,22 @@ def gevd_mwf(Rxx: jnp.ndarray, Rnn: jnp.ndarray, mu: float = 1.0, rank=1,
     """
     C = Rxx.shape[-1]
     L, A = _whitened(Rxx, Rnn)
-    lam, U = jnp.linalg.eigh(A)  # ascending
+    if eigh_impl == "xla":
+        lam, U = jnp.linalg.eigh(A)  # ascending
+    elif eigh_impl == "jacobi":
+        from disco_tpu.ops.eigh_ops import eigh_jacobi
+
+        lam, U = eigh_jacobi(A)
+    elif eigh_impl == "jacobi-pallas":
+        from disco_tpu.ops.eigh_ops import eigh_jacobi_pallas
+
+        # interpret off-TPU: the Mosaic lowering is TPU-only, and the
+        # interpreter makes the branch testable on any backend.
+        lam, U = eigh_jacobi_pallas(A, interpret=jax.default_backend() != "tpu")
+    else:
+        raise ValueError(
+            f"unknown eigh_impl {eigh_impl!r}; expected 'xla', 'jacobi' or 'jacobi-pallas'"
+        )
     lam = lam[..., ::-1]
     U = U[..., ::-1]
     lam = jnp.clip(lam, EIG_FLOOR, EIG_CEIL)
@@ -180,7 +199,7 @@ def gevd_mwf_power(Rxx: jnp.ndarray, Rnn: jnp.ndarray, mu: float = 1.0, iters: i
     return jnp.where(ok, W, e1), jnp.where(ok, t1, e1)
 
 
-RANK1_SOLVERS = ("eigh", "power")
+RANK1_SOLVERS = ("eigh", "power", "jacobi", "jacobi-pallas")
 
 
 def rank1_gevd(Rss, Rnn, mu: float = 1.0, solver: str = "eigh", sanitize: bool = True):
@@ -194,9 +213,14 @@ def rank1_gevd(Rss, Rnn, mu: float = 1.0, solver: str = "eigh", sanitize: bool =
       f32 roundoff on offline frame-mean covariances at a fraction of the
       eigensolve cost; streaming warm-up covariances with weak eigengaps
       need ``power:N`` with larger N (see tests/test_streaming.py).
+    * ``'jacobi'`` / ``'jacobi-pallas'`` — fixed-sweep cyclic Jacobi full
+      eigendecomposition (``disco_tpu.ops.eigh_ops``), as a statically
+      unrolled XLA schedule or one fused VMEM pallas kernel.
     """
     if solver == "eigh":
         return gevd_mwf(Rss, Rnn, mu=mu, rank=1, sanitize=sanitize)
+    if solver in ("jacobi", "jacobi-pallas"):
+        return gevd_mwf(Rss, Rnn, mu=mu, rank=1, sanitize=sanitize, eigh_impl=solver)
     if solver == "power":
         return gevd_mwf_power(Rss, Rnn, mu=mu, sanitize=sanitize)
     if solver.startswith("power:"):

@@ -36,11 +36,12 @@ def snr_value(v: str):
 
 
 def solver_spec(v: str):
-    """argparse type for rank-1 GEVD solver specs: 'eigh', 'power' or
-    'power:N' (see ``disco_tpu.beam.filters.rank1_gevd``)."""
+    """argparse type for rank-1 GEVD solver specs: 'eigh', 'power',
+    'power:N', 'jacobi' or 'jacobi-pallas'
+    (see ``disco_tpu.beam.filters.rank1_gevd``)."""
     import argparse
 
-    if v in ("eigh", "power"):
+    if v in ("eigh", "power", "jacobi", "jacobi-pallas"):
         return v
     if v.startswith("power:"):
         try:
@@ -53,5 +54,6 @@ def solver_spec(v: str):
             )
         return v
     raise argparse.ArgumentTypeError(
-        f"unknown solver {v!r}; expected 'eigh', 'power' or 'power:N'"
+        f"unknown solver {v!r}; expected 'eigh', 'power', 'power:N', "
+        "'jacobi' or 'jacobi-pallas'"
     )

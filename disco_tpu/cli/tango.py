@@ -44,9 +44,9 @@ def build_parser():
                         "effect; default: off for --rir, 8192 for --rirs)")
     p.add_argument("--solver", type=solver_spec, default="eigh",
                    help="rank-1 GEVD solver: 'eigh' (batched eigendecomposition), "
-                        "'power' (dominant-pair power iteration, faster on TPU) or "
-                        "'power:N' (N iterations — streaming mode needs ~power:96 "
-                        "for eigh-level quality)")
+                        "'power'/'power:N' (dominant-pair power iteration; "
+                        "streaming mode needs ~power:96 for eigh-level quality), "
+                        "'jacobi' or 'jacobi-pallas' (fixed-sweep cyclic Jacobi)")
     return p
 
 

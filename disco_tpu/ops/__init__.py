@@ -1,3 +1,12 @@
+from disco_tpu.ops.eigh_ops import eigh_jacobi, eigh_jacobi_pallas
 from disco_tpu.ops.stft_ops import dft_matrices, idft_matrices, istft_matmul, stft_matmul, stft_pallas
 
-__all__ = ["dft_matrices", "idft_matrices", "istft_matmul", "stft_matmul", "stft_pallas"]
+__all__ = [
+    "dft_matrices",
+    "eigh_jacobi",
+    "eigh_jacobi_pallas",
+    "idft_matrices",
+    "istft_matmul",
+    "stft_matmul",
+    "stft_pallas",
+]
