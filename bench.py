@@ -141,6 +141,19 @@ def bench_jax(batch=16, dur_s=10.0, iters=5):
     dt_p, _ = _slope_time(run_p, yb, sb, nb, iters=iters)
     rtf_power = audio_s / dt_p
 
+    # full-eigendecomposition alternative (ops/eigh_ops.py); measured so the
+    # hardware record carries all solver families.  A failure is recorded as
+    # an error string, not silently null — the record must distinguish
+    # "solver broken on this backend" from "not measured".
+    jacobi_error = None
+    try:
+        run_j = make_run("jacobi")
+        dt_j, _ = _slope_time(run_j, yb, sb, nb, iters=iters)
+        rtf_jacobi = audio_s / dt_j
+    except Exception as e:
+        rtf_jacobi = None
+        jacobi_error = f"{type(e).__name__}: {e}"[:200]
+
     # ---- FLOP model: XLA's cost analysis of the exact compiled program
     flops_total = None
     try:
@@ -184,6 +197,8 @@ def bench_jax(batch=16, dur_s=10.0, iters=5):
         "rtf": rtf,
         "rtf_single_dispatch": rtf_single,
         "rtf_power": rtf_power,
+        "rtf_jacobi": rtf_jacobi,
+        "jacobi_error": jacobi_error,
         "dispatch_overhead_ms": round(max(dt1 - dt, 0.0) * 1e3, 2),
         "flops_per_clip": flops_per_clip,
         "mfu": mfu,
@@ -224,6 +239,8 @@ def main():
                 "vs_baseline": round(vs, 2) if vs else None,
                 "value_single_dispatch": round(r["rtf_single_dispatch"], 2),
                 "rtf_power_solver": round(r["rtf_power"], 2),
+                "rtf_jacobi_solver": round(r["rtf_jacobi"], 2) if r.get("rtf_jacobi") else None,
+                "jacobi_error": r.get("jacobi_error"),
                 "dispatch_overhead_ms": r["dispatch_overhead_ms"],
                 "mfu": round(r["mfu"], 6) if r["mfu"] else None,
                 "flops_per_clip": round(r["flops_per_clip"]) if r["flops_per_clip"] else None,
