@@ -28,6 +28,7 @@ from flax import struct
 from flax.training import train_state
 
 from disco_tpu.nn.losses import reconstruction_loss
+from disco_tpu.utils.transfer import prefetch_to_device
 
 
 class TrainState(train_state.TrainState):
@@ -230,17 +231,22 @@ def fit(
 
     gate = SaveAndStop(patience=patience if patience is not None else n_epochs, mode="min")
     for epoch in range(first_epoch, first_epoch + n_epochs):
-        tr, nb = 0.0, 0
-        for x, y in train_batches():
-            state, loss = train_step(state, jnp.asarray(x), jnp.asarray(y))
-            tr += float(loss)
+        # Losses stay ON DEVICE across the epoch as a running sum: a
+        # float() per step would fence the pipeline (host sync per batch),
+        # serializing host batch prep against device compute.  With async
+        # dispatch + the prefetch feed, step N+1's data is ready while
+        # step N runs; one readback per epoch.
+        tr, nb = jnp.zeros(()), 0
+        for x, y in prefetch_to_device(train_batches()):
+            state, loss = train_step(state, x, y)
+            tr = tr + loss
             nb += 1
-        va, nv = 0.0, 0
-        for x, y in val_batches():
-            va += float(eval_step(state, jnp.asarray(x), jnp.asarray(y)))
+        va, nv = jnp.zeros(()), 0
+        for x, y in prefetch_to_device(val_batches()):
+            va = va + eval_step(state, x, y)
             nv += 1
-        train_losses[epoch] = tr / max(nb, 1)
-        val_losses[epoch] = va / max(nv, 1)
+        train_losses[epoch] = float(tr) / nb if nb else 0.0
+        val_losses[epoch] = float(va) / nv if nv else 0.0
         if verbose:
             print(f"epoch {epoch}\tTrain\t{train_losses[epoch]:.6f}\tVal\t{val_losses[epoch]:.6f}")
         np.savez(save_dir / f"{run_name}_losses.npz", train_loss=train_losses, val_loss=val_losses)

@@ -1,4 +1,4 @@
-from disco_tpu.utils.transfer import to_device, to_host
+from disco_tpu.utils.transfer import prefetch_to_device, to_device, to_host
 from disco_tpu.utils.profiling import StageTimer, trace_to
 
-__all__ = ["to_host", "to_device", "StageTimer", "trace_to"]
+__all__ = ["to_host", "to_device", "prefetch_to_device", "StageTimer", "trace_to"]

@@ -38,3 +38,57 @@ def to_device(x) -> jax.Array:
         im = np.ascontiguousarray(x.imag, dtype=np.float32)
         return _combine(jnp.asarray(re), jnp.asarray(im))
     return jnp.asarray(x)
+
+
+def prefetch_to_device(iterator, size: int = 2):
+    """Overlap host batch preparation and host->device transfer with device
+    compute: the loader-parallel layer of SURVEY.md §2.9 (the reference uses
+    torch DataLoader workers, train.py:104-105).
+
+    A background thread drains ``iterator`` (host-side numpy work — file
+    reads, windowing — overlapping the GIL-released device step), and a
+    lookahead deque keeps ``size`` batches already ``to_device``-transferred
+    ahead of the consumer (transfers are async, so they run behind the
+    in-flight step).  Batches may be arbitrary pytrees of numpy arrays.
+
+    Exceptions from the source iterator are re-raised at the consuming
+    site; the feeder thread is a daemon, so abandoning the generator (e.g.
+    early-stop mid-epoch) never blocks interpreter exit.
+    """
+    import collections
+    import queue as queue_mod
+    import threading
+
+    if size < 1:
+        raise ValueError("prefetch_to_device needs size >= 1")
+
+    hostq: "queue_mod.Queue" = queue_mod.Queue(maxsize=size)
+    _END = object()
+
+    def feeder():
+        try:
+            for item in iterator:
+                hostq.put(item)
+            hostq.put(_END)
+        except BaseException as e:  # surfaced at the consumer
+            hostq.put(e)
+
+    threading.Thread(target=feeder, daemon=True).start()
+
+    lookahead: "collections.deque" = collections.deque()
+
+    def enqueue(n):
+        for _ in range(n):
+            item = hostq.get()
+            if item is _END:
+                return False
+            if isinstance(item, BaseException):
+                raise item
+            lookahead.append(jax.tree_util.tree_map(to_device, item))
+        return True
+
+    more = enqueue(size)
+    while lookahead:
+        yield lookahead.popleft()
+        if more:
+            more = enqueue(1)

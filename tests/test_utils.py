@@ -1,9 +1,10 @@
 """Tests for transfer helpers and the profiling utility."""
+import pytest
 import numpy as np
 
 import jax.numpy as jnp
 
-from disco_tpu.utils import StageTimer, to_device, to_host, trace_to
+from disco_tpu.utils import StageTimer, prefetch_to_device, to_device, to_host, trace_to
 
 
 def test_to_host_complex_roundtrip():
@@ -31,6 +32,43 @@ def test_stage_timer():
     rep = t.report()
     assert rep["a"]["calls"] == 2 and rep["b"]["calls"] == 1
     assert "a" in t.pretty()
+
+
+def test_prefetch_to_device_order_and_values():
+    """Every batch arrives exactly once, in order, as device arrays."""
+    batches = [(np.full((2, 3), i, np.float32), np.full((2,), -i, np.float32)) for i in range(7)]
+    got = list(prefetch_to_device(iter(batches), size=3))
+    assert len(got) == 7
+    for i, (x, y) in enumerate(got):
+        assert isinstance(x, jnp.ndarray)
+        np.testing.assert_array_equal(np.asarray(x), batches[i][0])
+        np.testing.assert_array_equal(np.asarray(y), batches[i][1])
+
+
+def test_prefetch_to_device_empty_and_short():
+    assert list(prefetch_to_device(iter([]), size=2)) == []
+    one = [(np.zeros(2, np.float32),)]
+    assert len(list(prefetch_to_device(iter(one), size=4))) == 1
+    with pytest.raises(ValueError, match="size >= 1"):
+        list(prefetch_to_device(iter(one), size=0))
+
+
+def test_prefetch_to_device_propagates_source_error():
+    def bad():
+        yield (np.zeros(2, np.float32),)
+        raise RuntimeError("loader exploded")
+
+    it = prefetch_to_device(bad(), size=1)
+    next(it)
+    with pytest.raises(RuntimeError, match="loader exploded"):
+        list(it)
+
+
+def test_prefetch_complex_batches():
+    """Complex pytree leaves go through the complex-safe transfer."""
+    z = (np.arange(4) + 1j * np.arange(4)).astype(np.complex64)
+    (got,), = list(prefetch_to_device(iter([(z,)]), size=1))
+    np.testing.assert_array_equal(np.asarray(to_host(got)), z)
 
 
 def test_trace_to_noop_on_failure(tmp_path):
