@@ -254,7 +254,9 @@ def _stoi_third_octaves(fs=_STOI_FS, nfft=_STOI_NFFT, n_bands=_STOI_NBANDS, min_
 
 
 def _stoi_frames(x, win=_STOI_WIN, hop=_STOI_HOP):
-    n = 1 + max(0, (len(x) - win)) // hop
+    if len(x) < win:  # shorter than one frame: no frames (stoi -> nan)
+        return np.zeros((0, win))
+    n = 1 + (len(x) - win) // hop
     idx = np.arange(win)[None, :] + hop * np.arange(n)[:, None]
     return x[idx] * np.hanning(win + 2)[1:-1]
 
@@ -263,6 +265,8 @@ def _remove_silent_frames(x, y, dyn_range=_STOI_DYN, win=_STOI_WIN, hop=_STOI_HO
     """Drop frames of x whose energy is > dyn_range dB below the loudest
     frame; apply the same selection to y; overlap-add back to time."""
     xf, yf = _stoi_frames(x, win, hop), _stoi_frames(y, win, hop)
+    if not len(xf):
+        return np.zeros(0), np.zeros(0)
     energies = 20 * np.log10(np.linalg.norm(xf, axis=1) + np.finfo(np.float64).eps)
     keep = energies > (np.max(energies) - dyn_range)
     xf, yf = xf[keep], yf[keep]

@@ -20,10 +20,13 @@ from __future__ import annotations
 
 import os
 import pickle
+import threading
 from functools import partial
 from pathlib import Path
 
 import numpy as np
+
+_FIG_LOCK = threading.Lock()  # see the save_fig block in _persist_and_score
 
 from disco_tpu.core.bss import BssEval
 from disco_tpu.core.dsp import istft
@@ -232,11 +235,14 @@ def _persist_and_score(
             try:
                 from disco_tpu.enhance.inference import plot_conf
 
-                fig = plot_conf(np.load(infos_path, allow_pickle=True).item(), return_fig=True)
-                fig.savefig(out / "FIG" / f"{rir}.png")
-                import matplotlib.pyplot as plt
-
-                plt.close(fig)
+                # One figure at a time: the OO matplotlib API avoids pyplot's
+                # main-thread requirement, but first-render font-cache builds
+                # and the Agg rasterizer are not re-entrant — scoring may run
+                # on a thread pool (enhance_rirs_batched score_workers).  The
+                # unregistered Figure needs no pyplot close; it is GC'd.
+                with _FIG_LOCK:
+                    fig = plot_conf(np.load(infos_path, allow_pickle=True).item(), return_fig=True)
+                    fig.savefig(out / "FIG" / f"{rir}.png")
             except Exception:
                 pass  # plotting is best-effort observability, never fatal
     return results
@@ -404,6 +410,7 @@ def enhance_rirs_batched(
     models=(None, None),
     z_sigs: str = "zs_hat",
     solver: str = "eigh",
+    score_workers: int = 4,
 ):
     """Corpus-scale enhancement: many RIRs per jitted launch.
 
@@ -416,6 +423,12 @@ def enhance_rirs_batched(
     whose per-clip, per-node forwards are batched into one device call per
     step per chunk, then scored/persisted per RIR exactly like
     :func:`enhance_rir`.
+
+    ``score_workers``: per-RIR scoring (_persist_and_score — the 512-tap
+    BSS Gram factorizations, STOI and fw metrics dominate host CPU) runs in
+    a thread pool so chunk N's metrics overlap chunk N+1's decode + device
+    launch; only one chunk of futures is in flight (memory bound), and 1
+    means inline scoring.  The metric math is identical either way.
 
     Returns {rir: results dict} for the RIRs actually processed
     (already-done ones are skipped — same idempotency contract).
@@ -460,40 +473,57 @@ def enhance_rirs_batched(
 
         return jax.vmap(one)(Yb, Sb, Nb, Mz, Mw)
 
+    from concurrent.futures import ThreadPoolExecutor
+
     all_results = {}
-    for Lp, items in groups.items():
-        for start in range(0, len(items), max_batch):
-            chunk = items[start : start + max_batch]
-            sigs = [
-                load_input_signals(layout, rir, noise, snr_range, n_nodes, mics_per_node)
-                for rir, _, layout in chunk
-            ]
-            ys, ss, ns = [], [], []
-            for (y, s, n, *_rest) in sigs:
-                pad = ((0, 0), (0, 0), (0, Lp - y.shape[-1]))
-                ys.append(np.pad(y, pad))
-                ss.append(np.pad(s, pad))
-                ns.append(np.pad(n, pad))
-            # pad the remainder chunk to max_batch by repeating the first
-            # clip: ONE compiled program per bucket, dummy outputs dropped
-            n_real = len(ys)
-            while len(ys) < max_batch:
-                ys.append(ys[0]); ss.append(ss[0]); ns.append(ns[0])
-            Yb = stft(jnp.asarray(np.stack(ys)))
-            Sb = stft(jnp.asarray(np.stack(ss)))
-            Nb = stft(jnp.asarray(np.stack(ns)))
-            if models == (None, None):
-                res_b = run_batch(Yb, Sb, Nb)
-            else:
-                Mz, Mw = _batched_masks(Yb, Sb, Nb, models, mask_type, mu, n_nodes, z_sigs)
-                res_b = run_batch_with_masks(Yb, Sb, Nb, Mz, Mw)
-            for i in range(n_real):
-                rir, out, layout = chunk[i]
-                y, s, n, s_dry, n_dry, fs, rnd_snrs = sigs[i]
-                res_i = jax.tree_util.tree_map(lambda x: x[i], res_b)
-                L = y.shape[-1]
-                all_results[rir] = _persist_and_score(
-                    out, layout, rir, noise, snr_range, y, s, n, s_dry, n_dry,
-                    fs, rnd_snrs, res_i, L, n_stft_frames(L), n_nodes, save_fig,
-                )
+    pending: list = []  # (rir, future) of the PREVIOUS chunk
+
+    def drain():
+        for rir_, fut in pending:
+            all_results[rir_] = fut.result()
+        pending.clear()
+
+    with ThreadPoolExecutor(max_workers=max(score_workers, 1)) as ex:
+        for Lp, items in groups.items():
+            for start in range(0, len(items), max_batch):
+                chunk = items[start : start + max_batch]
+                sigs = [
+                    load_input_signals(layout, rir, noise, snr_range, n_nodes, mics_per_node)
+                    for rir, _, layout in chunk
+                ]
+                ys, ss, ns = [], [], []
+                for (y, s, n, *_rest) in sigs:
+                    pad = ((0, 0), (0, 0), (0, Lp - y.shape[-1]))
+                    ys.append(np.pad(y, pad))
+                    ss.append(np.pad(s, pad))
+                    ns.append(np.pad(n, pad))
+                # pad the remainder chunk to max_batch by repeating the first
+                # clip: ONE compiled program per bucket, dummy outputs dropped
+                n_real = len(ys)
+                while len(ys) < max_batch:
+                    ys.append(ys[0]); ss.append(ss[0]); ns.append(ns[0])
+                Yb = stft(jnp.asarray(np.stack(ys)))
+                Sb = stft(jnp.asarray(np.stack(ss)))
+                Nb = stft(jnp.asarray(np.stack(ns)))
+                if models == (None, None):
+                    res_b = run_batch(Yb, Sb, Nb)
+                else:
+                    Mz, Mw = _batched_masks(Yb, Sb, Nb, models, mask_type, mu, n_nodes, z_sigs)
+                    res_b = run_batch_with_masks(Yb, Sb, Nb, Mz, Mw)
+                drain()  # previous chunk scored; bounds futures to one chunk
+                for i in range(n_real):
+                    rir, out, layout = chunk[i]
+                    y, s, n, s_dry, n_dry, fs, rnd_snrs = sigs[i]
+                    res_i = jax.tree_util.tree_map(lambda x: x[i], res_b)
+                    L = y.shape[-1]
+                    score = partial(
+                        _persist_and_score,
+                        out, layout, rir, noise, snr_range, y, s, n, s_dry, n_dry,
+                        fs, rnd_snrs, res_i, L, n_stft_frames(L), n_nodes, save_fig,
+                    )
+                    if score_workers <= 1:
+                        all_results[rir] = score()
+                    else:
+                        pending.append((rir, ex.submit(score)))
+        drain()
     return all_results

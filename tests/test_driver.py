@@ -15,29 +15,34 @@ NOISE = "ssn"
 SNR_RANGE = (0, 6)
 
 
-@pytest.fixture
-def processed_corpus(tmp_path):
-    """A 2-second synthetic processed corpus for one RIR: a coherent target
-    across mics + diffuse noise, plus dry refs and the SNR log."""
+def _build_corpus(root, rirs):
+    """A 2-second synthetic processed corpus for the given RIR ids: a
+    coherent target across mics + diffuse noise, plus dry refs and the SNR
+    log per RIR."""
     rng = np.random.default_rng(7)
-    root = tmp_path / "dataset"
     layout = DatasetLayout(str(root), "living", "test")
     L = 2 * FS
-    src = 0.2 * rng.standard_normal(L)  # broadband speech-like source
-    for node in range(K):
-        for c in range(C):
-            ch = 1 + node * C + c
-            s = np.convolve(src, rng.standard_normal(8) * 0.5, mode="same")
-            n = 0.1 * rng.standard_normal(L)
-            write_wav(layout.ensure_dir(layout.wav_processed(SNR_RANGE, "target", RIR, ch)), s, FS)
-            write_wav(layout.ensure_dir(layout.wav_processed(SNR_RANGE, "noise", RIR, ch, noise=NOISE)), n, FS)
-            write_wav(layout.ensure_dir(layout.wav_processed(SNR_RANGE, "mixture", RIR, ch, noise=NOISE)), s + n, FS)
-    write_wav(layout.ensure_dir(layout.dry_source("target", RIR, 1)), src, FS)
-    write_wav(layout.ensure_dir(layout.dry_source("noise", RIR, 2, noise=NOISE)), 0.1 * rng.standard_normal(L), FS)
-    snr_log = layout.snr_log(SNR_RANGE, RIR, NOISE)
-    layout.ensure_dir(snr_log)
-    np.save(snr_log, np.full(K, 3.0))
+    for rir in rirs:
+        src = 0.2 * rng.standard_normal(L)  # broadband speech-like source
+        for node in range(K):
+            for c in range(C):
+                ch = 1 + node * C + c
+                s = np.convolve(src, rng.standard_normal(8) * 0.5, mode="same")
+                n = 0.1 * rng.standard_normal(L)
+                write_wav(layout.ensure_dir(layout.wav_processed(SNR_RANGE, "target", rir, ch)), s, FS)
+                write_wav(layout.ensure_dir(layout.wav_processed(SNR_RANGE, "noise", rir, ch, noise=NOISE)), n, FS)
+                write_wav(layout.ensure_dir(layout.wav_processed(SNR_RANGE, "mixture", rir, ch, noise=NOISE)), s + n, FS)
+        write_wav(layout.ensure_dir(layout.dry_source("target", rir, 1)), src, FS)
+        write_wav(layout.ensure_dir(layout.dry_source("noise", rir, 2, noise=NOISE)), 0.1 * rng.standard_normal(L), FS)
+        snr_log = layout.snr_log(SNR_RANGE, rir, NOISE)
+        layout.ensure_dir(snr_log)
+        np.save(snr_log, np.full(K, 3.0))
     return root
+
+
+@pytest.fixture
+def processed_corpus(tmp_path):
+    return _build_corpus(tmp_path / "dataset", [RIR])
 
 
 EXPECTED_KEYS = {
@@ -233,6 +238,34 @@ def test_enhance_rirs_batched(processed_corpus, tmp_path):
         str(processed_corpus), "living", [RIR], NOISE,
         snr_range=SNR_RANGE, out_root=str(out_root), save_fig=False,
     ) == {}
+
+
+def test_enhance_rirs_batched_score_workers_identical(tmp_path):
+    """Threaded scoring (score_workers>1) produces bit-identical metrics to
+    inline scoring — the overlap changes scheduling, never math.  Three RIRs
+    with max_batch=1 force three chunks, so multiple futures and the
+    cross-chunk drain() ordering are actually exercised (results must stay
+    keyed to their RIR across chunk boundaries)."""
+    from disco_tpu.enhance.driver import enhance_rirs_batched
+
+    rirs = [RIR, RIR + 1, RIR + 2]
+    corpus = _build_corpus(tmp_path / "dataset3", rirs)
+    kw = dict(snr_range=SNR_RANGE, save_fig=False, max_batch=1)
+    r_inline = enhance_rirs_batched(
+        str(corpus), "living", rirs, NOISE,
+        out_root=str(tmp_path / "inline"), score_workers=1, **kw,
+    )
+    r_pool = enhance_rirs_batched(
+        str(corpus), "living", rirs, NOISE,
+        out_root=str(tmp_path / "pool"), score_workers=4, **kw,
+    )
+    assert set(r_inline) == set(r_pool) == set(rirs)
+    for rir in rirs:
+        for key in r_inline[rir]:
+            np.testing.assert_array_equal(
+                np.asarray(r_inline[rir][key]), np.asarray(r_pool[rir][key]),
+                err_msg=f"{rir}/{key}",
+            )
 
 
 def test_aggregate_cli(processed_corpus, tmp_path, capsys):
