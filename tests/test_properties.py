@@ -1,0 +1,107 @@
+"""Property-based invariants (hypothesis) for the pure DSP/math kernels —
+the reference's parametrized-pure-function test style (SURVEY.md §4) pushed
+to randomized inputs.  Jitted functions keep FIXED shapes across examples
+(values are drawn, shapes are not) so each property compiles once."""
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from disco_tpu.core.dsp import N_FFT, istft, n_stft_frames, stft
+from disco_tpu.core.masks import tf_mask
+from disco_tpu.core.mathx import cart2pol, db2lin, lin2db, pol2cart
+from disco_tpu.core.sigproc import increase_to_snr
+
+_SET = settings(max_examples=25, deadline=None)
+
+floats = st.floats(min_value=-1e3, max_value=1e3, allow_nan=False, width=64)
+pos_floats = st.floats(min_value=1e-6, max_value=1e6, allow_nan=False, width=64)
+
+
+@given(st.lists(pos_floats, min_size=1, max_size=16))
+@_SET
+def test_db_roundtrip(vals):
+    x = np.asarray(vals)
+    np.testing.assert_allclose(db2lin(lin2db(x)), x, rtol=1e-5)  # f32 kernels
+
+
+@given(st.lists(floats, min_size=2, max_size=2), st.lists(floats, min_size=2, max_size=2))
+@_SET
+def test_polar_roundtrip(a, b):
+    x, y = np.asarray(a), np.asarray(b)
+    rho, phi = cart2pol(x, y)
+    x2, y2 = pol2cart(rho, phi)
+    np.testing.assert_allclose(x2, x, atol=1e-3)  # f32 trig at |v| up to 1e3
+    np.testing.assert_allclose(y2, y, atol=1e-3)
+
+
+@given(st.integers(min_value=0, max_value=2**31 - 1))
+@_SET
+def test_stft_istft_roundtrip(seed):
+    """Perfect reconstruction (COLA) to f32 tolerance at a fixed length."""
+    rng = np.random.default_rng(seed)
+    L = 4096
+    x = rng.standard_normal(L).astype(np.float32)
+    y = np.asarray(istft(stft(x), length=L))
+    # boundary frames are touched by the reflect-pad; interior is exact
+    np.testing.assert_allclose(y[N_FFT:-N_FFT], x[N_FFT:-N_FFT], atol=2e-6)
+    assert np.asarray(stft(x)).shape == (N_FFT // 2 + 1, n_stft_frames(L))
+
+
+@given(st.integers(min_value=0, max_value=2**31 - 1),
+       st.sampled_from(["irm1", "irm2", "ibm1", "iam1", "iam2"]))
+@_SET
+def test_mask_ranges(seed, kind):
+    rng = np.random.default_rng(seed)
+    S = (rng.standard_normal((8, 10)) + 1j * rng.standard_normal((8, 10))).astype(np.complex64)
+    N = (rng.standard_normal((8, 10)) + 1j * rng.standard_normal((8, 10))).astype(np.complex64)
+    m = np.asarray(tf_mask(S, N, kind))
+    assert np.isfinite(m).all()
+    assert (m >= 0).all()
+    if kind.startswith(("irm", "ibm")):
+        assert (m <= 1.0 + 1e-6).all()
+
+
+@given(st.integers(min_value=0, max_value=2**31 - 1),
+       st.floats(min_value=-10, max_value=20, allow_nan=False))
+@_SET
+def test_increase_to_snr_hits_target(seed, snr_db):
+    rng = np.random.default_rng(seed)
+    s = rng.standard_normal(8000)
+    n = rng.standard_normal(8000)
+    n2 = increase_to_snr(s, n, snr_db)
+    got = 10 * np.log10(np.var(s) / np.var(n2))
+    assert abs(got - snr_db) < 0.2, (got, snr_db)
+
+
+@given(st.integers(min_value=0, max_value=2**31 - 1),
+       st.floats(min_value=-5.0, max_value=5.0, allow_nan=False))
+@_SET
+def test_jacobi_shift_invariance(seed, shift):
+    """eigh_jacobi(A + c I) has eigenvalues shifted by exactly c and the
+    same invariant subspaces (residual check against the shifted matrix)."""
+    from disco_tpu.ops.eigh_ops import eigh_jacobi
+
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((4, 5, 5)) + 1j * rng.standard_normal((4, 5, 5))
+    A = (X @ np.conj(np.swapaxes(X, -1, -2)) / 5).astype(np.complex64)
+    lam0, _ = eigh_jacobi(A)
+    As = (A + shift * np.eye(5)).astype(np.complex64)
+    lam1, V1 = eigh_jacobi(As)
+    np.testing.assert_allclose(np.asarray(lam1), np.asarray(lam0) + shift, atol=5e-3)
+    V1 = np.asarray(V1, np.complex128)
+    resid = np.linalg.norm(As.astype(np.complex128) @ V1 - V1 * np.asarray(lam1, np.float64)[..., None, :])
+    assert resid / (np.linalg.norm(As) + 1e-9) < 1e-3
+
+
+@given(st.integers(min_value=0, max_value=2**31 - 1))
+@_SET
+def test_welford_matches_numpy(seed):
+    from disco_tpu.core.mathx import WelfordsOnlineAlgorithm
+
+    rng = np.random.default_rng(seed)
+    chunks = [rng.standard_normal((3, rng.integers(1, 40))) for _ in range(4)]  # (features, frames)
+    w = WelfordsOnlineAlgorithm(3)
+    for c in chunks:
+        w.quick_update(c)
+    allx = np.concatenate(chunks, axis=1)
+    np.testing.assert_allclose(np.asarray(w.mean), allx.mean(1), atol=1e-4)  # f32 state
+    np.testing.assert_allclose(np.asarray(w.std), allx.std(1), atol=1e-4)
