@@ -40,10 +40,16 @@ def lin2db(x):
 
 
 def cart2pol(x, y):
-    """Cartesian -> polar, angle in radians (math_utils.py:78-97)."""
+    """Cartesian -> polar, angle in radians (math_utils.py:78-97).
+
+    XLA's ``arctan2`` returns NaN when BOTH arguments are f32 denormals
+    (numpy gives the true angle); such points are numerically at the
+    origin, so the angle falls back to the ``arctan2(0, 0) = 0``
+    convention instead of poisoning downstream geometry."""
     x = jnp.asarray(x)
     y = jnp.asarray(y)
-    return jnp.sqrt(x**2 + y**2), jnp.arctan2(y, x)
+    phi = jnp.arctan2(y, x)
+    return jnp.sqrt(x**2 + y**2), jnp.where(jnp.isnan(phi), 0.0, phi)
 
 
 def pol2cart(r, theta):

@@ -98,7 +98,8 @@ def test_welford_matches_numpy(seed):
     from disco_tpu.core.mathx import WelfordsOnlineAlgorithm
 
     rng = np.random.default_rng(seed)
-    chunks = [rng.standard_normal((3, rng.integers(1, 40))) for _ in range(4)]  # (features, frames)
+    widths = (7, 31, 2, 19)  # fixed: one jit compile per shape across all examples
+    chunks = [rng.standard_normal((3, w)) for w in widths]  # (features, frames)
     w = WelfordsOnlineAlgorithm(3)
     for c in chunks:
         w.quick_update(c)
