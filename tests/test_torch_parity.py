@@ -44,6 +44,21 @@ def _build_flax():
     return model, variables
 
 
+def _copy_gru_weights(cell_params, torch_gru, hidden: int):
+    """flax GRUCell params -> torch GRU layer-0 weights: rows ordered
+    [r, z, n]; flax has no hidden-side r/z biases (zeroed in torch)."""
+    with torch.no_grad():
+        Wi = np.concatenate([np.asarray(cell_params[g]["kernel"]).T for g in ("ir", "iz", "in")], 0)
+        Wh = np.concatenate([np.asarray(cell_params[g]["kernel"]).T for g in ("hr", "hz", "hn")], 0)
+        bi = np.concatenate([np.asarray(cell_params[g]["bias"]) for g in ("ir", "iz", "in")])
+        bh = np.zeros(3 * hidden, np.float32)
+        bh[2 * hidden :] = np.asarray(cell_params["hn"]["bias"])
+        torch_gru.weight_ih_l0.copy_(torch.from_numpy(Wi.copy()))
+        torch_gru.weight_hh_l0.copy_(torch.from_numpy(Wh.copy()))
+        torch_gru.bias_ih_l0.copy_(torch.from_numpy(bi))
+        torch_gru.bias_hh_l0.copy_(torch.from_numpy(bh))
+
+
 class _TorchTwin(torch.nn.Module):
     """The same architecture in torch, with OUR feature-merge order
     (time kept, (freq, channel) flattened with channel fastest) so weights
@@ -90,23 +105,8 @@ def _copy_flax_to_torch(variables, twin):
             twin.bns[i].running_var.copy_(torch.from_numpy(np.asarray(bn_s["var"])))
 
         # flax GRUCell: r = σ(x·Wir + bir + h·Whr); z likewise; n = tanh(x·Win
-        # + bin + r*(h·Whn + bhn)).  torch rows are ordered [r, z, n] with two
-        # bias vectors; flax's hidden-side r/z biases do not exist → zero.
-        cell = p["RNN_0"]["GRUCell_0"]
-        Wi = np.concatenate(
-            [np.asarray(cell[g]["kernel"]).T for g in ("ir", "iz", "in")], axis=0
-        )  # (3H, I)
-        Wh = np.concatenate(
-            [np.asarray(cell[g]["kernel"]).T for g in ("hr", "hz", "hn")], axis=0
-        )
-        bi = np.concatenate([np.asarray(cell[g]["bias"]) for g in ("ir", "iz", "in")])
-        H = RNN_UNITS
-        bh = np.zeros(3 * H, np.float32)
-        bh[2 * H :] = np.asarray(cell["hn"]["bias"])
-        twin.gru.weight_ih_l0.copy_(torch.from_numpy(Wi.copy()))
-        twin.gru.weight_hh_l0.copy_(torch.from_numpy(Wh.copy()))
-        twin.gru.bias_ih_l0.copy_(torch.from_numpy(bi))
-        twin.gru.bias_hh_l0.copy_(torch.from_numpy(bh))
+        # + bin + r*(h·Whn + bhn)) — mapping in _copy_gru_weights.
+        _copy_gru_weights(p["RNN_0"]["GRUCell_0"], twin.gru, RNN_UNITS)
 
         ff = p["FF_0"]["Dense_0"]
         twin.ff.weight.copy_(torch.from_numpy(np.asarray(ff["kernel"]).T.copy()))
@@ -136,6 +136,38 @@ def test_crnn_matches_torch_twin():
     np.testing.assert_allclose(ours, theirs, atol=2e-5)
 
 
+def test_rnn_mask_family_matches_torch_twin():
+    """The 2-D RNN architecture ('rnn' archi path, no convs): stacked GRUs
+    + sigmoid FF against the torch equivalent at identical weights."""
+    import jax
+
+    from disco_tpu.nn.crnn import RNNMask
+
+    WIN2, FEAT, H1, H2, OUT = 11, 20, 12, 8, 20
+    model = RNNMask(
+        input_shape=(WIN2, FEAT), rnn_units=(H1, H2), rnn_cell="gru",
+        ff_units=(OUT,), ff_activation="sigmoid",
+    )
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((3, WIN2, FEAT)).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(2), x)
+    ours = np.asarray(model.apply(variables, x, train=False))
+
+    g1 = torch.nn.GRU(FEAT, H1, batch_first=True)
+    g2 = torch.nn.GRU(H1, H2, batch_first=True)
+    ff = torch.nn.Linear(H2, OUT)
+    with torch.no_grad():
+        _copy_gru_weights(variables["params"]["RNN_0"]["GRUCell_0"], g1, H1)
+        _copy_gru_weights(variables["params"]["RNN_0"]["GRUCell_1"], g2, H2)
+        ffp = variables["params"]["FF_0"]["Dense_0"]
+        ff.weight.copy_(torch.from_numpy(np.asarray(ffp["kernel"]).T.copy()))
+        ff.bias.copy_(torch.from_numpy(np.asarray(ffp["bias"])))
+        h, _ = g1(torch.from_numpy(x))
+        h, _ = g2(h)
+        theirs = torch.sigmoid(ff(h)).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-5)
+
+
 def test_gru_gate_convention_matches_torch():
     """Isolated single-layer GRU parity over a long sequence: the gate
     formulas (reset applied to the projected hidden state, matching torch)
@@ -153,16 +185,7 @@ def test_gru_gate_convention_matches_torch():
     ours = np.asarray(rnn.apply(variables, jnp.asarray(x)))
 
     tg = torch.nn.GRU(I, H, batch_first=True)
-    cellp = variables["params"]["cell"]
+    _copy_gru_weights(variables["params"]["cell"], tg, H)
     with torch.no_grad():
-        Wi = np.concatenate([np.asarray(cellp[g]["kernel"]).T for g in ("ir", "iz", "in")], 0)
-        Wh = np.concatenate([np.asarray(cellp[g]["kernel"]).T for g in ("hr", "hz", "hn")], 0)
-        bi = np.concatenate([np.asarray(cellp[g]["bias"]) for g in ("ir", "iz", "in")])
-        bh = np.zeros(3 * H, np.float32)
-        bh[2 * H :] = np.asarray(cellp["hn"]["bias"])
-        tg.weight_ih_l0.copy_(torch.from_numpy(Wi.copy()))
-        tg.weight_hh_l0.copy_(torch.from_numpy(Wh.copy()))
-        tg.bias_ih_l0.copy_(torch.from_numpy(bi))
-        tg.bias_hh_l0.copy_(torch.from_numpy(bh))
         theirs = tg(torch.from_numpy(x))[0].numpy()
     np.testing.assert_allclose(ours, theirs, atol=1e-5)
