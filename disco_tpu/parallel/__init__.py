@@ -3,6 +3,7 @@ from disco_tpu.parallel.mesh import (
     make_mesh,
     make_mesh_2d,
     node_sharding,
+    tango_batch_sharded,
     tango_frame_sharded,
     tango_sharded,
 )
@@ -15,6 +16,7 @@ __all__ = [
     "node_sharding",
     "tango_sharded",
     "tango_frame_sharded",
+    "tango_batch_sharded",
     "distributed_init",
     "hybrid_mesh",
 ]

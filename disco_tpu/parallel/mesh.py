@@ -231,6 +231,55 @@ def tango_frame_sharded(
     )
 
 
+@partial(
+    jax.jit,
+    static_argnames=("mesh", "policy", "ref_mic", "mask_type", "solver"),
+)
+def tango_batch_sharded(
+    Yb,
+    Sb,
+    Nb,
+    masks_z_b,
+    mask_w_b,
+    mesh: Mesh,
+    mu: float = 1.0,
+    policy="local",
+    ref_mic: int = 0,
+    mask_type: str = "irm1",
+    solver: str = "eigh",
+) -> TangoResult:
+    """Corpus-scale TANGO on a (batch, node) mesh via GSPMD auto-partitioning:
+    clips shard over 'batch' (the reference's ``--rirs`` data parallelism as a
+    MESH axis instead of a process array), nodes over 'node'.
+
+    Unlike :func:`tango_sharded` (explicit shard_map + all_gather), this is
+    the sharding-annotation formulation: the batched single-device program
+    ``vmap(tango)`` runs under sharding CONSTRAINTS on its operands and
+    outputs, and XLA inserts the node-axis collectives for the z-exchange
+    itself — the "pick a mesh, annotate shardings, let the compiler place
+    collectives" recipe.  Semantically identical to ``vmap(tango)`` on one
+    device (tests/test_parallel.py); compiled once per (mesh, policy, ...)
+    combination like the sibling shard_map pipelines.
+
+    Args:
+      Yb, Sb, Nb: (B, K, C, F, T) STFT stacks; B divisible by the 'batch'
+        mesh size, K by 'node'.
+      masks_z_b, mask_w_b: (B, K, F, T).
+    """
+    from disco_tpu.enhance.tango import tango
+
+    sh = NamedSharding(mesh, P("batch", "node"))  # trailing dims replicated
+    constrain = lambda t: jax.lax.with_sharding_constraint(t, sh)
+    Yb, Sb, Nb, masks_z_b, mask_w_b = map(constrain, (Yb, Sb, Nb, masks_z_b, mask_w_b))
+    res = jax.vmap(
+        lambda Y, S, N, mz, mw: tango(
+            Y, S, N, mz, mw, mu=mu, policy=policy, ref_mic=ref_mic,
+            mask_type=mask_type, solver=solver,
+        )
+    )(Yb, Sb, Nb, masks_z_b, mask_w_b)
+    return jax.tree_util.tree_map(constrain, res)
+
+
 def mesh_from_config(cfg) -> Mesh:
     """Build the mesh described by a :class:`disco_tpu.config.MeshConfig`
     (or the root config's ``.mesh``): node-only, node x frame, or the
