@@ -77,6 +77,34 @@ def test_corrupt_chunk_size_is_an_error_not_a_crash(tmp_path):
         fastwav.read_wavs_batch([good, bad])
 
 
+def test_fuzzed_garbage_never_crashes(tmp_path, rng):
+    """Random bytes — truncated headers, bogus chunk ids, mid-chunk EOFs —
+    must surface as the RuntimeError contract, never a native crash."""
+    good = tmp_path / "anchor.wav"
+    write_wav(good, np.zeros(256), FS, subtype="PCM_16")
+    template = bytearray(good.read_bytes())
+    for i in range(40):
+        raw = bytearray(template)
+        kind = i % 4
+        if kind == 0:  # pure noise
+            raw = bytearray(rng.integers(0, 256, rng.integers(1, 200), dtype=np.uint8).tobytes())
+        elif kind == 1:  # truncate anywhere
+            raw = raw[: int(rng.integers(1, len(raw)))]
+        elif kind == 2:  # flip random bytes in the header region
+            for _ in range(4):
+                raw[int(rng.integers(0, min(64, len(raw))))] = int(rng.integers(0, 256))
+        else:  # random chunk-size fields
+            raw[4:8] = rng.integers(0, 256, 4, dtype=np.uint8).tobytes()
+        bad = tmp_path / f"fuzz_{i}.wav"
+        bad.write_bytes(bytes(raw))
+        try:
+            batch, _ = fastwav.read_wavs_batch([good, bad])
+            # a mutation may leave a decodable file — fine, but finite
+            assert np.isfinite(batch).all()
+        except RuntimeError:
+            pass  # the documented failure contract
+
+
 def test_empty_batch_raises():
     with pytest.raises(ValueError, match="empty"):
         fastwav.read_wavs_batch([])
