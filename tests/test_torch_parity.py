@@ -44,19 +44,21 @@ def _build_flax():
     return model, variables
 
 
-def _copy_gru_weights(cell_params, torch_gru, hidden: int):
+def _copy_gru_weights(cell_params, torch_gru, hidden: int, suffix: str = ""):
     """flax GRUCell params -> torch GRU layer-0 weights: rows ordered
-    [r, z, n]; flax has no hidden-side r/z biases (zeroed in torch)."""
+    [r, z, n]; flax has no hidden-side r/z biases (zeroed in torch).
+    ``suffix="_reverse"`` targets the reverse direction of a bidirectional
+    torch GRU."""
     with torch.no_grad():
         Wi = np.concatenate([np.asarray(cell_params[g]["kernel"]).T for g in ("ir", "iz", "in")], 0)
         Wh = np.concatenate([np.asarray(cell_params[g]["kernel"]).T for g in ("hr", "hz", "hn")], 0)
         bi = np.concatenate([np.asarray(cell_params[g]["bias"]) for g in ("ir", "iz", "in")])
         bh = np.zeros(3 * hidden, np.float32)
         bh[2 * hidden :] = np.asarray(cell_params["hn"]["bias"])
-        torch_gru.weight_ih_l0.copy_(torch.from_numpy(Wi.copy()))
-        torch_gru.weight_hh_l0.copy_(torch.from_numpy(Wh.copy()))
-        torch_gru.bias_ih_l0.copy_(torch.from_numpy(bi))
-        torch_gru.bias_hh_l0.copy_(torch.from_numpy(bh))
+        getattr(torch_gru, f"weight_ih_l0{suffix}").copy_(torch.from_numpy(Wi.copy()))
+        getattr(torch_gru, f"weight_hh_l0{suffix}").copy_(torch.from_numpy(Wh.copy()))
+        getattr(torch_gru, f"bias_ih_l0{suffix}").copy_(torch.from_numpy(bi))
+        getattr(torch_gru, f"bias_hh_l0{suffix}").copy_(torch.from_numpy(bh))
 
 
 class _TorchTwin(torch.nn.Module):
@@ -165,6 +167,30 @@ def test_rnn_mask_family_matches_torch_twin():
         h, _ = g1(torch.from_numpy(x))
         h, _ = g2(h)
         theirs = torch.sigmoid(ff(h)).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-5)
+
+
+def test_bidirectional_rnn_matches_torch():
+    """The rnn_bi path: our [forward ‖ backward] concat equals torch's
+    bidirectional GRU output layout at identical weights."""
+    import jax
+    import jax.numpy as jnp
+
+    from disco_tpu.nn.bricks import RNN
+
+    I, H, T = 6, 5, 30
+    brick = RNN(features=(H,), cell_type="gru", bidirectional=True)
+    rng = np.random.default_rng(8)
+    x = rng.standard_normal((2, T, I)).astype(np.float32)
+    variables = brick.init(jax.random.PRNGKey(4), jnp.asarray(x))
+    ours = np.asarray(brick.apply(variables, jnp.asarray(x)))
+
+    tg = torch.nn.GRU(I, H, batch_first=True, bidirectional=True)
+    p = variables["params"]
+    _copy_gru_weights(p["GRUCell_0"], tg, H)
+    _copy_gru_weights(p["GRUCell_1"], tg, H, suffix="_reverse")
+    with torch.no_grad():
+        theirs = tg(torch.from_numpy(x))[0].numpy()
     np.testing.assert_allclose(ours, theirs, atol=1e-5)
 
 
