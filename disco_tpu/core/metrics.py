@@ -288,15 +288,17 @@ def _remove_silent_frames(x, y, dyn_range=_STOI_DYN, win=_STOI_WIN, hop=_STOI_HO
     xf, yf = xf[keep], yf[keep]
     n_kept = xf.shape[0]
     out_len = (n_kept - 1) * hop + win if n_kept else 0
-    xs, ys, wsum = np.zeros(out_len), np.zeros(out_len), np.zeros(out_len)
+    # vectorized overlap-add: scatter every (frame, tap) into its output
+    # position in one ufunc pass (the per-frame Python loop was a measured
+    # corpus-scoring hot spot)
+    idx = (hop * np.arange(n_kept)[:, None] + np.arange(win)[None, :]).ravel()
     w = np.hanning(win + 2)[1:-1]
-    for i in range(n_kept):
-        sl = slice(i * hop, i * hop + win)
-        xs[sl] += xf[i]
-        ys[sl] += yf[i]
-        wsum[sl] += w
+    xs, ys, wsum = np.zeros(out_len), np.zeros(out_len), np.zeros(out_len)
+    np.add.at(xs, idx, xf.ravel())
+    np.add.at(ys, idx, yf.ravel())
+    np.add.at(wsum, idx, np.broadcast_to(w, (n_kept, win)).ravel())
     wsum[wsum == 0] = 1.0
-    return xs / wsum * 1.0, ys / wsum * 1.0
+    return xs / wsum, ys / wsum
 
 
 def _resample_to_10k(x, fs):
