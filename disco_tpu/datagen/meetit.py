@@ -122,7 +122,7 @@ def simulate_meetit_room(
     )
     images = np.asarray(fft_convolve(sources[:, None, :], rirs, out_len=L))  # (S, M, L)
 
-    bounds = np.concatenate([[0], np.cumsum(mics_per_node)])
+    bounds = node_channel_bounds(mics_per_node)
     sirs = np.zeros(len(mics_per_node))
     for src in range(n_sources):
         local_target = images[src, bounds[src] : bounds[src + 1]]
@@ -244,6 +244,14 @@ def generate_meetit_rirs(
     return generated
 
 
+def node_channel_bounds(mics_per_node) -> np.ndarray:
+    """Cumulative channel offsets per node: node k's channels are
+    ``bounds[k]..bounds[k+1]-1`` (1-based file channel = offset + 1), and its
+    reference mic is the first — THE mapping shared by the sample loader and
+    every consumer scoring against per-channel artifacts."""
+    return np.concatenate([[0], np.cumsum(mics_per_node)])
+
+
 def load_meetit_sample(layout: DatasetLayout, rir_id: int, mics_per_node):
     """Load one generated MEETIT sample back from disk: the per-channel
     mixture STFTs and per-source IRMs written by :func:`generate_meetit_rirs`,
@@ -256,7 +264,7 @@ def load_meetit_sample(layout: DatasetLayout, rir_id: int, mics_per_node):
     M = int(np.sum(mics_per_node))
     mix = np.stack([np.load(base / "stft" / "mix" / f"{rir_id}_Ch-{ch + 1}.npy") for ch in range(M)])
     n_src = len(mics_per_node)
-    bounds = np.concatenate([[0], np.cumsum(mics_per_node)])
+    bounds = node_channel_bounds(mics_per_node)
     K = len(mics_per_node)
     Y = np.stack([mix[bounds[k] : bounds[k + 1]] for k in range(K)])  # (K, C, F, T)
     masks = np.stack(
