@@ -217,7 +217,46 @@ def bench_numpy(dur_s=2.0):
     return K * dur_s / dt
 
 
+def _start_watchdog(timeout_s: float):
+    """Emit a diagnostic JSON line and exit if the bench makes no progress.
+
+    The tunneled chip attachment claims the device at first jax use and
+    BLOCKS INDEFINITELY while another (possibly dead) holder keeps the
+    claim — observed wedged for hours after a killed process.  Without
+    this, a wedged chip turns the bench record into silence; with it, the
+    record says what happened.  Disable with BENCH_WATCHDOG_S=0.
+    """
+    import threading
+
+    done = threading.Event()
+
+    def fire():
+        if not done.wait(timeout_s):
+            print(
+                json.dumps(
+                    {
+                        "metric": "rtf_8node_mwf_enhancement",
+                        "value": None,
+                        "unit": "x_realtime",
+                        "error": f"bench did not complete within BENCH_WATCHDOG_S={timeout_s:.0f}s. "
+                                 "On the tunneled TPU the usual cause is a wedged device "
+                                 "attachment (chip claim held by a dead process blocks the "
+                                 "first jax use indefinitely — see README/verify notes); a "
+                                 "legitimately slow run (CPU backend, raised BENCH_* knobs) "
+                                 "needs a larger BENCH_WATCHDOG_S.",
+                    }
+                ),
+                flush=True,
+            )
+            os._exit(3)
+
+    threading.Thread(target=fire, daemon=True).start()
+    return done
+
+
 def main():
+    timeout_s = float(os.environ.get("BENCH_WATCHDOG_S", 1800))
+    done = _start_watchdog(timeout_s) if timeout_s > 0 else None
     # BENCH_BATCH / BENCH_DUR_S / BENCH_ITERS override the workload size
     # (defaults are the headline config; smaller values for CPU smoke tests).
     r = bench_jax(
@@ -225,6 +264,8 @@ def main():
         dur_s=float(os.environ.get("BENCH_DUR_S", 10.0)),
         iters=int(os.environ.get("BENCH_ITERS", 5)),
     )
+    if done is not None:
+        done.set()
     try:
         rtf_np = bench_numpy()
     except Exception:
