@@ -411,6 +411,7 @@ def enhance_rirs_batched(
     z_sigs: str = "zs_hat",
     solver: str = "eigh",
     score_workers: int = 4,
+    mesh=None,
 ):
     """Corpus-scale enhancement: many RIRs per jitted launch.
 
@@ -429,6 +430,13 @@ def enhance_rirs_batched(
     a thread pool so chunk N's metrics overlap chunk N+1's decode + device
     launch; only one chunk of futures is in flight (memory bound), and 1
     means inline scoring.  The metric math is identical either way.
+
+    ``mesh``: optional (batch, node) ``jax.sharding.Mesh`` — each chunk
+    then runs as ``disco_tpu.parallel.tango_batch_sharded`` (clips over
+    'batch', nodes over 'node', GSPMD-placed collectives) instead of the
+    single-device vmap; ``max_batch`` must be divisible by the mesh's
+    'batch' size and ``n_nodes`` by its 'node' size.  Results are
+    identical (tests/test_driver.py).
 
     Returns {rir: results dict} for the RIRs actually processed
     (already-done ones are skipped — same idempotency contract).
@@ -456,22 +464,39 @@ def enhance_rirs_batched(
         Lp = bucket_length(L, bucket) if bucket else L
         groups.setdefault(Lp, []).append((rir, out, layout))
 
-    @partial(jax.jit, static_argnames=())
-    def run_batch(Yb, Sb, Nb):
-        def one(Y, S, N):
-            m = oracle_masks(S, N, mask_type)
-            return tango(Y, S, N, m, m, mu=mu, policy=policy, mask_type=mask_type,
-                         solver=solver)
+    if mesh is not None:
+        from disco_tpu.parallel import tango_batch_sharded
 
-        return jax.vmap(one)(Yb, Sb, Nb)
+        # jitted ONCE (not per chunk — a fresh lambda per call would defeat
+        # the jit cache and re-compile the mask program every chunk)
+        oracle_mask_fn = jax.jit(jax.vmap(partial(oracle_masks, mask_type=mask_type)))
 
-    @partial(jax.jit, static_argnames=())
-    def run_batch_with_masks(Yb, Sb, Nb, Mz, Mw):
-        def one(Y, S, N, mz, mw):
-            return tango(Y, S, N, mz, mw, mu=mu, policy=policy, mask_type=mask_type,
-                         solver=solver)
+        def run_batch_with_masks(Yb, Sb, Nb, Mz, Mw):
+            return tango_batch_sharded(
+                Yb, Sb, Nb, Mz, Mw, mesh, mu=mu, policy=policy,
+                mask_type=mask_type, solver=solver,
+            )
 
-        return jax.vmap(one)(Yb, Sb, Nb, Mz, Mw)
+        def run_batch(Yb, Sb, Nb):
+            Mb = oracle_mask_fn(Sb, Nb)
+            return run_batch_with_masks(Yb, Sb, Nb, Mb, Mb)
+    else:
+        @partial(jax.jit, static_argnames=())
+        def run_batch(Yb, Sb, Nb):
+            def one(Y, S, N):
+                m = oracle_masks(S, N, mask_type)
+                return tango(Y, S, N, m, m, mu=mu, policy=policy, mask_type=mask_type,
+                             solver=solver)
+
+            return jax.vmap(one)(Yb, Sb, Nb)
+
+        @partial(jax.jit, static_argnames=())
+        def run_batch_with_masks(Yb, Sb, Nb, Mz, Mw):
+            def one(Y, S, N, mz, mw):
+                return tango(Y, S, N, mz, mw, mu=mu, policy=policy, mask_type=mask_type,
+                             solver=solver)
+
+            return jax.vmap(one)(Yb, Sb, Nb, Mz, Mw)
 
     from concurrent.futures import ThreadPoolExecutor
 
