@@ -47,6 +47,11 @@ def build_parser():
                         "'power'/'power:N' (dominant-pair power iteration; "
                         "streaming mode needs ~power:96 for eigh-level quality), "
                         "'jacobi' or 'jacobi-pallas' (fixed-sweep cyclic Jacobi)")
+    p.add_argument("--mesh", nargs=2, type=int, default=None, metavar=("BATCH", "NODE"),
+                   help="--rirs mode only: run each chunk on a (BATCH, NODE) device "
+                        "mesh (clips sharded over 'batch', nodes over 'node', "
+                        "GSPMD-placed collectives); needs BATCH*NODE devices and "
+                        "--batch_size divisible by BATCH")
     return p
 
 
@@ -82,11 +87,34 @@ def main(argv=None):
         _load_model(args.mods[0], archi=args.archi),
         _load_model(args.mods[1], archi=args.archi, n_ch=n_ch2),
     )
+    if args.mesh is not None and args.rirs is None:
+        raise SystemExit("--mesh needs batched corpus mode (--rirs)")
     if args.rirs is not None:
         if args.streaming:
             raise SystemExit("--streaming needs per-RIR mode (--rir)")
         from disco_tpu.enhance.driver import enhance_rirs_batched
 
+        mesh = None
+        if args.mesh is not None:
+            import jax
+
+            from disco_tpu.parallel import make_mesh
+
+            n_batch, n_node = args.mesh
+            n_dev = len(jax.devices())
+            if n_batch * n_node > n_dev:
+                raise SystemExit(
+                    f"--mesh {n_batch} {n_node} needs {n_batch * n_node} devices; "
+                    f"{n_dev} available"
+                )
+            if args.batch_size % n_batch:
+                raise SystemExit(
+                    f"--batch_size {args.batch_size} must be divisible by the mesh "
+                    f"batch axis ({n_batch})"
+                )
+            if 4 % n_node:  # the DISCO array has 4 nodes (tango.py:30)
+                raise SystemExit(f"the 4-node array is not divisible over {n_node} mesh nodes")
+            mesh = make_mesh(n_batch=n_batch, n_node=n_node)
         results = enhance_rirs_batched(
             args.dataset, args.scenario, range(args.rirs[0], args.rirs[0] + args.rirs[1]),
             args.noise, save_dir=args.sav_dir, snr_range=tuple(args.snr),
@@ -94,7 +122,7 @@ def main(argv=None):
             bucket=8192 if args.bucket is None else args.bucket,
             max_batch=args.batch_size, models=models,
             z_sigs=args.zsigs[0] if len(args.zsigs) == 1 else "zs&zn",
-            solver=args.solver,
+            solver=args.solver, mesh=mesh,
         )
         print(f"{len(results)} RIRs enhanced (batched)")
         return results
