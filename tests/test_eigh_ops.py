@@ -64,6 +64,18 @@ def test_jacobi_diagonal_and_degenerate(rng):
     _check_eigpairs(A, lam, V)
 
 
+def test_jacobi_trivial_sizes(rng):
+    """C=1 (no rotation pairs) returns the diagonal; a single matrix (no
+    batch dims) works too."""
+    A = np.array([[[3.5 + 0j]]], np.complex64)
+    lam, V = eigh_jacobi(A)
+    np.testing.assert_allclose(np.asarray(lam), [[3.5]], atol=1e-7)
+    np.testing.assert_allclose(np.asarray(V), [[[1.0]]], atol=1e-7)
+    A2 = _random_hermitian(rng, 1, 3)[0]  # (3, 3), no batch axis
+    lam2, V2 = eigh_jacobi(A2)
+    _check_eigpairs(A2[None], np.asarray(lam2)[None], np.asarray(V2)[None])
+
+
 def test_jacobi_batched_leading_axes(rng):
     """Arbitrary leading batch axes, as used by the (node, freq) filter bank."""
     A = _random_hermitian(rng, 6, 4).reshape(2, 3, 4, 4)
