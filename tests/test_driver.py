@@ -6,7 +6,7 @@ import numpy as np
 import pytest
 
 from disco_tpu.enhance.driver import aggregate_results, enhance_rir
-from disco_tpu.io import DatasetLayout, write_wav
+from disco_tpu.io import DatasetLayout, read_wav, write_wav
 
 FS = 16000
 K, C = 4, 4
@@ -15,14 +15,15 @@ NOISE = "ssn"
 SNR_RANGE = (0, 6)
 
 
-def _build_corpus(root, rirs):
-    """A 2-second synthetic processed corpus for the given RIR ids: a
-    coherent target across mics + diffuse noise, plus dry refs and the SNR
-    log per RIR."""
+def _build_corpus(root, rirs, lengths=None):
+    """A synthetic processed corpus for the given RIR ids (2 s clips unless
+    per-RIR ``lengths`` is given): a coherent target across mics + diffuse
+    noise, plus dry refs and the SNR log per RIR."""
     rng = np.random.default_rng(7)
     layout = DatasetLayout(str(root), "living", "test")
-    L = 2 * FS
+    lengths = dict(zip(rirs, lengths)) if lengths is not None else {}
     for rir in rirs:
+        L = lengths.get(rir, 2 * FS)
         src = 0.2 * rng.standard_normal(L)  # broadband speech-like source
         for node in range(K):
             for c in range(C):
@@ -238,6 +239,31 @@ def test_enhance_rirs_batched(processed_corpus, tmp_path):
         str(processed_corpus), "living", [RIR], NOISE,
         snr_range=SNR_RANGE, out_root=str(out_root), save_fig=False,
     ) == {}
+
+
+def test_enhance_rirs_batched_ragged_lengths(tmp_path):
+    """A ragged corpus (clip lengths landing in two different buckets) is
+    grouped into one compiled program per bucket, padded clips are trimmed
+    back to their true lengths, and every RIR is scored and persisted."""
+    from disco_tpu.enhance.driver import enhance_rirs_batched
+
+    rirs = [RIR, RIR + 1, RIR + 2]
+    # bucket_length(.., 8192): 32000->32768 alone; 33000 and 40000 BOTH ->
+    # 40960, so one compiled batch holds two clips of different true lengths
+    # and each must be trimmed to its own L
+    lengths = [2 * FS, 33000, 40000]
+    corpus = _build_corpus(tmp_path / "ragged", rirs, lengths=lengths)
+    out_root = tmp_path / "res"
+    results = enhance_rirs_batched(
+        str(corpus), "living", rirs, NOISE, snr_range=SNR_RANGE,
+        out_root=str(out_root), save_fig=False, bucket=8192, max_batch=2,
+    )
+    assert set(results) == set(rirs)
+    for rir, L in zip(rirs, lengths):
+        # enhanced WAV trimmed to the true clip length (padding removed)
+        wav = read_wav(out_root / "WAV" / str(rir) / f"out_mix-{NOISE}_Node-1.wav")[0]
+        assert len(wav) == L, (rir, len(wav), L)
+        assert np.all(results[rir]["sdr_cnv"] > results[rir]["sdr_in_cnv"])
 
 
 def test_enhance_rirs_batched_score_workers_identical(tmp_path):
