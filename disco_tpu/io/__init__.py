@@ -1,4 +1,5 @@
 from disco_tpu.io.audio import read_wav, write_wav
+from disco_tpu.io.fastwav import read_wavs_batch
 from disco_tpu.io.layout import DatasetLayout
 
-__all__ = ["read_wav", "write_wav", "DatasetLayout"]
+__all__ = ["read_wav", "read_wavs_batch", "write_wav", "DatasetLayout"]
