@@ -38,6 +38,15 @@ def test_partial_dict_applies_defaults():
     cfg = config_from_dict({"stft": {"n_fft": 256}})
     assert cfg.stft.n_fft == 256
     assert cfg.stft.hop == 256  # default preserved
+
+
+def test_enhance_solver_field_roundtrips(tmp_path):
+    """The round-2 solver spec survives dict construction and YAML I/O."""
+    cfg = config_from_dict({"enhance": {"solver": "power:24"}})
+    assert cfg.enhance.solver == "power:24"
+    assert cfg.enhance.filter_type == "gevd"  # defaults preserved
+    back = load_config(save_config(cfg, tmp_path / "s.yaml"))
+    assert back.enhance.solver == "power:24"
     assert cfg.array.n_nodes == 4
 
 
