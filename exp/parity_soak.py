@@ -1,0 +1,109 @@
+"""Randomized end-to-end TANGO soak vs the float64 NumPy oracle.
+
+The suite pins parity on fixed scenes; this sweep draws random (K, C, L,
+noise level, mask type, policy) configurations and compares per-node
+SI-SDR between the jitted pipeline and ``tests/reference_impls.tango_np``.
+
+The contract is ONE-SIDED (fail only when ours lands BELOW the oracle by
+more than ``tol``): binary (ibm) masks routinely produce rank-deficient
+noise statistics whose GEVD eigenvector selection is legitimately
+solver-sensitive — measured on random scenes, our whitened-eigh +
+diagonal-loading + e1-fallback pipeline is never worse and is sometimes
+BETTER than the reference formulation by up to ~1 dB, and it stays finite
+on degenerate bins where the float64 scipy path emits NaN.  Graded (irm)
+masks agree two-sidedly to <0.15 dB.
+
+Usage: PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python exp/parity_soak.py [--n 10]
+Prints one line per configuration and a final PASS/FAIL summary.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+# Force the CPU backend regardless of the environment: the image exports
+# JAX_PLATFORMS=axon, under which a bare run would claim (and, if
+# interrupted, wedge) the single tunneled TPU chip for a CPU-bound soak.
+# The sitecustomize may have imported jax already, so set the config too
+# (the conftest.py pattern).
+os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except RuntimeError:
+    pass  # backend already initialised by the caller — respect their choice
+
+
+def run(n_configs: int = 10, seed: int = 0, tol_db: float = 0.15) -> int:
+    from disco_tpu.core.dsp import istft, stft
+    from disco_tpu.core.metrics import si_sdr
+    from disco_tpu.enhance import oracle_masks, tango
+    from tests.reference_impls import istft_np, si_sdr_np, tango_np
+
+    rng = np.random.default_rng(seed)
+    failures = 0
+    for i in range(n_configs):
+        K = int(rng.integers(2, 5))
+        C = int(rng.integers(2, 4))
+        L = int(rng.integers(12000, 40000))
+        noise_scale = float(rng.uniform(0.3, 1.2))
+        mask_type = rng.choice(["irm1", "irm2", "ibm1"])
+        policy = rng.choice(["local", "none"])
+
+        src = rng.standard_normal(L)
+        s = np.stack([
+            np.stack([np.convolve(src, rng.standard_normal(8) * 0.5, mode="same") for _ in range(C)])
+            for _ in range(K)
+        ])
+        n = noise_scale * rng.standard_normal((K, C, L))
+        y = s + n
+
+        want = tango_np(y, s, n, mask_type=mask_type, mask_for_z=policy if policy == "local" else None)
+        Y, S, N = stft(y), stft(s), stft(n)
+        masks = oracle_masks(S, N, mask_type)
+        res = tango(Y, S, N, masks, masks, policy=policy, mask_type=mask_type)
+
+        worst_deficit = 0.0  # how far ours falls BELOW the oracle
+        best_surplus = 0.0
+        oracle_nans = 0
+        ours_bad = False
+        for k in range(K):
+            ours_sdr = float(si_sdr(s[k, 0], np.asarray(istft(res.yf[k], L), np.float64)))
+            oracle_sdr = float(si_sdr_np(s[k, 0], istft_np(want["yf"][k], L)))
+            if not np.isfinite(ours_sdr):
+                ours_bad = True
+            if not np.isfinite(oracle_sdr):
+                oracle_nans += 1  # ours must stay finite where the oracle blows up
+                continue
+            worst_deficit = max(worst_deficit, oracle_sdr - ours_sdr)
+            best_surplus = max(best_surplus, ours_sdr - oracle_sdr)
+        ok = (worst_deficit < tol_db) and not ours_bad
+        failures += not ok
+        print(
+            f"[{i:02d}] K={K} C={C} L={L} noise={noise_scale:.2f} {mask_type}/{policy}: "
+            f"deficit {worst_deficit:.4f} dB, surplus {best_surplus:.4f} dB"
+            + (f", oracle NaN at {oracle_nans} node(s)" if oracle_nans else "")
+            + f" {'ok' if ok else 'FAIL'}",
+            flush=True,
+        )
+    print(
+        f"{'PASS' if failures == 0 else 'FAIL'}: {n_configs - failures}/{n_configs} configs "
+        f"at or above the oracle within {tol_db} dB"
+    )
+    return failures
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=10)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--tol", type=float, default=0.15)
+    args = p.parse_args()
+    raise SystemExit(1 if run(args.n, args.seed, args.tol) else 0)
