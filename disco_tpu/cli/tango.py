@@ -42,7 +42,10 @@ def build_parser():
                    help="round clip lengths up to this many samples to cap "
                         "recompiles on ragged corpora (0 = off; ~2 dB boundary "
                         "effect; default: off for --rir, 8192 for --rirs)")
-    p.add_argument("--solver", type=solver_spec, default="eigh",
+    p.add_argument("--config", default=None,
+                   help="YAML config file (config.save_config layout); its "
+                        "enhance.solver becomes the --solver default")
+    p.add_argument("--solver", type=solver_spec, default=None,
                    help="rank-1 GEVD solver: 'eigh' (batched eigendecomposition), "
                         "'power'/'power:N' (dominant-pair power iteration; "
                         "streaming mode needs ~power:96 for eigh-level quality), "
@@ -74,8 +77,25 @@ def _load_model(path, archi: str = "crnn", n_ch: int = 1):
     return (model, {"params": state.params, "batch_stats": state.batch_stats})
 
 
+def resolve_solver(args):
+    """Solver precedence: explicit --solver > YAML enhance.solver from
+    --config > the EnhanceConfig dataclass default (config.py)."""
+    if args.solver is not None:
+        return args.solver
+    import argparse as _argparse
+
+    from disco_tpu.config import EnhanceConfig, load_config
+
+    cfg_enh = load_config(args.config).enhance if args.config else EnhanceConfig()
+    try:
+        return solver_spec(cfg_enh.solver)
+    except _argparse.ArgumentTypeError as e:
+        raise SystemExit(f"--config {args.config}: enhance.solver: {e}")
+
+
 def main(argv=None):
     args = build_parser().parse_args(argv)
+    args.solver = resolve_solver(args)
     if args.rir is None and args.rirs is None:
         raise SystemExit("one of --rir or --rirs is required")
     policy = none_str(args.mask_z) or "none"

@@ -62,7 +62,11 @@ class EnhanceConfig:
     mu: float = 1.0
     filter_type: str = "gevd"
     rank: int = 1
-    solver: str = "eigh"  # rank-1 GEVD solver: 'eigh' | 'power'
+    # rank-1 GEVD solver spec: 'eigh' | 'power' | 'power:N' | 'jacobi' |
+    # 'jacobi-pallas' (beam.filters.rank1_gevd).  The TANGO CLI resolves
+    # its solver as: explicit --solver > enhance.solver from a --config
+    # YAML > this default (cli/tango.py main()).
+    solver: str = "eigh"
     stft_clip: tuple = (1e-6, 1e3)
     frames_lost: int = 6  # conv-cropped frames of the CRNN (utils.py:10)
 

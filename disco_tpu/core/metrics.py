@@ -346,6 +346,20 @@ def stoi(x, y, fs_sig, extended: bool = False):
             n_seg += 1
         return d_sum / n_seg
 
+    d_sum, n_seg = _stoi_corr_sum(Xb, Yb)
+    return d_sum / (n_seg * _STOI_NBANDS)
+
+
+def _stoi_corr_sum(Xb, Yb):
+    """Sum over sliding 30-frame segments of the per-band clipped envelope
+    correlations (the inner loop of Taal et al. 2011, eqs. 4-6), given the
+    (bands, frames) third-octave envelope matrices.
+
+    Factored out so the correlation machinery can be anchored analytically
+    on hand-built envelopes (tests/test_analytic_anchors.py) independent of
+    the framing/FFT front end.  Returns (d_sum, n_segments)."""
+    eps = np.finfo(np.float64).eps
+    n_frames = Xb.shape[1]
     beta_clip = 10.0 ** (-_STOI_BETA / 20.0)
     d_sum, n_seg = 0.0, 0
     for m in range(_STOI_SEG, n_frames + 1):
@@ -358,4 +372,4 @@ def stoi(x, y, fs_sig, extended: bool = False):
         corr = np.sum(xm * ym, axis=1) / (np.linalg.norm(xm, axis=1) * np.linalg.norm(ym, axis=1) + eps)
         d_sum += corr.sum()
         n_seg += 1
-    return d_sum / (n_seg * _STOI_NBANDS)
+    return d_sum, n_seg

@@ -55,16 +55,18 @@ def get_lib():
             lib = ctypes.CDLL(_LIB)
         except OSError:
             return None
+        # fixed-width int64 on both sides of the ABI: the numpy buffers are
+        # int64 and C 'long' is 32-bit on LLP64 platforms (ADVICE round 2)
         lib.fast_read_wavs.restype = ctypes.c_int
         lib.fast_read_wavs.argtypes = [
             ctypes.POINTER(ctypes.c_char_p),
             ctypes.c_int,
             ctypes.POINTER(ctypes.c_float),
-            ctypes.c_long,
-            ctypes.POINTER(ctypes.c_long),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_int),
             ctypes.c_int,
-            ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_int64),
         ]
         _lib = lib
         return _lib
@@ -124,10 +126,10 @@ def read_wavs_batch(paths, n_threads: int | None = None):
         n,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         L,
-        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         fss.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
         n_threads,
-        fail.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        fail.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
     )
     if rc != 0:
         bad = int(fail[0])

@@ -12,13 +12,14 @@
 // 8 (unsigned) / 16 / 24 / 32-bit and IEEE float 32/64, plus
 // WAVE_FORMAT_EXTENSIBLE headers.  MONO files only — the corpus layout is
 // one channel per file; anything else fails the file and the Python
-// wrapper falls back to the general reader.
+// wrapper raises RuntimeError naming it (the pure-Python path is used only
+// when this library is unavailable, not as a per-file retry).
 //
 // ABI (ctypes, see disco_tpu/io/fastwav.py):
 //   int fast_read_wavs(const char** paths, int n_paths,
-//                      float* out, long slot_samples,
-//                      long* out_len, int* out_fs,
-//                      int n_threads, long* fail_idx)
+//                      float* out, int64_t slot_samples,
+//                      int64_t* out_len, int* out_fs,
+//                      int n_threads, int64_t* fail_idx)
 // Each file i is decoded into out[i*slot_samples : (i+1)*slot_samples],
 // truncated to slot_samples, zero-padded past its true length (written to
 // out_len[i]); out_fs[i] is the sample rate.  Returns 0 on success, else 1
@@ -44,8 +45,8 @@ uint32_t rd32(const unsigned char* p) {
 }
 uint16_t rd16(const unsigned char* p) { return p[0] | (p[1] << 8); }
 
-bool read_one(const char* path, float* slot, long slot_samples,
-              long* len_out, int* fs_out) {
+bool read_one(const char* path, float* slot, int64_t slot_samples,
+              int64_t* len_out, int* fs_out) {
   FILE* f = fopen(path, "rb");
   if (!f) return false;
   unsigned char hdr[12];
@@ -61,7 +62,7 @@ bool read_one(const char* path, float* slot, long slot_samples,
     fclose(f);
     return false;
   }
-  const long file_size = ftell(f);
+  const int64_t file_size = ftell(f);
   fseek(f, 12, SEEK_SET);
   uint16_t fmt_code = 0, n_ch = 0, bits = 0;
   uint32_t fs = 0;
@@ -71,7 +72,7 @@ bool read_one(const char* path, float* slot, long slot_samples,
   unsigned char ch[8];
   while (fread(ch, 1, 8, f) == 8) {
     uint32_t sz = rd32(ch + 4);
-    if ((long)sz > file_size - ftell(f)) {
+    if ((int64_t)sz > file_size - ftell(f)) {
       fclose(f);
       return false;
     }
@@ -109,35 +110,35 @@ bool read_one(const char* path, float* slot, long slot_samples,
   fclose(f);
   if (!have_fmt || data.empty() || n_ch != 1) return false;
 
-  const long bytes_per = bits / 8;
+  const int64_t bytes_per = bits / 8;
   if (bytes_per == 0) return false;
-  const long n = (long)(data.size() / bytes_per);
-  const long m = n < slot_samples ? n : slot_samples;
+  const int64_t n = (int64_t)(data.size() / bytes_per);
+  const int64_t m = n < slot_samples ? n : slot_samples;
   const unsigned char* p = data.data();
 
   if (fmt_code == kFloat && bits == 32) {
     memcpy(slot, p, m * 4);
   } else if (fmt_code == kFloat && bits == 64) {
     const double* src = reinterpret_cast<const double*>(p);
-    for (long i = 0; i < m; ++i) slot[i] = (float)src[i];
+    for (int64_t i = 0; i < m; ++i) slot[i] = (float)src[i];
   } else if (fmt_code == kPcm && bits == 8) {
-    for (long i = 0; i < m; ++i) slot[i] = ((float)p[i] - 128.0f) / 128.0f;
+    for (int64_t i = 0; i < m; ++i) slot[i] = ((float)p[i] - 128.0f) / 128.0f;
   } else if (fmt_code == kPcm && bits == 16) {
     const int16_t* src = reinterpret_cast<const int16_t*>(p);
-    for (long i = 0; i < m; ++i) slot[i] = (float)src[i] / 32768.0f;
+    for (int64_t i = 0; i < m; ++i) slot[i] = (float)src[i] / 32768.0f;
   } else if (fmt_code == kPcm && bits == 24) {
-    for (long i = 0; i < m; ++i) {
+    for (int64_t i = 0; i < m; ++i) {
       int32_t v = p[3 * i] | (p[3 * i + 1] << 8) | (p[3 * i + 2] << 16);
       v = (v ^ 0x800000) - 0x800000;  // sign-extend 24 -> 32
       slot[i] = (float)v / 8388608.0f;
     }
   } else if (fmt_code == kPcm && bits == 32) {
     const int32_t* src = reinterpret_cast<const int32_t*>(p);
-    for (long i = 0; i < m; ++i) slot[i] = (float)((double)src[i] / 2147483648.0);
+    for (int64_t i = 0; i < m; ++i) slot[i] = (float)((double)src[i] / 2147483648.0);
   } else {
     return false;
   }
-  for (long i = m; i < slot_samples; ++i) slot[i] = 0.0f;
+  for (int64_t i = m; i < slot_samples; ++i) slot[i] = 0.0f;
   *len_out = n;
   *fs_out = (int)fs;
   return true;
@@ -146,26 +147,26 @@ bool read_one(const char* path, float* slot, long slot_samples,
 }  // namespace
 
 extern "C" int fast_read_wavs(const char** paths, int n_paths, float* out,
-                              long slot_samples, long* out_len, int* out_fs,
-                              int n_threads, long* fail_idx) {
+                              int64_t slot_samples, int64_t* out_len, int* out_fs,
+                              int n_threads, int64_t* fail_idx) {
   if (n_threads < 1) n_threads = 1;
   std::atomic<int> next(0);
-  std::atomic<long> first_fail(-1);
+  std::atomic<int64_t> first_fail(-1);
 
   auto worker = [&]() {
     while (true) {
       int i = next.fetch_add(1);
       if (i >= n_paths || first_fail.load() >= 0) break;
-      long len = 0;
+      int64_t len = 0;
       int fs = 0;
       bool ok = false;
       try {
-        ok = read_one(paths[i], out + (long)i * slot_samples, slot_samples, &len, &fs);
+        ok = read_one(paths[i], out + (int64_t)i * slot_samples, slot_samples, &len, &fs);
       } catch (...) {
         ok = false;  // e.g. bad_alloc — must not escape the thread
       }
       if (!ok) {
-        long expect = -1;
+        int64_t expect = -1;
         first_fail.compare_exchange_strong(expect, i);
         break;
       }

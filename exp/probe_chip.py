@@ -1,0 +1,41 @@
+"""Minimal TPU-attachment probe (round 3).
+
+One process, one claim cycle, graceful exit either way.  Never kill this
+externally — a SIGKILL mid-attach is the suspected round-2 wedge trigger
+(ROUND2.md).  If the attachment blocks, the process just waits; when the
+chip answers it runs one fenced scalar op, prints a JSON line and exits 0.
+"""
+import json
+import sys
+import time
+
+t0 = time.time()
+try:
+    import jax
+    import jax.numpy as jnp
+
+    devs = jax.devices()
+    t_attach = time.time() - t0
+    x = jnp.asarray([1.0, 2.0])
+    t1 = time.time()
+    val = float(jnp.ravel(x + x)[0])  # 1-element readback = real fence
+    t_op = time.time() - t1
+    print(
+        json.dumps(
+            {
+                "ok": True,
+                "devices": [str(d) for d in devs],
+                "kind": devs[0].device_kind,
+                "attach_s": round(t_attach, 2),
+                "fenced_op_s": round(t_op, 3),
+                "val": val,
+            }
+        ),
+        flush=True,
+    )
+except Exception as e:
+    print(
+        json.dumps({"ok": False, "error": f"{type(e).__name__}: {e}"[:500], "after_s": round(time.time() - t0, 2)}),
+        flush=True,
+    )
+    sys.exit(1)

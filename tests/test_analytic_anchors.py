@@ -1,0 +1,221 @@
+"""Closed-form analytic anchors for the self-authored numerical oracles.
+
+Round-2 verdict (VERDICT.md "What's weak" #3): the ISM, bss_eval and STOI
+implementations were validated only against builder-authored float64 oracles
+— strong against regressions, weak against a shared misreading of the
+third-party conventions they replace (pyroomacoustics libroom, mir_eval,
+pystoi).  The tests here assert values derivable BY HAND from the published
+definitions, with the expected numbers computed inline from first
+principles (no reference_impls import):
+
+* ISM: a free-field scene has exactly one image — the direct path — whose
+  windowed-sinc taps and 1/(4*pi*d) amplitude are written out analytically;
+  a first-order room is pinned against a hand-enumerated 7-image sum.
+  (reference convolve_signals.py:84-99 delegates this to libroom)
+* bss_eval: impulse references make every delayed-span projection an exact
+  windowed selection, so SDR/SIR/SAR have closed forms; any <512-tap
+  filtering of the reference is admissible distortion and must score ~inf.
+  (reference tango.py:552-567 delegates to mir_eval)
+* STOI: perfect input scores exactly 1, the score is gain-invariant on both
+  arguments, monotone in SNR, and the segment-correlation core reproduces
+  hand-built +-1 envelope correlations.  (reference tango.py:569-578
+  delegates to pystoi)
+"""
+import math
+
+import numpy as np
+import pytest
+
+from disco_tpu.core.bss import BssEval, bss_eval_one
+from disco_tpu.core.metrics import _STOI_NBANDS, _STOI_SEG, _stoi_corr_sum, stoi
+from disco_tpu.sim.ism import C_SOUND, FDL, shoebox_rir
+
+FS = 16000
+
+
+def _hann_sinc(u: float) -> float:
+    """The libroom windowed-sinc fractional-delay tap at offset ``u`` from
+    the (fractional) delay, written from the published formula: an 81-tap
+    Hann-windowed sinc, window half-width (FDL//2)+1."""
+    half = FDL // 2
+    if abs(u) > half + 1:
+        return 0.0
+    w = 0.5 * (1.0 + math.cos(math.pi * u / (half + 1)))
+    s = 1.0 if u == 0 else math.sin(math.pi * u) / (math.pi * u)
+    return s * w
+
+
+# ------------------------------------------------------------------- ISM
+def test_ism_free_field_integer_delay_is_single_tap():
+    """alpha=1 (fully absorbing walls) leaves ONLY the direct path, and an
+    integer-sample delay collapses the windowed sinc to one tap: the RIR
+    must be exactly 1/(4*pi*d) at sample round(d*fs/c) and ~0 elsewhere."""
+    k = 100  # integer delay in samples
+    d = k * C_SOUND / FS  # 2.143 m
+    room = np.array([10.0, 10.0, 10.0])
+    src = np.array([2.0, 2.0, 2.0])
+    mic = np.array([[2.0 + d, 2.0, 2.0]])
+    rir = np.asarray(shoebox_rir(room, src, mic, alpha=1.0, max_order=20, rir_len=512))
+    amp = 1.0 / (4.0 * math.pi * d)
+    assert rir.shape == (1, 512)
+    assert rir[0, k] == pytest.approx(amp, rel=1e-6)
+    rest = rir[0].copy()
+    rest[k] = 0.0
+    # sinc at the other integer offsets is ~sin(pi*n): float32 rounding of
+    # pi*n leaves ~1e-5 relative residue, far below any physical image
+    assert np.max(np.abs(rest)) < 1e-4 * amp
+
+
+def test_ism_free_field_half_sample_delay_taps():
+    """Fractional delay: every tap of the 81-tap windowed sinc at frac=0.5
+    must equal amp * sinc(j - 0.5) * hann(j - 0.5), computed by hand."""
+    delay = 100.5
+    d = delay * C_SOUND / FS
+    room = np.array([12.0, 12.0, 12.0])
+    src = np.array([3.0, 3.0, 3.0])
+    mic = np.array([[3.0 + d, 3.0, 3.0]])
+    rir = np.asarray(shoebox_rir(room, src, mic, alpha=1.0, max_order=0, rir_len=512))
+    amp = 1.0 / (4.0 * math.pi * d)
+    half = FDL // 2
+    expect = np.zeros(512)
+    for j in range(-half, half + 1):
+        expect[100 + j] = amp * _hann_sinc(j - 0.5)
+    np.testing.assert_allclose(rir[0], expect, rtol=2e-5, atol=1e-9)
+
+
+def test_ism_first_order_hand_enumerated_images():
+    """max_order=1: the RIR must equal the hand-enumerated 7-image sum —
+    direct + one mirror per wall at the textbook positions
+    (2nL - x_s per axis), each with amplitude beta^1 / (4 pi d)."""
+    L = np.array([4.0, 5.0, 6.0])
+    src = np.array([1.0, 2.0, 3.0])
+    mic = np.array([2.5, 2.0, 3.0])
+    alpha = 0.75
+    beta = math.sqrt(1.0 - alpha)  # 0.5
+    # (image position, reflection count) — enumerated by hand
+    images = [
+        ((1.0, 2.0, 3.0), 0),    # direct
+        ((-1.0, 2.0, 3.0), 1),   # x = 0 wall
+        ((7.0, 2.0, 3.0), 1),    # x = Lx wall: 2*4 - 1
+        ((1.0, -2.0, 3.0), 1),   # y = 0 wall
+        ((1.0, 8.0, 3.0), 1),    # y = Ly wall: 2*5 - 2
+        ((1.0, 2.0, -3.0), 1),   # z = 0 wall
+        ((1.0, 2.0, 9.0), 1),    # z = Lz wall: 2*6 - 3
+    ]
+    rir_len = 2048
+    expect = np.zeros(rir_len)
+    half = FDL // 2
+    for pos, n_refl in images:
+        d = math.dist(pos, mic)
+        a = beta**n_refl / (4.0 * math.pi * d)
+        delay = d * FS / C_SOUND
+        t0, frac = int(math.floor(delay)), delay - math.floor(delay)
+        for j in range(-half, half + 1):
+            t = t0 + j
+            if 0 <= t < rir_len:
+                expect[t] += a * _hann_sinc(j - frac)
+    rir = np.asarray(shoebox_rir(L, src, mic[None, :], alpha=alpha, max_order=1, rir_len=rir_len))
+    np.testing.assert_allclose(rir[0], expect, rtol=2e-4, atol=1e-8)
+
+
+# ------------------------------------------------------------------- bss_eval
+def test_bss_impulse_references_closed_form():
+    """Impulse references make the block-Toeplitz Gram the identity, so the
+    decomposition is an exact windowed selection with closed-form scores.
+
+    refs: s1 = delta_0, s2 = delta_2000; flen=512 spans cover samples
+    [0, 511] and [2000, 2511].  Estimate e = 3 delta_5 + 2 delta_2007 +
+    4 delta_1000 therefore decomposes EXACTLY into s_target = 3 delta_5,
+    e_interf = 2 delta_2007, e_artif = 4 delta_1000 (Vincent 2006 eqs. 2-5):
+
+        SDR = 10 log10(9 / (4 + 16)),  SIR = 10 log10(9 / 4),
+        SAR = 10 log10((9 + 4) / 16).
+    """
+    T = 3000
+    refs = np.zeros((2, T))
+    refs[0, 0] = 1.0
+    refs[1, 2000] = 1.0
+    est = np.zeros(T)
+    est[5] = 3.0
+    est[2007] = 2.0
+    est[1000] = 4.0
+    sdr, sir, sar = BssEval(refs).score(est, j=0)
+    assert sdr == pytest.approx(10 * math.log10(9 / 20), abs=1e-9)
+    assert sir == pytest.approx(10 * math.log10(9 / 4), abs=1e-9)
+    assert sar == pytest.approx(10 * math.log10(13 / 16), abs=1e-9)
+
+
+def test_bss_admissible_filtering_scores_infinite(rng):
+    """Any estimate that is a <512-tap filtering of its reference is
+    admissible distortion by definition (mir_eval convention the driver's
+    metrics must keep): SDR/SIR/SAR all ~inf."""
+    s = rng.standard_normal(4000)
+    s[-200:] = 0.0  # silent tail: the filtered estimate loses nothing to
+    # the length-T truncation, so the projection residual is exactly 0
+    h = np.zeros(3)
+    h[0], h[2] = 0.5, 0.25
+    est = np.convolve(s, h)[:4000]
+    sdr, sir, sar = bss_eval_one(s[None, :], est)
+    assert sdr > 100.0
+    assert np.isinf(sir) or sir > 100.0
+    assert np.isinf(sar) or sar > 100.0
+
+
+def test_bss_pure_delay_scores_infinite(rng):
+    """A pure delay below the filter length is a special case of admissible
+    filtering — the 'delayed estimate must not be penalized' property that
+    distinguishes bss_eval from the scale-invariant family."""
+    s = rng.standard_normal(4000)
+    s[-200:] = 0.0  # see above: keep the delayed copy inside the window
+    est = np.roll(s, 100)
+    est[:100] = 0.0
+    sdr, _, _ = bss_eval_one(s[None, :], est)
+    assert sdr > 100.0
+
+
+# ------------------------------------------------------------------- STOI
+def test_stoi_perfect_signal_is_exactly_one(rng):
+    """x == y: every band's clipped envelope correlation is exactly 1, so
+    the mean over segments and bands is exactly 1 (to float rounding)."""
+    x = rng.standard_normal(10000)  # 1 s at the internal 10 kHz rate
+    assert stoi(x, x, 10000) == pytest.approx(1.0, abs=1e-12)
+
+
+def test_stoi_gain_invariance(rng):
+    """The per-segment normalization (alpha) and the relative silent-frame
+    threshold make the score exactly invariant to scalar gain on either
+    argument (Taal 2011 sec. II)."""
+    x = rng.standard_normal(12000)
+    y = x + 0.3 * rng.standard_normal(12000)
+    base = stoi(x, y, 10000)
+    assert stoi(x, 7.3 * y, 10000) == pytest.approx(base, abs=1e-12)
+    assert stoi(0.02 * x, y, 10000) == pytest.approx(base, abs=1e-12)
+
+
+def test_stoi_monotone_in_snr(rng):
+    """More additive noise can only lower intelligibility: the score must be
+    non-increasing over a decreasing-SNR sweep (same noise draw)."""
+    x = rng.standard_normal(12000)
+    n = rng.standard_normal(12000)
+    scores = [stoi(x, x + sig * n, 10000) for sig in (0.0, 0.1, 0.3, 1.0, 3.0)]
+    assert scores[0] == pytest.approx(1.0, abs=1e-12)
+    for a, b in zip(scores, scores[1:]):
+        assert b <= a + 1e-9
+    assert scores[-1] < scores[0] - 0.2  # and the sweep actually moves
+
+
+def test_stoi_segment_correlation_hand_built_envelopes():
+    """The correlation core on hand-built envelopes: anti-proportional
+    band envelopes (1 + a m_t vs 1 - a m_t, depth small enough that the
+    -15 dB clipping never engages) correlate to exactly -1 in every band;
+    proportional ones to exactly +1."""
+    n_frames = 40
+    t = np.arange(n_frames)
+    m = np.sin(2 * np.pi * t / 10.0)
+    Xb = np.tile(1.0 + 0.2 * m, (_STOI_NBANDS, 1))
+    n_seg_expect = n_frames - _STOI_SEG + 1
+    d, n_seg = _stoi_corr_sum(Xb, np.tile(1.0 - 0.2 * m, (_STOI_NBANDS, 1)))
+    assert n_seg == n_seg_expect
+    assert d == pytest.approx(-_STOI_NBANDS * n_seg_expect, abs=1e-9)
+    d, _ = _stoi_corr_sum(Xb, 3.0 * Xb)
+    assert d == pytest.approx(_STOI_NBANDS * n_seg_expect, abs=1e-9)

@@ -192,3 +192,35 @@ def test_get_z_cli_with_crnn_model(generated, tmp_path):
     lay = DatasetLayout(str(generated), "random", "train")
     z = np.load(lay.stft_z("crnn_z", [0, 6], "zs_hat", 1, 1, "ssn"))
     assert z.dtype == np.complex64 and z.ndim == 2 and np.isfinite(z).all()
+
+
+def test_tango_cli_solver_precedence(tmp_path):
+    """--solver resolution: explicit flag > YAML enhance.solver (--config) >
+    the EnhanceConfig dataclass default (config.py)."""
+    import dataclasses
+
+    from disco_tpu.config import DiscoConfig, EnhanceConfig, save_config
+
+    cfg = DiscoConfig(enhance=dataclasses.replace(EnhanceConfig(), solver="power:8"))
+    path = save_config(cfg, tmp_path / "cfg.yaml")
+
+    def resolved(argv):
+        return tango.resolve_solver(tango.build_parser().parse_args(argv + ["--rir", "1"]))
+
+    assert resolved([]) == "eigh"
+    assert resolved(["--config", str(path)]) == "power:8"
+    assert resolved(["--config", str(path), "--solver", "jacobi"]) == "jacobi"
+
+
+def test_tango_cli_bad_yaml_solver_is_clean_error(tmp_path):
+    import dataclasses
+
+    import pytest
+
+    from disco_tpu.config import DiscoConfig, EnhanceConfig, save_config
+
+    cfg = DiscoConfig(enhance=dataclasses.replace(EnhanceConfig(), solver="nope"))
+    path = save_config(cfg, tmp_path / "bad.yaml")
+    args = tango.build_parser().parse_args(["--rir", "1", "--config", str(path)])
+    with pytest.raises(SystemExit, match="enhance.solver"):
+        tango.resolve_solver(args)
