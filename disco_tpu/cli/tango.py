@@ -87,6 +87,24 @@ def resolve_solver(args):
     from disco_tpu.config import EnhanceConfig, load_config
 
     cfg_enh = load_config(args.config).enhance if args.config else EnhanceConfig()
+    if args.config:
+        # Only enhance.solver is consumed here; silently honoring part of a
+        # DiscoConfig YAML would be a trap, so name what is being ignored.
+        import dataclasses
+        import sys
+
+        ignored = [
+            f.name
+            for f in dataclasses.fields(EnhanceConfig)
+            if f.name != "solver"
+            and getattr(cfg_enh, f.name) != getattr(EnhanceConfig(), f.name)
+        ]
+        if ignored:
+            print(
+                f"warning: --config {args.config}: only enhance.solver is used by "
+                f"this CLI; ignoring non-default enhance fields {ignored}",
+                file=sys.stderr,
+            )
     try:
         return solver_spec(cfg_enh.solver)
     except _argparse.ArgumentTypeError as e:
