@@ -116,13 +116,14 @@ def bench_jax(batch=16, dur_s=10.0, iters=5):
     sb = jnp.asarray(np.stack([s] * batch))
     nb = jnp.asarray(np.stack([n] * batch))
 
-    def make_run(solver):
+    def make_run(solver, cov_impl="xla"):
         @jax.jit
         def run(yb, sb, nb):
             def one(y, s, n):
                 Y, S, N = stft(y), stft(s), stft(n)
                 m = oracle_masks(S, N, "irm1")
-                return tango(Y, S, N, m, m, policy="local", solver=solver).yf
+                return tango(Y, S, N, m, m, policy="local", solver=solver,
+                             cov_impl=cov_impl).yf
 
             # Return the full enhanced spectra: jit outputs must be
             # materialized, so the timed program is exactly the production
@@ -153,6 +154,18 @@ def bench_jax(batch=16, dur_s=10.0, iters=5):
     except Exception as e:
         rtf_jacobi = None
         jacobi_error = f"{type(e).__name__}: {e}"[:200]
+
+    # fused masked-covariance kernel (ops/cov_ops.py, round-2 verdict #3):
+    # same eigh solver, covariance stage reads Y once instead of
+    # materializing the masked copies.
+    covfused_error = None
+    try:
+        run_c = make_run("eigh", cov_impl="pallas")
+        dt_c, _ = _slope_time(run_c, yb, sb, nb, iters=iters)
+        rtf_covfused = audio_s / dt_c
+    except Exception as e:
+        rtf_covfused = None
+        covfused_error = f"{type(e).__name__}: {e}"[:200]
 
     # ---- FLOP model: XLA's cost analysis of the exact compiled program
     flops_total = None
@@ -199,6 +212,8 @@ def bench_jax(batch=16, dur_s=10.0, iters=5):
         "rtf_power": rtf_power,
         "rtf_jacobi": rtf_jacobi,
         "jacobi_error": jacobi_error,
+        "rtf_covfused": rtf_covfused,
+        "covfused_error": covfused_error,
         "dispatch_overhead_ms": round(max(dt1 - dt, 0.0) * 1e3, 2),
         "flops_per_clip": flops_per_clip,
         "mfu": mfu,
@@ -321,6 +336,8 @@ def main():
                 "rtf_power_solver": round(r["rtf_power"], 2),
                 "rtf_jacobi_solver": round(r["rtf_jacobi"], 2) if r.get("rtf_jacobi") else None,
                 "jacobi_error": r.get("jacobi_error"),
+                "rtf_covfused": round(r["rtf_covfused"], 2) if r.get("rtf_covfused") else None,
+                "covfused_error": r.get("covfused_error"),
                 "dispatch_overhead_ms": r["dispatch_overhead_ms"],
                 "latency_ms_frame": round(lat_ms, 4) if lat_ms else None,
                 "frame_budget_ms": round(budget_ms, 3) if budget_ms else None,

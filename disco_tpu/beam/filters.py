@@ -118,9 +118,13 @@ def gevd_mwf(Rxx: jnp.ndarray, Rnn: jnp.ndarray, mu: float = 1.0, rank=1,
     elif eigh_impl == "jacobi-pallas":
         from disco_tpu.ops.eigh_ops import eigh_jacobi_pallas
 
+        from disco_tpu.utils.backend import is_tpu
+
         # interpret off-TPU: the Mosaic lowering is TPU-only, and the
-        # interpreter makes the branch testable on any backend.
-        lam, U = eigh_jacobi_pallas(A, interpret=jax.default_backend() != "tpu")
+        # interpreter makes the branch testable on any backend.  Keyed off
+        # the device kind, not the platform string — plugin platforms
+        # (e.g. the tunneled 'axon' attachment) are real TPUs.
+        lam, U = eigh_jacobi_pallas(A, interpret=not is_tpu())
     else:
         raise ValueError(
             f"unknown eigh_impl {eigh_impl!r}; expected 'xla', 'jacobi' or 'jacobi-pallas'"

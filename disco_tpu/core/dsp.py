@@ -59,7 +59,9 @@ def stft(x: jnp.ndarray, n_fft: int = N_FFT, hop: int = N_HOP, impl: str = "auto
       (freq, frames) layout the rest of the framework uses.
     """
     if impl == "auto":
-        impl = "matmul" if (n_fft == 2 * hop and jax.default_backend() == "tpu") else "rfft"
+        from disco_tpu.utils.backend import is_tpu
+
+        impl = "matmul" if (n_fft == 2 * hop and is_tpu()) else "rfft"
     if impl in ("matmul", "pallas"):
         from disco_tpu.ops.stft_ops import stft_matmul, stft_pallas
 
@@ -109,7 +111,9 @@ def istft(
       real signal(s) of shape (..., length), float32.
     """
     if impl == "auto":
-        impl = "matmul" if (n_fft == 2 * hop and jax.default_backend() == "tpu") else "irfft"
+        from disco_tpu.utils.backend import is_tpu
+
+        impl = "matmul" if (n_fft == 2 * hop and is_tpu()) else "irfft"
     if impl == "matmul":
         from disco_tpu.ops.stft_ops import istft_matmul
 

@@ -1,0 +1,144 @@
+"""Fused masked spatial covariances as one pallas kernel.
+
+The TANGO steps estimate speech/noise covariances by materializing the
+masked STFT copies ``s_hat = m * Y`` and ``n_hat = (1 - m) * Y`` and then
+contracting each over frames (reference tango.py:347-364;
+``beam.covariance.masked_covariances``).  On TPU that costs HBM round
+trips for two full (C, F, T) complex intermediates — written once and
+read back by the covariance matmuls — while the covariances themselves
+are tiny ((F, C, C), ~100 KB).  The round-2 roofline named this traffic
+the next lever after the eigensolve (VERDICT round-2 #3).
+
+:func:`masked_cov_pallas` computes BOTH covariances in one kernel pass:
+each grid step DMAs a (C, Fb, T) block of Y (planar re/im) plus its mask
+block into VMEM once and emits only the (Fb, C, C) covariance blocks —
+the masked copies never exist in HBM.  The math per frequency bin is
+
+    Rss[c, d] = (1/T) sum_t m_t^2      Y[c, t] conj(Y[d, t])
+    Rnn[c, d] = (1/T) sum_t (1 - m_t)^2 Y[c, t] conj(Y[d, t])
+
+evaluated hermitian-triangle-wise as elementwise products + lane-axis
+reductions over well-tiled (Fb, T) planes (VPU work; no tiny-matmul MXU
+padding waste, nothing Mosaic cannot lower).  Output layout inside the
+kernel is (C, C, F) so every store is a contiguous lane vector; the host
+transposes the tiny result to the (..., F, C, C) convention.
+
+:func:`masked_covariances_fused` dispatches 'xla' (the einsum path) /
+'pallas' so callers can pick per backend; parity is pinned in
+tests/test_ops.py against ``beam.covariance.masked_covariances``.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from disco_tpu.beam.covariance import masked_covariances
+
+
+def _cov_kernel(yr_ref, yi_ref, m_ref, ssr_ref, ssi_ref, nnr_ref, nni_ref, *, C, inv_t):
+    """One (C, Fb, T) block: both masked covariances, hermitian triangle."""
+    m = m_ref[0]  # (Fb, T)
+    ws = (m * m) * inv_t
+    one_m = 1.0 - m
+    wn = (one_m * one_m) * inv_t
+    for c in range(C):
+        xr_c, xi_c = yr_ref[0, c], yi_ref[0, c]  # (Fb, T)
+        for d in range(c, C):
+            xr_d, xi_d = yr_ref[0, d], yi_ref[0, d]
+            # Y_c conj(Y_d): re = rc rd + ic id, im = ic rd - rc id
+            prr = xr_c * xr_d + xi_c * xi_d
+            pii = xi_c * xr_d - xr_c * xi_d
+            ss_re = jnp.sum(ws * prr, axis=-1)  # (Fb,)
+            ss_im = jnp.sum(ws * pii, axis=-1)
+            nn_re = jnp.sum(wn * prr, axis=-1)
+            nn_im = jnp.sum(wn * pii, axis=-1)
+            ssr_ref[0, c, d, :] = ss_re
+            ssi_ref[0, c, d, :] = ss_im
+            nnr_ref[0, c, d, :] = nn_re
+            nni_ref[0, c, d, :] = nn_im
+            if d != c:  # hermitian mirror
+                ssr_ref[0, d, c, :] = ss_re
+                ssi_ref[0, d, c, :] = -ss_im
+                nnr_ref[0, d, c, :] = nn_re
+                nni_ref[0, d, c, :] = -nn_im
+
+
+@partial(jax.jit, static_argnames=("f_tile", "interpret"))
+def masked_cov_pallas(y: jnp.ndarray, mask: jnp.ndarray, f_tile: int = 8, interpret: bool = False):
+    """Speech/noise covariances from a mixture and TF mask, fused.
+
+    Drop-in for ``beam.covariance.masked_covariances`` (same semantics,
+    reference tango.py:347-364): Y is read from HBM exactly once and the
+    masked copies never touch HBM.
+
+    Args:
+      y: (..., C, F, T) complex64 mixture STFT.
+      mask: (..., F, T) float mask, broadcast over channels.
+      f_tile: frequency bins per grid step (F is zero-padded to a multiple).
+      interpret: pallas interpreter mode (CPU correctness tests).
+
+    Returns:
+      (Rss, Rnn), each (..., F, C, C) complex64.
+    """
+    y = jnp.asarray(y)
+    *lead, C, F, T = y.shape
+    B = 1
+    for n in lead:
+        B *= n
+    yr = jnp.real(y).astype(jnp.float32).reshape(B, C, F, T)
+    yi = jnp.imag(y).astype(jnp.float32).reshape(B, C, F, T)
+    m = jnp.broadcast_to(jnp.asarray(mask, jnp.float32), tuple(lead) + (F, T)).reshape(B, F, T)
+
+    n_ft = -(-F // f_tile)
+    Fp = n_ft * f_tile
+    if Fp != F:
+        pad = ((0, 0), (0, 0), (0, Fp - F), (0, 0))
+        yr, yi = jnp.pad(yr, pad), jnp.pad(yi, pad)
+        m = jnp.pad(m, ((0, 0), (0, Fp - F), (0, 0)))
+
+    from jax.experimental import pallas as pl
+
+    out = pl.pallas_call(
+        partial(_cov_kernel, C=C, inv_t=1.0 / T),
+        grid=(B, n_ft),
+        in_specs=[
+            pl.BlockSpec((1, C, f_tile, T), lambda b, f: (b, 0, f, 0)),
+            pl.BlockSpec((1, C, f_tile, T), lambda b, f: (b, 0, f, 0)),
+            pl.BlockSpec((1, f_tile, T), lambda b, f: (b, f, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, C, C, f_tile), lambda b, f: (b, 0, 0, f)),
+            pl.BlockSpec((1, C, C, f_tile), lambda b, f: (b, 0, 0, f)),
+            pl.BlockSpec((1, C, C, f_tile), lambda b, f: (b, 0, 0, f)),
+            pl.BlockSpec((1, C, C, f_tile), lambda b, f: (b, 0, 0, f)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((B, C, C, Fp), jnp.float32)] * 4,
+        interpret=interpret,
+    )(yr, yi, m)
+    ssr, ssi, nnr, nni = (o[..., :F] for o in out)
+    Rss = jax.lax.complex(ssr, ssi).transpose(0, 3, 1, 2)
+    Rnn = jax.lax.complex(nnr, nni).transpose(0, 3, 1, 2)
+    shape = tuple(lead) + (F, C, C)
+    return Rss.reshape(shape), Rnn.reshape(shape)
+
+
+def masked_covariances_fused(y, mask, impl: str = "xla", interpret: bool | None = None):
+    """Masked speech/noise covariance pair with implementation dispatch —
+    the mask->covariance stage of reference tango.py:347-364.
+
+    'xla': einsum via materialized masked copies (``beam.covariance``);
+    'pallas': single fused read of Y (:func:`masked_cov_pallas`).
+    ``interpret=None`` resolves to the pallas interpreter off-TPU (the
+    Mosaic lowering is TPU-only) — the one place this decision lives.
+    """
+    if impl == "xla":
+        return masked_covariances(y, mask)
+    if impl == "pallas":
+        if interpret is None:
+            from disco_tpu.utils.backend import is_tpu
+
+            interpret = not is_tpu()
+        return masked_cov_pallas(y, mask, interpret=interpret)
+    raise ValueError(f"unknown cov impl {impl!r}; expected 'xla' or 'pallas'")

@@ -1,0 +1,36 @@
+"""Backend identification that survives plugin platform names.
+
+``jax.default_backend()`` returns the *platform* name, which for TPU
+plugins need not be the literal string ``"tpu"`` (the tunneled attachment
+in this build environment registers as ``"axon"``).  Code that routes by
+hardware class — the MXU DFT-matmul STFT (core/dsp.py), the Mosaic pallas
+kernels (beam/filters.py, ops/) — must key off the DEVICE, not the
+platform string, or it silently takes the non-TPU path on real TPU
+hardware.
+"""
+from __future__ import annotations
+
+_cached: bool | None = None
+
+
+def is_tpu() -> bool:
+    """True when the default JAX backend drives TPU devices (any platform
+    name: 'tpu', plugin names like 'axon', ...).
+
+    The answer is memoized only on success — a transient device-enumeration
+    failure must not permanently pin the process to the non-TPU code paths.
+    """
+    global _cached
+    if _cached is not None:
+        return _cached
+    import jax
+
+    if jax.default_backend() == "tpu":
+        _cached = True
+        return True
+    try:
+        kind = jax.devices()[0].device_kind
+    except Exception:
+        return False  # transient: do NOT cache
+    _cached = "tpu" in kind.lower()
+    return _cached
