@@ -50,6 +50,9 @@ def build_parser():
                         "'power'/'power:N' (dominant-pair power iteration; "
                         "streaming mode needs ~power:96 for eigh-level quality), "
                         "'jacobi' or 'jacobi-pallas' (fixed-sweep cyclic Jacobi)")
+    p.add_argument("--cov_impl", choices=["xla", "pallas"], default="xla",
+                   help="masked-covariance stage: 'xla' (einsum) or 'pallas' "
+                        "(fused single-read kernel, ops/cov_ops.py)")
     p.add_argument("--mesh", nargs=2, type=int, default=None, metavar=("BATCH", "NODE"),
                    help="--rirs mode only: run each chunk on a (BATCH, NODE) device "
                         "mesh (clips sharded over 'batch', nodes over 'node', "
@@ -160,7 +163,7 @@ def main(argv=None):
             bucket=8192 if args.bucket is None else args.bucket,
             max_batch=args.batch_size, models=models,
             z_sigs=args.zsigs[0] if len(args.zsigs) == 1 else "zs&zn",
-            solver=args.solver, mesh=mesh,
+            solver=args.solver, cov_impl=args.cov_impl, mesh=mesh,
         )
         print(f"{len(results)} RIRs enhanced (batched)")
         return results
@@ -170,7 +173,7 @@ def main(argv=None):
         mask_type=args.vad_type[0], policy=policy, models=models,
         out_root=args.out_root, streaming=args.streaming, bucket=args.bucket or 0,
         z_sigs=args.zsigs[0] if len(args.zsigs) == 1 else "zs&zn",
-        solver=args.solver,
+        solver=args.solver, cov_impl=args.cov_impl,
     )
     if results is None:
         print(f"Conf {args.rir} with {args.noise} noise already processed")

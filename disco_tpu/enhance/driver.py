@@ -268,6 +268,7 @@ def enhance_rir(
     bucket: int = 0,
     z_sigs: str = "zs_hat",
     solver: str = "eigh",
+    cov_impl: str = "xla",
 ):
     """Enhance one RIR end-to-end and persist everything (reference
     tango.py:460-641).  ``models``: per-step CRNN params or None for the
@@ -312,6 +313,14 @@ def enhance_rir(
                 f"streaming mode implements the 'local'/'distant'/'none' "
                 f"mask-for-z policies; got {policy!r}"
             )
+        if cov_impl != "xla":
+            # the online estimator is exponential smoothing, not a frame
+            # mean — the fused offline kernel does not apply; reject rather
+            # than silently compare xla against itself in an A/B
+            raise ValueError(
+                f"streaming mode uses the smoothed-covariance estimator; "
+                f"cov_impl={cov_impl!r} applies to the offline pipeline only"
+            )
         from disco_tpu.enhance.tango import TangoResult
         from disco_tpu.enhance.streaming import streaming_tango
 
@@ -327,7 +336,7 @@ def enhance_rir(
         )
     else:
         res = tango(Y, S, N, masks_z, mask_w, mu=mu, policy=policy, mask_type=mask_type,
-                    solver=solver)
+                    solver=solver, cov_impl=cov_impl)
 
     return _persist_and_score(
         out, layout, rir, noise, snr_range, y, s, n, s_dry, n_dry, fs,
@@ -410,6 +419,7 @@ def enhance_rirs_batched(
     models=(None, None),
     z_sigs: str = "zs_hat",
     solver: str = "eigh",
+    cov_impl: str = "xla",
     score_workers: int = 4,
     mesh=None,
 ):
@@ -474,7 +484,7 @@ def enhance_rirs_batched(
         def run_batch_with_masks(Yb, Sb, Nb, Mz, Mw):
             return tango_batch_sharded(
                 Yb, Sb, Nb, Mz, Mw, mesh, mu=mu, policy=policy,
-                mask_type=mask_type, solver=solver,
+                mask_type=mask_type, solver=solver, cov_impl=cov_impl,
             )
 
         def run_batch(Yb, Sb, Nb):
@@ -486,7 +496,7 @@ def enhance_rirs_batched(
             def one(Y, S, N):
                 m = oracle_masks(S, N, mask_type)
                 return tango(Y, S, N, m, m, mu=mu, policy=policy, mask_type=mask_type,
-                             solver=solver)
+                             solver=solver, cov_impl=cov_impl)
 
             return jax.vmap(one)(Yb, Sb, Nb)
 
@@ -494,7 +504,7 @@ def enhance_rirs_batched(
         def run_batch_with_masks(Yb, Sb, Nb, Mz, Mw):
             def one(Y, S, N, mz, mw):
                 return tango(Y, S, N, mz, mw, mu=mu, policy=policy, mask_type=mask_type,
-                             solver=solver)
+                             solver=solver, cov_impl=cov_impl)
 
             return jax.vmap(one)(Yb, Sb, Nb, Mz, Mw)
 
@@ -522,10 +532,18 @@ def enhance_rirs_batched(
                     ys.append(np.pad(y, pad))
                     ss.append(np.pad(s, pad))
                     ns.append(np.pad(n, pad))
-                # pad the remainder chunk to max_batch by repeating the first
-                # clip: ONE compiled program per bucket, dummy outputs dropped
+                # Remainder chunks pad to the next power of two, not to
+                # max_batch (round-2 verdict #9: repeating clip 0 up to
+                # 15/16 of a launch was discarded work on small splits).
+                # Cost model: at most log2(max_batch) extra compiled
+                # programs per length bucket, <2x padding waste vs up to
+                # max_batch-x before.  Mesh runs keep the full batch — the
+                # chunk size must stay divisible by the mesh 'batch' axis.
                 n_real = len(ys)
-                while len(ys) < max_batch:
+                tail = max_batch if mesh is not None else min(
+                    max_batch, 1 << max(n_real - 1, 0).bit_length()
+                )
+                while len(ys) < tail:
                     ys.append(ys[0]); ss.append(ss[0]); ns.append(ns[0])
                 Yb = stft(jnp.asarray(np.stack(ys)))
                 Sb = stft(jnp.asarray(np.stack(ss)))

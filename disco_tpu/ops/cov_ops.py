@@ -100,6 +100,13 @@ def masked_cov_pallas(y: jnp.ndarray, mask: jnp.ndarray, f_tile: int = 8, interp
 
     from jax.experimental import pallas as pl
 
+    # NOTE on shard_map: pallas_call's vma handling is incomplete in this
+    # jax version (its interpreter rejects even correctly-annotated
+    # out_shapes with "dynamic_slice requires varying manual axes to
+    # match"), so the shard_map caller (parallel/mesh.py) disables
+    # check_vma for the pallas cov variant instead of annotating here.
+    out_struct = jax.ShapeDtypeStruct((B, C, C, Fp), jnp.float32)
+
     out = pl.pallas_call(
         partial(_cov_kernel, C=C, inv_t=1.0 / T),
         grid=(B, n_ft),
@@ -114,7 +121,7 @@ def masked_cov_pallas(y: jnp.ndarray, mask: jnp.ndarray, f_tile: int = 8, interp
             pl.BlockSpec((1, C, C, f_tile), lambda b, f: (b, 0, 0, f)),
             pl.BlockSpec((1, C, C, f_tile), lambda b, f: (b, 0, 0, f)),
         ],
-        out_shape=[jax.ShapeDtypeStruct((B, C, C, Fp), jnp.float32)] * 4,
+        out_shape=[out_struct] * 4,
         interpret=interpret,
     )(yr, yi, m)
     ssr, ssi, nnr, nni = (o[..., :F] for o in out)

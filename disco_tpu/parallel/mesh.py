@@ -94,6 +94,7 @@ def ring_all_gather(x, axis_name: str):
 def _tango_on_mesh(
     Y, S, N, masks_z, mask_w, mesh, frame_axis, mu, policy, ref_mic, mask_type,
     oracle_step1_stats, z_exchange: str = "all_gather", solver: str = "eigh",
+    cov_impl: str = "xla",
 ) -> TangoResult:
     """Shared shard_map body for the node-sharded and node+frame-sharded
     pipelines — identical math, different partition specs.
@@ -122,13 +123,18 @@ def _tango_on_mesh(
         mesh=mesh,
         in_specs=(spec4, spec4, spec4, spec3, spec3),
         out_specs=(spec3,) * 7,
+        # pallas_call's vma handling inside shard_map is incomplete in this
+        # jax version (its interpreter hits "dynamic_slice requires varying
+        # manual axes to match"; upstream suggests check_vma=False as the
+        # workaround) — disable the check only for the fused-cov variant.
+        check_vma=cov_impl != "pallas",
     )
     def _run(Yk, Sk, Nk, mzk, mwk):
         # Local shard shapes: (K_local, C, F, T_local).
         step1 = jax.vmap(
             lambda y, s, n, m: tango_step1(
                 y, s, n, m, mu=mu, oracle_stats=oracle_step1_stats, ref_mic=ref_mic,
-                frame_axis=frame_axis, solver=solver,
+                frame_axis=frame_axis, solver=solver, cov_impl=cov_impl,
             )
         )
         local_z = step1(Yk, Sk, Nk, mzk)
@@ -147,7 +153,7 @@ def _tango_on_mesh(
             lambda y, s, n, mw, kk: tango_step2(
                 y, s, n, mw, kk, all_z, all_masks_w, all_S_ref, all_N_ref,
                 mu=mu, policy=policy, ref_mic=ref_mic, mask_type=mask_type,
-                frame_axis=frame_axis, solver=solver,
+                frame_axis=frame_axis, solver=solver, cov_impl=cov_impl,
             ),
             in_axes=(0, 0, 0, 0, 0),
         )
@@ -163,7 +169,7 @@ def _tango_on_mesh(
 
 @partial(
     jax.jit,
-    static_argnames=("mesh", "policy", "ref_mic", "mask_type", "oracle_step1_stats", "z_exchange", "solver"),
+    static_argnames=("mesh", "policy", "ref_mic", "mask_type", "oracle_step1_stats", "z_exchange", "solver", "cov_impl"),
 )
 def tango_sharded(
     Y,
@@ -179,6 +185,7 @@ def tango_sharded(
     oracle_step1_stats: bool = False,
     z_exchange: str = "all_gather",
     solver: str = "eigh",
+    cov_impl: str = "xla",
 ) -> TangoResult:
     """Two-step TANGO with the node axis sharded over ``mesh``'s 'node' axis.
 
@@ -192,7 +199,7 @@ def tango_sharded(
     """
     return _tango_on_mesh(
         Y, S, N, masks_z, mask_w, mesh, None, mu, policy, ref_mic, mask_type,
-        oracle_step1_stats, z_exchange, solver,
+        oracle_step1_stats, z_exchange, solver, cov_impl,
     )
 
 
@@ -233,7 +240,7 @@ def tango_frame_sharded(
 
 @partial(
     jax.jit,
-    static_argnames=("mesh", "policy", "ref_mic", "mask_type", "solver"),
+    static_argnames=("mesh", "policy", "ref_mic", "mask_type", "solver", "cov_impl"),
 )
 def tango_batch_sharded(
     Yb,
@@ -247,6 +254,7 @@ def tango_batch_sharded(
     ref_mic: int = 0,
     mask_type: str = "irm1",
     solver: str = "eigh",
+    cov_impl: str = "xla",
 ) -> TangoResult:
     """Corpus-scale TANGO on a (batch, node) mesh via GSPMD auto-partitioning:
     clips shard over 'batch' (the reference's ``--rirs`` data parallelism as a
@@ -274,7 +282,7 @@ def tango_batch_sharded(
     res = jax.vmap(
         lambda Y, S, N, mz, mw: tango(
             Y, S, N, mz, mw, mu=mu, policy=policy, ref_mic=ref_mic,
-            mask_type=mask_type, solver=solver,
+            mask_type=mask_type, solver=solver, cov_impl=cov_impl,
         )
     )(Yb, Sb, Nb, masks_z_b, mask_w_b)
     return jax.tree_util.tree_map(constrain, res)

@@ -86,6 +86,71 @@ def crnn_corpus_ab(B=16, dur_s=4.0):
     }
 
 
+def solver_ab(B=16, dur_s=10.0, iters=3):
+    """Round-3 queue #2: A/B the rank-1 GEVD solver families on-device at
+    the headline batch — slope-timed RTF per solver plus SDR agreement vs
+    the eigh reference output, so the offline default can be flipped (or
+    kept) on measured numbers.  Also A/Bs the fused covariance kernel."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from bench import _slope_time
+    from disco_tpu.core.dsp import istft, stft
+    from disco_tpu.core.metrics import si_sdr
+    from disco_tpu.enhance import oracle_masks
+    from disco_tpu.enhance.tango import tango
+    from disco_tpu.milestones import _scene
+
+    FS, K, C = 16000, 8, 4
+    L = int(dur_s * FS)
+    y, s, n = _scene(K, C, L, noise_scale=0.5)
+    yb = jnp.asarray(np.stack([y] * B))
+    sb = jnp.asarray(np.stack([s] * B))
+    nb = jnp.asarray(np.stack([n] * B))
+
+    def make(solver, cov_impl="xla"):
+        @jax.jit
+        def run(yb, sb, nb):
+            def one(y, s, n):
+                Y, S, N = stft(y), stft(s), stft(n)
+                m = oracle_masks(S, N, "irm1")
+                return tango(Y, S, N, m, m, policy="local", solver=solver,
+                             cov_impl=cov_impl).yf
+            return jax.vmap(one)(yb, sb, nb)
+        return run
+
+    audio_s = B * K * dur_s
+    out = {}
+    ref_t = None  # set ONLY by the eigh lane — agreement numbers must never
+    # silently re-anchor to whichever lane happened to succeed first
+    for name, solver, cov in (
+        ("eigh", "eigh", "xla"),
+        ("power", "power", "xla"),
+        ("jacobi", "jacobi", "xla"),
+        ("jacobi-pallas", "jacobi-pallas", "xla"),
+        ("eigh+covfused", "eigh", "pallas"),
+    ):
+        try:
+            run = make(solver, cov)
+            yf = run(yb, sb, nb)
+            dt, _ = _slope_time(run, yb, sb, nb, iters=iters)
+            lane = {"rtf": round(audio_s / dt, 1), "ms_per_batch": round(dt * 1e3, 2)}
+            if name == "eigh":
+                ref_t = np.asarray(istft(yf[0], length=L), np.float64)
+            elif ref_t is not None:
+                est_t = np.asarray(istft(yf[0], length=L), np.float64)
+                lane["si_sdr_vs_eigh_db"] = round(
+                    float(np.mean([si_sdr(ref_t[k], est_t[k]) for k in range(K)])), 2
+                )
+            else:
+                lane["si_sdr_vs_eigh_db"] = None  # eigh lane failed: no anchor
+        except Exception as e:
+            lane = {"error": f"{type(e).__name__}: {e}"[:200]}
+        out[name] = lane
+    return out
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--quick", action="store_true", help="smaller scales")
@@ -98,11 +163,13 @@ def main(argv=None):
         # bench_jax returns the report dict directly (rtf, rtf_power,
         # dispatch_overhead_ms, mfu, stage_ms, ...)
         section("bench", lambda: bench_mod.bench_jax(batch=4, dur_s=4.0, iters=2))
+        section("solver_ab", lambda: solver_ab(B=2, dur_s=2.0, iters=1))
         section("crnn_corpus_ab", lambda: crnn_corpus_ab(B=4, dur_s=2.0))
         section("milestone_separation", lambda: milestones.meetit_separation(dur_s=2.0, K=4, C=2, iters=1))
         section("streaming_latency", lambda: milestones.streaming_latency(dur_s=2.0, K=2, C=2, iters=1))
         return
     section("bench", bench_mod.bench_jax)
+    section("solver_ab", solver_ab)
     section("crnn_corpus_ab", crnn_corpus_ab)
     for name, fn in (
         ("milestone_1", milestones.mvdr_single_clip),

@@ -361,3 +361,13 @@ def test_aggregate_cli(processed_corpus, tmp_path, capsys):
     assert set(sub) == {"sdr_cnv", "snr_out"}
     # empty dir
     assert aggregate.main([str(tmp_path / "nothing")]) == {}
+
+
+def test_streaming_rejects_pallas_cov(processed_corpus, tmp_path):
+    """--streaming uses the smoothed-covariance estimator; the fused offline
+    kernel must be rejected, not silently ignored."""
+    with pytest.raises(ValueError, match="cov_impl"):
+        enhance_rir(
+            str(processed_corpus), "living", RIR, NOISE, save_dir="s_cov",
+            streaming=True, cov_impl="pallas", out_root=str(tmp_path / "res_s_cov"),
+        )
