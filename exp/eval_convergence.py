@@ -1,0 +1,74 @@
+"""Evaluation stage of the convergence experiment, decoupled from training.
+
+The round-3 build host has one CPU core, so the two CRNN trainings run as
+separate long-lived background processes (`/root/train_one.py sc|mc`
+wrappers around cli/train.main, each dropping a ``{kind}_done.json`` marker
+with its run name).  This script picks up those markers — or, with
+``--allow-partial``, the latest checkpoint on disk even while training is
+still running — and runs the held-out test-split oracle-vs-CRNN TANGO
+evaluation + loss-curve summary of ``exp/train_convergence.py``, writing
+the committed artifact ``exp/convergence_result.json``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+from pathlib import Path
+
+from train_convergence import TEST_BASE, evaluate, loss_summary  # noqa: E402
+
+
+def _run_name(models_dir: Path, kind: str, allow_partial: bool) -> str:
+    marker = models_dir / f"{kind}_done.json"
+    if marker.exists():
+        return json.loads(marker.read_text())["run_name"]
+    if not allow_partial:
+        raise SystemExit(f"{marker} missing — training not finished (use --allow-partial)")
+    # newest *_model.msgpack whose loss file exists
+    cands = sorted(models_dir.glob("*_model.msgpack"), key=lambda p: p.stat().st_mtime)
+    if not cands:
+        raise SystemExit(f"no checkpoints under {models_dir}")
+    return cands[-1].name.replace("_model.msgpack", "")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--workdir", default="/root/convergence_run")
+    p.add_argument("--test_rirs", type=int, default=20)
+    p.add_argument("--scenario", default="living")
+    p.add_argument("--noise", default="ssn")
+    p.add_argument("--sc", default=None, help="single-channel run name (default: marker)")
+    p.add_argument("--mc", default=None, help="multichannel run name (default: marker)")
+    p.add_argument("--allow-partial", action="store_true",
+                   help="fall back to the newest checkpoint when a done-marker is absent")
+    p.add_argument("--out_json", default="exp/convergence_result.json")
+    args = p.parse_args(argv)
+
+    work = Path(args.workdir)
+    models_dir = work / "models"
+    sc = args.sc or _run_name(models_dir, "sc", args.allow_partial)
+    mc = args.mc or _run_name(models_dir, "mc", args.allow_partial)
+    data = work / "dataset"
+
+    deltas = evaluate(data, work, models_dir, sc, mc, args.scenario, args.noise, args.test_rirs)
+    result = {
+        "config": "crnn_convergence",
+        "n_train_rirs": 150,
+        "n_test_rirs": args.test_rirs,
+        "single_channel": {"run": sc, **loss_summary(models_dir, sc)},
+        "multichannel": {"run": mc, **loss_summary(models_dir, mc)},
+        "test_deltas": deltas,
+        "crnn_vs_oracle_si_sdr_gap": round(
+            deltas["oracle"]["delta_si_sdr"] - deltas["crnn"]["delta_si_sdr"], 3
+        ),
+        "partial": not (
+            (models_dir / "sc_done.json").exists() and (models_dir / "mc_done.json").exists()
+        ),
+    }
+    Path(args.out_json).write_text(json.dumps(result, indent=1))
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    main()

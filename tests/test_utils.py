@@ -76,3 +76,15 @@ def test_trace_to_noop_on_failure(tmp_path):
     with trace_to(str(tmp_path / "t1")):
         with trace_to(str(tmp_path / "t2")):
             pass
+
+
+def test_is_tpu_false_on_cpu_and_memoized():
+    """Backend routing helper: False on the CPU test backend, and the
+    success-path answer is memoized (transient failures are NOT — see
+    utils/backend.py)."""
+    from disco_tpu.utils import backend
+
+    assert backend.is_tpu() is False
+    assert backend._cached is False  # success path memoized
+    # memoized answer is returned without re-probing jax
+    assert backend.is_tpu() is False
