@@ -56,6 +56,10 @@ def run(n_configs: int = 10, seed: int = 0, tol_db: float = 0.15) -> int:
         noise_scale = float(rng.uniform(0.3, 1.2))
         mask_type = rng.choice(["irm1", "irm2", "ibm1"])
         policy = rng.choice(["local", "none"])
+        # round 3: the fused masked-covariance kernel joins the soak — on
+        # 'local' configs it covers BOTH steps' stat stacks (interpret mode
+        # on CPU), exercising random shapes the fixed tests don't
+        cov_impl = rng.choice(["xla", "pallas"]) if policy == "local" else "xla"
 
         src = rng.standard_normal(L)
         s = np.stack([
@@ -68,7 +72,8 @@ def run(n_configs: int = 10, seed: int = 0, tol_db: float = 0.15) -> int:
         want = tango_np(y, s, n, mask_type=mask_type, mask_for_z=policy if policy == "local" else None)
         Y, S, N = stft(y), stft(s), stft(n)
         masks = oracle_masks(S, N, mask_type)
-        res = tango(Y, S, N, masks, masks, policy=policy, mask_type=mask_type)
+        res = tango(Y, S, N, masks, masks, policy=policy, mask_type=mask_type,
+                    cov_impl=cov_impl)
 
         worst_deficit = 0.0  # how far ours falls BELOW the oracle
         best_surplus = 0.0
@@ -87,7 +92,8 @@ def run(n_configs: int = 10, seed: int = 0, tol_db: float = 0.15) -> int:
         ok = (worst_deficit < tol_db) and not ours_bad
         failures += not ok
         print(
-            f"[{i:02d}] K={K} C={C} L={L} noise={noise_scale:.2f} {mask_type}/{policy}: "
+            f"[{i:02d}] K={K} C={C} L={L} noise={noise_scale:.2f} {mask_type}/{policy}"
+            f"{'/covfused' if cov_impl == 'pallas' else ''}: "
             f"deficit {worst_deficit:.4f} dB, surplus {best_surplus:.4f} dB"
             + (f", oracle NaN at {oracle_nans} node(s)" if oracle_nans else "")
             + f" {'ok' if ok else 'FAIL'}",
