@@ -302,11 +302,31 @@ def main():
     done = _start_watchdog(timeout_s) if timeout_s > 0 else None
     # BENCH_BATCH / BENCH_DUR_S / BENCH_ITERS override the workload size
     # (defaults are the headline config; smaller values for CPU smoke tests).
-    r = bench_jax(
-        batch=int(os.environ.get("BENCH_BATCH", 16)),
-        dur_s=float(os.environ.get("BENCH_DUR_S", 10.0)),
-        iters=int(os.environ.get("BENCH_ITERS", 5)),
-    )
+    try:
+        r = bench_jax(
+            batch=int(os.environ.get("BENCH_BATCH", 16)),
+            dur_s=float(os.environ.get("BENCH_DUR_S", 10.0)),
+            iters=int(os.environ.get("BENCH_ITERS", 5)),
+        )
+    except Exception as e:
+        # A failed backend init (e.g. the tunneled chip service answering
+        # UNAVAILABLE, as in BENCH_r02) must still leave a PARSEABLE record:
+        # one JSON line naming the cause, then a nonzero exit.  A raw stack
+        # trace is an artifact only a human can read.
+        if done is not None:
+            done.set()
+        print(
+            json.dumps(
+                {
+                    "metric": "rtf_8node_mwf_enhancement",
+                    "value": None,
+                    "unit": "x_realtime",
+                    "error": f"{type(e).__name__}: {e}"[:500],
+                }
+            ),
+            flush=True,
+        )
+        raise SystemExit(2)
     streaming_error = None
     try:
         lat_ms, budget_ms, stream_rtf = bench_streaming(
