@@ -84,9 +84,9 @@ def _whitened(Rxx: jnp.ndarray, Rnn: jnp.ndarray):
     return L, 0.5 * (A + A.conj().swapaxes(-1, -2))  # re-hermitize vs roundoff
 
 
-@partial(jax.jit, static_argnames=("rank", "sanitize", "eigh_impl"))
+@partial(jax.jit, static_argnames=("rank", "sanitize", "eigh_impl", "sweeps"))
 def gevd_mwf(Rxx: jnp.ndarray, Rnn: jnp.ndarray, mu: float = 1.0, rank=1,
-             sanitize: bool = True, eigh_impl: str = "xla"):
+             sanitize: bool = True, eigh_impl: str = "xla", sweeps: int | None = None):
     """Rank-``rank`` GEVD-MWF (the 'gevd' branch of internal_formulas.py:56-73).
 
     Args:
@@ -102,6 +102,9 @@ def gevd_mwf(Rxx: jnp.ndarray, Rnn: jnp.ndarray, mu: float = 1.0, rank=1,
         (``jnp.linalg.eigh``), 'jacobi' (fixed-sweep cyclic Jacobi,
         ``disco_tpu.ops.eigh_ops.eigh_jacobi``) or 'jacobi-pallas' (the
         same schedule as one fused VMEM kernel).
+      sweeps: Jacobi sweep count for the 'jacobi'/'jacobi-pallas' impls
+        (static; ignored by 'xla').  None -> the size-adaptive
+        ``eigh_ops.default_sweeps``.
 
     Returns:
       (W, t1): filter (..., C) and the GEVD reference-selection vector
@@ -114,7 +117,7 @@ def gevd_mwf(Rxx: jnp.ndarray, Rnn: jnp.ndarray, mu: float = 1.0, rank=1,
     elif eigh_impl == "jacobi":
         from disco_tpu.ops.eigh_ops import eigh_jacobi
 
-        lam, U = eigh_jacobi(A)
+        lam, U = eigh_jacobi(A, sweeps=sweeps)
     elif eigh_impl == "jacobi-pallas":
         from disco_tpu.ops.eigh_ops import eigh_jacobi_pallas
 
@@ -124,7 +127,7 @@ def gevd_mwf(Rxx: jnp.ndarray, Rnn: jnp.ndarray, mu: float = 1.0, rank=1,
         # interpreter makes the branch testable on any backend.  Keyed off
         # the device kind, not the platform string — plugin platforms
         # (e.g. the tunneled 'axon' attachment) are real TPUs.
-        lam, U = eigh_jacobi_pallas(A, interpret=not is_tpu())
+        lam, U = eigh_jacobi_pallas(A, sweeps=sweeps, interpret=not is_tpu())
     else:
         raise ValueError(
             f"unknown eigh_impl {eigh_impl!r}; expected 'xla', 'jacobi' or 'jacobi-pallas'"
@@ -206,6 +209,32 @@ def gevd_mwf_power(Rxx: jnp.ndarray, Rnn: jnp.ndarray, mu: float = 1.0, iters: i
 RANK1_SOLVERS = ("eigh", "power", "jacobi", "jacobi-pallas")
 
 
+def parse_solver_spec(v: str) -> tuple[str, int | None]:
+    """THE parser for rank-1 GEVD solver specs — ``'base'`` or ``'base:N'``
+    with base in :data:`RANK1_SOLVERS` — shared by :func:`rank1_gevd` and
+    the CLI validator (cli/common.solver_spec), so the dispatch table and
+    argparse can never disagree on the grammar.  Returns (base, N-or-None);
+    raises ValueError on an unknown base, an 'eigh:N' suffix, or a
+    malformed/empty/<1 N (including multi-colon strings)."""
+    base, sep, n_str = v.partition(":")
+    if base not in RANK1_SOLVERS:
+        raise ValueError(
+            f"unknown GEVD solver {v!r}; expected one of {RANK1_SOLVERS}, "
+            "optionally with ':N' (power iterations / jacobi sweeps)"
+        )
+    if not sep:
+        return base, None
+    if base == "eigh":
+        raise ValueError(f"solver spec {v!r}: 'eigh' takes no ':N' suffix")
+    try:
+        n = int(n_str)
+    except ValueError:
+        n = 0
+    if n < 1:
+        raise ValueError(f"malformed solver spec {v!r}: '{base}:N' needs integer N >= 1")
+    return base, n
+
+
 def rank1_gevd(Rss, Rnn, mu: float = 1.0, solver: str = "eigh", sanitize: bool = True):
     """Rank-1 GEVD-MWF by solver spec — THE dispatch table shared by the
     offline TANGO steps, the streaming refreshes and ``intern_filter``:
@@ -217,24 +246,20 @@ def rank1_gevd(Rss, Rnn, mu: float = 1.0, solver: str = "eigh", sanitize: bool =
       f32 roundoff on offline frame-mean covariances at a fraction of the
       eigensolve cost; streaming warm-up covariances with weak eigengaps
       need ``power:N`` with larger N (see tests/test_streaming.py).
-    * ``'jacobi'`` / ``'jacobi-pallas'`` — fixed-sweep cyclic Jacobi full
-      eigendecomposition (``disco_tpu.ops.eigh_ops``), as a statically
-      unrolled XLA schedule or one fused VMEM pallas kernel.
+    * ``'jacobi'`` / ``'jacobi-pallas'`` (optionally ``':N'`` for an
+      explicit sweep count; default size-adaptive, eigh_ops.default_sweeps)
+      — fixed-sweep cyclic Jacobi full eigendecomposition
+      (``disco_tpu.ops.eigh_ops``), as a statically unrolled XLA schedule
+      or one fused VMEM pallas kernel.
     """
-    if solver == "eigh":
+    base, n = parse_solver_spec(solver)
+    if base == "eigh":
         return gevd_mwf(Rss, Rnn, mu=mu, rank=1, sanitize=sanitize)
-    if solver in ("jacobi", "jacobi-pallas"):
-        return gevd_mwf(Rss, Rnn, mu=mu, rank=1, sanitize=sanitize, eigh_impl=solver)
-    if solver == "power":
+    if base in ("jacobi", "jacobi-pallas"):
+        return gevd_mwf(Rss, Rnn, mu=mu, rank=1, sanitize=sanitize, eigh_impl=base, sweeps=n)
+    if n is None:
         return gevd_mwf_power(Rss, Rnn, mu=mu, sanitize=sanitize)
-    if solver.startswith("power:"):
-        iters = int(solver.split(":", 1)[1])
-        if iters < 1:
-            raise ValueError(f"solver spec {solver!r}: 'power:N' needs N >= 1")
-        return gevd_mwf_power(Rss, Rnn, mu=mu, iters=iters, sanitize=sanitize)
-    raise ValueError(
-        f"unknown GEVD solver {solver!r}; expected one of {RANK1_SOLVERS} or 'power:N'"
-    )
+    return gevd_mwf_power(Rss, Rnn, mu=mu, iters=n, sanitize=sanitize)
 
 
 @jax.jit

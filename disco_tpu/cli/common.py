@@ -36,24 +36,15 @@ def snr_value(v: str):
 
 
 def solver_spec(v: str):
-    """argparse type for rank-1 GEVD solver specs: 'eigh', 'power',
-    'power:N', 'jacobi' or 'jacobi-pallas'
-    (see ``disco_tpu.beam.filters.rank1_gevd``)."""
+    """argparse type for rank-1 GEVD solver specs — delegates to THE shared
+    grammar (``disco_tpu.beam.filters.parse_solver_spec``): 'eigh',
+    'power[:N]', 'jacobi[:N]' or 'jacobi-pallas[:N]'."""
     import argparse
 
-    if v in ("eigh", "power", "jacobi", "jacobi-pallas"):
-        return v
-    if v.startswith("power:"):
-        try:
-            n = int(v.split(":", 1)[1])
-        except ValueError:
-            n = 0
-        if n < 1:
-            raise argparse.ArgumentTypeError(
-                f"malformed solver spec {v!r}: 'power:N' needs integer N >= 1"
-            )
-        return v
-    raise argparse.ArgumentTypeError(
-        f"unknown solver {v!r}; expected 'eigh', 'power', 'power:N', "
-        "'jacobi' or 'jacobi-pallas'"
-    )
+    from disco_tpu.beam.filters import parse_solver_spec
+
+    try:
+        parse_solver_spec(v)
+    except ValueError as e:
+        raise argparse.ArgumentTypeError(str(e))
+    return v

@@ -43,6 +43,19 @@ def _pairs(C: int):
     return [(p, q) for p in range(C - 1) for q in range(p + 1, C)]
 
 
+def default_sweeps(C: int) -> int:
+    """Size-adaptive sweep count reaching f32 machine-precision residuals
+    with margin (measured, round 3): C=4 converges by sweep 4 (residual
+    5e-7), C=11 by sweep 6 (1e-6) with sweep 5 borderline (8e-4).  The
+    pipeline's step-1 matrices are C<=5, so the adaptive default halves
+    the dominant-stage rotation count there vs the old fixed 8."""
+    if C <= 5:
+        return 5
+    if C <= 12:
+        return 7
+    return 8
+
+
 def _rotation(app, aqq, apq_re, apq_im, eps):
     """Jacobi rotation (c, sigma_re, sigma_im) zeroing the (p, q) entry.
 
@@ -143,13 +156,13 @@ def _sorted_eigpairs(Ar, Vr, Vi):
 
 
 @partial(jax.jit, static_argnames=("sweeps",))
-def eigh_jacobi(A: jnp.ndarray, sweeps: int = 8):
+def eigh_jacobi(A: jnp.ndarray, sweeps: int | None = None):
     """Batched hermitian eigendecomposition, ascending (like jnp.linalg.eigh).
 
     Args:
       A: (..., C, C) hermitian, complex64 or float32.
-      sweeps: fixed cyclic sweep count (8 reaches f32 machine precision for
-        C <= 16; see tests).
+      sweeps: fixed cyclic sweep count; None -> :func:`default_sweeps` (size-
+        adaptive, f32 machine precision with margin; 8 covers C <= 16).
 
     Returns:
       (lam, V): eigenvalues (..., C) float32 ascending, eigenvectors
@@ -157,6 +170,8 @@ def eigh_jacobi(A: jnp.ndarray, sweeps: int = 8):
     """
     A = jnp.asarray(A)
     C = A.shape[-1]
+    if sweeps is None:
+        sweeps = default_sweeps(C)
     complex_in = jnp.iscomplexobj(A)
     Ar = jnp.real(A).astype(jnp.float32)
     Ai = jnp.imag(A).astype(jnp.float32) if complex_in else jnp.zeros_like(Ar)
@@ -188,7 +203,7 @@ def _eigh_kernel(ar_ref, ai_ref, lam_ref, vr_ref, vi_ref, *, C, sweeps, eps):
 
 
 @partial(jax.jit, static_argnames=("sweeps", "tile", "interpret"))
-def eigh_jacobi_pallas(A: jnp.ndarray, sweeps: int = 8, tile: int = 256, interpret: bool = False):
+def eigh_jacobi_pallas(A: jnp.ndarray, sweeps: int | None = None, tile: int = 256, interpret: bool = False):
     """:func:`eigh_jacobi` as one fused pallas kernel (see module docstring).
 
     Args:
@@ -200,6 +215,8 @@ def eigh_jacobi_pallas(A: jnp.ndarray, sweeps: int = 8, tile: int = 256, interpr
 
     A = jnp.asarray(A)
     C = A.shape[-1]
+    if sweeps is None:
+        sweeps = default_sweeps(C)
     batch_shape = A.shape[:-2]
     complex_in = jnp.iscomplexobj(A)
     Ar = jnp.real(A).astype(jnp.float32).reshape((-1, C, C))
