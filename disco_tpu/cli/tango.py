@@ -49,7 +49,9 @@ def build_parser():
                    help="rank-1 GEVD solver: 'eigh' (batched eigendecomposition), "
                         "'power'/'power:N' (dominant-pair power iteration; "
                         "streaming mode needs ~power:96 for eigh-level quality), "
-                        "'jacobi' or 'jacobi-pallas' (fixed-sweep cyclic Jacobi)")
+                        "'jacobi[:N]' or 'jacobi-pallas[:N]' (cyclic Jacobi, "
+                        "size-adaptive sweeps; full eig, so it tracks eigh in "
+                        "streaming mode too)")
     p.add_argument("--cov_impl", choices=["xla", "pallas"], default="xla",
                    help="masked-covariance stage: 'xla' (einsum) or 'pallas' "
                         "(fused single-read kernel, ops/cov_ops.py)")
