@@ -1,0 +1,56 @@
+"""Unit tests for bench.py's k-queued slope timing — the measurement math
+every hardware RTF claim rests on (README 'Timing methodology').
+
+The tunnel model: each fenced measurement costs ``overhead + k * t_exec``
+(one fixed RPC round-trip per fence, k queued on-device executions).  The
+slope estimator must recover ``t_exec`` exactly under that model and fall
+back conservatively when jitter makes the slope non-positive."""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def test_slope_recovers_on_device_time(monkeypatch):
+    import bench
+
+    calls = {}
+
+    def fake_time_queued(fn, *args, k=1, iters=5):
+        calls[k] = calls.get(k, 0) + 1
+        return 0.080 + k * 0.012  # 80 ms tunnel + 12 ms/exec
+
+    monkeypatch.setattr(bench, "_time_queued", fake_time_queued)
+    slope, t1 = bench._slope_time(lambda: None, k=6, iters=3)
+    assert abs(slope - 0.012) < 1e-12  # true on-device time, tunnel removed
+    assert abs(t1 - 0.092) < 1e-12  # single-dispatch keeps the tunnel
+    assert set(calls) == {1, 6}
+
+
+def test_slope_nonpositive_falls_back_to_upper_bound(monkeypatch):
+    """RPC jitter can make t_k <= t_1; the estimator must then report the
+    conservative amortized upper bound t_k / k, never a tiny/negative
+    'fast' number."""
+    import bench
+
+    def fake_time_queued(fn, *args, k=1, iters=5):
+        return 0.100 if k == 1 else 0.090  # jitter: k=6 cheaper than k=1
+
+    monkeypatch.setattr(bench, "_time_queued", fake_time_queued)
+    slope, _ = bench._slope_time(lambda: None, k=6, iters=3)
+    assert abs(slope - 0.090 / 6) < 1e-12
+
+
+def test_time_queued_uses_median(monkeypatch):
+    import time as _time
+
+    import bench
+
+    seq = iter([0.0, 0.5, 1.0, 1.1, 2.0, 2.9, 4.0, 4.2, 6.0, 6.25])
+    monkeypatch.setattr(_time, "perf_counter", lambda: next(seq))
+    monkeypatch.setattr(bench, "_fence", lambda x: 0.0)
+    monkeypatch.setattr(bench, "_leaf", lambda x: x)
+    # warm-up consumes nothing from the clock (fence mocked), 5 iters ->
+    # deltas 0.5, 0.1, 0.9, 0.2, 0.25 -> sorted median = 0.25
+    dt = bench._time_queued(lambda: 0, k=1, iters=5)
+    assert abs(dt - 0.25) < 1e-12
