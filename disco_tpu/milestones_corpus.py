@@ -19,20 +19,58 @@ import numpy as np
 FS = 16000
 
 
+def _speechlike(rng, n: int, fs: int = FS, f0_base: float = 140.0) -> np.ndarray:
+    """Harmonic speech-like signal: pitched harmonic source with a wandering
+    f0, two random formant resonances per 'syllable', a small aspiration
+    noise floor, and a pause-structured envelope.
+
+    Round-3 finding: the earlier stand-in (amplitude-modulated WHITE noise)
+    is spectrally identical to the SSN noise it is mixed against, so the
+    IRM is nearly unpredictable from mixture spectra and a mask CRNN
+    trained on such a corpus collapses to the mean mask (held-out deltas
+    go negative — see exp/convergence_result_flatspec.json).  Harmonic
+    structure is what makes the reference's mask-learning task work, so
+    the stand-in must have it too."""
+    from scipy.signal import lfilter
+
+    t = np.arange(n) / fs
+    # wandering pitch: slow vibrato + per-utterance drift
+    f0 = f0_base * (1.0 + 0.15 * np.sin(2 * np.pi * 2.7 * t + rng.uniform(0, 7)))
+    phase = 2 * np.pi * np.cumsum(f0) / fs
+    src = np.zeros(n)
+    for h in range(1, 13):  # sawtooth-ish rolloff
+        src += np.sin(h * phase + rng.uniform(0, 7)) / h
+    # syllabic segments: each gets its own 2 formant resonators
+    out = np.zeros(n)
+    seg = int(0.22 * fs)
+    for a in range(0, n, seg):
+        b = min(a + seg, n)
+        x = src[a:b] + 0.1 * rng.standard_normal(b - a)  # aspiration floor
+        for fmt in rng.uniform([350, 900], [900, 2600]):
+            r = 0.97
+            th = 2 * np.pi * fmt / fs
+            x = lfilter([1.0 - r], [1.0, -2 * r * np.cos(th), r * r], x)
+        out[a:b] = x
+    env = (np.sin(2 * np.pi * rng.uniform(1.0, 1.6) * t + rng.uniform(0, 7)) > -0.3).astype(np.float64)
+    out = env * out
+    peak = np.max(np.abs(out))
+    return 0.4 * out / (peak + 1e-9)
+
+
 def synth_speech_tree(root, n_speakers: int = 3, dur_s: float = 6.0, seed: int = 0):
-    """LibriSpeech-shaped tree of synthetic speech-like signals (modulated
-    noise with pause structure), covering the three splits disco-gen globs."""
+    """LibriSpeech-shaped tree of synthetic harmonic speech-like signals
+    (see :func:`_speechlike`), covering the three splits disco-gen globs."""
     from disco_tpu.io import write_wav
 
     rng = np.random.default_rng(seed)
-    t = np.arange(int(dur_s * FS)) / FS
+    n = int(dur_s * FS)
     for i in range(n_speakers):
         spk = str(19 + 7 * i)
-        env = (np.sin(2 * np.pi * (1.1 + 0.3 * i) * t + i) > -0.3).astype(np.float64)
+        f0_base = 110.0 * 2 ** rng.uniform(0.0, 0.8)  # per-speaker register
         for split in ("train-clean-100", "train-clean-360", "test-clean"):
             d = root / split / spk / "1"
             d.mkdir(parents=True, exist_ok=True)
-            write_wav(d / f"{spk}-1-0001.wav", 0.3 * env * rng.standard_normal(len(t)), FS)
+            write_wav(d / f"{spk}-1-0001.wav", _speechlike(rng, n, f0_base=f0_base), FS)
     return root
 
 
