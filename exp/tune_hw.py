@@ -1,0 +1,220 @@
+"""Second-wave hardware tuning sweep (round 3).
+
+Covers the ROUND3.md "decisions staged for hardware" that the first-wave
+``exp/tpu_validation.py`` sweep does NOT answer:
+
+- ``stft_variants``     — rfft vs MXU-matmul vs pallas STFT on the bench
+                          shapes (the routing bug fixed this round means the
+                          matmul path has never been slope-timed on silicon).
+- ``jacobi_sweeps``     — ``jacobi:N`` for N in 3..8: RTF + SI-SDR agreement
+                          vs the eigh lane, so the size-adaptive sweep
+                          schedule (ops/eigh_ops.default_sweeps) can be tuned
+                          to measured convergence on-device.
+- ``streaming_solver``  — per-frame refresh cost of the online pipeline with
+                          solver eigh vs jacobi (round-3 streaming parity is
+                          pinned at 0.2 dB; which is *faster* per refresh is
+                          the open hardware question).
+- ``combo``             — solver x cov_impl cross products solver_ab skipped
+                          (jacobi+pallas-cov etc.): the candidate new default
+                          is whatever this section says is fastest at
+                          SDR-parity.
+
+One process, one claim cycle, every section exception-isolated; one JSON
+line per section (same contract as exp/tpu_validation.py).
+
+Usage: python exp/tune_hw.py [--quick]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))  # repo root
+
+
+def section(name, fn):
+    t0 = time.perf_counter()
+    try:
+        out = fn()
+        out = {"section": name, "ok": True, **(out if isinstance(out, dict) else {"result": out})}
+    except Exception as e:
+        out = {"section": name, "ok": False, "error": f"{type(e).__name__}: {e}"[:300]}
+    out["wall_s"] = round(time.perf_counter() - t0, 1)
+    print(json.dumps(out), flush=True)
+    return out
+
+
+def stft_variants(batch=16, dur_s=10.0, iters=5):
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from bench import _slope_time
+    from disco_tpu.core.dsp import stft
+    from disco_tpu.milestones import _scene
+
+    FS, K, C = 16000, 8, 4
+    L = int(dur_s * FS)
+    y, _, _ = _scene(K, C, L, noise_scale=0.5)
+    yb = jnp.asarray(np.stack([y] * batch))
+
+    out = {}
+    ref, ref_name = None, None
+    for impl in ("rfft", "matmul", "pallas"):
+        try:
+            run = jax.jit(lambda x, impl=impl: stft(x, impl=impl))
+            Y = run(yb)
+            dt, _ = _slope_time(run, yb, iters=iters)
+            lane = {"ms": round(dt * 1e3, 2)}
+            Yh = np.asarray(jnp.abs(Y), np.float64)
+            if ref is None:
+                ref, ref_name = Yh, impl  # anchor = first lane that succeeds
+            else:
+                denom = float(np.mean(ref**2)) or 1.0
+                lane[f"rel_err_vs_{ref_name}"] = float(np.sqrt(np.mean((Yh - ref) ** 2) / denom))
+        except Exception as e:
+            lane = {"error": f"{type(e).__name__}: {e}"[:200]}
+        out[impl] = lane
+    return out
+
+
+def _tango_harness(B, dur_s, K=8, C=4):
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from disco_tpu.core.dsp import stft
+    from disco_tpu.enhance import oracle_masks
+    from disco_tpu.enhance.tango import tango
+    from disco_tpu.milestones import _scene
+
+    FS = 16000
+    L = int(dur_s * FS)
+    y, s, n = _scene(K, C, L, noise_scale=0.5)
+    yb = jnp.asarray(np.stack([y] * B))
+    sb = jnp.asarray(np.stack([s] * B))
+    nb = jnp.asarray(np.stack([n] * B))
+
+    def make(solver, cov_impl="xla"):
+        @jax.jit
+        def run(yb, sb, nb):
+            def one(y, s, n):
+                Y, S, N = stft(y), stft(s), stft(n)
+                m = oracle_masks(S, N, "irm1")
+                return tango(Y, S, N, m, m, policy="local", solver=solver,
+                             cov_impl=cov_impl).yf
+            return jax.vmap(one)(yb, sb, nb)
+        return run
+
+    return make, (yb, sb, nb), L, K, B * K * dur_s
+
+
+def _solver_lanes(lanes, B=16, dur_s=10.0, iters=3):
+    """Shared lane runner: RTF per (solver, cov_impl) + SI-SDR agreement
+    against the eigh/xla anchor (anchored ONLY by the eigh lane, as in
+    exp/tpu_validation.solver_ab)."""
+    import numpy as np
+
+    from bench import _slope_time
+    from disco_tpu.core.dsp import istft
+    from disco_tpu.core.metrics import si_sdr
+
+    make, args, L, K, audio_s = _tango_harness(B, dur_s)
+    out = {}
+    ref_t = None
+    for name, solver, cov in lanes:
+        try:
+            run = make(solver, cov)
+            yf = run(*args)
+            dt, _ = _slope_time(run, *args, iters=iters)
+            lane = {"rtf": round(audio_s / dt, 1), "ms_per_batch": round(dt * 1e3, 2)}
+            est_t = np.asarray(istft(yf[0], length=L), np.float64)
+            if name == "eigh":
+                ref_t = est_t
+            elif ref_t is not None:
+                lane["si_sdr_vs_eigh_db"] = round(
+                    float(np.mean([si_sdr(ref_t[k], est_t[k]) for k in range(K)])), 2
+                )
+            else:
+                lane["si_sdr_vs_eigh_db"] = None
+        except Exception as e:
+            lane = {"error": f"{type(e).__name__}: {e}"[:200]}
+        out[name] = lane
+    return out
+
+
+def jacobi_sweeps(B=16, dur_s=10.0, iters=3, ns=(3, 4, 5, 6, 8)):
+    lanes = [("eigh", "eigh", "xla")]
+    lanes += [(f"jacobi:{n}", f"jacobi:{n}", "xla") for n in ns]
+    return _solver_lanes(lanes, B=B, dur_s=dur_s, iters=iters)
+
+
+def combo(B=16, dur_s=10.0, iters=3):
+    lanes = [
+        ("eigh", "eigh", "xla"),
+        ("jacobi+covfused", "jacobi", "pallas"),
+        ("power+covfused", "power", "pallas"),
+        ("jacobi-pallas+covfused", "jacobi-pallas", "pallas"),
+    ]
+    return _solver_lanes(lanes, B=B, dur_s=dur_s, iters=iters)
+
+
+def streaming_solver(dur_s=10.0, K=4, C=4, update_every=4, iters=5):
+    import numpy as np
+    import jax
+
+    from bench import _slope_time
+    from disco_tpu.core.dsp import stft
+    from disco_tpu.enhance import oracle_masks
+    from disco_tpu.enhance.streaming import streaming_tango
+    from disco_tpu.milestones import _scene
+
+    FS = 16000
+    L = int(dur_s * FS)
+    y, s, n = _scene(K, C, L, noise_scale=0.5)
+    Y, S, N = stft(y), stft(s), stft(n)
+    masks = oracle_masks(S, N, "irm1")
+    T = Y.shape[-1]
+    budget_ms = 1e3 * 256 / FS
+
+    out = {"frame_budget_ms": round(budget_ms, 3)}
+    for solver in ("eigh", "jacobi"):
+        try:
+            run = jax.jit(
+                lambda Y, mz, mw, solver=solver: streaming_tango(
+                    Y, mz, mw, update_every=update_every, policy="local", solver=solver
+                )["yf"]
+            )
+            dt, _ = _slope_time(run, Y, masks, masks, iters=iters)
+            per_frame_ms = 1e3 * dt / T
+            out[solver] = {
+                "latency_ms_frame": round(per_frame_ms, 4),
+                "rtf": round(budget_ms / per_frame_ms, 1),
+            }
+        except Exception as e:
+            out[solver] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    return out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--quick", action="store_true", help="smaller scales")
+    args = p.parse_args(argv)
+
+    if args.quick:
+        section("stft_variants", lambda: stft_variants(batch=2, dur_s=2.0, iters=1))
+        section("jacobi_sweeps", lambda: jacobi_sweeps(B=2, dur_s=2.0, iters=1, ns=(4, 6)))
+        section("streaming_solver", lambda: streaming_solver(dur_s=2.0, K=2, C=2, iters=1))
+        section("combo", lambda: combo(B=2, dur_s=2.0, iters=1))
+        return
+    section("stft_variants", stft_variants)
+    section("jacobi_sweeps", jacobi_sweeps)
+    section("streaming_solver", streaming_solver)
+    section("combo", combo)
+
+
+if __name__ == "__main__":
+    main()
