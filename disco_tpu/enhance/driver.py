@@ -21,7 +21,7 @@ from __future__ import annotations
 import os
 import pickle
 import threading
-from functools import partial
+from functools import lru_cache, partial
 from pathlib import Path
 
 import numpy as np
@@ -363,6 +363,21 @@ def aggregate_results(oim_dir, kind: str = "tango", noise: str | None = None):
     return concatenate_dicts(dicts)
 
 
+@lru_cache(maxsize=8)
+def _jitted_step1_2d(mu: float):
+    """One jitted (batch, node)-vmapped step-1 program per mu.  Cached at
+    module level so repeated corpus batches reuse the traced program — a
+    fresh ``jax.jit`` per batch re-traces everything (see the round-3 note
+    on ``inference._jitted_sliding_masks``)."""
+    import jax
+
+    from disco_tpu.enhance.tango import tango_step1
+
+    return jax.jit(
+        jax.vmap(jax.vmap(lambda y, s, n, m: tango_step1(y, s, n, m, mu=mu)))
+    )
+
+
 def _batched_masks(Yb, Sb, Nb, models, mask_type, mu, n_nodes, z_sigs):
     """Step-1/step-2 masks for a WHOLE clip batch: the (B, K) node forwards
     of each CRNN step run as one concatenated device call
@@ -372,7 +387,6 @@ def _batched_masks(Yb, Sb, Nb, models, mask_type, mu, n_nodes, z_sigs):
     import jax.numpy as jnp
 
     from disco_tpu.enhance.inference import crnn_masks_batched
-    from disco_tpu.enhance.tango import tango_step1
 
     B, K, _, F, T = Yb.shape
     oracle = jax.vmap(lambda S, N: oracle_masks(S, N, mask_type))(Sb, Nb)
@@ -387,10 +401,7 @@ def _batched_masks(Yb, Sb, Nb, models, mask_type, mu, n_nodes, z_sigs):
     if models[1] is None:
         Mw = oracle
     else:
-        step1 = jax.jit(
-            jax.vmap(jax.vmap(lambda y, s, n, m: tango_step1(y, s, n, m, mu=mu)))
-        )
-        out = step1(Yb, Sb, Nb, Mz)
+        out = _jitted_step1_2d(mu)(Yb, Sb, Nb, Mz)
         zs = jax.vmap(lambda zy, zn: _z_for_mask_device(zy, zn, n_nodes, z_sigs))(
             out["z_y"], out["zn"]
         ).reshape(B * K, -1, F, T)

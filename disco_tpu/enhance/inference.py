@@ -283,6 +283,7 @@ def _conv_stream_safe(model) -> bool:
 
 
 @functools.lru_cache(maxsize=32)
+@functools.lru_cache(maxsize=64)
 def _jitted_sliding_masks(model, win_len: int, frame_to_pred: str, group: int,
                           pad: tuple, norm_type: str | None, n_fill: int,
                           no_z: bool):
@@ -291,7 +292,15 @@ def _jitted_sliding_masks(model, win_len: int, frame_to_pred: str, group: int,
     windows, apply the model over ``group`` streams at a time, keep the
     predicted frame — all inside one jit, with ``lax.map`` over stream
     groups bounding peak memory.  ``n_fill`` duplicate streams pad B to a
-    multiple of ``group`` (dropped by the caller)."""
+    multiple of ``group`` (dropped by the caller).
+
+    The lru_cache is load-bearing for throughput, not a micro-optimization:
+    without it every call builds a fresh ``jax.jit`` wrapper, so every
+    corpus batch re-traces and re-lowers the full mask program (measured on
+    the round-3 hardware A/B as the batched path running 4x SLOWER than the
+    per-clip loop purely on host-side tracing time — the XLA executable
+    cache only saves the final compile step).  All key arguments are
+    hashable: flax modules hash by structure, the rest are static config."""
 
     streaming = _conv_stream_safe(model)  # CRNN: convs hoisted to full stream
 

@@ -38,22 +38,30 @@ from disco_tpu.beam.covariance import masked_covariances
 
 
 def _cov_kernel(yr_ref, yi_ref, m_ref, ssr_ref, ssi_ref, nnr_ref, nni_ref, *, C, inv_t):
-    """One (C, Fb, T) block: both masked covariances, hermitian triangle."""
-    m = m_ref[0]  # (Fb, T)
+    """One (C, T, Fb) block: both masked covariances, hermitian triangle.
+
+    Layout note (learned on real Mosaic, TPU v5e): the frame reduction runs
+    over the SUBLANE axis (frames-major (T, Fb) planes, ``axis=0``) so each
+    per-bin result is born as a lane vector and every store below is a
+    native contiguous lane store.  The frames-minor formulation (reduce
+    over the lane axis, store across sublanes) is rejected by the Mosaic
+    lowering — block-shape ValueError at f_tile=8, UNIMPLEMENTED relayout
+    at f_tile=128."""
+    m = m_ref[0]  # (T, Fb)
     ws = (m * m) * inv_t
     one_m = 1.0 - m
     wn = (one_m * one_m) * inv_t
     for c in range(C):
-        xr_c, xi_c = yr_ref[0, c], yi_ref[0, c]  # (Fb, T)
+        xr_c, xi_c = yr_ref[0, c], yi_ref[0, c]  # (T, Fb)
         for d in range(c, C):
             xr_d, xi_d = yr_ref[0, d], yi_ref[0, d]
             # Y_c conj(Y_d): re = rc rd + ic id, im = ic rd - rc id
             prr = xr_c * xr_d + xi_c * xi_d
             pii = xi_c * xr_d - xr_c * xi_d
-            ss_re = jnp.sum(ws * prr, axis=-1)  # (Fb,)
-            ss_im = jnp.sum(ws * pii, axis=-1)
-            nn_re = jnp.sum(wn * prr, axis=-1)
-            nn_im = jnp.sum(wn * pii, axis=-1)
+            ss_re = jnp.sum(ws * prr, axis=0)  # (Fb,) lane vector
+            ss_im = jnp.sum(ws * pii, axis=0)
+            nn_re = jnp.sum(wn * prr, axis=0)
+            nn_im = jnp.sum(wn * pii, axis=0)
             ssr_ref[0, c, d, :] = ss_re
             ssi_ref[0, c, d, :] = ss_im
             nnr_ref[0, c, d, :] = nn_re
@@ -92,16 +100,23 @@ def masked_cov_pallas(y: jnp.ndarray, mask: jnp.ndarray, f_tile: int = 128, inte
     B = 1
     for n in lead:
         B *= n
-    yr = jnp.real(y).astype(jnp.float32).reshape(B, C, F, T)
-    yi = jnp.imag(y).astype(jnp.float32).reshape(B, C, F, T)
-    m = jnp.broadcast_to(jnp.asarray(mask, jnp.float32), tuple(lead) + (F, T)).reshape(B, F, T)
+    # frames-major planes: the kernel reduces over sublanes (see
+    # _cov_kernel's layout note) — transpose costs one HBM pass of Y, still
+    # far below the three masked-copy round trips the einsum path pays
+    yr = jnp.real(y).astype(jnp.float32).reshape(B, C, F, T).transpose(0, 1, 3, 2)
+    yi = jnp.imag(y).astype(jnp.float32).reshape(B, C, F, T).transpose(0, 1, 3, 2)
+    m = (
+        jnp.broadcast_to(jnp.asarray(mask, jnp.float32), tuple(lead) + (F, T))
+        .reshape(B, F, T)
+        .transpose(0, 2, 1)
+    )
 
     n_ft = -(-F // f_tile)
     Fp = n_ft * f_tile
     if Fp != F:
-        pad = ((0, 0), (0, 0), (0, Fp - F), (0, 0))
+        pad = ((0, 0), (0, 0), (0, 0), (0, Fp - F))
         yr, yi = jnp.pad(yr, pad), jnp.pad(yi, pad)
-        m = jnp.pad(m, ((0, 0), (0, Fp - F), (0, 0)))
+        m = jnp.pad(m, ((0, 0), (0, 0), (0, Fp - F)))
 
     from jax.experimental import pallas as pl
 
@@ -116,9 +131,9 @@ def masked_cov_pallas(y: jnp.ndarray, mask: jnp.ndarray, f_tile: int = 128, inte
         partial(_cov_kernel, C=C, inv_t=1.0 / T),
         grid=(B, n_ft),
         in_specs=[
-            pl.BlockSpec((1, C, f_tile, T), lambda b, f: (b, 0, f, 0)),
-            pl.BlockSpec((1, C, f_tile, T), lambda b, f: (b, 0, f, 0)),
-            pl.BlockSpec((1, f_tile, T), lambda b, f: (b, f, 0)),
+            pl.BlockSpec((1, C, T, f_tile), lambda b, f: (b, 0, 0, f)),
+            pl.BlockSpec((1, C, T, f_tile), lambda b, f: (b, 0, 0, f)),
+            pl.BlockSpec((1, T, f_tile), lambda b, f: (b, 0, f)),
         ],
         out_specs=[
             pl.BlockSpec((1, C, C, f_tile), lambda b, f: (b, 0, 0, f)),

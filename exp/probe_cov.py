@@ -1,0 +1,23 @@
+import sys; sys.path.insert(0, "/root/repo")
+import json, time
+import numpy as np
+import jax.numpy as jnp
+
+out = {}
+rng = np.random.default_rng(0)
+y = (rng.standard_normal((1, 4, 257, 130)) + 1j * rng.standard_normal((1, 4, 257, 130))).astype(np.complex64)
+m = rng.uniform(size=(1, 257, 130)).astype(np.float32)
+
+from disco_tpu.ops.cov_ops import masked_cov_pallas
+from disco_tpu.beam.covariance import masked_covariances
+
+t0 = time.time()
+try:
+    Rss, Rnn = masked_cov_pallas(jnp.asarray(y), jnp.asarray(m), interpret=False)
+    ref_ss, ref_nn = masked_covariances(jnp.asarray(y), jnp.asarray(m))
+    err = float(jnp.max(jnp.abs(jnp.real(Rss) - jnp.real(ref_ss))) + jnp.max(jnp.abs(jnp.imag(Rss) - jnp.imag(ref_ss))))
+    scale = float(jnp.max(jnp.abs(jnp.real(ref_ss))))
+    out["covfused"] = {"ok": True, "rel_err": err / scale, "s": round(time.time() - t0, 1)}
+except Exception as e:
+    out["covfused"] = {"ok": False, "error": f"{type(e).__name__}: {e}"[:300], "s": round(time.time() - t0, 1)}
+print(json.dumps(out), flush=True)

@@ -1,0 +1,113 @@
+"""Bisect which Pallas construct the Mosaic lowering rejects on this chip.
+
+The fused covariance kernel compiles in interpret mode but returns
+UNIMPLEMENTED from the real TPU compiler; this ladder isolates the
+offending construct (run with the repo root on sys.path, one claim cycle).
+"""
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+import json
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+B, C, T, Fp = 1, 4, 130, 128
+
+
+def run_case(name, kernel, n_out, out_dims, in_specs, out_specs, args):
+    try:
+        outs = pl.pallas_call(
+            kernel,
+            grid=(B, 1),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=[jax.ShapeDtypeStruct(d, jnp.float32) for d in out_dims],
+        )(*args)
+        jax.block_until_ready(outs)
+        v = float(jnp.ravel(outs[0])[0])
+        return {"ok": True, "v": round(v, 4)}
+    except Exception as e:
+        return {"ok": False, "error": f"{type(e).__name__}: {e}"[:160]}
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((B, C, T, Fp)).astype(np.float32))
+    m = jnp.asarray(rng.standard_normal((B, T, Fp)).astype(np.float32))
+
+    spec4 = pl.BlockSpec((1, C, T, Fp), lambda b, f: (b, 0, 0, f))
+    spec3 = pl.BlockSpec((1, T, Fp), lambda b, f: (b, 0, f))
+    ospec = pl.BlockSpec((1, C, C, Fp), lambda b, f: (b, 0, 0, f))
+    oshape = (B, C, C, Fp)
+    results = {}
+
+    def k_copy(x_ref, o_ref):
+        o_ref[0, 0, 0, :] = x_ref[0, 0, 0, :]
+
+    results["copy_lane_row"] = run_case(
+        "copy", k_copy, 1, [oshape], [spec4], [ospec], (x,))
+
+    def k_reduce(x_ref, o_ref):
+        o_ref[0, 0, 0, :] = jnp.sum(x_ref[0, 0], axis=0)
+
+    results["sublane_reduce_store"] = run_case(
+        "reduce", k_reduce, 1, [oshape], [spec4], [ospec], (x,))
+
+    def k_reduce_all(x_ref, o_ref):
+        for c in range(C):
+            for d in range(C):
+                o_ref[0, c, d, :] = jnp.sum(x_ref[0, c] * x_ref[0, d], axis=0)
+
+    results["pairwise_loop"] = run_case(
+        "pairloop", k_reduce_all, 1, [oshape], [spec4], [ospec], (x,))
+
+    def k_mask3d(x_ref, m_ref, o_ref):
+        w = m_ref[0] * m_ref[0]
+        o_ref[0, 0, 0, :] = jnp.sum(w * x_ref[0, 0], axis=0)
+
+    results["mask3d_input"] = run_case(
+        "mask3d", k_mask3d, 1, [oshape], [spec4, spec3], [ospec], (x, m))
+
+    def k_4out(x_ref, o1, o2, o3, o4):
+        s = jnp.sum(x_ref[0, 0], axis=0)
+        o1[0, 0, 0, :] = s
+        o2[0, 0, 0, :] = s
+        o3[0, 0, 0, :] = -s
+        o4[0, 0, 0, :] = 2.0 * s
+
+    results["four_outputs"] = run_case(
+        "4out", k_4out, 4, [oshape] * 4, [spec4], [ospec] * 4, (x,))
+
+    # the real kernel, via its public wrapper (T=130 unaligned sublanes)
+    from disco_tpu.ops.cov_ops import masked_cov_pallas
+
+    y = jnp.asarray(
+        (rng.standard_normal((B, C, 257, T)) + 1j * rng.standard_normal((B, C, 257, T))).astype(np.complex64)
+    )
+    mm = jnp.asarray(rng.uniform(size=(B, 257, T)).astype(np.float32))
+    try:
+        Rss, _ = masked_cov_pallas(y, mm, interpret=False)
+        jax.block_until_ready(Rss)
+        results["full_kernel_T130"] = {"ok": True}
+    except Exception as e:
+        results["full_kernel_T130"] = {"ok": False, "error": f"{type(e).__name__}: {e}"[:160]}
+
+    # aligned frame count (T=128): is unaligned sublane blocking the issue?
+    try:
+        Rss, _ = masked_cov_pallas(y[..., :128], mm[..., :128], interpret=False)
+        jax.block_until_ready(Rss)
+        results["full_kernel_T128"] = {"ok": True}
+    except Exception as e:
+        results["full_kernel_T128"] = {"ok": False, "error": f"{type(e).__name__}: {e}"[:160]}
+
+    print(json.dumps(results), flush=True)
+
+
+if __name__ == "__main__":
+    main()
