@@ -29,10 +29,12 @@ C<=11 matrices), so it sits on the memory/latency side of the roofline, not
 the MXU side — a LOW MFU with a HIGH RTF is the expected signature, and the
 stage breakdown shows where the time actually goes.
 
-``rtf_power_solver`` additionally reports the pipeline with
-``solver='power'`` (dominant-eigenpair power iteration, SDR parity pinned at
-0.1 dB in tests/test_tango.py) — the headline ``value`` stays on the default
-eigh path.
+The headline ``value`` runs the pipeline DEFAULT solver — 'power'
+(dominant-eigenpair power iteration) since round 4, flipped from 'eigh' on
+the round-3 on-device A/B (solver_ab, exp/tpu_validation_r3.jsonl: power
+6722x vs eigh 4833x at 49 dB output agreement; SDR parity pinned at 0.1 dB
+in tests/test_tango.py).  ``rtf_eigh_solver`` keeps the
+reference-bit-matching eigh lane in every record.
 """
 import json
 import os
@@ -40,7 +42,13 @@ import time
 
 import numpy as np
 
-from disco_tpu.milestones import _fence, _scene
+from disco_tpu.milestones import (  # noqa: F401  (_slope_time re-exported
+    _fence,  # for exp/tune_hw.py and the validation sweeps)
+    _leaf,
+    _scene,
+    _slope_time,
+    _time_queued,
+)
 
 FS = 16000
 K, C = 8, 4  # 8-node, 4 mics per node (north-star config)
@@ -69,40 +77,10 @@ def _peak_flops():
     return _PEAK_TFLOPS["cpu"] * 1e12
 
 
-def _leaf(out):
-    import jax
-
-    return jax.tree_util.tree_leaves(out)[0]
-
-
-def _time_queued(fn, *args, k: int = 1, iters: int = 5):
-    """Median wall time of k async-queued executions under ONE fence."""
-    _fence(_leaf(fn(*args)))  # warm-up / compile
-    times = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        outs = [fn(*args) for _ in range(k)]
-        _fence(_leaf(outs[-1]))
-        times.append(time.perf_counter() - t0)
-    return sorted(times)[len(times) // 2]
-
-
-def _slope_time(fn, *args, k: int = 6, iters: int = 5):
-    """(on-device per-exec seconds, single-dispatch seconds) via the
-    k-queued slope (see module docstring).  When RPC jitter swamps the
-    signal (tk <= t1, i.e. the slope is non-positive), fall back to tk/k —
-    a conservative upper bound that still amortizes the overhead k-fold —
-    rather than reporting an absurdly small time as 'fast'."""
-    t1 = _time_queued(fn, *args, k=1, iters=iters)
-    tk = _time_queued(fn, *args, k=k, iters=iters)
-    slope = (tk - t1) / (k - 1)
-    if slope <= 0:
-        slope = tk / k
-    return slope, t1
 
 
 def bench_jax(batch=16, dur_s=10.0, iters=5):
-    """Returns dict with rtf (slope), rtf_single_dispatch, rtf_power,
+    """Returns dict with rtf (slope, default=power solver), rtf_single_dispatch, rtf_eigh,
     dispatch overhead, flops_per_clip, mfu, stage_ms."""
     import jax
     import jax.numpy as jnp
@@ -132,15 +110,17 @@ def bench_jax(batch=16, dur_s=10.0, iters=5):
 
         return run
 
-    run = make_run("eigh")
+    # headline lane = the production default (tango's solver default:
+    # 'power' since round 4, traceable to the round-3 solver_ab artifact)
+    run = make_run("power")
     dt, dt1 = _slope_time(run, yb, sb, nb, iters=iters)
     audio_s = batch * K * dur_s  # per-node enhanced outputs
     rtf = audio_s / dt
     rtf_single = audio_s / dt1
 
-    run_p = make_run("power")
-    dt_p, _ = _slope_time(run_p, yb, sb, nb, iters=iters)
-    rtf_power = audio_s / dt_p
+    run_e = make_run("eigh")
+    dt_e, _ = _slope_time(run_e, yb, sb, nb, iters=iters)
+    rtf_eigh = audio_s / dt_e
 
     # full-eigendecomposition alternative (ops/eigh_ops.py); measured so the
     # hardware record carries all solver families.  A failure is recorded as
@@ -156,11 +136,11 @@ def bench_jax(batch=16, dur_s=10.0, iters=5):
         jacobi_error = f"{type(e).__name__}: {e}"[:200]
 
     # fused masked-covariance kernel (ops/cov_ops.py, round-2 verdict #3):
-    # same eigh solver, covariance stage reads Y once instead of
+    # same default solver, covariance stage reads Y once instead of
     # materializing the masked copies.
     covfused_error = None
     try:
-        run_c = make_run("eigh", cov_impl="pallas")
+        run_c = make_run("power", cov_impl="pallas")
         dt_c, _ = _slope_time(run_c, yb, sb, nb, iters=iters)
         rtf_covfused = audio_s / dt_c
     except Exception as e:
@@ -209,7 +189,7 @@ def bench_jax(batch=16, dur_s=10.0, iters=5):
     return {
         "rtf": rtf,
         "rtf_single_dispatch": rtf_single,
-        "rtf_power": rtf_power,
+        "rtf_eigh": rtf_eigh,
         "rtf_jacobi": rtf_jacobi,
         "jacobi_error": jacobi_error,
         "rtf_covfused": rtf_covfused,
@@ -353,7 +333,8 @@ def main():
                 "unit": "x_realtime",
                 "vs_baseline": round(vs, 2) if vs else None,
                 "value_single_dispatch": round(r["rtf_single_dispatch"], 2),
-                "rtf_power_solver": round(r["rtf_power"], 2),
+                "solver_default": "power",
+                "rtf_eigh_solver": round(r["rtf_eigh"], 2),
                 "rtf_jacobi_solver": round(r["rtf_jacobi"], 2) if r.get("rtf_jacobi") else None,
                 "jacobi_error": r.get("jacobi_error"),
                 "rtf_covfused": round(r["rtf_covfused"], 2) if r.get("rtf_covfused") else None,
@@ -366,7 +347,7 @@ def main():
                 "mfu": round(r["mfu"], 6) if r["mfu"] else None,
                 "flops_per_clip": round(r["flops_per_clip"]) if r["flops_per_clip"] else None,
                 "stage_ms": r["stage_ms"],
-                "notes": "value = on-device RTF via k-queued slope timing (tunnel adds ~80ms/dispatch, reported separately; value_single_dispatch includes it); stages timed as separate fenced programs (full pipeline fuses tighter); numpy baseline at 2s clips; MFU vs dense-f32 peak (pipeline is FFT/small-eig bound by design)",
+                "notes": "value = DEFAULT pipeline (solver=power since round 4; rtf_eigh_solver is the reference-bit-matching lane), on-device RTF via k-queued slope timing (tunnel adds ~80ms/dispatch, reported separately; value_single_dispatch includes it); stages timed as separate fenced programs (full pipeline fuses tighter); numpy baseline at 2s clips; MFU vs dense-f32 peak (pipeline is FFT/small-eig bound by design)",
             }
         )
     )

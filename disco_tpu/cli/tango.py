@@ -46,12 +46,15 @@ def build_parser():
                    help="YAML config file (config.save_config layout); its "
                         "enhance.solver becomes the --solver default")
     p.add_argument("--solver", type=solver_spec, default=None,
-                   help="rank-1 GEVD solver: 'eigh' (batched eigendecomposition), "
+                   help="rank-1 GEVD solver: 'eigh' (batched eigendecomposition; "
+                        "bit-matches the reference semantics), "
                         "'power'/'power:N' (dominant-pair power iteration; "
                         "streaming mode needs ~power:96 for eigh-level quality), "
                         "'jacobi[:N]' or 'jacobi-pallas[:N]' (cyclic Jacobi, "
                         "size-adaptive sweeps; full eig, so it tracks eigh in "
-                        "streaming mode too)")
+                        "streaming mode too).  Default: 'power' offline / "
+                        "'eigh' with --streaming (measured on-device, round-3 "
+                        "solver_ab)")
     p.add_argument("--cov_impl", choices=["xla", "pallas"], default="xla",
                    help="masked-covariance stage: 'xla' (einsum) or 'pallas' "
                         "(fused single-read kernel, ops/cov_ops.py)")
@@ -84,14 +87,18 @@ def _load_model(path, archi: str = "crnn", n_ch: int = 1):
 
 def resolve_solver(args):
     """Solver precedence: explicit --solver > YAML enhance.solver from
-    --config > the EnhanceConfig dataclass default (config.py)."""
+    --config > None, deferring to the driver's mode-aware default
+    ('power' offline / 'eigh' streaming — enhance/driver.py, traceable to
+    the round-3 solver_ab artifact)."""
     if args.solver is not None:
         return args.solver
+    if not args.config:
+        return None
     import argparse as _argparse
 
     from disco_tpu.config import EnhanceConfig, load_config
 
-    cfg_enh = load_config(args.config).enhance if args.config else EnhanceConfig()
+    cfg_enh = load_config(args.config).enhance
     if args.config:
         # Only enhance.solver is consumed here; silently honoring part of a
         # DiscoConfig YAML would be a trap, so name what is being ignored.

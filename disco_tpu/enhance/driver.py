@@ -267,16 +267,25 @@ def enhance_rir(
     streaming: bool = False,
     bucket: int = 0,
     z_sigs: str = "zs_hat",
-    solver: str = "eigh",
+    solver: str | None = None,
     cov_impl: str = "xla",
 ):
     """Enhance one RIR end-to-end and persist everything (reference
     tango.py:460-641).  ``models``: per-step CRNN params or None for the
     oracle masks of ``mask_type``.  ``streaming=True`` runs the
     frame-recursive online pipeline (exponential-smoothing covariances,
-    block filter refresh) instead of the offline frame-mean one.  Returns
-    the tango results dict, or None when the RIR was already processed
-    (idempotency)."""
+    block filter refresh) instead of the offline frame-mean one.
+
+    ``solver=None`` resolves per mode: 'power' offline (measured fastest
+    at SDR parity — round-3 solver_ab, exp/tpu_validation_r3.jsonl) but
+    'eigh' in streaming mode, whose warm-up covariances have weak
+    eigengaps that the 12-iteration power default cannot resolve
+    (tests/test_streaming.py pins ~power:96 for eigh-level quality there).
+
+    Returns the tango results dict, or None when the RIR was already
+    processed (idempotency)."""
+    if solver is None:
+        solver = "eigh" if streaming else "power"
     import jax.numpy as jnp
 
     from disco_tpu.core.dsp import stft
@@ -429,7 +438,7 @@ def enhance_rirs_batched(
     max_batch: int = 16,
     models=(None, None),
     z_sigs: str = "zs_hat",
-    solver: str = "eigh",
+    solver: str | None = None,
     cov_impl: str = "xla",
     score_workers: int = 4,
     mesh=None,
@@ -462,6 +471,8 @@ def enhance_rirs_batched(
     Returns {rir: results dict} for the RIRs actually processed
     (already-done ones are skipped — same idempotency contract).
     """
+    if solver is None:
+        solver = "power"  # offline default, measured (round-3 solver_ab)
     import jax
     import jax.numpy as jnp
 

@@ -282,7 +282,6 @@ def _conv_stream_safe(model) -> bool:
     )
 
 
-@functools.lru_cache(maxsize=32)
 @functools.lru_cache(maxsize=64)
 def _jitted_sliding_masks(model, win_len: int, frame_to_pred: str, group: int,
                           pad: tuple, norm_type: str | None, n_fill: int,

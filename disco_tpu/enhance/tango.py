@@ -101,7 +101,7 @@ def _masked_cov_pair(X, mask, cov_impl: str, frame_axis):
 @partial(jax.jit, static_argnames=("oracle_stats", "ref_mic", "frame_axis", "solver", "cov_impl"))
 def tango_step1(
     Y, S, N, mask_z, mu: float = 1.0, oracle_stats: bool = False, ref_mic: int = 0,
-    frame_axis: str | None = None, solver: str = "eigh", cov_impl: str = "xla",
+    frame_axis: str | None = None, solver: str = "power", cov_impl: str = "xla",
 ):
     """Step 1 at ONE node: local rank-1 GEVD-MWF -> compressed signals.
 
@@ -180,7 +180,7 @@ def tango_step2(
     ref_mic: int = 0,
     mask_type: str = "irm1",
     frame_axis: str | None = None,
-    solver: str = "eigh",
+    solver: str = "power",
     cov_impl: str = "xla",
 ):
     """Step 2 at ONE node k: global rank-1 GEVD-MWF on ``[y_k ‖ z_{j≠k}]``
@@ -243,7 +243,7 @@ def tango(
     ref_mic: int = 0,
     mask_type: str = "irm1",
     oracle_step1_stats: bool = False,
-    solver: str = "eigh",
+    solver: str = "power",
     cov_impl: str = "xla",
 ) -> TangoResult:
     """The full two-step pipeline on one device: ``vmap`` over the node axis,

@@ -17,10 +17,11 @@ the masked copies never exist in HBM.  The math per frequency bin is
     Rss[c, d] = (1/T) sum_t m_t^2      Y[c, t] conj(Y[d, t])
     Rnn[c, d] = (1/T) sum_t (1 - m_t)^2 Y[c, t] conj(Y[d, t])
 
-evaluated hermitian-triangle-wise as elementwise products + lane-axis
-reductions over well-tiled (Fb, T) planes (VPU work; no tiny-matmul MXU
-padding waste, nothing Mosaic cannot lower).  Output layout inside the
-kernel is (C, C, F) so every store is a contiguous lane vector; the host
+evaluated hermitian-triangle-wise as elementwise products + SUBLANE-axis
+reductions over frames-major (T, Fb) planes (VPU work; no tiny-matmul MXU
+padding waste) — see ``_cov_kernel``'s layout note.  Each per-bin result
+is born as an (Fb,) lane vector, so output layout inside the kernel is
+(C, C, Fb) and every store is a contiguous lane store; the host
 transposes the tiny result to the (..., F, C, C) convention.
 
 :func:`masked_covariances_fused` dispatches 'xla' (the einsum path) /
@@ -40,13 +41,18 @@ from disco_tpu.beam.covariance import masked_covariances
 def _cov_kernel(yr_ref, yi_ref, m_ref, ssr_ref, ssi_ref, nnr_ref, nni_ref, *, C, inv_t):
     """One (C, T, Fb) block: both masked covariances, hermitian triangle.
 
-    Layout note (learned on real Mosaic, TPU v5e): the frame reduction runs
-    over the SUBLANE axis (frames-major (T, Fb) planes, ``axis=0``) so each
-    per-bin result is born as a lane vector and every store below is a
-    native contiguous lane store.  The frames-minor formulation (reduce
-    over the lane axis, store across sublanes) is rejected by the Mosaic
-    lowering — block-shape ValueError at f_tile=8, UNIMPLEMENTED relayout
-    at f_tile=128."""
+    Layout note: the frame reduction runs over the SUBLANE axis
+    (frames-major (T, Fb) planes, ``axis=0``) so each per-bin result is
+    born as a lane vector and every store below is a native contiguous
+    lane store.  What the chip has actually said so far (round-3 driver
+    artifacts): the frames-MINOR formulation is rejected at lowering
+    (block-shape ValueError at f_tile=8; UNIMPLEMENTED relayout at
+    f_tile=128 — exp/bench_r3_manual.json), and this frames-major rewrite
+    moved the failure to a tpu_compile_helper subprocess crash
+    (BENCH_r03.json covfused_error) — i.e. it is *expected* to lower but
+    has never yet compiled on real Mosaic.  exp/probe_mosaic.py bisects
+    the remaining crash; until it passes on-device, treat 'pallas' as an
+    experimental lane ('xla' is the default everywhere)."""
     m = m_ref[0]  # (T, Fb)
     ws = (m * m) * inv_t
     one_m = 1.0 - m

@@ -83,15 +83,38 @@ def _rotation(app, aqq, apq_re, apq_im, eps):
 def _apply_rotation(Ar, Ai, Vr, Vi, p, q, eps):
     """One (p, q) rotation on re/im planes: A <- G^H A G, V <- V G.
 
-    Shapes: (..., C, C).  p, q are static ints — all indexing is static
-    slices, no gathers.
+    Shapes: (..., C, C).  p, q are static ints.  Scatter-free: rows and
+    columns are READ with static slices but WRITTEN back as broadcast
+    selects against constant one-hot masks over the whole (C, C) plane —
+    the ``.at[].set()`` formulation lowers to scatter, which Mosaic lacks
+    (round-3 solver_ab on real TPU: "Unimplemented primitive in Pallas TPU
+    lowering ... scatter"), while masked selects are plain VPU work.  At
+    the pipeline's C <= 11 the full-plane select costs about the same as
+    the row write it replaces; XLA constant-folds the masks either way.
     """
+    C = Ar.shape[-1]
     c, sr, si = _rotation(
         Ar[..., p, p], Ar[..., q, q], Ar[..., p, q], Ai[..., p, q], eps
     )
     c = c[..., None]
     sr = sr[..., None]
     si = si[..., None]
+
+    # one-hot (C, C) masks from 2-D iota — NOT materialized numpy constants,
+    # which pallas kernels may not capture (and 1-D iota has no Mosaic
+    # lowering; jnp.eye is itself iota-based, hence kernel-safe)
+    row_idx = jax.lax.broadcasted_iota(jnp.int32, (C, C), 0)
+    col_idx = jax.lax.broadcasted_iota(jnp.int32, (C, C), 1)
+    row_p, row_q = row_idx == p, row_idx == q
+    col_p, col_q = col_idx == p, col_idx == q
+
+    def put_rows(M, new_p, new_q):
+        return jnp.where(row_p, new_p[..., None, :],
+                         jnp.where(row_q, new_q[..., None, :], M))
+
+    def put_cols(M, new_p, new_q):
+        return jnp.where(col_p, new_p[..., :, None],
+                         jnp.where(col_q, new_q[..., :, None], M))
 
     # rows: (G^H A)[p] = c A[p] - sigma A[q];  (G^H A)[q] = conj(sigma) A[p] + c A[q]
     rp_r, rp_i = Ar[..., p, :], Ai[..., p, :]
@@ -100,8 +123,8 @@ def _apply_rotation(Ar, Ai, Vr, Vi, p, q, eps):
     new_p_i = c * rp_i - (sr * rq_i + si * rq_r)
     new_q_r = (sr * rp_r + si * rp_i) + c * rq_r
     new_q_i = (sr * rp_i - si * rp_r) + c * rq_i
-    Ar = Ar.at[..., p, :].set(new_p_r).at[..., q, :].set(new_q_r)
-    Ai = Ai.at[..., p, :].set(new_p_i).at[..., q, :].set(new_q_i)
+    Ar = put_rows(Ar, new_p_r, new_q_r)
+    Ai = put_rows(Ai, new_p_i, new_q_i)
 
     # cols: (M G)[:, p] = c M[:, p] - conj(sigma) M[:, q];  (M G)[:, q] = sigma M[:, p] + c M[:, q]
     cp_r, cp_i = Ar[..., :, p], Ai[..., :, p]
@@ -110,8 +133,8 @@ def _apply_rotation(Ar, Ai, Vr, Vi, p, q, eps):
     new_cp_i = c * cp_i - (sr * cq_i - si * cq_r)
     new_cq_r = (sr * cp_r - si * cp_i) + c * cq_r
     new_cq_i = (sr * cp_i + si * cp_r) + c * cq_i
-    Ar = Ar.at[..., :, p].set(new_cp_r).at[..., :, q].set(new_cq_r)
-    Ai = Ai.at[..., :, p].set(new_cp_i).at[..., :, q].set(new_cq_i)
+    Ar = put_cols(Ar, new_cp_r, new_cq_r)
+    Ai = put_cols(Ai, new_cp_i, new_cq_i)
 
     # eigenvectors: V <- V G (same column update)
     vp_r, vp_i = Vr[..., :, p], Vi[..., :, p]
@@ -120,8 +143,8 @@ def _apply_rotation(Ar, Ai, Vr, Vi, p, q, eps):
     new_vp_i = c * vp_i - (sr * vq_i - si * vq_r)
     new_vq_r = (sr * vp_r - si * vp_i) + c * vq_r
     new_vq_i = (sr * vp_i + si * vp_r) + c * vq_i
-    Vr = Vr.at[..., :, p].set(new_vp_r).at[..., :, q].set(new_vq_r)
-    Vi = Vi.at[..., :, p].set(new_vp_i).at[..., :, q].set(new_vq_i)
+    Vr = put_cols(Vr, new_vp_r, new_vq_r)
+    Vi = put_cols(Vi, new_vp_i, new_vq_i)
     return Ar, Ai, Vr, Vi
 
 
@@ -191,13 +214,15 @@ def _eigh_kernel(ar_ref, ai_ref, lam_ref, vr_ref, vi_ref, *, C, sweeps, eps):
     """One batch tile: all sweeps in VMEM, single HBM round-trip.  Emits the
     UNSORTED converged diagonal + eigenvector planes — the argsort/gather of
     ``_sorted_eigpairs`` has no Mosaic lowering, so ordering happens in
-    plain XLA after the pallas_call."""
+    plain XLA after the pallas_call.  The diagonal is extracted as a masked
+    lane reduction (``sum(A * I, axis=-1)``) rather than ``jnp.diagonal``,
+    whose gather Mosaic also lacks."""
     Ar = ar_ref[...]
     Ai = ai_ref[...]
     Vr = jnp.broadcast_to(jnp.eye(C, dtype=jnp.float32), Ar.shape)
     Vi = jnp.zeros_like(Ar)
     Ar, Ai, Vr, Vi = _sweep_body(Ar, Ai, Vr, Vi, C, sweeps, eps)
-    lam_ref[...] = jnp.diagonal(Ar, axis1=-2, axis2=-1)
+    lam_ref[...] = jnp.sum(Ar * jnp.eye(C, dtype=jnp.float32), axis=-1)
     vr_ref[...] = Vr
     vi_ref[...] = Vi
 

@@ -93,7 +93,7 @@ def ring_all_gather(x, axis_name: str):
 
 def _tango_on_mesh(
     Y, S, N, masks_z, mask_w, mesh, frame_axis, mu, policy, ref_mic, mask_type,
-    oracle_step1_stats, z_exchange: str = "all_gather", solver: str = "eigh",
+    oracle_step1_stats, z_exchange: str = "all_gather", solver: str = "power",
     cov_impl: str = "xla",
 ) -> TangoResult:
     """Shared shard_map body for the node-sharded and node+frame-sharded
@@ -184,7 +184,7 @@ def tango_sharded(
     mask_type: str = "irm1",
     oracle_step1_stats: bool = False,
     z_exchange: str = "all_gather",
-    solver: str = "eigh",
+    solver: str = "power",
     cov_impl: str = "xla",
 ) -> TangoResult:
     """Two-step TANGO with the node axis sharded over ``mesh``'s 'node' axis.
@@ -219,7 +219,7 @@ def tango_frame_sharded(
     ref_mic: int = 0,
     mask_type: str = "irm1",
     oracle_step1_stats: bool = False,
-    solver: str = "eigh",
+    solver: str = "power",
 ) -> TangoResult:
     """Two-step TANGO sharded over BOTH the node axis and the STFT frame
     axis — the framework's sequence-parallel mode (SURVEY.md §5.7).
@@ -253,7 +253,7 @@ def tango_batch_sharded(
     policy="local",
     ref_mic: int = 0,
     mask_type: str = "irm1",
-    solver: str = "eigh",
+    solver: str = "power",
     cov_impl: str = "xla",
 ) -> TangoResult:
     """Corpus-scale TANGO on a (batch, node) mesh via GSPMD auto-partitioning:

@@ -1,0 +1,22 @@
+#!/bin/bash
+# Round-4 claim cycle A: every staged hardware measurement, sequentially,
+# one JAX process at a time (the environment contract — CLAUDE.md).
+# Fast compile probes first (seconds of signal on the round-4 kernel
+# rewrites), then the tuning + validation sweeps, then the bench artifact.
+set -u
+cd /root/repo
+log() { echo "=== $1 ($(date +%H:%M:%S)) ==="; }
+
+log "probe_jacobi (scatter-free kernel compile check)"
+python exp/probe_jacobi.py > exp/probe_jacobi_r4.json 2> exp/probe_jacobi_r4.err
+log "probe_mosaic (covfused bisect ladder)"
+python exp/probe_mosaic.py > exp/probe_mosaic_r4.json 2> exp/probe_mosaic_r4.err
+log "probe_cov (covfused full-kernel parity)"
+python exp/probe_cov.py > exp/probe_cov_r4.json 2> exp/probe_cov_r4.err
+log "tune_hw (second-wave sweeps)"
+python exp/tune_hw.py > exp/tune_hw_r4.jsonl 2> exp/tune_hw_r4.err
+log "tpu_validation (bench + solver_ab + crnn_ab + milestones)"
+python exp/tpu_validation.py > exp/tpu_validation_r4.jsonl 2> exp/tpu_validation_r4.err
+log "bench.py (round artifact rehearsal)"
+python bench.py > exp/bench_r4_manual.json 2> exp/bench_r4_manual.err
+log "done"

@@ -1,3 +1,10 @@
+"""One-shot covfused probe: does masked_cov_pallas lower on this backend,
+and does it agree with the einsum reference on BOTH covariances?
+
+Rnn matters as much as Rss: its (1-m)^2 weighting is the branch that
+behaves differently in zero-padded bins.  ``interpret`` gates on is_tpu()
+(like masked_covariances_fused) so the probe is also runnable off-chip.
+"""
 import sys; sys.path.insert(0, "/root/repo")
 import json, time
 import numpy as np
@@ -10,14 +17,26 @@ m = rng.uniform(size=(1, 257, 130)).astype(np.float32)
 
 from disco_tpu.ops.cov_ops import masked_cov_pallas
 from disco_tpu.beam.covariance import masked_covariances
+from disco_tpu.utils.backend import is_tpu
+
+
+def _rel_err(a, b):
+    err = float(jnp.max(jnp.abs(jnp.real(a) - jnp.real(b))) + jnp.max(jnp.abs(jnp.imag(a) - jnp.imag(b))))
+    return err / float(jnp.max(jnp.abs(jnp.real(b))))
+
 
 t0 = time.time()
 try:
-    Rss, Rnn = masked_cov_pallas(jnp.asarray(y), jnp.asarray(m), interpret=False)
+    interpret = not is_tpu()
+    Rss, Rnn = masked_cov_pallas(jnp.asarray(y), jnp.asarray(m), interpret=interpret)
     ref_ss, ref_nn = masked_covariances(jnp.asarray(y), jnp.asarray(m))
-    err = float(jnp.max(jnp.abs(jnp.real(Rss) - jnp.real(ref_ss))) + jnp.max(jnp.abs(jnp.imag(Rss) - jnp.imag(ref_ss))))
-    scale = float(jnp.max(jnp.abs(jnp.real(ref_ss))))
-    out["covfused"] = {"ok": True, "rel_err": err / scale, "s": round(time.time() - t0, 1)}
+    out["covfused"] = {
+        "ok": True,
+        "interpret": interpret,
+        "rel_err_rss": _rel_err(Rss, ref_ss),
+        "rel_err_rnn": _rel_err(Rnn, ref_nn),
+        "s": round(time.time() - t0, 1),
+    }
 except Exception as e:
     out["covfused"] = {"ok": False, "error": f"{type(e).__name__}: {e}"[:300], "s": round(time.time() - t0, 1)}
 print(json.dumps(out), flush=True)

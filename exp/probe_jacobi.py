@@ -1,0 +1,34 @@
+"""One-shot Mosaic compile probe for the scatter-free eigh_jacobi_pallas.
+
+Round-3 solver_ab killed the old kernel at lowering ("Unimplemented ...
+scatter"); round 4 rewrote the rotation updates as broadcast one-hot
+selects (ops/eigh_ops.py).  This probe answers, in seconds, whether the
+rewrite actually lowers and agrees with jnp.linalg.eigh on-chip —
+before the full solver_ab lane spends minutes on it.
+"""
+import sys; sys.path.insert(0, "/root/repo")
+import json, time
+import numpy as np
+import jax.numpy as jnp
+
+out = {}
+rng = np.random.default_rng(0)
+for C in (4, 11):
+    B = 2 * 257
+    X = rng.standard_normal((B, C, C)) + 1j * rng.standard_normal((B, C, C))
+    A = jnp.asarray((X + np.conj(np.transpose(X, (0, 2, 1)))).astype(np.complex64))
+    t0 = time.time()
+    try:
+        from disco_tpu.ops.eigh_ops import eigh_jacobi_pallas
+        from disco_tpu.utils.backend import is_tpu
+
+        lam, V = eigh_jacobi_pallas(A, interpret=not is_tpu())
+        lam = np.asarray(lam)
+        ref = np.linalg.eigvalsh(np.asarray(A))
+        err = float(np.max(np.abs(lam - ref)) / np.max(np.abs(ref)))
+        out[f"C{C}"] = {"ok": True, "rel_err_eigvals": round(err, 8),
+                        "s": round(time.time() - t0, 1)}
+    except Exception as e:
+        out[f"C{C}"] = {"ok": False, "error": f"{type(e).__name__}: {e}"[:300],
+                        "s": round(time.time() - t0, 1)}
+print(json.dumps(out), flush=True)

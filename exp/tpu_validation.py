@@ -160,7 +160,7 @@ def main(argv=None):
     from disco_tpu import milestones
 
     if args.quick:
-        # bench_jax returns the report dict directly (rtf, rtf_power,
+        # bench_jax returns the report dict directly (rtf, rtf_eigh,
         # dispatch_overhead_ms, mfu, stage_ms, ...)
         section("bench", lambda: bench_mod.bench_jax(batch=4, dur_s=4.0, iters=2))
         section("solver_ab", lambda: solver_ab(B=2, dur_s=2.0, iters=1))

@@ -50,6 +50,15 @@ def test_enhance_solver_field_roundtrips(tmp_path):
     assert cfg.array.n_nodes == 4
 
 
+def test_enhance_solver_default_is_power():
+    """Round-4 default flip: the offline solver default is 'power',
+    traceable to the round-3 on-device A/B (exp/tpu_validation_r3.jsonl
+    solver_ab: 6722x vs eigh 4833x at 49 dB output agreement)."""
+    from disco_tpu.config import EnhanceConfig
+
+    assert EnhanceConfig().solver == "power"
+
+
 def test_unknown_key_rejected():
     with pytest.raises(ValueError, match="unknown keys"):
         config_from_dict({"stft": {"nfft": 256}})

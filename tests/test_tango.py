@@ -45,10 +45,15 @@ def oracle(scene):
 
 @pytest.fixture(scope="module")
 def ours(scene):
+    # solver='eigh' explicitly: this fixture is the reference-bit-matching
+    # anchor for the tight-tolerance parity tests (the reference semantics
+    # of internal_formulas.py:56-73).  The pipeline DEFAULT is 'power'
+    # since round 4; its agreement with this anchor is pinned at the SDR
+    # level by test_default_solver_sdr_parity below.
     y, s, n = scene
     Y, S, N = stft(y), stft(s), stft(n)
     masks_z = oracle_masks(S, N, "irm1")
-    return tango(Y, S, N, masks_z, masks_z, policy="local"), (Y, S, N)
+    return tango(Y, S, N, masks_z, masks_z, policy="local", solver="eigh"), (Y, S, N)
 
 
 def test_others_index():
@@ -113,7 +118,8 @@ def test_policy_none_matches_oracle(scene):
     want = tango_np(y, s, n, mask_type="irm1", mask_for_z=None)
     Y, S, N = stft(y), stft(s), stft(n)
     masks = oracle_masks(S, N, "irm1")
-    res = tango(Y, S, N, masks, masks, policy="none")
+    # bit-parity anchor vs the f64 oracle -> the eigh lane (see `ours`)
+    res = tango(Y, S, N, masks, masks, policy="none", solver="eigh")
     err = np.linalg.norm(np.asarray(res.yf) - want["yf"]) / np.linalg.norm(want["yf"])
     assert err < 5e-3, err
 
@@ -131,14 +137,15 @@ def test_other_policies_run_and_enhance(scene, policy):
     assert si_sdr(s[0, 0], enh) > si_sdr(s[0, 0], y[0, 0])
 
 
-def test_power_solver_sdr_parity(scene, ours):
-    """The full two-step pipeline with solver='power' lands within 0.1 dB
-    SI-SDR of the eigh pipeline at every node — the acceptance bar that
-    lets the cheap solver stand in for the batched eigendecomposition."""
+def test_default_solver_sdr_parity(scene, ours):
+    """The full two-step pipeline on its DEFAULT solver ('power' since the
+    round-4 flip from the solver_ab artifact) lands within 0.1 dB SI-SDR
+    of the eigh anchor at every node — the acceptance bar that lets the
+    cheap solver stand in for the batched eigendecomposition."""
     y, s, n = scene
     res_e, (Y, S, N) = ours
     masks = oracle_masks(S, N, "irm1")
-    res_p = tango(Y, S, N, masks, masks, policy="local", solver="power")
+    res_p = tango(Y, S, N, masks, masks, policy="local")  # default solver
     for k in range(K):
         sdr_e = si_sdr(s[k, 0], np.asarray(istft(res_e.yf[k], L), np.float64))
         sdr_p = si_sdr(s[k, 0], np.asarray(istft(res_p.yf[k], L), np.float64))
