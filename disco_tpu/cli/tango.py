@@ -87,38 +87,61 @@ def _load_model(path, archi: str = "crnn", n_ch: int = 1):
 
 def resolve_solver(args):
     """Solver precedence: explicit --solver > YAML enhance.solver from
-    --config > None, deferring to the driver's mode-aware default
-    ('power' offline / 'eigh' streaming — enhance/driver.py, traceable to
-    the round-3 solver_ab artifact)."""
+    --config (only when the key is literally present in the file) > None,
+    deferring to the driver's mode-aware default ('power' offline / 'eigh'
+    streaming — enhance/driver.py, traceable to the round-3 solver_ab
+    artifact).  The raw YAML is inspected rather than the default-filled
+    EnhanceConfig: reading the dataclass field would silently turn "no
+    solver in the file" into an explicit 'power', overriding the streaming
+    default the help text promises."""
     if args.solver is not None:
         return args.solver
     if not args.config:
         return None
     import argparse as _argparse
 
-    from disco_tpu.config import EnhanceConfig, load_config
+    import yaml
 
-    cfg_enh = load_config(args.config).enhance
-    if args.config:
-        # Only enhance.solver is consumed here; silently honoring part of a
-        # DiscoConfig YAML would be a trap, so name what is being ignored.
-        import dataclasses
-        import sys
+    from disco_tpu.config import EnhanceConfig, config_from_dict
 
-        ignored = [
-            f.name
-            for f in dataclasses.fields(EnhanceConfig)
-            if f.name != "solver"
-            and getattr(cfg_enh, f.name) != getattr(EnhanceConfig(), f.name)
-        ]
-        if ignored:
-            print(
-                f"warning: --config {args.config}: only enhance.solver is used by "
-                f"this CLI; ignoring non-default enhance fields {ignored}",
-                file=sys.stderr,
-            )
+    # Parse ONCE: the same dict is both validated (config_from_dict) and
+    # inspected for literal key presence, so the two views can never
+    # diverge.  A present-but-empty section ('enhance:\n') parses as None;
+    # normalize it to {} so validation sees "section with all defaults".
+    with open(args.config) as fh:
+        raw = yaml.safe_load(fh) or {}
+    raw = {k: ({} if v is None and k != "root" else v) for k, v in raw.items()}
+    raw_enh = raw.get("enhance", {})
+    cfg_enh = config_from_dict(raw).enhance  # full validation of the file
+    # Only enhance.solver is consumed here; silently honoring part of a
+    # DiscoConfig YAML would be a trap, so name what is being ignored.
+    import dataclasses
+    import sys
+
+    ignored = [
+        f.name
+        for f in dataclasses.fields(EnhanceConfig)
+        if f.name != "solver"
+        and getattr(cfg_enh, f.name) != getattr(EnhanceConfig(), f.name)
+    ]
+    if ignored:
+        print(
+            f"warning: --config {args.config}: only enhance.solver is used by "
+            f"this CLI; ignoring non-default enhance fields {ignored}",
+            file=sys.stderr,
+        )
+    if "solver" not in raw_enh:
+        return None
+    raw_solver = raw_enh["solver"]
+    if not isinstance(raw_solver, str):
+        # 'solver: null' / 'solver: 5' — clean error, not an AttributeError
+        # from str.partition deep inside the spec parser.
+        raise SystemExit(
+            f"--config {args.config}: enhance.solver: expected a solver spec "
+            f"string ('eigh' | 'power[:N]' | 'jacobi[:N]' | ...), got {raw_solver!r}"
+        )
     try:
-        return solver_spec(cfg_enh.solver)
+        return solver_spec(raw_solver)
     except _argparse.ArgumentTypeError as e:
         raise SystemExit(f"--config {args.config}: enhance.solver: {e}")
 

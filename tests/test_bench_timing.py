@@ -13,6 +13,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 def test_slope_recovers_on_device_time(monkeypatch):
     import bench
+    from disco_tpu import milestones
 
     calls = {}
 
@@ -20,7 +21,10 @@ def test_slope_recovers_on_device_time(monkeypatch):
         calls[k] = calls.get(k, 0) + 1
         return 0.080 + k * 0.012  # 80 ms tunnel + 12 ms/exec
 
-    monkeypatch.setattr(bench, "_time_queued", fake_time_queued)
+    # bench re-exports the timing seam from disco_tpu.milestones (round 4);
+    # _slope_time resolves _time_queued in milestones' globals, so that is
+    # the module to patch.
+    monkeypatch.setattr(milestones, "_time_queued", fake_time_queued)
     slope, t1 = bench._slope_time(lambda: None, k=6, iters=3)
     assert abs(slope - 0.012) < 1e-12  # true on-device time, tunnel removed
     assert abs(t1 - 0.092) < 1e-12  # single-dispatch keeps the tunnel
@@ -32,11 +36,12 @@ def test_slope_nonpositive_falls_back_to_upper_bound(monkeypatch):
     conservative amortized upper bound t_k / k, never a tiny/negative
     'fast' number."""
     import bench
+    from disco_tpu import milestones
 
     def fake_time_queued(fn, *args, k=1, iters=5):
         return 0.100 if k == 1 else 0.090  # jitter: k=6 cheaper than k=1
 
-    monkeypatch.setattr(bench, "_time_queued", fake_time_queued)
+    monkeypatch.setattr(milestones, "_time_queued", fake_time_queued)
     slope, _ = bench._slope_time(lambda: None, k=6, iters=3)
     assert abs(slope - 0.090 / 6) < 1e-12
 
@@ -45,11 +50,12 @@ def test_time_queued_uses_median(monkeypatch):
     import time as _time
 
     import bench
+    from disco_tpu import milestones
 
     seq = iter([0.0, 0.5, 1.0, 1.1, 2.0, 2.9, 4.0, 4.2, 6.0, 6.25])
     monkeypatch.setattr(_time, "perf_counter", lambda: next(seq))
-    monkeypatch.setattr(bench, "_fence", lambda x: 0.0)
-    monkeypatch.setattr(bench, "_leaf", lambda x: x)
+    monkeypatch.setattr(milestones, "_fence", lambda x: 0.0)
+    monkeypatch.setattr(milestones, "_leaf", lambda x: x)
     # warm-up consumes nothing from the clock (fence mocked), 5 iters ->
     # deltas 0.5, 0.1, 0.9, 0.2, 0.25 -> sorted median = 0.25
     dt = bench._time_queued(lambda: 0, k=1, iters=5)

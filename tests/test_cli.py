@@ -212,6 +212,27 @@ def test_tango_cli_solver_precedence(tmp_path):
     assert resolved(["--config", str(path)]) == "power:8"
     assert resolved(["--config", str(path), "--solver", "jacobi"]) == "jacobi"
 
+    # A YAML that OMITS enhance.solver must defer to the driver (None), not
+    # leak the dataclass default 'power' into streaming runs (round-4
+    # advisor finding: cli/tango.py resolve_solver).
+    no_solver = tmp_path / "nosolver.yaml"
+    no_solver.write_text("enhance:\n  mu: 1.5\n")
+    assert resolved(["--config", str(no_solver)]) is None
+    empty = tmp_path / "empty.yaml"
+    empty.write_text("")
+    assert resolved(["--config", str(empty)]) is None
+    # 'enhance:' with no body parses as a null section — still "no solver".
+    null_section = tmp_path / "nullsec.yaml"
+    null_section.write_text("enhance:\n")
+    assert resolved(["--config", str(null_section)]) is None
+    # present-but-non-string solver: clean SystemExit, not an AttributeError
+    import pytest
+
+    bad_type = tmp_path / "badtype.yaml"
+    bad_type.write_text("enhance:\n  solver: null\n")
+    with pytest.raises(SystemExit, match="enhance.solver"):
+        resolved(["--config", str(bad_type)])
+
 
 def test_tango_cli_bad_yaml_solver_is_clean_error(tmp_path):
     import dataclasses

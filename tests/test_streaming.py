@@ -63,7 +63,7 @@ def test_streaming_power_solver(scene):
     below eigh — why 'eigh' stays the streaming default); 'power:N' buys the
     gap back (documented contract: still enhances at 12, within 0.5 dB of
     eigh at 96).  Offline frame-mean covariances converge at 12 iterations
-    (test_tango.test_power_solver_sdr_parity, 0.1 dB)."""
+    (test_tango.test_default_solver_sdr_parity, 0.1 dB)."""
     y, s, n, L = scene
     Y, S, N = stft(y), stft(s), stft(n)
     masks = oracle_masks(S, N, "irm1")
