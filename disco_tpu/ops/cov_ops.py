@@ -34,31 +34,42 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
 
 from disco_tpu.beam.covariance import masked_covariances
 
 
 def _cov_kernel(yr_ref, yi_ref, m_ref, ssr_ref, ssi_ref, nnr_ref, nni_ref, *, C, inv_t):
-    """One (C, T, Fb) block: both masked covariances, hermitian triangle.
+    """One (C, Tb, Fb) block: both masked covariances, hermitian triangle,
+    ACCUMULATED over the innermost (frame-tile) grid axis.
 
     Layout note: the frame reduction runs over the SUBLANE axis
-    (frames-major (T, Fb) planes, ``axis=0``) so each per-bin result is
+    (frames-major (Tb, Fb) planes, ``axis=0``) so each per-bin result is
     born as a lane vector and every store below is a native contiguous
-    lane store.  What the chip has actually said so far (round-3 driver
-    artifacts): the frames-MINOR formulation is rejected at lowering
-    (block-shape ValueError at f_tile=8; UNIMPLEMENTED relayout at
-    f_tile=128 — exp/bench_r3_manual.json), and this frames-major rewrite
-    moved the failure to a tpu_compile_helper subprocess crash
-    (BENCH_r03.json covfused_error) — i.e. it is *expected* to lower but
-    has never yet compiled on real Mosaic.  exp/probe_mosaic.py bisects
-    the remaining crash; until it passes on-device, treat 'pallas' as an
-    experimental lane ('xla' is the default everywhere)."""
-    m = m_ref[0]  # (T, Fb)
+    lane store.  The frame axis is additionally TILED (grid axis 2, with
+    the output block's index map ignoring it, so the covariance block
+    stays VMEM-resident and accumulates across frame tiles): an untiled
+    10 s clip at the step-2 stack width is a ~14 MB input block — past
+    the ~16 MB VMEM budget, which is how the round-3/4 full-pipeline
+    compiles died (tpu_compile_helper crash, BENCH_r03/r04
+    covfused_error) while the round-5 short-clip probe compiled fine in
+    ~1 s (exp/probe_mosaic_r5.json: every ladder construct AND the full
+    kernel at T=130 pass on real Mosaic)."""
+    t_idx = pl.program_id(2)
+
+    @pl.when(t_idx == 0)
+    def _init():
+        ssr_ref[...] = jnp.zeros_like(ssr_ref)
+        ssi_ref[...] = jnp.zeros_like(ssi_ref)
+        nnr_ref[...] = jnp.zeros_like(nnr_ref)
+        nni_ref[...] = jnp.zeros_like(nni_ref)
+
+    m = m_ref[0]  # (Tb, Fb)
     ws = (m * m) * inv_t
     one_m = 1.0 - m
     wn = (one_m * one_m) * inv_t
     for c in range(C):
-        xr_c, xi_c = yr_ref[0, c], yi_ref[0, c]  # (T, Fb)
+        xr_c, xi_c = yr_ref[0, c], yi_ref[0, c]  # (Tb, Fb)
         for d in range(c, C):
             xr_d, xi_d = yr_ref[0, d], yi_ref[0, d]
             # Y_c conj(Y_d): re = rc rd + ic id, im = ic rd - rc id
@@ -68,19 +79,21 @@ def _cov_kernel(yr_ref, yi_ref, m_ref, ssr_ref, ssi_ref, nnr_ref, nni_ref, *, C,
             ss_im = jnp.sum(ws * pii, axis=0)
             nn_re = jnp.sum(wn * prr, axis=0)
             nn_im = jnp.sum(wn * pii, axis=0)
-            ssr_ref[0, c, d, :] = ss_re
-            ssi_ref[0, c, d, :] = ss_im
-            nnr_ref[0, c, d, :] = nn_re
-            nni_ref[0, c, d, :] = nn_im
+            ssr_ref[0, c, d, :] += ss_re
+            ssi_ref[0, c, d, :] += ss_im
+            nnr_ref[0, c, d, :] += nn_re
+            nni_ref[0, c, d, :] += nn_im
             if d != c:  # hermitian mirror
-                ssr_ref[0, d, c, :] = ss_re
-                ssi_ref[0, d, c, :] = -ss_im
-                nnr_ref[0, d, c, :] = nn_re
-                nni_ref[0, d, c, :] = -nn_im
+                ssr_ref[0, d, c, :] += ss_re
+                ssi_ref[0, d, c, :] += -ss_im
+                nnr_ref[0, d, c, :] += nn_re
+                nni_ref[0, d, c, :] += -nn_im
 
 
-@partial(jax.jit, static_argnames=("f_tile", "interpret"))
-def masked_cov_pallas(y: jnp.ndarray, mask: jnp.ndarray, f_tile: int = 128, interpret: bool = False):
+@partial(jax.jit, static_argnames=("f_tile", "t_tile", "interpret"))
+def masked_cov_pallas(
+    y: jnp.ndarray, mask: jnp.ndarray, f_tile: int = 128, t_tile: int = 256, interpret: bool = False
+):
     """Speech/noise covariances from a mixture and TF mask, fused.
 
     Drop-in for ``beam.covariance.masked_covariances`` (same semantics,
@@ -93,9 +106,13 @@ def masked_cov_pallas(y: jnp.ndarray, mask: jnp.ndarray, f_tile: int = 128, inte
       f_tile: frequency bins per grid step (F is zero-padded to a multiple).
         Mosaic requires the covariance blocks' trailing dim to be a multiple
         of 128 (measured on TPU v5e: f_tile=8 is rejected at lowering), so
-        the default is 128.  VMEM per grid step is ~2*C*f_tile*T*4 bytes —
-        ~7 MB at the widest production shape (C=11 step-2 stack, 11 s clip);
-        clips beyond ~30 s should use the 'xla' path instead.
+        the default is 128.
+      t_tile: frames per grid step (T is zero-padded to a multiple; zero
+        frames contribute zero to both sums, so padding is exact).  Bounds
+        VMEM per grid step at ~2*C*f_tile*t_tile*4 bytes (~2.9 MB at the
+        C=11 step-2 stack) regardless of clip length — the untiled kernel
+        blew the ~16 MB VMEM budget at 10 s clips, which is where the
+        round-3/4 on-device compile crashes came from.
       interpret: pallas interpreter mode (CPU correctness tests).
 
     Returns:
@@ -119,12 +136,12 @@ def masked_cov_pallas(y: jnp.ndarray, mask: jnp.ndarray, f_tile: int = 128, inte
 
     n_ft = -(-F // f_tile)
     Fp = n_ft * f_tile
-    if Fp != F:
-        pad = ((0, 0), (0, 0), (0, 0), (0, Fp - F))
+    n_tt = -(-T // t_tile)
+    Tp = n_tt * t_tile
+    if Fp != F or Tp != T:
+        pad = ((0, 0), (0, 0), (0, Tp - T), (0, Fp - F))
         yr, yi = jnp.pad(yr, pad), jnp.pad(yi, pad)
-        m = jnp.pad(m, ((0, 0), (0, 0), (0, Fp - F)))
-
-    from jax.experimental import pallas as pl
+        m = jnp.pad(m, ((0, 0), (0, Tp - T), (0, Fp - F)))
 
     # NOTE on shard_map: pallas_call's vma handling is incomplete in this
     # jax version (its interpreter rejects even correctly-annotated
@@ -133,19 +150,21 @@ def masked_cov_pallas(y: jnp.ndarray, mask: jnp.ndarray, f_tile: int = 128, inte
     # check_vma for the pallas cov variant instead of annotating here.
     out_struct = jax.ShapeDtypeStruct((B, C, C, Fp), jnp.float32)
 
+    # frame tiles innermost: the output block's index map ignores t, so the
+    # (1, C, C, f_tile) accumulator stays VMEM-resident across the sweep
     out = pl.pallas_call(
         partial(_cov_kernel, C=C, inv_t=1.0 / T),
-        grid=(B, n_ft),
+        grid=(B, n_ft, n_tt),
         in_specs=[
-            pl.BlockSpec((1, C, T, f_tile), lambda b, f: (b, 0, 0, f)),
-            pl.BlockSpec((1, C, T, f_tile), lambda b, f: (b, 0, 0, f)),
-            pl.BlockSpec((1, T, f_tile), lambda b, f: (b, 0, f)),
+            pl.BlockSpec((1, C, t_tile, f_tile), lambda b, f, t: (b, 0, t, f)),
+            pl.BlockSpec((1, C, t_tile, f_tile), lambda b, f, t: (b, 0, t, f)),
+            pl.BlockSpec((1, t_tile, f_tile), lambda b, f, t: (b, t, f)),
         ],
         out_specs=[
-            pl.BlockSpec((1, C, C, f_tile), lambda b, f: (b, 0, 0, f)),
-            pl.BlockSpec((1, C, C, f_tile), lambda b, f: (b, 0, 0, f)),
-            pl.BlockSpec((1, C, C, f_tile), lambda b, f: (b, 0, 0, f)),
-            pl.BlockSpec((1, C, C, f_tile), lambda b, f: (b, 0, 0, f)),
+            pl.BlockSpec((1, C, C, f_tile), lambda b, f, t: (b, 0, 0, f)),
+            pl.BlockSpec((1, C, C, f_tile), lambda b, f, t: (b, 0, 0, f)),
+            pl.BlockSpec((1, C, C, f_tile), lambda b, f, t: (b, 0, 0, f)),
+            pl.BlockSpec((1, C, C, f_tile), lambda b, f, t: (b, 0, 0, f)),
         ],
         out_shape=[out_struct] * 4,
         interpret=interpret,

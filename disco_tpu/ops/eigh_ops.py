@@ -210,30 +210,107 @@ def eigh_jacobi(A: jnp.ndarray, sweeps: int | None = None):
 
 
 # --------------------------------------------------------------- pallas path
+#
+# Layout: BATCH IN LANES.  The round-4 kernel tiled as (tile, C, C) — the
+# matrix dims sat in the (sublane, lane) position, so every rotation was a
+# C<=11-lane op on a 128-lane VPU plus a relayout, and the real Mosaic
+# compile ran away (round-5 probe: >9.5 min without finishing, while
+# trivial kernels compile in ~1-3 s on the same attachment).  Here a block
+# is (C, C, tile): matrix element (p, q) IS a full (tile,)-lane vector of
+# batch elements, every rotation is a handful of natively-shaped
+# elementwise (C, C, tile) / (tile,) VPU ops, and the p/q row-column
+# writes are broadcast selects against LEADING-dim iota masks (no scatter,
+# no lane-dim relayout).
+
+
+def _lane_rotation(Ar, Ai, Vr, Vi, p, q, eps):
+    """One (p, q) rotation in the lanes layout: arrays are (C, C, tile),
+    matrix indices lead, the batch fills the lane dim.  A <- G^H A G,
+    V <- V G, same math as :func:`_apply_rotation` (rows/cols swapped into
+    leading dims)."""
+    C = Ar.shape[0]
+    c, sr, si = _rotation(Ar[p, p], Ar[q, q], Ar[p, q], Ai[p, q], eps)  # (tile,)
+
+    row_p = jax.lax.broadcasted_iota(jnp.int32, (C, 1, 1), 0) == p
+    row_q = jax.lax.broadcasted_iota(jnp.int32, (C, 1, 1), 0) == q
+    col_p = jax.lax.broadcasted_iota(jnp.int32, (1, C, 1), 1) == p
+    col_q = jax.lax.broadcasted_iota(jnp.int32, (1, C, 1), 1) == q
+
+    def put_rows(M, new_p, new_q):
+        return jnp.where(row_p, new_p[None], jnp.where(row_q, new_q[None], M))
+
+    def put_cols(M, new_p, new_q):
+        return jnp.where(col_p, new_p[:, None], jnp.where(col_q, new_q[:, None], M))
+
+    # rows: (G^H A)[p] = c A[p] - sigma A[q];  (G^H A)[q] = conj(sigma) A[p] + c A[q]
+    rp_r, rp_i = Ar[p], Ai[p]  # (C, tile)
+    rq_r, rq_i = Ar[q], Ai[q]
+    new_p_r = c * rp_r - (sr * rq_r - si * rq_i)
+    new_p_i = c * rp_i - (sr * rq_i + si * rq_r)
+    new_q_r = (sr * rp_r + si * rp_i) + c * rq_r
+    new_q_i = (sr * rp_i - si * rp_r) + c * rq_i
+    Ar = put_rows(Ar, new_p_r, new_q_r)
+    Ai = put_rows(Ai, new_p_i, new_q_i)
+
+    # cols: (M G)[:, p] = c M[:, p] - conj(sigma) M[:, q];  (M G)[:, q] = sigma M[:, p] + c M[:, q]
+    cp_r, cp_i = Ar[:, p], Ai[:, p]  # (C, tile)
+    cq_r, cq_i = Ar[:, q], Ai[:, q]
+    new_cp_r = c * cp_r - (sr * cq_r + si * cq_i)
+    new_cp_i = c * cp_i - (sr * cq_i - si * cq_r)
+    new_cq_r = (sr * cp_r - si * cp_i) + c * cq_r
+    new_cq_i = (sr * cp_i + si * cp_r) + c * cq_i
+    Ar = put_cols(Ar, new_cp_r, new_cq_r)
+    Ai = put_cols(Ai, new_cp_i, new_cq_i)
+
+    # eigenvectors: V <- V G (same column update)
+    vp_r, vp_i = Vr[:, p], Vi[:, p]
+    vq_r, vq_i = Vr[:, q], Vi[:, q]
+    new_vp_r = c * vp_r - (sr * vq_r + si * vq_i)
+    new_vp_i = c * vp_i - (sr * vq_i - si * vq_r)
+    new_vq_r = (sr * vp_r - si * vp_i) + c * vq_r
+    new_vq_i = (sr * vp_i + si * vp_r) + c * vq_i
+    Vr = put_cols(Vr, new_vp_r, new_vq_r)
+    Vi = put_cols(Vi, new_vp_i, new_vq_i)
+    return Ar, Ai, Vr, Vi
+
+
 def _eigh_kernel(ar_ref, ai_ref, lam_ref, vr_ref, vi_ref, *, C, sweeps, eps):
-    """One batch tile: all sweeps in VMEM, single HBM round-trip.  Emits the
+    """One lane tile: all sweeps in VMEM, single HBM round-trip.  Emits the
     UNSORTED converged diagonal + eigenvector planes — the argsort/gather of
     ``_sorted_eigpairs`` has no Mosaic lowering, so ordering happens in
     plain XLA after the pallas_call.  The diagonal is extracted as a masked
-    lane reduction (``sum(A * I, axis=-1)``) rather than ``jnp.diagonal``,
+    sublane reduction (``sum(A * I, axis=1)``) rather than ``jnp.diagonal``,
     whose gather Mosaic also lacks."""
-    Ar = ar_ref[...]
+    Ar = ar_ref[...]  # (C, C, tile)
     Ai = ai_ref[...]
-    Vr = jnp.broadcast_to(jnp.eye(C, dtype=jnp.float32), Ar.shape)
+    eye = (
+        jax.lax.broadcasted_iota(jnp.int32, (C, C, 1), 0)
+        == jax.lax.broadcasted_iota(jnp.int32, (C, C, 1), 1)
+    ).astype(jnp.float32)
+    Vr = jnp.broadcast_to(eye, Ar.shape)
     Vi = jnp.zeros_like(Ar)
-    Ar, Ai, Vr, Vi = _sweep_body(Ar, Ai, Vr, Vi, C, sweeps, eps)
-    lam_ref[...] = jnp.sum(Ar * jnp.eye(C, dtype=jnp.float32), axis=-1)
+
+    def one_sweep(_, carry):
+        Ar, Ai, Vr, Vi = carry
+        for p, q in _pairs(C):
+            Ar, Ai, Vr, Vi = _lane_rotation(Ar, Ai, Vr, Vi, p, q, eps)
+        return Ar, Ai, Vr, Vi
+
+    Ar, Ai, Vr, Vi = jax.lax.fori_loop(0, sweeps, one_sweep, (Ar, Ai, Vr, Vi))
+    lam_ref[...] = jnp.sum(Ar * eye, axis=1)  # (C, tile)
     vr_ref[...] = Vr
     vi_ref[...] = Vi
 
 
 @partial(jax.jit, static_argnames=("sweeps", "tile", "interpret"))
-def eigh_jacobi_pallas(A: jnp.ndarray, sweeps: int | None = None, tile: int = 256, interpret: bool = False):
-    """:func:`eigh_jacobi` as one fused pallas kernel (see module docstring).
+def eigh_jacobi_pallas(A: jnp.ndarray, sweeps: int | None = None, tile: int = 512, interpret: bool = False):
+    """:func:`eigh_jacobi` as one fused pallas kernel (see module docstring
+    and the batch-in-lanes layout note above).
 
     Args:
       A: (..., C, C) hermitian complex64/float32; batch dims are flattened
-        into tiles of ``tile`` matrices per grid step.
+        into the LANE dim in tiles of ``tile`` matrices per grid step
+        (``tile`` should be a multiple of 128).
       interpret: run in the pallas interpreter (CPU correctness tests).
     """
     from jax.experimental import pallas as pl
@@ -244,44 +321,49 @@ def eigh_jacobi_pallas(A: jnp.ndarray, sweeps: int | None = None, tile: int = 25
         sweeps = default_sweeps(C)
     batch_shape = A.shape[:-2]
     complex_in = jnp.iscomplexobj(A)
-    Ar = jnp.real(A).astype(jnp.float32).reshape((-1, C, C))
+    # (B, C, C) -> lanes layout (C, C, B)
+    Ar = jnp.real(A).astype(jnp.float32).reshape((-1, C, C)).transpose(1, 2, 0)
     Ai = (
-        jnp.imag(A).astype(jnp.float32).reshape((-1, C, C))
+        jnp.imag(A).astype(jnp.float32).reshape((-1, C, C)).transpose(1, 2, 0)
         if complex_in
         else jnp.zeros_like(Ar)
     )
-    B = Ar.shape[0]
+    B = Ar.shape[-1]
     n_tiles = -(-B // tile)
     pad = n_tiles * tile - B
     if pad:
         # identity padding keeps the padded matrices well-conditioned
-        eye = jnp.broadcast_to(jnp.eye(C, dtype=jnp.float32), (pad, C, C))
-        Ar = jnp.concatenate([Ar, eye])
-        Ai = jnp.concatenate([Ai, jnp.zeros((pad, C, C), jnp.float32)])
+        eye = jnp.broadcast_to(jnp.eye(C, dtype=jnp.float32)[:, :, None], (C, C, pad))
+        Ar = jnp.concatenate([Ar, eye], axis=-1)
+        Ai = jnp.concatenate([Ai, jnp.zeros((C, C, pad), jnp.float32)], axis=-1)
     eps = float(np.finfo(np.float32).tiny ** 0.5)
 
     lam, Vr, Vi = pl.pallas_call(
         partial(_eigh_kernel, C=C, sweeps=sweeps, eps=eps),
         grid=(n_tiles,),
         in_specs=[
-            pl.BlockSpec((tile, C, C), lambda i: (i, 0, 0)),
-            pl.BlockSpec((tile, C, C), lambda i: (i, 0, 0)),
+            pl.BlockSpec((C, C, tile), lambda i: (0, 0, i)),
+            pl.BlockSpec((C, C, tile), lambda i: (0, 0, i)),
         ],
         out_specs=[
-            pl.BlockSpec((tile, C), lambda i: (i, 0)),
-            pl.BlockSpec((tile, C, C), lambda i: (i, 0, 0)),
-            pl.BlockSpec((tile, C, C), lambda i: (i, 0, 0)),
+            pl.BlockSpec((C, tile), lambda i: (0, i)),
+            pl.BlockSpec((C, C, tile), lambda i: (0, 0, i)),
+            pl.BlockSpec((C, C, tile), lambda i: (0, 0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n_tiles * tile, C), jnp.float32),
-            jax.ShapeDtypeStruct((n_tiles * tile, C, C), jnp.float32),
-            jax.ShapeDtypeStruct((n_tiles * tile, C, C), jnp.float32),
+            jax.ShapeDtypeStruct((C, n_tiles * tile), jnp.float32),
+            jax.ShapeDtypeStruct((C, C, n_tiles * tile), jnp.float32),
+            jax.ShapeDtypeStruct((C, C, n_tiles * tile), jnp.float32),
         ],
         interpret=interpret,
     )(Ar, Ai)
-    lam, Vr, Vi = _sort_eigpairs(lam, Vr, Vi)  # outside the kernel (no Mosaic sort)
-    lam = lam[:B].reshape(batch_shape + (C,))
-    Vr = Vr[:B].reshape(batch_shape + (C, C))
-    Vi = Vi[:B].reshape(batch_shape + (C, C))
+    # back to batch-major, then sort outside the kernel (no Mosaic sort)
+    lam = lam[:, :B].transpose(1, 0)
+    Vr = Vr[:, :, :B].transpose(2, 0, 1)
+    Vi = Vi[:, :, :B].transpose(2, 0, 1)
+    lam, Vr, Vi = _sort_eigpairs(lam, Vr, Vi)
+    lam = lam.reshape(batch_shape + (C,))
+    Vr = Vr.reshape(batch_shape + (C, C))
+    Vi = Vi.reshape(batch_shape + (C, C))
     V = jax.lax.complex(Vr, Vi) if complex_in else Vr
     return lam, V

@@ -1,0 +1,133 @@
+"""Round-5 composition probe: WHERE does the covfused lane die on-chip?
+
+The round-5 ladder (exp/probe_mosaic_r5.json) proved every kernel construct
+AND the full masked_cov_pallas at T=130 compile and run on real Mosaic in
+~1 s — yet bench.py's full-pipeline covfused lane crashed the remote
+compiler in rounds 3 and 4.  The delta is composition: 10 s clips
+(T=1249: the untiled frame block was ~14 MB of VMEM at the C=11 step-2
+stack), double vmap nesting (batch=16 x K=8 nodes), and the surrounding
+tango program.  cov_ops is now frame-tiled (t_tile=256); this probe walks
+the exact ladder from standalone production shapes to bench's literal
+run_c configuration, all data generated ON DEVICE (complex dtypes cannot
+cross the tunnel, and the bench shapes are GB-scale).
+
+Incremental JSONL on stderr per case; summary JSON on stdout.
+"""
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+results = {}
+
+
+def case(name, fn):
+    t0 = time.time()
+    try:
+        r = fn()
+        r = {"ok": True, **(r or {}), "s": round(time.time() - t0, 1)}
+    except Exception as e:
+        r = {"ok": False, "error": f"{type(e).__name__}: {e}"[:300], "s": round(time.time() - t0, 1)}
+    results[name] = r
+    print(json.dumps({name: r}), file=sys.stderr, flush=True)
+    return r
+
+
+def _rel_err(a, b):
+    """max |a-b| / max|b| on device, scalar readback (real parts only —
+    complex cannot cross the tunnel; im handled separately)."""
+    num = jnp.maximum(
+        jnp.max(jnp.abs(jnp.real(a) - jnp.real(b))),
+        jnp.max(jnp.abs(jnp.imag(a) - jnp.imag(b))),
+    )
+    den = jnp.max(jnp.abs(jnp.real(b)))
+    return float(num / den)
+
+
+def _rand_cov_inputs(key, B, C, F, T):
+    ky, km = jax.random.split(key)
+    yr = jax.random.normal(ky, (B, C, F, T, 2), jnp.float32)
+    y = jax.lax.complex(yr[..., 0], yr[..., 1])
+    m = jax.random.uniform(km, (B, F, T), jnp.float32)
+    return y, m
+
+
+from disco_tpu.beam.covariance import masked_covariances
+from disco_tpu.ops.cov_ops import masked_cov_pallas
+
+key = jax.random.PRNGKey(0)
+
+
+def cov_shape_case(B, C, F, T):
+    def fn():
+        y, m = _rand_cov_inputs(key, B, C, F, T)
+        Rss, Rnn = masked_cov_pallas(y, m)
+        Rss_ref, Rnn_ref = masked_covariances(y, m)
+        return {
+            "rel_err_rss": round(_rel_err(Rss, Rss_ref), 8),
+            "rel_err_rnn": round(_rel_err(Rnn, Rnn_ref), 8),
+        }
+
+    return fn
+
+
+# 1-2: standalone production shapes (step-1 stack C=4, step-2 stack C=11),
+# bench clip length 10 s -> T=1249 engages the frame-tile accumulation
+case("cov_C4_T1249_B32", cov_shape_case(32, 4, 257, 1249))
+case("cov_C11_T1249_B16", cov_shape_case(16, 11, 257, 1249))
+
+
+# 3: vmap over a leading axis (tango vmaps step1 over nodes)
+def vmap_case():
+    y, m = _rand_cov_inputs(key, 8, 4, 257, 130)
+    got = jax.vmap(masked_cov_pallas)(y[:, None], m[:, None])
+    ref = jax.vmap(masked_covariances)(y[:, None], m[:, None])
+    return {"rel_err": round(_rel_err(got[0], ref[0]), 8)}
+
+
+case("cov_under_vmap", vmap_case)
+
+# 4-5: the full tango pipeline with cov_impl='pallas' — first at 2 s clips
+# (short program), then bench.py's literal run_c configuration (10 s,
+# batch=16, K=8, C=4), the shape that produced the round-3/4 compiler crash
+from disco_tpu.core.dsp import stft
+from disco_tpu.enhance import oracle_masks, tango
+
+
+def tango_case(batch, K, C, dur_s, solver="power"):
+    L = int(dur_s * 16000)
+
+    def fn():
+        ks = jax.random.split(key, 3)
+        s = jax.random.normal(ks[0], (batch, K, C, L), jnp.float32)
+        n = 0.8 * jax.random.normal(ks[1], (batch, K, C, L), jnp.float32)
+        y = s + n
+
+        def make_run(cov_impl):
+            @jax.jit
+            def run(y, s, n):
+                def one(y1, s1, n1):
+                    Y, S, N = stft(y1), stft(s1), stft(n1)
+                    m = oracle_masks(S, N, "irm1")
+                    return tango(Y, S, N, m, m, policy="local", solver=solver, cov_impl=cov_impl).yf
+
+                return jax.vmap(one)(y, s, n)
+
+            return run
+
+        yf_p = make_run("pallas")(y, s, n)
+        yf_x = make_run("xla")(y, s, n)
+        return {"rel_err_vs_xla": round(_rel_err(yf_p, yf_x), 8)}
+
+    return fn
+
+
+case("tango_pallas_2s_b4_K4", tango_case(4, 4, 4, 2.0))
+case("tango_pallas_10s_b16_K8_bench_shape", tango_case(16, 8, 4, 10.0))
+
+print(json.dumps(results), flush=True)

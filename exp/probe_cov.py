@@ -18,18 +18,21 @@ m = rng.uniform(size=(1, 257, 130)).astype(np.float32)
 from disco_tpu.ops.cov_ops import masked_cov_pallas
 from disco_tpu.beam.covariance import masked_covariances
 from disco_tpu.utils.backend import is_tpu
+from disco_tpu.utils.transfer import to_device, to_host
 
 
 def _rel_err(a, b):
-    err = float(jnp.max(jnp.abs(jnp.real(a) - jnp.real(b))) + jnp.max(jnp.abs(jnp.imag(a) - jnp.imag(b))))
-    return err / float(jnp.max(jnp.abs(jnp.real(b))))
+    a, b = to_host(a), to_host(b)
+    err = float(np.max(np.abs(a.real - b.real)) + np.max(np.abs(a.imag - b.imag)))
+    return err / float(np.max(np.abs(b.real)))
 
 
 t0 = time.time()
 try:
     interpret = not is_tpu()
-    Rss, Rnn = masked_cov_pallas(jnp.asarray(y), jnp.asarray(m), interpret=interpret)
-    ref_ss, ref_nn = masked_covariances(jnp.asarray(y), jnp.asarray(m))
+    yd, md = to_device(y), to_device(m)  # complex-safe on the tunnel
+    Rss, Rnn = masked_cov_pallas(yd, md, interpret=interpret)
+    ref_ss, ref_nn = masked_covariances(yd, md)
     out["covfused"] = {
         "ok": True,
         "interpret": interpret,

@@ -31,4 +31,6 @@ for C in (4, 11):
     except Exception as e:
         out[f"C{C}"] = {"ok": False, "error": f"{type(e).__name__}: {e}"[:300],
                         "s": round(time.time() - t0, 1)}
+    # incremental: a hang on the next case must not lose this verdict
+    print(json.dumps({f"C{C}": out[f"C{C}"]}), file=sys.stderr, flush=True)
 print(json.dumps(out), flush=True)

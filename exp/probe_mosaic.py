@@ -21,6 +21,9 @@ B, C, T, Fp = 1, 4, 130, 128
 
 
 def run_case(name, kernel, n_out, out_dims, in_specs, out_specs, args):
+    import time as _t
+
+    t0 = _t.time()
     try:
         outs = pl.pallas_call(
             kernel,
@@ -31,9 +34,13 @@ def run_case(name, kernel, n_out, out_dims, in_specs, out_specs, args):
         )(*args)
         jax.block_until_ready(outs)
         v = float(jnp.ravel(outs[0])[0])
-        return {"ok": True, "v": round(v, 4)}
+        r = {"ok": True, "v": round(v, 4), "s": round(_t.time() - t0, 1)}
     except Exception as e:
-        return {"ok": False, "error": f"{type(e).__name__}: {e}"[:160]}
+        r = {"ok": False, "error": f"{type(e).__name__}: {e}"[:160], "s": round(_t.time() - t0, 1)}
+    # incremental JSONL on stderr: a hang on a later case must not lose
+    # the earlier verdicts (round-5 lesson: probe_jacobi hung >9 min silent)
+    print(json.dumps({name: r}), file=sys.stderr, flush=True)
+    return r
 
 
 def main():
@@ -86,25 +93,29 @@ def main():
 
     # the real kernel, via its public wrapper (T=130 unaligned sublanes)
     from disco_tpu.ops.cov_ops import masked_cov_pallas
+    from disco_tpu.utils.transfer import to_device
 
-    y = jnp.asarray(
-        (rng.standard_normal((B, C, 257, T)) + 1j * rng.standard_normal((B, C, 257, T))).astype(np.complex64)
-    )
-    mm = jnp.asarray(rng.uniform(size=(B, 257, T)).astype(np.float32))
-    try:
-        Rss, _ = masked_cov_pallas(y, mm, interpret=False)
-        jax.block_until_ready(Rss)
-        results["full_kernel_T130"] = {"ok": True}
-    except Exception as e:
-        results["full_kernel_T130"] = {"ok": False, "error": f"{type(e).__name__}: {e}"[:160]}
+    # complex arrays go through to_device (two real transfers + on-device
+    # combine): the tunnel's host<->device path lacks complex dtypes, and
+    # the eager jnp slice of a complex array dies the same way (this very
+    # line cost round 5 a probe run)
+    y_np = (rng.standard_normal((B, C, 257, T)) + 1j * rng.standard_normal((B, C, 257, T))).astype(np.complex64)
+    mm_np = rng.uniform(size=(B, 257, T)).astype(np.float32)
+    import time as _t
 
-    # aligned frame count (T=128): is unaligned sublane blocking the issue?
-    try:
-        Rss, _ = masked_cov_pallas(y[..., :128], mm[..., :128], interpret=False)
-        jax.block_until_ready(Rss)
-        results["full_kernel_T128"] = {"ok": True}
-    except Exception as e:
-        results["full_kernel_T128"] = {"ok": False, "error": f"{type(e).__name__}: {e}"[:160]}
+    for name, yv, mv in (
+        ("full_kernel_T130", to_device(y_np), to_device(mm_np)),
+        # aligned frame count (T=128): is unaligned sublane blocking the issue?
+        ("full_kernel_T128", to_device(y_np[..., :128]), to_device(mm_np[..., :128])),
+    ):
+        t0 = _t.time()
+        try:
+            Rss, _ = masked_cov_pallas(yv, mv, interpret=False)
+            jax.block_until_ready(Rss)
+            results[name] = {"ok": True, "s": round(_t.time() - t0, 1)}
+        except Exception as e:
+            results[name] = {"ok": False, "error": f"{type(e).__name__}: {e}"[:160], "s": round(_t.time() - t0, 1)}
+        print(json.dumps({name: results[name]}), file=sys.stderr, flush=True)
 
     print(json.dumps(results), flush=True)
 

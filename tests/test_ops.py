@@ -138,6 +138,22 @@ def test_masked_cov_pallas_batched_leading_axes():
     np.testing.assert_allclose(np.asarray(Rnn), np.asarray(Rnn_ref), rtol=2e-4, atol=1e-6)
 
 
+def test_masked_cov_pallas_frame_tiled_accumulation():
+    """T > t_tile engages the innermost-grid accumulation sweep (the VMEM
+    fix for long clips: round-3/4 on-device compiles died at 10 s clips
+    because the untiled frame block outgrew VMEM).  Non-multiple T also
+    exercises the zero-padded tail tile."""
+    from disco_tpu.beam.covariance import masked_covariances
+    from disco_tpu.ops.cov_ops import masked_cov_pallas
+
+    rng = np.random.default_rng(9)
+    y, m = _cov_case(rng, lead=(), C=3, F=17, T=53)
+    Rss_ref, Rnn_ref = masked_covariances(y, m)
+    Rss, Rnn = masked_cov_pallas(y, m, t_tile=16, interpret=True)  # 53 -> 4 tiles
+    np.testing.assert_allclose(np.asarray(Rss), np.asarray(Rss_ref), rtol=2e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(Rnn), np.asarray(Rnn_ref), rtol=2e-4, atol=1e-6)
+
+
 def test_masked_cov_fused_dispatch():
     from disco_tpu.ops.cov_ops import masked_covariances_fused
 
