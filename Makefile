@@ -3,10 +3,21 @@
 
 PYTHON ?= python
 
-.PHONY: test coverage doc install native clean bench milestone-corpus dryrun
+.PHONY: test coverage doc install native clean bench milestone-corpus dryrun obs-check
 
-test:
+test: obs-check
 	$(PYTHON) -m pytest tests/ -q
+
+# Telemetry gates (run before the suite so drift fails fast):
+# 1. the bench trajectory must not regress between the last two committed
+#    rounds (disco_tpu.cli.obs compare exits 1 on a >5% headline RTF drop);
+#    the two newest BENCH_r*.json are picked up automatically so the gate
+#    never goes stale when a new round's artifact lands;
+# 2. the JSONL event schema the obs subsystem emits must validate
+#    (tests/test_obs.py -k schema re-emits every producer and re-reads it).
+obs-check:
+	$(PYTHON) -m disco_tpu.cli.obs compare $$(ls BENCH_r*.json | sort | tail -2)
+	$(PYTHON) -m pytest tests/test_obs.py -q -k "schema"
 
 coverage:
 	$(PYTHON) -m coverage run --branch --source=disco_tpu -m pytest tests/ -q

@@ -36,6 +36,7 @@ the round-3 on-device A/B (solver_ab, exp/tpu_validation_r3.jsonl: power
 in tests/test_tango.py).  ``rtf_eigh_solver`` keeps the
 reference-bit-matching eigh lane in every record.
 """
+import argparse
 import json
 import os
 import time
@@ -49,6 +50,8 @@ from disco_tpu.milestones import (  # noqa: F401  (_slope_time re-exported
     _slope_time,
     _time_queued,
 )
+from disco_tpu.obs import events as obs_events
+from disco_tpu.obs.metrics import REGISTRY as obs_registry
 
 FS = 16000
 K, C = 8, 4  # 8-node, 4 mics per node (north-star config)
@@ -248,6 +251,10 @@ def _start_watchdog(timeout_s: float):
     claim — observed wedged for hours after a killed process.  Without
     this, a wedged chip turns the bench record into silence; with it, the
     record says what happened.  Disable with BENCH_WATCHDOG_S=0.
+
+    With --obs-log active the same diagnostic also lands in the event
+    stream as a ``watchdog`` event (flushed before ``os._exit``), so the
+    sideband log tells the story even when stdout is lost.
     """
     import threading
 
@@ -255,6 +262,14 @@ def _start_watchdog(timeout_s: float):
 
     def fire():
         if not done.wait(timeout_s):
+            obs_events.record(
+                "watchdog", stage="bench",
+                timeout_s=timeout_s,
+                suspected_cause="wedged tunneled device attachment "
+                                "(chip claim held by a dead process) or an "
+                                "undersized BENCH_WATCHDOG_S for this backend",
+                **obs_registry.snapshot(),
+            )
             print(
                 json.dumps(
                     {
@@ -277,17 +292,40 @@ def _start_watchdog(timeout_s: float):
     return done
 
 
-def main():
+def build_parser():
+    p = argparse.ArgumentParser(
+        description="Headline RTF benchmark (prints ONE JSON line to stdout)"
+    )
+    p.add_argument(
+        "--obs-log",
+        default=os.environ.get("BENCH_OBS_LOG") or None,
+        help="append the full telemetry event stream (manifest, per-lane "
+             "stage events, watchdog diagnostics, the final record) to this "
+             "JSONL file; stdout stays exactly one JSON line either way",
+    )
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    # knobs: BENCH_BATCH / BENCH_DUR_S / BENCH_ITERS override the workload
+    # size (defaults are the headline config; smaller values for CPU smoke
+    # tests).
+    batch = int(os.environ.get("BENCH_BATCH", 16))
+    dur_s = float(os.environ.get("BENCH_DUR_S", 10.0))
+    iters = int(os.environ.get("BENCH_ITERS", 5))
     timeout_s = float(os.environ.get("BENCH_WATCHDOG_S", 1800))
-    done = _start_watchdog(timeout_s) if timeout_s > 0 else None
-    # BENCH_BATCH / BENCH_DUR_S / BENCH_ITERS override the workload size
-    # (defaults are the headline config; smaller values for CPU smoke tests).
-    try:
-        r = bench_jax(
-            batch=int(os.environ.get("BENCH_BATCH", 16)),
-            dur_s=float(os.environ.get("BENCH_DUR_S", 10.0)),
-            iters=int(os.environ.get("BENCH_ITERS", 5)),
+    if args.obs_log:
+        obs_events.enable(args.obs_log)
+        obs_events.write_manifest(
+            config={"batch": batch, "dur_s": dur_s, "iters": iters,
+                    "watchdog_s": timeout_s},
+            tool="bench.py",
         )
+    done = _start_watchdog(timeout_s) if timeout_s > 0 else None
+    try:
+        with obs_events.stage("bench_jax", batch=batch, clip_dur_s=dur_s, iters=iters):
+            r = bench_jax(batch=batch, dur_s=dur_s, iters=iters)
     except Exception as e:
         # A failed backend init (e.g. the tunneled chip service answering
         # UNAVAILABLE, as in BENCH_r02) must still leave a PARSEABLE record:
@@ -295,24 +333,20 @@ def main():
         # trace is an artifact only a human can read.
         if done is not None:
             done.set()
-        print(
-            json.dumps(
-                {
-                    "metric": "rtf_8node_mwf_enhancement",
-                    "value": None,
-                    "unit": "x_realtime",
-                    "error": f"{type(e).__name__}: {e}"[:500],
-                }
-            ),
-            flush=True,
-        )
+        record = {
+            "metric": "rtf_8node_mwf_enhancement",
+            "value": None,
+            "unit": "x_realtime",
+            "error": f"{type(e).__name__}: {e}"[:500],
+        }
+        obs_events.record("bench_result", stage="bench", **record)
+        obs_events.disable()
+        print(json.dumps(record), flush=True)
         raise SystemExit(2)
     streaming_error = None
     try:
-        lat_ms, budget_ms, stream_rtf = bench_streaming(
-            dur_s=float(os.environ.get("BENCH_DUR_S", 10.0)),
-            iters=int(os.environ.get("BENCH_ITERS", 5)),
-        )
+        with obs_events.stage("bench_streaming", clip_dur_s=dur_s, iters=iters):
+            lat_ms, budget_ms, stream_rtf = bench_streaming(dur_s=dur_s, iters=iters)
     except Exception as e:
         # like the jacobi lane: the artifact must distinguish "lane crashed"
         # from "not measured"
@@ -321,36 +355,39 @@ def main():
     if done is not None:
         done.set()
     try:
-        rtf_np = bench_numpy()
+        with obs_events.stage("bench_numpy"):
+            rtf_np = bench_numpy()
     except Exception:
         rtf_np = None
     vs = (r["rtf"] / rtf_np) if rtf_np else None
-    print(
-        json.dumps(
-            {
-                "metric": "rtf_8node_mwf_enhancement",
-                "value": round(r["rtf"], 2),
-                "unit": "x_realtime",
-                "vs_baseline": round(vs, 2) if vs else None,
-                "value_single_dispatch": round(r["rtf_single_dispatch"], 2),
-                "solver_default": "power",
-                "rtf_eigh_solver": round(r["rtf_eigh"], 2),
-                "rtf_jacobi_solver": round(r["rtf_jacobi"], 2) if r.get("rtf_jacobi") else None,
-                "jacobi_error": r.get("jacobi_error"),
-                "rtf_covfused": round(r["rtf_covfused"], 2) if r.get("rtf_covfused") else None,
-                "covfused_error": r.get("covfused_error"),
-                "dispatch_overhead_ms": r["dispatch_overhead_ms"],
-                "latency_ms_frame": round(lat_ms, 4) if lat_ms else None,
-                "frame_budget_ms": round(budget_ms, 3) if budget_ms else None,
-                "streaming_rtf": round(stream_rtf, 1) if stream_rtf else None,
-                "streaming_error": streaming_error,
-                "mfu": round(r["mfu"], 6) if r["mfu"] else None,
-                "flops_per_clip": round(r["flops_per_clip"]) if r["flops_per_clip"] else None,
-                "stage_ms": r["stage_ms"],
-                "notes": "value = DEFAULT pipeline (solver=power since round 4; rtf_eigh_solver is the reference-bit-matching lane), on-device RTF via k-queued slope timing (tunnel adds ~80ms/dispatch, reported separately; value_single_dispatch includes it); stages timed as separate fenced programs (full pipeline fuses tighter); numpy baseline at 2s clips; MFU vs dense-f32 peak (pipeline is FFT/small-eig bound by design)",
-            }
-        )
-    )
+    record = {
+        "metric": "rtf_8node_mwf_enhancement",
+        "value": round(r["rtf"], 2),
+        "unit": "x_realtime",
+        "vs_baseline": round(vs, 2) if vs else None,
+        "value_single_dispatch": round(r["rtf_single_dispatch"], 2),
+        "solver_default": "power",
+        "rtf_eigh_solver": round(r["rtf_eigh"], 2),
+        "rtf_jacobi_solver": round(r["rtf_jacobi"], 2) if r.get("rtf_jacobi") else None,
+        "jacobi_error": r.get("jacobi_error"),
+        "rtf_covfused": round(r["rtf_covfused"], 2) if r.get("rtf_covfused") else None,
+        "covfused_error": r.get("covfused_error"),
+        "dispatch_overhead_ms": r["dispatch_overhead_ms"],
+        "latency_ms_frame": round(lat_ms, 4) if lat_ms else None,
+        "frame_budget_ms": round(budget_ms, 3) if budget_ms else None,
+        "streaming_rtf": round(stream_rtf, 1) if stream_rtf else None,
+        "streaming_error": streaming_error,
+        "mfu": round(r["mfu"], 6) if r["mfu"] else None,
+        "flops_per_clip": round(r["flops_per_clip"]) if r["flops_per_clip"] else None,
+        "stage_ms": r["stage_ms"],
+        "notes": "value = DEFAULT pipeline (solver=power since round 4; rtf_eigh_solver is the reference-bit-matching lane), on-device RTF via k-queued slope timing (tunnel adds ~80ms/dispatch, reported separately; value_single_dispatch includes it); stages timed as separate fenced programs (full pipeline fuses tighter); numpy baseline at 2s clips; MFU vs dense-f32 peak (pipeline is FFT/small-eig bound by design)",
+    }
+    # sideband first (mirror of the stdout record + final counter snapshot),
+    # THEN the one stdout line — events go to the file, never stdout.
+    obs_events.record("bench_result", stage="bench", **record)
+    obs_events.record("counters", **obs_registry.snapshot())
+    obs_events.disable()
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

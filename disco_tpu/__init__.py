@@ -23,8 +23,10 @@ nn         Flax CRNN mask estimator, training engine, corpus datasets,
 sim        room geometry sampling, batched image-source RIRs, FFT convolution
 datagen    DISCO/MEETIT corpus generation, mixing pass, downloaders
 io         wav / npy I/O and the dataset file layout
-cli        argparse entry points (disco-gen / -mix / -tango / -train / ...)
-utils      complex-safe host<->device transfer, profiling
+cli        argparse entry points (disco-gen / -mix / -tango / -train / -obs ...)
+obs        structured run telemetry: JSONL event log + manifest, metrics
+           registry, fence/RPC + recompile accounting, numerics sentinels
+utils      complex-safe host<->device transfer
 milestones the five BASELINE benchmark configurations
 """
 

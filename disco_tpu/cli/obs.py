@@ -1,0 +1,257 @@
+"""Telemetry reader CLI: render an event log, diff two bench artifacts.
+
+Two subcommands:
+
+* ``report LOG.jsonl`` — aggregate a JSONL event log (``disco_tpu.obs``
+  schema) into a manifest summary, a per-stage time/call/fence table with
+  the estimated tunnel-RPC overhead (n_fences × ~80 ms — the Axon cost
+  model, CLAUDE.md), recompile and sentinel listings, and the final counter
+  snapshot.
+* ``compare OLD.json NEW.json`` — diff two bench records (either the
+  driver-captured ``BENCH_r*.json`` wrapper with its ``parsed`` field, a raw
+  ``bench.py`` stdout line, or an obs event log containing a
+  ``bench_result`` event) into a regression verdict on the headline RTF.
+  Exits nonzero on a regression beyond ``--threshold``, which is what lets
+  ``make obs-check`` gate CI on the bench trajectory.
+
+No reference counterpart (the reference has no observability, SURVEY.md
+§5.1) — this is the first-class reader the BENCH_r01–r05 trajectory never
+had.  Reading telemetry never touches devices: neither this module nor the
+``disco_tpu.obs`` modules it imports ever *call* into jax (obs.metrics
+imports it lazily), so running the reader on the tunneled-TPU image cannot
+claim the chip — the claim happens at first device use (CLAUDE.md), which
+never occurs here.  (The interpreter may still *load* jax via the image's
+sitecustomize or the ``disco_tpu.cli`` package import; loading is safe.)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+from pathlib import Path
+
+from disco_tpu.obs.accounting import RPC_MS_ESTIMATE
+from disco_tpu.obs.events import read_events
+
+
+def build_parser():
+    p = argparse.ArgumentParser(description="Render disco_tpu telemetry")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    rep = sub.add_parser("report", help="render a JSONL event log")
+    rep.add_argument("log", help="event log written via --obs-log")
+
+    cmp_ = sub.add_parser("compare", help="diff two bench records (old new)")
+    cmp_.add_argument("old", help="baseline bench JSON (BENCH_r*.json / raw line / obs log)")
+    cmp_.add_argument("new", help="candidate bench JSON")
+    cmp_.add_argument("--threshold", type=float, default=0.05,
+                      help="relative RTF drop that counts as a regression "
+                           "(default 0.05; BENCH_r04→r05 headline noise was ~0.2%%)")
+    return p
+
+
+# -- report -----------------------------------------------------------------
+def summarize(events: list[dict]) -> dict:
+    """Aggregate an event list into the report structure (pure function —
+    the testable core of ``report``)."""
+    # LAST manifest wins (the log is append-mode: a re-used --obs-log path
+    # holds one manifest per run, and the stage/counter tail being rendered
+    # belongs to the newest one — same rule as the counters snapshot below)
+    manifest = next((e for e in reversed(events) if e["kind"] == "manifest"), None)
+    stages: dict[str, dict] = {}
+    for e in events:
+        if e["kind"] != "stage_end":
+            continue
+        s = stages.setdefault(
+            e["stage"], {"calls": 0, "total_s": 0.0, "fences": 0}
+        )
+        s["calls"] += 1
+        s["total_s"] += float(e["attrs"].get("dur_s") or 0.0)
+        s["fences"] += int(e["attrs"].get("fences") or 0)
+    for s in stages.values():
+        s["mean_s"] = s["total_s"] / s["calls"]
+    counters = next(
+        (e["attrs"] for e in reversed(events) if e["kind"] == "counters"), None
+    )
+    n_fences = sum(s["fences"] for s in stages.values())
+    if counters and "counters" in counters:
+        n_fences = max(n_fences, int(counters["counters"].get("fences", 0)))
+    return {
+        "manifest": manifest["attrs"] if manifest else None,
+        "stages": dict(sorted(stages.items(), key=lambda kv: -kv[1]["total_s"])),
+        "counters": counters,
+        "recompiles": [e for e in events if e["kind"] == "jit_trace"],
+        "sentinels": [e for e in events if e["kind"] == "sentinel"],
+        "epochs": [e for e in events if e["kind"] == "epoch"],
+        "clips": sum(1 for e in events if e["kind"] == "clip"),
+        "watchdogs": [e for e in events if e["kind"] == "watchdog"],
+        "n_events": len(events),
+        "n_fences": n_fences,
+        "est_rpc_s": n_fences * RPC_MS_ESTIMATE / 1e3,
+    }
+
+
+def render_report(summary: dict) -> str:
+    lines = []
+    man = summary["manifest"]
+    if man:
+        sha = (man.get("git_sha") or "?")[:12]
+        lines.append(
+            f"run: git {sha}  platform={man.get('platform')} "
+            f"x{man.get('device_count')} ({man.get('device_kind')})"
+        )
+        vers = man.get("versions") or {}
+        lines.append(
+            "versions: " + " ".join(f"{k}={v}" for k, v in vers.items() if v)
+        )
+        if man.get("config"):
+            lines.append(f"config: {json.dumps(man['config'], sort_keys=True)}")
+    else:
+        lines.append("run: (no manifest event)")
+    lines.append("")
+    lines.append(f"{'stage':<22}{'calls':>7}{'total_s':>12}{'mean_ms':>12}{'fences':>8}")
+    for name, s in summary["stages"].items():
+        lines.append(
+            f"{name:<22}{s['calls']:>7}{s['total_s']:>12.4f}"
+            f"{s['mean_s'] * 1e3:>12.3f}{s['fences']:>8}"
+        )
+    if not summary["stages"]:
+        lines.append("(no stage events)")
+    lines.append(
+        f"fences: {summary['n_fences']}  est RPC overhead "
+        f"~{summary['est_rpc_s']:.2f}s at {RPC_MS_ESTIMATE:.0f}ms/fence"
+    )
+    if summary["clips"]:
+        lines.append(f"clips enhanced: {summary['clips']}")
+    if summary["recompiles"]:
+        by_label: dict[str, int] = {}
+        for e in summary["recompiles"]:
+            by_label[e["stage"]] = by_label.get(e["stage"], 0) + int(
+                e["attrs"].get("n_new_programs", 1)
+            )
+        lines.append(
+            "recompiles: "
+            + "  ".join(f"{k}×{v}" for k, v in sorted(by_label.items()))
+        )
+    def fmt6(v):
+        # the schema admits any attrs dict; the reader must render partial
+        # epoch events, not crash on a missing loss
+        return f"{v:.6f}" if isinstance(v, (int, float)) else "-"
+
+    for e in summary["epochs"]:
+        a = e["attrs"]
+        lines.append(
+            f"epoch {a.get('epoch')}: train {fmt6(a.get('train_loss'))} "
+            f"val {fmt6(a.get('val_loss'))} ({a.get('steps')} steps)"
+        )
+    for e in summary["sentinels"]:
+        a = e["attrs"]
+        lines.append(
+            f"SENTINEL non-finite at stage {e['stage']!r}: {a.get('name')} "
+            f"{a.get('n_nonfinite')}/{a.get('shape')} bad "
+            f"(nan={a.get('n_nan')}, inf={a.get('n_inf')})"
+        )
+    for e in summary["watchdogs"]:
+        lines.append(f"WATCHDOG fired: {e['attrs'].get('suspected_cause')}")
+    return "\n".join(lines)
+
+
+# -- compare ----------------------------------------------------------------
+def load_bench_record(path) -> dict:
+    """Load a bench record from any of its on-disk shapes: the driver's
+    ``BENCH_r*.json`` wrapper (``parsed`` field), a raw ``bench.py`` stdout
+    line, or an obs event log whose ``bench_result`` event carries it."""
+    path = Path(path)
+    text = path.read_text()
+    try:
+        d = json.loads(text)
+        if isinstance(d, dict) and "kind" in d and "attrs" in d:
+            d = None  # a single-line event log parses as JSON too
+    except json.JSONDecodeError:
+        d = None
+    if d is None:  # a JSONL event log: take its bench_result payload
+        for e in reversed(read_events(path, validate=False)):
+            if e.get("kind") == "bench_result":
+                return e["attrs"]
+        raise SystemExit(f"{path}: neither a bench JSON nor an event log with a bench_result")
+    if isinstance(d, dict) and "parsed" in d:
+        d = d["parsed"]
+    if not isinstance(d, dict) or "metric" not in d:
+        raise SystemExit(f"{path}: not a bench record (no 'metric' field)")
+    return d
+
+
+def compare_records(old: dict, new: dict, threshold: float = 0.05) -> dict:
+    """Diff two bench records into {verdict, headline, rows}.  Verdict is on
+    the headline RTF: REGRESSION below ``-threshold``, IMPROVED above
+    ``+threshold``, OK within — with failed lanes (null values) surfaced."""
+    rows = []
+
+    def rel(o, n):
+        return (n - o) / o if (o and n is not None) else None
+
+    for key, higher_is_better in (
+        ("value", True),
+        ("value_single_dispatch", True),
+        ("rtf_eigh_solver", True),
+        ("rtf_jacobi_solver", True),
+        ("rtf_covfused", True),
+        ("streaming_rtf", True),
+        ("latency_ms_frame", False),
+        ("dispatch_overhead_ms", False),
+        ("mfu", True),
+    ):
+        o, n = old.get(key), new.get(key)
+        if o is None and n is None:
+            continue
+        rows.append({"key": key, "old": o, "new": n, "rel": rel(o, n),
+                     "higher_is_better": higher_is_better})
+    for sk in sorted(set(old.get("stage_ms") or {}) | set(new.get("stage_ms") or {})):
+        o = (old.get("stage_ms") or {}).get(sk)
+        n = (new.get("stage_ms") or {}).get(sk)
+        rows.append({"key": f"stage_ms.{sk}", "old": o, "new": n,
+                     "rel": rel(o, n), "higher_is_better": False})
+
+    o, n = old.get("value"), new.get("value")
+    if n is None:
+        verdict, detail = "REGRESSION", "candidate headline RTF is null (failed run)"
+    elif o is None:
+        verdict, detail = "UNKNOWN", "baseline headline RTF is null"
+    else:
+        r = (n - o) / o
+        if r < -threshold:
+            verdict = "REGRESSION"
+        elif r > threshold:
+            verdict = "IMPROVED"
+        else:
+            verdict = "OK"
+        detail = f"headline rtf {o:g} → {n:g} ({r:+.1%}, threshold ±{threshold:.0%})"
+    return {"verdict": verdict, "detail": detail, "rows": rows}
+
+
+def render_compare(diff: dict) -> str:
+    lines = [f"{'metric':<28}{'old':>14}{'new':>14}{'delta':>10}"]
+    for r in diff["rows"]:
+        fmt = lambda v: "-" if v is None else f"{v:g}"
+        delta = "-" if r["rel"] is None else f"{r['rel']:+.1%}"
+        lines.append(f"{r['key']:<28}{fmt(r['old']):>14}{fmt(r['new']):>14}{delta:>10}")
+    lines.append(f"VERDICT: {diff['verdict']} — {diff['detail']}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if args.cmd == "report":
+        summary = summarize(read_events(args.log))
+        print(render_report(summary))
+        return summary
+    diff = compare_records(
+        load_bench_record(args.old), load_bench_record(args.new), args.threshold
+    )
+    print(render_compare(diff))
+    if diff["verdict"] == "REGRESSION":
+        raise SystemExit(1)
+    return diff
+
+
+if __name__ == "__main__":
+    main()

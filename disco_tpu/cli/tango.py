@@ -63,6 +63,15 @@ def build_parser():
                         "mesh (clips sharded over 'batch', nodes over 'node', "
                         "GSPMD-placed collectives); needs BATCH*NODE devices and "
                         "--batch_size divisible by BATCH")
+    p.add_argument("--obs-log", default=None,
+                   help="record structured run telemetry (manifest, per-stage "
+                        "events, fence/RPC accounting, numerics sentinels) to "
+                        "this JSONL file; render with `python -m "
+                        "disco_tpu.cli.obs report PATH`")
+    p.add_argument("--trace-dir", default=None,
+                   help="capture a jax.profiler trace into this directory "
+                        "(view with XProf/TensorBoard; no-op if the profiler "
+                        "is unavailable)")
     return p
 
 
@@ -110,8 +119,22 @@ def resolve_solver(args):
     # normalize it to {} so validation sees "section with all defaults".
     with open(args.config) as fh:
         raw = yaml.safe_load(fh) or {}
+    if not isinstance(raw, dict):
+        # a YAML list/scalar top level would crash .items() below with a raw
+        # AttributeError (round-5 advisor finding) — clean error instead
+        raise SystemExit(
+            f"--config {args.config}: expected a mapping of config sections "
+            f"at the top level, got {type(raw).__name__}"
+        )
     raw = {k: ({} if v is None and k != "root" else v) for k, v in raw.items()}
     raw_enh = raw.get("enhance", {})
+    if not isinstance(raw_enh, dict):
+        # 'enhance: eigh' — a scalar section would otherwise surface as an
+        # uncaught ValueError deep inside config_from_dict
+        raise SystemExit(
+            f"--config {args.config}: 'enhance' must be a mapping of fields "
+            f"(e.g. 'enhance:\\n  solver: eigh'), got {raw_enh!r}"
+        )
     cfg_enh = config_from_dict(raw).enhance  # full validation of the file
     # Only enhance.solver is consumed here; silently honoring part of a
     # DiscoConfig YAML would be a trap, so name what is being ignored.
@@ -151,8 +174,34 @@ def main(argv=None):
     args.solver = resolve_solver(args)
     if args.rir is None and args.rirs is None:
         raise SystemExit("one of --rir or --rirs is required")
+    if args.mesh is not None and args.rirs is None:
+        raise SystemExit("--mesh needs batched corpus mode (--rirs)")
     policy = none_str(args.mask_z) or "none"
 
+    if args.obs_log:
+        from disco_tpu import obs
+
+        obs.enable(args.obs_log)
+        obs.write_manifest(
+            config={k: v for k, v in vars(args).items() if v is not None},
+            tool="disco-tango",
+        )
+    try:
+        return _run(args, policy)
+    finally:
+        if args.obs_log:
+            from disco_tpu import obs
+
+            obs.record("counters", **obs.REGISTRY.snapshot())
+            obs.disable()
+
+
+def _run(args, policy):
+    import contextlib
+
+    from disco_tpu.utils import trace_to
+
+    trace_cm = trace_to(args.trace_dir) if args.trace_dir else contextlib.nullcontext()
     # step-2 model consumes [y_ref ‖ z exchanges]: 1 + (K-1)*len(zsigs)
     # channels (reference nodes_nbs, tango.py:492-494)
     n_ch2 = 1 + 3 * len(args.zsigs)
@@ -160,8 +209,6 @@ def main(argv=None):
         _load_model(args.mods[0], archi=args.archi),
         _load_model(args.mods[1], archi=args.archi, n_ch=n_ch2),
     )
-    if args.mesh is not None and args.rirs is None:
-        raise SystemExit("--mesh needs batched corpus mode (--rirs)")
     if args.rirs is not None:
         if args.streaming:
             raise SystemExit("--streaming needs per-RIR mode (--rir)")
@@ -188,25 +235,27 @@ def main(argv=None):
             if 4 % n_node:  # the DISCO array has 4 nodes (tango.py:30)
                 raise SystemExit(f"the 4-node array is not divisible over {n_node} mesh nodes")
             mesh = make_mesh(n_batch=n_batch, n_node=n_node)
-        results = enhance_rirs_batched(
-            args.dataset, args.scenario, range(args.rirs[0], args.rirs[0] + args.rirs[1]),
-            args.noise, save_dir=args.sav_dir, snr_range=tuple(args.snr),
-            mask_type=args.vad_type[0], policy=policy, out_root=args.out_root,
-            bucket=8192 if args.bucket is None else args.bucket,
-            max_batch=args.batch_size, models=models,
-            z_sigs=args.zsigs[0] if len(args.zsigs) == 1 else "zs&zn",
-            solver=args.solver, cov_impl=args.cov_impl, mesh=mesh,
-        )
+        with trace_cm:
+            results = enhance_rirs_batched(
+                args.dataset, args.scenario, range(args.rirs[0], args.rirs[0] + args.rirs[1]),
+                args.noise, save_dir=args.sav_dir, snr_range=tuple(args.snr),
+                mask_type=args.vad_type[0], policy=policy, out_root=args.out_root,
+                bucket=8192 if args.bucket is None else args.bucket,
+                max_batch=args.batch_size, models=models,
+                z_sigs=args.zsigs[0] if len(args.zsigs) == 1 else "zs&zn",
+                solver=args.solver, cov_impl=args.cov_impl, mesh=mesh,
+            )
         print(f"{len(results)} RIRs enhanced (batched)")
         return results
-    results = enhance_rir(
-        args.dataset, args.scenario, args.rir, args.noise,
-        save_dir=args.sav_dir, snr_range=tuple(args.snr),
-        mask_type=args.vad_type[0], policy=policy, models=models,
-        out_root=args.out_root, streaming=args.streaming, bucket=args.bucket or 0,
-        z_sigs=args.zsigs[0] if len(args.zsigs) == 1 else "zs&zn",
-        solver=args.solver, cov_impl=args.cov_impl,
-    )
+    with trace_cm:
+        results = enhance_rir(
+            args.dataset, args.scenario, args.rir, args.noise,
+            save_dir=args.sav_dir, snr_range=tuple(args.snr),
+            mask_type=args.vad_type[0], policy=policy, models=models,
+            out_root=args.out_root, streaming=args.streaming, bucket=args.bucket or 0,
+            z_sigs=args.zsigs[0] if len(args.zsigs) == 1 else "zs&zn",
+            solver=args.solver, cov_impl=args.cov_impl,
+        )
     if results is None:
         print(f"Conf {args.rir} with {args.noise} noise already processed")
     else:

@@ -40,11 +40,37 @@ def build_parser():
     p.add_argument("--single_channel", "-sc", action="store_true",
                    help="train the step-1 single-channel model (no z inputs)")
     p.add_argument("--seed", type=int, default=26, help="train.py:20 seed")
+    p.add_argument("--obs-log", default=None,
+                   help="record structured run telemetry (manifest, per-epoch "
+                        "events with losses/steps/recompiles) to this JSONL "
+                        "file; render with `python -m disco_tpu.cli.obs report`")
+    p.add_argument("--trace-dir", default=None,
+                   help="capture a jax.profiler trace into this directory "
+                        "(view with XProf/TensorBoard)")
     return p
 
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
+    if args.obs_log:
+        from disco_tpu import obs
+
+        obs.enable(args.obs_log)
+        obs.write_manifest(
+            config={k: v for k, v in vars(args).items() if v is not None},
+            tool="disco-train",
+        )
+    try:
+        return _run(args)
+    finally:
+        if args.obs_log:
+            from disco_tpu import obs
+
+            obs.record("counters", **obs.REGISTRY.snapshot())
+            obs.disable()
+
+
+def _run(args):
     cfg = TrainConfig()
     rng = np.random.default_rng(args.seed)
 
@@ -97,16 +123,22 @@ def main(argv=None):
     x0, _ = dataset[0]
     state = create_train_state(model, tx, x0[None], seed=args.seed)
 
-    state, train_losses, val_losses, run_name = fit(
-        model, state,
-        subset_batches(train_idx, shuffle=True),
-        subset_batches(val_idx, shuffle=False),
-        n_epochs=args.n_epochs,
-        save_path=args.save_path,
-        output_frames=cfg.output_frames,
-        resume_from=none_str(args.weights),
-        patience=cfg.early_stop_patience,
-    )
+    import contextlib
+
+    from disco_tpu.utils import trace_to
+
+    trace_cm = trace_to(args.trace_dir) if args.trace_dir else contextlib.nullcontext()
+    with trace_cm:
+        state, train_losses, val_losses, run_name = fit(
+            model, state,
+            subset_batches(train_idx, shuffle=True),
+            subset_batches(val_idx, shuffle=False),
+            n_epochs=args.n_epochs,
+            save_path=args.save_path,
+            output_frames=cfg.output_frames,
+            resume_from=none_str(args.weights),
+            patience=cfg.early_stop_patience,
+        )
     print(f"run {run_name}: best val loss {np.nanmin(val_losses):.6f}")
     return run_name
 

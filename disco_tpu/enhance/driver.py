@@ -18,6 +18,7 @@ numbers are paper-table comparable — and the ``si_sdr_*`` / ``si_sir_*`` /
 """
 from __future__ import annotations
 
+import contextlib
 import os
 import pickle
 import threading
@@ -35,6 +36,10 @@ from disco_tpu.enhance.tango import oracle_masks, tango
 from disco_tpu.enhance.zexport import load_node_signals
 from disco_tpu.io.audio import read_wav, write_wav
 from disco_tpu.io.layout import DatasetLayout, case_of_rir, snr_dirname
+from disco_tpu.obs import accounting as obs_accounting
+from disco_tpu.obs import events as obs_events
+from disco_tpu.obs import sentinels as obs_sentinels
+from disco_tpu.obs.metrics import REGISTRY as obs_registry
 from disco_tpu.utils import to_host
 
 
@@ -176,12 +181,21 @@ def _persist_and_score(
     """Per-RIR second half of the reference main (tango.py:528-639): ISTFT
     back to time, every metric variant, and the WAV/MASK/OIM/STFT-z/FIG
     results tree.  Shared by the single-RIR and batched drivers."""
-    sh_t = np.asarray(istft(res.yf, length=L))
-    szh_t = np.asarray(istft(res.z_y, length=L))
-    sf_t = np.asarray(istft(res.sf, length=L))
-    nf_t = np.asarray(istft(res.nf, length=L))
-    szf_t = np.asarray(istft(res.z_s, length=L))
-    nzf_t = np.asarray(istft(res.z_n, length=L))
+    with obs_events.stage("istft", rir=rir):
+        sh_t = np.asarray(istft(res.yf, length=L))
+        szh_t = np.asarray(istft(res.z_y, length=L))
+        sf_t = np.asarray(istft(res.sf, length=L))
+        nf_t = np.asarray(istft(res.nf, length=L))
+        szf_t = np.asarray(istft(res.z_s, length=L))
+        nzf_t = np.asarray(istft(res.z_n, length=L))
+    obs_sentinels.check_finite("istft_out", sh_t, stage="istft")
+    # score_persist covers the whole tail of the function (node loop,
+    # pickles, best-effort figure); ExitStack reuses the shared `stage`
+    # implementation without reindenting the tail.  Closed on the success
+    # path below — a crashed clip aborts the run, so losing its stage_end
+    # is acceptable telemetry, not a leak (the recorder flushes per event).
+    _score_stage = contextlib.ExitStack()
+    _score_stage.enter_context(obs_events.stage("score_persist", rir=rir, noise=noise))
 
     for sub in ("WAV", "MASK", "OIM", "FIG"):
         os.makedirs(out / sub, exist_ok=True)
@@ -245,6 +259,11 @@ def _persist_and_score(
                     fig.savefig(out / "FIG" / f"{rir}.png")
             except Exception:
                 pass  # plotting is best-effort observability, never fatal
+    _score_stage.close()
+    obs_registry.counter("clips_enhanced").inc()
+    if obs_events.enabled():
+        obs_events.record("clip", rir=rir, noise=noise, n_nodes=n_nodes,
+                          sdr_cnv_mean=float(np.mean(results["sdr_cnv"])))
     return results
 
 
@@ -296,9 +315,10 @@ def enhance_rir(
         return None
 
     layout = DatasetLayout(root, scenario, case_of_rir(rir))
-    y, s, n, s_dry, n_dry, fs, rnd_snrs = load_input_signals(
-        layout, rir, noise, snr_range, n_nodes, mics_per_node
-    )
+    with obs_events.stage("load_input", rir=rir, noise=noise):
+        y, s, n, s_dry, n_dry, fs, rnd_snrs = load_input_signals(
+            layout, rir, noise, snr_range, n_nodes, mics_per_node
+        )
     L = y.shape[-1]
     if bucket:
         from disco_tpu.core.dsp import bucket_length
@@ -312,8 +332,12 @@ def enhance_rir(
     from disco_tpu.core.dsp import n_stft_frames
 
     T_true = n_stft_frames(L)  # saved masks/z trimmed to the true frames
-    Y, S, N = stft(jnp.asarray(y_in)), stft(jnp.asarray(s_in)), stft(jnp.asarray(n_in))
-    masks_z, mask_w = estimate_masks(Y, S, N, models, mask_type, n_nodes, mu=mu, z_sigs=z_sigs)
+    with obs_events.stage("stft", rir=rir):
+        Y, S, N = stft(jnp.asarray(y_in)), stft(jnp.asarray(s_in)), stft(jnp.asarray(n_in))
+    obs_sentinels.check_finite("stft_Y", Y, stage="stft")
+    with obs_events.stage("masks", rir=rir):
+        masks_z, mask_w = estimate_masks(Y, S, N, models, mask_type, n_nodes, mu=mu, z_sigs=z_sigs)
+    obs_sentinels.check_finite("masks", (masks_z, mask_w), stage="masks")
     if streaming:
         # The online pipeline implements the 'local'/'distant'/'none'
         # mask-for-z policies; the oracle policies are offline-only.
@@ -333,8 +357,9 @@ def enhance_rir(
         from disco_tpu.enhance.tango import TangoResult
         from disco_tpu.enhance.streaming import streaming_tango
 
-        st = streaming_tango(Y, masks_z, mask_w, mu=mu, S=S, N=N,
-                             with_diagnostics=True, policy=policy, solver=solver)
+        with obs_events.stage("mwf", rir=rir, mode="streaming", solver=solver):
+            st = streaming_tango(Y, masks_z, mask_w, mu=mu, S=S, N=N,
+                                 with_diagnostics=True, policy=policy, solver=solver)
         # ONE filter everywhere: every saved wav, mask, z and metric below
         # describes the online beamformer (sf/nf come from the same
         # per-block filters applied to the clean components).
@@ -344,13 +369,18 @@ def enhance_rir(
             masks_z=masks_z, mask_w=mask_w,
         )
     else:
-        res = tango(Y, S, N, masks_z, mask_w, mu=mu, policy=policy, mask_type=mask_type,
-                    solver=solver, cov_impl=cov_impl)
+        with obs_events.stage("mwf", rir=rir, mode="offline", solver=solver):
+            res = tango(Y, S, N, masks_z, mask_w, mu=mu, policy=policy, mask_type=mask_type,
+                        solver=solver, cov_impl=cov_impl)
+    obs_sentinels.check_finite("mwf_yf", res.yf, stage="mwf")
 
-    return _persist_and_score(
+    out_results = _persist_and_score(
         out, layout, rir, noise, snr_range, y, s, n, s_dry, n_dry, fs,
         rnd_snrs, res, L, T_true, n_nodes, save_fig,
     )
+    if obs_events.enabled():
+        obs_events.record("counters", **obs_registry.snapshot())
+    return out_results
 
 
 def aggregate_results(oim_dir, kind: str = "tango", noise: str | None = None):
@@ -378,12 +408,13 @@ def _jitted_step1_2d(mu: float):
     module level so repeated corpus batches reuse the traced program — a
     fresh ``jax.jit`` per batch re-traces everything (see the round-3 note
     on ``inference._jitted_sliding_masks``)."""
-    import jax
-
     from disco_tpu.enhance.tango import tango_step1
 
-    return jax.jit(
-        jax.vmap(jax.vmap(lambda y, s, n, m: tango_step1(y, s, n, m, mu=mu)))
+    import jax
+
+    return obs_accounting.counted_jit(
+        jax.vmap(jax.vmap(lambda y, s, n, m: tango_step1(y, s, n, m, mu=mu))),
+        label="step1_2d",
     )
 
 
@@ -501,7 +532,9 @@ def enhance_rirs_batched(
 
         # jitted ONCE (not per chunk — a fresh lambda per call would defeat
         # the jit cache and re-compile the mask program every chunk)
-        oracle_mask_fn = jax.jit(jax.vmap(partial(oracle_masks, mask_type=mask_type)))
+        oracle_mask_fn = obs_accounting.counted_jit(
+            jax.vmap(partial(oracle_masks, mask_type=mask_type)), label="oracle_masks_batched"
+        )
 
         def run_batch_with_masks(Yb, Sb, Nb, Mz, Mw):
             return tango_batch_sharded(
@@ -513,7 +546,11 @@ def enhance_rirs_batched(
             Mb = oracle_mask_fn(Sb, Nb)
             return run_batch_with_masks(Yb, Sb, Nb, Mb, Mb)
     else:
-        @partial(jax.jit, static_argnames=())
+        # counted_jit: each length bucket (and each remainder-chunk padded
+        # size) traces a fresh program — the recompile counter makes that
+        # compile tax visible in `obs report` instead of folded into chunk 1's
+        # wall time.
+        @obs_accounting.counted_jit(label="run_batch")
         def run_batch(Yb, Sb, Nb):
             def one(Y, S, N):
                 m = oracle_masks(S, N, mask_type)
@@ -522,7 +559,7 @@ def enhance_rirs_batched(
 
             return jax.vmap(one)(Yb, Sb, Nb)
 
-        @partial(jax.jit, static_argnames=())
+        @obs_accounting.counted_jit(label="run_batch_with_masks")
         def run_batch_with_masks(Yb, Sb, Nb, Mz, Mw):
             def one(Y, S, N, mz, mw):
                 return tango(Y, S, N, mz, mw, mu=mu, policy=policy, mask_type=mask_type,
@@ -544,10 +581,11 @@ def enhance_rirs_batched(
         for Lp, items in groups.items():
             for start in range(0, len(items), max_batch):
                 chunk = items[start : start + max_batch]
-                sigs = [
-                    load_input_signals(layout, rir, noise, snr_range, n_nodes, mics_per_node)
-                    for rir, _, layout in chunk
-                ]
+                with obs_events.stage("chunk_load", n_clips=len(chunk), bucket=Lp):
+                    sigs = [
+                        load_input_signals(layout, rir, noise, snr_range, n_nodes, mics_per_node)
+                        for rir, _, layout in chunk
+                    ]
                 ys, ss, ns = [], [], []
                 for (y, s, n, *_rest) in sigs:
                     pad = ((0, 0), (0, 0), (0, Lp - y.shape[-1]))
@@ -567,14 +605,19 @@ def enhance_rirs_batched(
                 )
                 while len(ys) < tail:
                     ys.append(ys[0]); ss.append(ss[0]); ns.append(ns[0])
-                Yb = stft(jnp.asarray(np.stack(ys)))
-                Sb = stft(jnp.asarray(np.stack(ss)))
-                Nb = stft(jnp.asarray(np.stack(ns)))
-                if models == (None, None):
-                    res_b = run_batch(Yb, Sb, Nb)
-                else:
-                    Mz, Mw = _batched_masks(Yb, Sb, Nb, models, mask_type, mu, n_nodes, z_sigs)
-                    res_b = run_batch_with_masks(Yb, Sb, Nb, Mz, Mw)
+                # chunk_enhance wall time is dispatch-side only (jit returns
+                # before the device finishes); the recompile events and the
+                # fence deltas in score_persist carry the device-side story.
+                with obs_events.stage("chunk_enhance", n_clips=n_real, bucket=Lp,
+                                      batch=len(ys)):
+                    Yb = stft(jnp.asarray(np.stack(ys)))
+                    Sb = stft(jnp.asarray(np.stack(ss)))
+                    Nb = stft(jnp.asarray(np.stack(ns)))
+                    if models == (None, None):
+                        res_b = run_batch(Yb, Sb, Nb)
+                    else:
+                        Mz, Mw = _batched_masks(Yb, Sb, Nb, models, mask_type, mu, n_nodes, z_sigs)
+                        res_b = run_batch_with_masks(Yb, Sb, Nb, Mz, Mw)
                 drain()  # previous chunk scored; bounds futures to one chunk
                 for i in range(n_real):
                     rir, out, layout = chunk[i]
@@ -591,4 +634,6 @@ def enhance_rirs_batched(
                     else:
                         pending.append((rir, ex.submit(score)))
         drain()
+    if obs_events.enabled():
+        obs_events.record("counters", **obs_registry.snapshot())
     return all_results

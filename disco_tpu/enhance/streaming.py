@@ -39,6 +39,7 @@ import jax.numpy as jnp
 
 from disco_tpu.beam.filters import rank1_gevd
 from disco_tpu.enhance.tango import others_index
+from disco_tpu.obs.accounting import counted_jit
 
 
 def _outer(x):
@@ -170,7 +171,12 @@ def _stream_filter(X, XS, XN, lam, u, mu, ref: int = 0, extras=None, init_state=
     return out, w[-1], Rss_e, Rnn_e, []
 
 
-@partial(jax.jit, static_argnames=("update_every", "ref_mic", "with_diagnostics", "solver"))
+# counted_jit: same semantics as jax.jit, plus a jit_trace event per fresh
+# trace (new static args / shapes) so online-mode retraces are visible in
+# `obs report` — per-chunk deployment with drifting chunk lengths is exactly
+# the recompile trap this counter exists to catch.
+@partial(counted_jit, label="streaming_step1",
+         static_argnames=("update_every", "ref_mic", "with_diagnostics", "solver"))
 def streaming_step1(
     Y,
     mask_z,
@@ -257,7 +263,8 @@ def _stream_stats(Y, all_z, zn, mask_w, oth, policy):
     )
 
 
-@partial(jax.jit, static_argnames=("update_every", "ref_mic", "with_diagnostics", "policy", "solver"))
+@partial(counted_jit, label="streaming_tango",
+         static_argnames=("update_every", "ref_mic", "with_diagnostics", "policy", "solver"))
 def streaming_tango(
     Y,
     masks_z,

@@ -41,7 +41,14 @@ def _fence(x) -> float:
     """Host readback of one element — the reliable execution fence on
     tunneled device attachments, where block_until_ready() was measured
     returning in ~20us for a >100ms program.  jnp.real first: the tunnel
-    cannot transfer complex dtypes.  Shared by bench.py."""
+    cannot transfer complex dtypes.  Shared by bench.py.
+
+    Every call ticks the obs fence counter (disco_tpu.obs.accounting): on
+    the tunnel each fence is a fixed ~80 ms RPC, so the count IS the
+    host-traffic cost model that `obs report` renders."""
+    from disco_tpu.obs import accounting
+
+    accounting.fence_tick()
     return float(jnp.real(jnp.ravel(x)[0]))
 
 

@@ -28,6 +28,9 @@ from flax import struct
 from flax.training import train_state
 
 from disco_tpu.nn.losses import reconstruction_loss
+from disco_tpu.obs import events as obs_events
+from disco_tpu.obs.accounting import counted_jit
+from disco_tpu.obs.metrics import REGISTRY as obs_registry
 from disco_tpu.utils.transfer import prefetch_to_device
 
 
@@ -83,7 +86,7 @@ def make_step_fns(model, output_frames="all", n_freq=None):
         )
         return loss, mutated
 
-    @jax.jit
+    @counted_jit(label="train_step")
     def train_step(state: TrainState, x, y):
         dropout_rng, next_rng = jax.random.split(state.dropout_rng)
         (loss, mutated), grads = jax.value_and_grad(compute_loss, has_aux=True)(
@@ -94,7 +97,7 @@ def make_step_fns(model, output_frames="all", n_freq=None):
         )
         return state, loss
 
-    @jax.jit
+    @counted_jit(label="eval_step")
     def eval_step(state: TrainState, x, y):
         loss, _ = compute_loss(state.params, state.batch_stats, state.dropout_rng, x, y, False)
         return loss
@@ -230,7 +233,9 @@ def fit(
         run_name = run_name or get_model_name()
 
     gate = SaveAndStop(patience=patience if patience is not None else n_epochs, mode="min")
+    recompiles0 = obs_registry.counter("jit_recompiles").value
     for epoch in range(first_epoch, first_epoch + n_epochs):
+        t_epoch = time.perf_counter()
         # Losses stay ON DEVICE across the epoch as a running sum: a
         # float() per step would fence the pipeline (host sync per batch),
         # serializing host batch prep against device compute.  With async
@@ -247,6 +252,19 @@ def fit(
             nv += 1
         train_losses[epoch] = float(tr) / nb if nb else 0.0
         val_losses[epoch] = float(va) / nv if nv else 0.0
+        obs_registry.counter("train_steps").inc(nb)
+        obs_registry.gauge("train_loss").set(train_losses[epoch])
+        obs_registry.gauge("val_loss").set(val_losses[epoch])
+        if obs_events.enabled():
+            recompiles = obs_registry.counter("jit_recompiles").value
+            obs_events.record(
+                "epoch", stage="train", epoch=int(epoch),
+                train_loss=train_losses[epoch], val_loss=val_losses[epoch],
+                steps=nb, val_batches=nv,
+                dur_s=round(time.perf_counter() - t_epoch, 6),
+                recompiles=recompiles - recompiles0,
+            )
+            recompiles0 = recompiles
         if verbose:
             print(f"epoch {epoch}\tTrain\t{train_losses[epoch]:.6f}\tVal\t{val_losses[epoch]:.6f}")
         np.savez(save_dir / f"{run_name}_losses.npz", train_loss=train_losses, val_loss=val_losses)

@@ -1,0 +1,78 @@
+"""disco_tpu.obs — structured run telemetry for the enhancement stack.
+
+The reference has no observability at all (SURVEY.md §5.1: ad-hoc
+``time.clock()`` prints in train.py are its only instrumentation).  This
+package is the rebuild's answer, sized for the Axon-tunnel reality that
+*dispatch/fence counting* — not wall-clock — is the load-bearing signal
+(every fenced dispatch costs a fixed ~80 ms RPC and ``block_until_ready``
+returns without waiting, CLAUDE.md):
+
+* :mod:`disco_tpu.obs.events`     — append-only JSONL event log with a
+  process-global :class:`~disco_tpu.obs.events.Recorder` (strict no-op when
+  disabled) and a run-manifest emitter (git SHA, backend, devices, config,
+  package versions).
+* :mod:`disco_tpu.obs.metrics`    — counters / gauges / histograms registry
+  with ``snapshot()`` and a pretty-printer; home of :class:`StageTimer` and
+  :func:`trace_to` (moved from ``utils.profiling``, which re-exports them).
+* :mod:`disco_tpu.obs.accounting` — fence/RPC accounting around
+  ``milestones._fence`` and a recompile counter via :func:`counted_jit`.
+* :mod:`disco_tpu.obs.sentinels`  — opt-in numerics watchdogs
+  (:func:`check_finite`) at stage boundaries that record the offending
+  stage + tensor stats instead of silently propagating NaNs.
+
+Consumers: ``enhance/driver.py`` and ``enhance/streaming.py`` (per-stage
+events, per-clip counters), ``nn/training.py`` (per-epoch events),
+``bench.py --obs-log`` (sideband event stream), and ``cli/obs.py``
+(``report`` / ``compare`` renderers).
+
+Everything here must be safe to call unconditionally from hot paths: with
+recording disabled (the default) every entry point returns after one
+attribute check, and no obs failure may ever break the pipeline it observes.
+"""
+from disco_tpu.obs.events import (
+    Event,
+    Recorder,
+    disable,
+    enable,
+    enabled,
+    read_events,
+    record,
+    recorder,
+    recording,
+    stage,
+    validate_event,
+    write_manifest,
+)
+from disco_tpu.obs.metrics import REGISTRY, StageTimer, trace_to
+from disco_tpu.obs.accounting import (
+    counted_jit,
+    fence_count,
+    fence_tick,
+    recompile_count,
+    rpc_overhead_s,
+)
+from disco_tpu.obs.sentinels import check_finite
+
+__all__ = [
+    "Event",
+    "Recorder",
+    "REGISTRY",
+    "StageTimer",
+    "check_finite",
+    "counted_jit",
+    "disable",
+    "enable",
+    "enabled",
+    "fence_count",
+    "fence_tick",
+    "read_events",
+    "recompile_count",
+    "record",
+    "recorder",
+    "recording",
+    "rpc_overhead_s",
+    "stage",
+    "trace_to",
+    "validate_event",
+    "write_manifest",
+]

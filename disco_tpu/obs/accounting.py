@@ -1,0 +1,113 @@
+"""Dispatch, fence/RPC and recompile accounting.
+
+On the Axon-tunneled chip every fenced dispatch costs a fixed ~80 ms RPC
+round-trip and ``block_until_ready`` returns without waiting (CLAUDE.md), so
+the *number of fences* — not wall-clock — is the cost model for host↔device
+traffic: ``est rpc ≈ n_fences × 80 ms`` vs. the k-queued-slope on-device
+time ``bench.py`` measures.  This module is the counting seam:
+
+* :func:`fence_tick` — called by ``disco_tpu.milestones._fence`` (the one
+  reliable execution fence; bench and the validation sweeps all go through
+  it) and by the numerics sentinels (each check is one host readback).
+* :func:`counted_jit` — a drop-in ``jax.jit`` wrapper for the ``enhance/``
+  entry points that detects cache misses by ``_cache_size()`` delta and
+  records a ``jit_trace`` event per retrace — the signal that shows, e.g.,
+  one compiled program per length bucket in the corpus driver.
+
+Counting stays on even when event recording is off (an int increment per
+~80 ms RPC is free); events are only emitted through the no-op-when-disabled
+recorder.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+
+from disco_tpu.obs import events as _events
+from disco_tpu.obs import metrics as _metrics
+
+#: Measured fixed RPC round-trip per fenced dispatch on the tunneled
+#: attachment (BENCH_r03–r05 ``dispatch_overhead_ms``: ~70–80 ms; README
+#: "Timing methodology").  An *estimate* for accounting, not a measurement.
+RPC_MS_ESTIMATE = 80.0
+
+_FENCES = _metrics.REGISTRY.counter("fences")
+_RECOMPILES = _metrics.REGISTRY.counter("jit_recompiles")
+_TLS = threading.local()  # per-thread fence count for stage attribution
+
+
+def fence_tick(n: int = 1) -> None:
+    """Count ``n`` execution fences (host readbacks / fenced dispatches)."""
+    _FENCES.inc(n)
+    _TLS.count = getattr(_TLS, "count", 0) + n
+
+
+def fence_count() -> int:
+    return _FENCES.value
+
+
+def fence_count_thread() -> int:
+    """Fences ticked by THIS thread.  ``events.stage`` diffs this, not the
+    process-wide count: the batched driver scores clips on a thread pool, and
+    a global delta would attribute a worker's sentinel readbacks to whatever
+    stage the main thread happens to be in."""
+    return getattr(_TLS, "count", 0)
+
+
+def recompile_count() -> int:
+    return _RECOMPILES.value
+
+
+def rpc_overhead_s(n_fences: int | None = None) -> float:
+    """Estimated tunnel-RPC overhead: ``n_fences × ~80 ms``.  Defaults to the
+    process-wide fence count."""
+    n = fence_count() if n_fences is None else n_fences
+    return n * RPC_MS_ESTIMATE / 1e3
+
+
+def _cache_size(jitted) -> int | None:
+    try:
+        return jitted._cache_size()
+    except Exception:  # pragma: no cover - jax-version dependent API
+        return None
+
+
+def counted_jit(fun=None, *, label: str | None = None, **jit_kwargs):
+    """``jax.jit`` with recompile accounting.
+
+    Drop-in for the ``@partial(jax.jit, static_argnames=...)`` entry points
+    in ``enhance/``: each call compares the compiled-program cache size
+    before/after dispatch; a growth means XLA traced a new program (new
+    shapes/dtypes or new static args), which increments the
+    ``jit_recompiles`` counter and records a ``jit_trace`` event naming the
+    entry point.  The check is two Python attribute reads per call —
+    invisible next to any device dispatch.
+
+    Usable bare (``counted_jit(f)``) or with options
+    (``@counted_jit(label="run_batch", static_argnames=("k",))``).  The
+    underlying jitted callable is exposed as ``.jitted`` (``.lower`` /
+    ``.clear_cache`` forward to it).
+    """
+    if fun is None:
+        return functools.partial(counted_jit, label=label, **jit_kwargs)
+
+    import jax
+
+    jitted = jax.jit(fun, **jit_kwargs)
+    name = label or getattr(fun, "__name__", "<jit>")
+
+    @functools.wraps(fun)
+    def wrapper(*args, **kwargs):
+        before = _cache_size(jitted)
+        out = jitted(*args, **kwargs)
+        after = _cache_size(jitted)
+        if before is not None and after is not None and after > before:
+            _RECOMPILES.inc(after - before)
+            _events.record("jit_trace", stage=name, n_new_programs=after - before,
+                           cache_size=after)
+        return out
+
+    wrapper.jitted = jitted
+    wrapper.lower = jitted.lower
+    wrapper.clear_cache = getattr(jitted, "clear_cache", None)
+    return wrapper

@@ -1,0 +1,286 @@
+"""Append-only JSONL event log (the telemetry backbone of the obs package).
+
+Every event is one JSON object per line::
+
+    {"t": <unix wall time>, "kind": <str>, "stage": <str|null>, "attrs": {...}}
+
+``kind`` is one of :data:`EVENT_KINDS`; ``stage`` names the pipeline stage
+the event describes (null for run-scoped events like the manifest).  The log
+is a *sideband*: nothing here ever writes to stdout (``bench.py``'s
+ONE-JSON-line stdout contract must survive with recording enabled), and the
+process-global :class:`Recorder` is a strict no-op while disabled — one
+attribute check and return, so the default pipeline pays nothing.
+
+No reference counterpart: the reference has no event log of any kind
+(SURVEY.md §5.1); the schema follows the structured-trace convention of
+production JAX stacks (jax.profiler trace events, Prometheus-style
+registries) sized down to a dependency-free JSONL file.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import sys
+import threading
+import time
+from pathlib import Path
+
+#: The closed set of event kinds ``cli/obs.py report`` and the schema test
+#: understand.  Extend deliberately — ``make obs-check`` pins this schema.
+EVENT_KINDS = frozenset(
+    {
+        "manifest",     # run header: git SHA, backend, devices, config, versions
+        "stage_end",    # a timed pipeline stage finished (attrs: dur_s, fences, ...)
+        "clip",         # one clip/RIR fully enhanced + persisted
+        "epoch",        # one training epoch (attrs: train_loss, val_loss, steps)
+        "jit_trace",    # a counted_jit entry point (re)compiled
+        "sentinel",     # numerics watchdog tripped (attrs: tensor stats)
+        "counters",     # metrics-registry snapshot (usually last event of a run)
+        "watchdog",     # bench watchdog fired (no-progress diagnostic)
+        "bench_result", # the full bench record, mirrored off stdout
+        "note",         # freeform annotation
+    }
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    """One telemetry event (the in-memory twin of a JSONL line)."""
+
+    kind: str
+    stage: str | None
+    t_wall: float
+    attrs: dict
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {"t": self.t_wall, "kind": self.kind, "stage": self.stage, "attrs": self.attrs},
+            default=_jsonable,
+        )
+
+
+def _jsonable(x):
+    """Last-resort JSON coercion: numpy scalars -> python, else repr.  An
+    unserializable attr must degrade to a string, never raise — recording can
+    be called from exception handlers and watchdog threads."""
+    if hasattr(x, "item"):
+        try:
+            return x.item()
+        except Exception:
+            pass
+    return repr(x)
+
+
+class Recorder:
+    """Process-global JSONL event sink.
+
+    Strict no-op while disabled: :meth:`record` returns after a single
+    attribute check.  When enabled, lines are appended and flushed per event
+    (the watchdog path calls ``os._exit`` right after recording), behind a
+    lock (the batched driver scores on a thread pool; the bench watchdog is
+    a daemon thread).
+    """
+
+    def __init__(self):
+        self.enabled = False
+        self.path: Path | None = None
+        self._fh = None
+        self._lock = threading.Lock()
+
+    def enable(self, path) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+            self.path = Path(path)
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            self._fh = open(self.path, "a")
+            self.enabled = True
+
+    def disable(self) -> None:
+        with self._lock:
+            self.enabled = False
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+            self.path = None
+
+    def record(self, kind: str, stage: str | None = None, **attrs) -> Event | None:
+        if not self.enabled:
+            return None
+        ev = Event(kind=kind, stage=stage, t_wall=time.time(), attrs=attrs)
+        with self._lock:
+            if self._fh is None:  # disabled between the check and the lock
+                return None
+            self._fh.write(ev.to_json() + "\n")
+            self._fh.flush()
+        return ev
+
+
+_RECORDER = Recorder()
+
+
+def recorder() -> Recorder:
+    return _RECORDER
+
+
+def enabled() -> bool:
+    return _RECORDER.enabled
+
+
+def enable(path) -> None:
+    """Start recording to ``path`` (JSONL, append)."""
+    _RECORDER.enable(path)
+
+
+def disable() -> None:
+    _RECORDER.disable()
+
+
+def record(kind: str, stage: str | None = None, **attrs) -> Event | None:
+    """Record one event on the process-global recorder (no-op when disabled)."""
+    return _RECORDER.record(kind, stage=stage, **attrs)
+
+
+@contextlib.contextmanager
+def recording(path):
+    """Scoped recording: enable for the block, disable after (test helper and
+    the CLI wiring — guarantees the file handle is released)."""
+    enable(path)
+    try:
+        yield _RECORDER
+    finally:
+        disable()
+
+
+@contextlib.contextmanager
+def stage(name: str, **attrs):
+    """Time a pipeline stage and record a ``stage_end`` event with its
+    duration and the fence-count delta across the block.
+
+    Disabled fast path: plain ``yield`` — no clock read, no dict build.
+    The fence delta attributes tunnel RPCs to the stage that paid them
+    (on the Axon attachment each fence is a fixed ~80 ms round-trip, so the
+    *count* is the cost model — see ``obs.accounting``).
+    """
+    if not _RECORDER.enabled:
+        yield
+        return
+    from disco_tpu.obs import accounting
+
+    # Per-thread fence delta: the batched driver runs stages concurrently on
+    # scoring workers; the process-wide count would cross-attribute fences.
+    f0 = accounting.fence_count_thread()
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dur = time.perf_counter() - t0
+        # measured keys win over caller attrs (never crash on a collision)
+        record(
+            "stage_end",
+            stage=name,
+            **{**attrs,
+               "dur_s": round(dur, 6),
+               "fences": accounting.fence_count_thread() - f0},
+        )
+
+
+def _git_sha() -> str | None:
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=Path(__file__).resolve().parents[2],
+            capture_output=True,
+            text=True,
+            timeout=10,
+        )
+        return out.stdout.strip() or None
+    except Exception:
+        return None
+
+
+def _versions() -> dict:
+    from importlib import metadata
+
+    vers = {"python": sys.version.split()[0]}
+    for pkg in ("jax", "jaxlib", "flax", "optax", "numpy", "scipy"):
+        try:
+            vers[pkg] = metadata.version(pkg)
+        except Exception:
+            vers[pkg] = None
+    return vers
+
+
+def write_manifest(config: dict | None = None, **extra) -> Event | None:
+    """Record the run manifest: git SHA, JAX backend/platform, device count
+    and kind, the run's config dict, and package versions.
+
+    Called once at driver/CLI startup (after ``enable``).  Every field is
+    individually guarded — a broken git checkout or an uninitialised backend
+    must degrade to nulls, never break the run being observed.
+    """
+    if not _RECORDER.enabled:
+        return None
+    platform = device_count = device_kind = None
+    try:
+        import jax
+
+        devs = jax.devices()
+        platform = devs[0].platform
+        device_count = len(devs)
+        device_kind = devs[0].device_kind
+    except Exception:
+        pass
+    return record(
+        "manifest",
+        git_sha=_git_sha(),
+        platform=platform,
+        device_count=device_count,
+        device_kind=device_kind,
+        argv=list(sys.argv),
+        cwd=os.getcwd(),
+        config=config or {},
+        versions=_versions(),
+        **extra,
+    )
+
+
+def validate_event(d: dict) -> None:
+    """Raise ``ValueError`` if ``d`` is not a schema-conforming event dict.
+    ``make obs-check`` runs the test built on this, so schema drift fails CI."""
+    for key in ("t", "kind", "stage", "attrs"):
+        if key not in d:
+            raise ValueError(f"event missing key {key!r}: {d}")
+    if not isinstance(d["t"], (int, float)):
+        raise ValueError(f"event 't' must be a number, got {d['t']!r}")
+    if d["kind"] not in EVENT_KINDS:
+        raise ValueError(f"unknown event kind {d['kind']!r} (known: {sorted(EVENT_KINDS)})")
+    if d["stage"] is not None and not isinstance(d["stage"], str):
+        raise ValueError(f"event 'stage' must be a string or null, got {d['stage']!r}")
+    if not isinstance(d["attrs"], dict):
+        raise ValueError(f"event 'attrs' must be an object, got {d['attrs']!r}")
+
+
+def read_events(path, validate: bool = True) -> list[dict]:
+    """Load a JSONL event log (the ``cli/obs.py report`` input)."""
+    events = []
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                d = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{lineno}: not valid JSON: {e}") from None
+            if validate:
+                try:
+                    validate_event(d)
+                except ValueError as e:
+                    raise ValueError(f"{path}:{lineno}: {e}") from None
+            events.append(d)
+    return events

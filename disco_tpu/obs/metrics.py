@@ -1,0 +1,218 @@
+"""Counters / gauges / histograms registry + the stage timer.
+
+The Prometheus-style in-process registry production JAX stacks keep next to
+their training loops, sized down to zero dependencies: named
+:class:`Counter` (monotonic), :class:`Gauge` (last value) and
+:class:`Histogram` (count/total/min/max) instruments, a process-global
+:data:`REGISTRY` with a ``snapshot()`` dict and pretty-printer, and the
+:class:`StageTimer` / :func:`trace_to` profiling tools which moved here from
+``disco_tpu.utils.profiling`` (that module keeps a deprecation re-export).
+
+No reference counterpart (SURVEY.md §5.1: the reference's only
+instrumentation is ad-hoc ``time.clock()`` prints, train.py:96-103).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+# NOTE: jax is imported lazily inside StageTimer.stage / trace_to — this
+# module sits on the import path of the telemetry reader (cli/obs.py), which
+# must stay genuinely jax-free: reading an event log should never pay the
+# jax import, let alone touch a device.
+
+
+class Counter:
+    """Monotonic named count (fences, recompiles, clips, sentinel trips).
+    Locked: the batched driver increments from scoring worker threads while
+    the main thread ticks fences — ``+=`` alone can drop increments."""
+
+    __slots__ = ("name", "value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> int:
+        with self._lock:
+            self.value += n
+            return self.value
+
+
+class Gauge:
+    """Last-value instrument (current loss, current RTF)."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = None
+
+    def set(self, v) -> None:
+        self.value = float(v)
+
+
+class Histogram:
+    """Streaming count/total/min/max summary (per-clip durations etc.) —
+    enough for a report table without binning policy."""
+
+    __slots__ = ("name", "count", "total", "min", "max", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.total = 0.0
+        self.min = None
+        self.max = None
+        self._lock = threading.Lock()
+
+    def observe(self, v) -> None:
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.total += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+
+    def summary(self) -> dict:
+        return {
+            "count": self.count,
+            "total": self.total,
+            "mean": self.total / self.count if self.count else None,
+            "min": self.min,
+            "max": self.max,
+        }
+
+
+class Registry:
+    """Named instruments, get-or-create.  ``reset()`` zeroes values in place
+    so module-level bindings (e.g. the fence counter in ``obs.accounting``)
+    stay live across test resets."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._histograms: dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            if name not in self._counters:
+                self._counters[name] = Counter(name)
+            return self._counters[name]
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            if name not in self._gauges:
+                self._gauges[name] = Gauge(name)
+            return self._gauges[name]
+
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            if name not in self._histograms:
+                self._histograms[name] = Histogram(name)
+            return self._histograms[name]
+
+    def snapshot(self) -> dict:
+        """{'counters': {name: int}, 'gauges': {...}, 'histograms': {...}} —
+        plain JSON-ready values, the payload of a ``counters`` event."""
+        with self._lock:
+            return {
+                "counters": {k: c.value for k, c in self._counters.items()},
+                "gauges": {k: g.value for k, g in self._gauges.items()},
+                "histograms": {k: h.summary() for k, h in self._histograms.items()},
+            }
+
+    def pretty(self) -> str:
+        snap = self.snapshot()
+        lines = []
+        for name, v in sorted(snap["counters"].items()):
+            lines.append(f"counter    {name:28s} {v}")
+        for name, v in sorted(snap["gauges"].items()):
+            lines.append(f"gauge      {name:28s} {v if v is None else f'{v:g}'}")
+        for name, s in sorted(snap["histograms"].items()):
+            mean = f"{s['mean']:g}" if s["mean"] is not None else "-"
+            lines.append(
+                f"histogram  {name:28s} n={s['count']} total={s['total']:g} mean={mean}"
+            )
+        return "\n".join(lines)
+
+    def reset(self) -> None:
+        with self._lock:
+            for c in self._counters.values():
+                c.value = 0
+            for g in self._gauges.values():
+                g.value = None
+            for h in self._histograms.values():
+                h.count, h.total, h.min, h.max = 0, 0.0, None, None
+
+
+#: Process-global registry — the single place run counters accumulate.
+REGISTRY = Registry()
+
+
+class StageTimer:
+    """Accumulate named wall-clock stage timings (moved from
+    ``utils.profiling``; SURVEY.md §5.1 — replaces the reference's scattered
+    ``time.clock()`` prints with one structured object).
+
+    >>> t = StageTimer()
+    >>> with t.stage("stft"):
+    ...     pass
+    >>> "stft" in t.report()
+    True
+    """
+
+    def __init__(self, sync: bool = True):
+        self.sync = sync
+        self.times: dict[str, float] = {}
+        self.counts: dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def stage(self, name: str, block_on=None):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            if block_on is not None and self.sync:
+                import jax
+
+                jax.block_until_ready(block_on)
+            dt = time.perf_counter() - start
+            self.times[name] = self.times.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def report(self) -> dict:
+        """{stage: {'total_s', 'calls', 'mean_s'}} sorted by total time."""
+        out = {
+            k: {"total_s": v, "calls": self.counts[k], "mean_s": v / self.counts[k]}
+            for k, v in self.times.items()
+        }
+        return dict(sorted(out.items(), key=lambda kv: -kv[1]["total_s"]))
+
+    def pretty(self) -> str:
+        lines = [f"{k:24s} {v['total_s']:9.4f}s  x{v['calls']:<5d} {v['mean_s']*1e3:9.3f} ms/call"
+                 for k, v in self.report().items()]
+        return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def trace_to(logdir: str):
+    """Capture a jax.profiler trace into ``logdir`` (view with XProf /
+    TensorBoard).  No-op (with a note) if the profiler cannot start —
+    tracing must never break the pipeline it observes."""
+    import jax
+
+    started = False
+    try:
+        jax.profiler.start_trace(logdir)
+        started = True
+    except Exception as e:  # pragma: no cover - backend-specific
+        print(f"[profiling] trace unavailable: {e}")
+    try:
+        yield
+    finally:
+        if started:
+            jax.profiler.stop_trace()

@@ -1,0 +1,96 @@
+"""Opt-in numerics watchdogs at stage boundaries.
+
+Round-2's hardware-correctness lesson (README "hardware-correctness note"):
+TPU bf16 matmul accumulation left frame-mean covariances indefinite and
+poisoned step-2 GEVDs with NaN bins that CPU tests never saw — the failure
+mode was *silent propagation*.  :func:`check_finite` is the guard the
+pipeline calls at its stage seams (post-STFT, post-mask, post-MWF,
+post-ISTFT in ``enhance/driver.py``): when recording is enabled it pulls the
+tensor to host, and on any non-finite value records a ``sentinel`` event
+naming the offending stage with tensor stats, instead of letting the NaN
+surface three stages later as a mysteriously zero metric.
+
+Strictly opt-in: with the recorder disabled (the default) each check is one
+attribute read — in particular it does NOT force a device sync, so the
+jitted pipeline's async dispatch is untouched.  When enabled, each checked
+tree leaf costs one host readback (counted as a fence — on the tunnel that
+is the ~80 ms unit of cost, which is why these live at clip-level stage
+boundaries and not inside kernels).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from disco_tpu.obs import accounting as _accounting
+from disco_tpu.obs import events as _events
+from disco_tpu.obs import metrics as _metrics
+
+_CHECKS = _metrics.REGISTRY.counter("sentinel_checks")
+_TRIPS = _metrics.REGISTRY.counter("sentinel_trips")
+
+
+def _leaf_stats(arr: np.ndarray) -> dict:
+    """Summary stats of one host array, split finite / non-finite.  Complex
+    input: ``np.isfinite`` is False if either component is non-finite, and
+    magnitude stats are reported on ``abs``."""
+    mag = np.abs(arr) if np.iscomplexobj(arr) else arr
+    finite = np.isfinite(mag)
+    n_bad = int(arr.size - finite.sum())
+    stats = {
+        "shape": list(arr.shape),
+        "dtype": str(arr.dtype),
+        "n_nonfinite": n_bad,
+        "frac_nonfinite": n_bad / arr.size if arr.size else 0.0,
+        "n_nan": int(np.isnan(mag).sum()),
+        "n_inf": int(np.isinf(mag).sum()),
+    }
+    if finite.any():
+        fm = mag[finite]
+        stats["finite_absmax"] = float(np.max(np.abs(fm)))
+        stats["finite_mean"] = float(np.mean(fm))
+    return stats
+
+
+def check_finite(name: str, tree, stage: str | None = None) -> bool:
+    """Record a ``sentinel`` event for every non-finite leaf of ``tree``.
+
+    Args:
+      name: what is being checked ("stft_Y", "mwf_yf", ...).
+      tree: array / pytree of arrays (device or host).
+      stage: pipeline stage to attribute a trip to (defaults to ``name``).
+
+    Returns True when every leaf is finite (always True when recording is
+    disabled — the check is skipped entirely; observability must never
+    change pipeline behavior, so this *records*, it does not raise).
+    """
+    if not _events.enabled():
+        return True
+    import jax
+
+    from disco_tpu.utils.transfer import to_host
+
+    ok = True
+    leaves = jax.tree_util.tree_leaves(tree)
+    for i, leaf in enumerate(leaves):
+        # Device arrays: to_host (complex dtypes cannot cross the Axon tunnel
+        # directly, CLAUDE.md), and the readback is fenced — count it: two
+        # round-trips for complex (to_host splits into real+imag transfers,
+        # utils/transfer.py), one for real.  Host arrays are free: checking
+        # them must not inflate the RPC estimate.
+        if isinstance(leaf, jax.Array):
+            arr = np.asarray(to_host(leaf))
+            _accounting.fence_tick(2 if np.iscomplexobj(arr) else 1)
+        else:
+            arr = np.asarray(leaf)
+        _CHECKS.inc()
+        mag = np.abs(arr) if np.iscomplexobj(arr) else arr
+        if not np.isfinite(mag).all():
+            ok = False
+            _TRIPS.inc()
+            _events.record(
+                "sentinel",
+                stage=stage or name,
+                name=name if len(leaves) == 1 else f"{name}[{i}]",
+                **_leaf_stats(arr),
+            )
+    return ok

@@ -3,6 +3,7 @@ from disco_tpu.parallel.mesh import (
     make_mesh,
     make_mesh_2d,
     node_sharding,
+    shard_map_compat,
     tango_batch_sharded,
     tango_frame_sharded,
     tango_sharded,
@@ -11,6 +12,7 @@ from disco_tpu.parallel.multihost import distributed_init, hybrid_mesh
 
 __all__ = [
     "ring_all_gather",
+    "shard_map_compat",
     "make_mesh",
     "make_mesh_2d",
     "node_sharding",

@@ -37,6 +37,38 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from disco_tpu.enhance.tango import TangoResult, tango_step1, tango_step2
 
 
+def shard_map_compat(f=None, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` across the API move: newer jax exposes it as
+    ``jax.shard_map(..., check_vma=...)``; before that it lives at
+    ``jax.experimental.shard_map.shard_map(..., check_rep=...)`` —
+    ``check_rep`` is the same replication/varying-manual-axes check under
+    its pre-0.6 name.  The image's jax pinned the older API after round 5
+    (MULTICHIP_r05 ran green on the newer one), so every shard_map in this
+    repo routes through this seam.  Usable as a decorator factory like
+    ``partial(jax.shard_map, ...)``."""
+    if f is None:
+        return partial(shard_map_compat, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_vma=check_vma)
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    return _legacy_shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_rep=check_vma)
+
+
+def axis_size_compat(axis_name) -> int:
+    """Static mesh-axis size inside a shard_map body, across the API move:
+    newer jax has ``jax.lax.axis_size``; 0.4.x answers the same question via
+    ``jax.core.axis_frame`` (which returns the size directly there, or a
+    frame object with ``.size`` on some versions)."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    frame = jax.core.axis_frame(axis_name)
+    return frame.size if hasattr(frame, "size") else frame
+
+
 def make_mesh(n_node: int | None = None, n_batch: int = 1, devices=None) -> Mesh:
     """A (batch, node) device mesh.  With ``n_node=None`` all devices not used
     by 'batch' go to 'node'."""
@@ -73,7 +105,7 @@ def ring_all_gather(x, axis_name: str):
       x: per-device shard, leading axis = local shard rows.
       axis_name: mesh axis to gather over.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size_compat(axis_name)
     idx = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]  # send to the next device
 
@@ -119,7 +151,7 @@ def _tango_on_mesh(
     )
 
     @partial(
-        jax.shard_map,
+        shard_map_compat,
         mesh=mesh,
         in_specs=(spec4, spec4, spec4, spec3, spec3),
         out_specs=(spec3,) * 7,

@@ -1,4 +1,7 @@
 from disco_tpu.utils.transfer import prefetch_to_device, to_device, to_host
-from disco_tpu.utils.profiling import StageTimer, trace_to
+# StageTimer/trace_to live in disco_tpu.obs.metrics since the obs subsystem
+# landed; re-exported here (and via the deprecated utils.profiling shim) so
+# existing `from disco_tpu.utils import StageTimer` call sites keep working.
+from disco_tpu.obs.metrics import StageTimer, trace_to
 
 __all__ = ["to_host", "to_device", "prefetch_to_device", "StageTimer", "trace_to"]

@@ -1,76 +1,18 @@
-"""Tracing / profiling as a first-class utility (SURVEY.md §5.1: the
-reference has none — only ad-hoc ``time.clock()`` prints, train.py:96-103).
-
-Two tools:
-
-* :class:`StageTimer` — named wall-clock stages with device synchronisation
-  (``block_until_ready`` on demand), accumulating a report dict.  Replaces
-  the reference's scattered prints with one structured object.
-* :func:`trace_to` — context manager around ``jax.profiler`` trace capture
-  for TensorBoard/XProf, gated so it is a no-op when tracing is unavailable.
-"""
+"""Deprecated shim — :class:`StageTimer` and :func:`trace_to` moved to
+:mod:`disco_tpu.obs.metrics` (the observability subsystem that grew out of
+this module).  Import from ``disco_tpu.obs`` instead; this re-export keeps
+old call sites working one release."""
 from __future__ import annotations
 
-import contextlib
-import time
+import warnings
 
-import jax
+from disco_tpu.obs.metrics import StageTimer, trace_to
 
+__all__ = ["StageTimer", "trace_to"]
 
-class StageTimer:
-    """Accumulate named wall-clock stage timings.
-
-    >>> t = StageTimer()
-    >>> with t.stage("stft"):
-    ...     pass
-    >>> "stft" in t.report()
-    True
-    """
-
-    def __init__(self, sync: bool = True):
-        self.sync = sync
-        self.times: dict[str, float] = {}
-        self.counts: dict[str, int] = {}
-
-    @contextlib.contextmanager
-    def stage(self, name: str, block_on=None):
-        start = time.perf_counter()
-        try:
-            yield
-        finally:
-            if block_on is not None and self.sync:
-                jax.block_until_ready(block_on)
-            dt = time.perf_counter() - start
-            self.times[name] = self.times.get(name, 0.0) + dt
-            self.counts[name] = self.counts.get(name, 0) + 1
-
-    def report(self) -> dict:
-        """{stage: {'total_s', 'calls', 'mean_s'}} sorted by total time."""
-        out = {
-            k: {"total_s": v, "calls": self.counts[k], "mean_s": v / self.counts[k]}
-            for k, v in self.times.items()
-        }
-        return dict(sorted(out.items(), key=lambda kv: -kv[1]["total_s"]))
-
-    def pretty(self) -> str:
-        lines = [f"{k:24s} {v['total_s']:9.4f}s  x{v['calls']:<5d} {v['mean_s']*1e3:9.3f} ms/call"
-                 for k, v in self.report().items()]
-        return "\n".join(lines)
-
-
-@contextlib.contextmanager
-def trace_to(logdir: str):
-    """Capture a jax.profiler trace into ``logdir`` (view with XProf /
-    TensorBoard).  No-op (with a note) if the profiler cannot start —
-    tracing must never break the pipeline it observes."""
-    started = False
-    try:
-        jax.profiler.start_trace(logdir)
-        started = True
-    except Exception as e:  # pragma: no cover - backend-specific
-        print(f"[profiling] trace unavailable: {e}")
-    try:
-        yield
-    finally:
-        if started:
-            jax.profiler.stop_trace()
+warnings.warn(
+    "disco_tpu.utils.profiling moved to disco_tpu.obs.metrics; "
+    "import StageTimer/trace_to from disco_tpu.obs",
+    DeprecationWarning,
+    stacklevel=2,
+)

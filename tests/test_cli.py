@@ -234,6 +234,78 @@ def test_tango_cli_solver_precedence(tmp_path):
         resolved(["--config", str(bad_type)])
 
 
+def test_tango_cli_non_mapping_yaml_shapes_are_clean_errors(tmp_path):
+    """Round-5 advisor finding (cli/tango.py): a YAML list/scalar top level
+    crashed resolve_solver with a raw AttributeError on raw.items(), and a
+    scalar `enhance:` section surfaced an uncaught ValueError from deep in
+    config_from_dict.  Both must be SystemExit naming the file path."""
+    import pytest
+
+    def resolved(path):
+        args = tango.build_parser().parse_args(["--rir", "1", "--config", str(path)])
+        return tango.resolve_solver(args)
+
+    top_list = tmp_path / "list.yaml"
+    top_list.write_text("- enhance\n- solver\n")
+    with pytest.raises(SystemExit, match=r"list\.yaml.*mapping of config sections"):
+        resolved(top_list)
+
+    top_scalar = tmp_path / "scalar.yaml"
+    top_scalar.write_text("eigh\n")
+    with pytest.raises(SystemExit, match=r"scalar\.yaml.*mapping of config sections"):
+        resolved(top_scalar)
+
+    scalar_section = tmp_path / "scalarsec.yaml"
+    scalar_section.write_text("enhance: eigh\n")
+    with pytest.raises(SystemExit, match=r"scalarsec\.yaml.*'enhance' must be a mapping"):
+        resolved(scalar_section)
+
+    list_section = tmp_path / "listsec.yaml"
+    list_section.write_text("enhance:\n  - solver\n")
+    with pytest.raises(SystemExit, match=r"listsec\.yaml.*'enhance' must be a mapping"):
+        resolved(list_section)
+
+
+def test_tango_cli_obs_log_emits_manifest_and_stage_events(generated, tmp_path):
+    """--obs-log: a driver run over the fixture corpus writes a sideband
+    JSONL with the run manifest first, >= 4 distinct pipeline stages, fence
+    accounting from the sentinel readbacks, and a clip event — and
+    `obs report` renders it (the observability-PR acceptance criterion)."""
+    from disco_tpu import obs as obs_pkg
+    from disco_tpu.cli import obs as obs_cli
+
+    log = tmp_path / "events.jsonl"
+    results = tango.main([
+        "--rir", "1", "--scenario", "random", "--noise", "ssn",
+        "--dataset", str(generated), "--sav_dir", "t_obs",
+        "--out_root", str(tmp_path / "results"),
+        "--obs-log", str(log),
+    ])
+    assert results is not None
+    assert not obs_pkg.enabled()  # CLI released the recorder on exit
+    events = obs_pkg.read_events(log)  # schema-validating read
+    assert events[0]["kind"] == "manifest"
+    assert events[0]["attrs"]["config"]["rir"] == 1
+    stages = {e["stage"] for e in events if e["kind"] == "stage_end"}
+    assert {"load_input", "stft", "masks", "mwf", "istft", "score_persist"} <= stages
+    assert len(stages) >= 4
+    clip_events = [e for e in events if e["kind"] == "clip"]
+    assert len(clip_events) == 1 and clip_events[0]["attrs"]["rir"] == 1
+    # sentinel readbacks (post-STFT/mask/MWF/ISTFT) each count as one fence
+    counters = [e for e in events if e["kind"] == "counters"][-1]["attrs"]["counters"]
+    assert counters["sentinel_checks"] >= 4
+    assert counters["fences"] >= 4
+    # counters are process-lifetime (other tests may have tripped sentinels
+    # in this process); THIS run's per-event story must be trip-free
+    assert [e for e in events if e["kind"] == "sentinel"] == []
+
+    summary = obs_cli.main(["report", str(log)])
+    assert summary["n_fences"] >= 4
+    assert summary["clips"] == 1
+    for name in ("stft", "masks", "mwf", "istft"):
+        assert summary["stages"][name]["calls"] >= 1
+
+
 def test_tango_cli_bad_yaml_solver_is_clean_error(tmp_path):
     import dataclasses
 

@@ -1,0 +1,484 @@
+"""Tests for disco_tpu.obs — events/schema, metrics, fence/recompile
+accounting, numerics sentinels, the obs CLI (report/compare), and bench.py's
+one-JSON-line stdout contract with --obs-log enabled.
+
+The JSONL schema tests double as the CI gate: `make obs-check` runs them
+(`-k schema`), so any event-schema drift fails the build."""
+import json
+import os
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from disco_tpu import obs
+from disco_tpu.cli import obs as obs_cli
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))  # for bench.py
+
+
+@pytest.fixture(autouse=True)
+def _clean_recorder():
+    """Every test starts and ends with recording off (the recorder is
+    process-global)."""
+    obs.disable()
+    yield
+    obs.disable()
+
+
+# -- events / recorder ------------------------------------------------------
+def test_recorder_disabled_is_noop(tmp_path):
+    assert not obs.enabled()
+    assert obs.record("note", msg="dropped") is None
+    with obs.stage("never"):
+        pass  # no recorder, no file, no error
+
+
+def test_record_roundtrip_and_manifest(tmp_path):
+    log = tmp_path / "run.jsonl"
+    with obs.recording(log):
+        ev = obs.write_manifest(config={"solver": "power"}, tool="test")
+        assert ev is not None
+        obs.record("note", stage="s", msg="hello", value=3)
+    events = obs.read_events(log)
+    assert [e["kind"] for e in events] == ["manifest", "note"]
+    man = events[0]["attrs"]
+    # manifest carries provenance: git SHA, backend, devices, versions
+    assert man["config"] == {"solver": "power"}
+    assert man["platform"] == "cpu" and man["device_count"] == 8
+    assert man["versions"]["jax"] and man["versions"]["numpy"]
+    assert len(man["git_sha"]) == 40
+    assert events[1]["attrs"] == {"msg": "hello", "value": 3}
+
+
+def test_stage_records_duration_and_fences(tmp_path):
+    log = tmp_path / "run.jsonl"
+    with obs.recording(log):
+        with obs.stage("work", rir=7):
+            obs.fence_tick(3)
+            time.sleep(0.01)
+    (ev,) = obs.read_events(log)
+    assert ev["kind"] == "stage_end" and ev["stage"] == "work"
+    assert ev["attrs"]["fences"] == 3 and ev["attrs"]["rir"] == 7
+    assert ev["attrs"]["dur_s"] >= 0.01
+
+
+def test_recorder_append_only_and_threadsafe(tmp_path):
+    log = tmp_path / "run.jsonl"
+    with obs.recording(log):
+        threads = [
+            threading.Thread(target=lambda i=i: obs.record("note", i=i))
+            for i in range(16)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    events = obs.read_events(log)
+    assert sorted(e["attrs"]["i"] for e in events) == list(range(16))
+
+
+def test_unserializable_attr_degrades_to_repr(tmp_path):
+    log = tmp_path / "run.jsonl"
+    with obs.recording(log):
+        obs.record("note", obj=object())  # must not raise
+    (ev,) = obs.read_events(log)
+    assert "object" in ev["attrs"]["obj"]
+
+
+# -- schema (run by `make obs-check` via -k schema) -------------------------
+def test_event_schema_validation():
+    good = {"t": 1.0, "kind": "note", "stage": None, "attrs": {}}
+    obs.validate_event(good)
+    with pytest.raises(ValueError, match="unknown event kind"):
+        obs.validate_event({**good, "kind": "nope"})
+    with pytest.raises(ValueError, match="missing key"):
+        obs.validate_event({"kind": "note"})
+    with pytest.raises(ValueError, match="'t' must be a number"):
+        obs.validate_event({**good, "t": "late"})
+    with pytest.raises(ValueError, match="'stage' must be a string"):
+        obs.validate_event({**good, "stage": 3})
+    with pytest.raises(ValueError, match="'attrs' must be an object"):
+        obs.validate_event({**good, "attrs": []})
+
+
+def test_emitted_log_conforms_to_schema(tmp_path):
+    """Every event the instrumented pipeline emits must validate: exercise
+    each producer once and re-read with validation on."""
+    log = tmp_path / "run.jsonl"
+    with obs.recording(log):
+        obs.write_manifest(config={"a": 1})
+        with obs.stage("stft", rir=1):
+            pass
+        f = obs.counted_jit(lambda x: x + 1, label="unit")
+        f(jnp.ones(3))
+        obs.check_finite("bad", jnp.asarray([np.nan]), stage="mwf")
+        obs.record("clip", rir=1, noise="ssn")
+        obs.record("epoch", stage="train", epoch=0, train_loss=0.5, val_loss=0.6)
+        obs.record("watchdog", stage="bench", timeout_s=1.0)
+        obs.record("bench_result", stage="bench", value=1.0)
+        obs.record("counters", **obs.REGISTRY.snapshot())
+    events = obs.read_events(log, validate=True)  # raises on any drift
+    assert {e["kind"] for e in events} == {
+        "manifest", "stage_end", "jit_trace", "sentinel", "clip", "epoch",
+        "watchdog", "bench_result", "counters",
+    }
+
+
+def test_read_events_rejects_schema_drift(tmp_path):
+    log = tmp_path / "bad.jsonl"
+    log.write_text('{"t": 1.0, "kind": "martian", "stage": null, "attrs": {}}\n')
+    with pytest.raises(ValueError, match="martian"):
+        obs.read_events(log)
+    log.write_text("not json\n")
+    with pytest.raises(ValueError, match="not valid JSON"):
+        obs.read_events(log)
+
+
+# -- metrics registry -------------------------------------------------------
+def test_registry_counters_gauges_histograms():
+    reg = obs.REGISTRY
+    base = reg.counter("t_counter").value
+    reg.counter("t_counter").inc()
+    reg.counter("t_counter").inc(4)
+    assert reg.counter("t_counter").value == base + 5
+    reg.gauge("t_gauge").set(2.5)
+    reg.histogram("t_hist").observe(1.0)
+    reg.histogram("t_hist").observe(3.0)
+    snap = reg.snapshot()
+    assert snap["counters"]["t_counter"] == base + 5
+    assert snap["gauges"]["t_gauge"] == 2.5
+    h = snap["histograms"]["t_hist"]
+    assert h["count"] == 2 and h["min"] == 1.0 and h["max"] == 3.0 and h["mean"] == 2.0
+    pretty = reg.pretty()
+    assert "t_counter" in pretty and "t_gauge" in pretty and "t_hist" in pretty
+
+
+def test_registry_reset_keeps_module_bindings_live():
+    """reset() zeroes in place: the fence counter bound at accounting import
+    time must keep counting after a reset."""
+    from disco_tpu.obs import accounting
+
+    obs.fence_tick()
+    obs.REGISTRY.reset()
+    assert obs.fence_count() == 0
+    obs.fence_tick()
+    assert obs.fence_count() == 1 == accounting._FENCES.value
+
+
+# -- accounting -------------------------------------------------------------
+def test_fence_accounting_via_milestones_fence():
+    from disco_tpu.milestones import _fence
+
+    n0 = obs.fence_count()
+    _fence(jnp.ones(3))
+    _fence(jnp.asarray([1j + 1.0]))  # complex goes through jnp.real
+    assert obs.fence_count() == n0 + 2
+    assert obs.rpc_overhead_s(2) == pytest.approx(0.16)  # 2 x ~80 ms
+
+
+def test_counted_jit_counts_retraces(tmp_path):
+    log = tmp_path / "run.jsonl"
+    calls = []
+
+    @obs.counted_jit(label="fn_under_test")
+    def f(x):
+        calls.append(1)
+        return x * 2
+
+    n0 = obs.recompile_count()
+    with obs.recording(log):
+        np.testing.assert_allclose(f(jnp.ones(3)), 2 * np.ones(3))
+        f(jnp.ones(3))          # cache hit: no event
+        f(jnp.ones((2, 2)))     # new shape: retrace
+    assert obs.recompile_count() == n0 + 2
+    assert len(calls) == 2  # traced twice, dispatched three times
+    events = [e for e in obs.read_events(log) if e["kind"] == "jit_trace"]
+    assert len(events) == 2
+    assert all(e["stage"] == "fn_under_test" for e in events)
+
+
+def test_counted_jit_supports_static_argnames_and_lower():
+    @obs.counted_jit(label="s", static_argnames=("k",))
+    def g(x, k=2):
+        return x * k
+
+    np.testing.assert_allclose(g(jnp.ones(2), k=3), 3 * np.ones(2))
+    assert g.lower(jnp.ones(2), k=3).compile() is not None
+
+
+# -- sentinels --------------------------------------------------------------
+def test_check_finite_disabled_is_noop_and_true():
+    assert obs.check_finite("x", jnp.asarray([np.nan])) is True  # opt-in
+
+
+def test_check_finite_records_offending_stage_and_stats(tmp_path):
+    log = tmp_path / "run.jsonl"
+    bad = np.ones((4, 8), np.float32)
+    bad[1, 3] = np.nan
+    bad[2, 5] = np.inf
+    with obs.recording(log):
+        assert obs.check_finite("clean", jnp.ones((3, 3))) is True
+        assert obs.check_finite("post_mwf", jnp.asarray(bad), stage="mwf") is False
+        # complex input: non-finite in either component trips
+        zbad = np.ones(4, np.complex64)
+        zbad[0] = np.nan + 1j
+        assert obs.check_finite("z", jnp.asarray(zbad), stage="stft") is False
+    events = [e for e in obs.read_events(log) if e["kind"] == "sentinel"]
+    assert len(events) == 2
+    ev = events[0]
+    assert ev["stage"] == "mwf" and ev["attrs"]["name"] == "post_mwf"
+    assert ev["attrs"]["n_nonfinite"] == 2
+    assert ev["attrs"]["n_nan"] == 1 and ev["attrs"]["n_inf"] == 1
+    assert ev["attrs"]["shape"] == [4, 8]
+    assert ev["attrs"]["finite_absmax"] == 1.0
+    assert events[1]["stage"] == "stft"
+
+
+def test_check_finite_pytree_names_leaves(tmp_path):
+    log = tmp_path / "run.jsonl"
+    with obs.recording(log):
+        ok = obs.check_finite(
+            "masks", (jnp.ones(3), jnp.asarray([np.inf])), stage="masks"
+        )
+    assert ok is False
+    (ev,) = [e for e in obs.read_events(log) if e["kind"] == "sentinel"]
+    assert ev["attrs"]["name"] == "masks[1]"
+
+
+# -- deprecation shim -------------------------------------------------------
+def test_utils_profiling_shim_warns_and_reexports():
+    import importlib
+
+    import disco_tpu.utils.profiling as prof
+
+    with pytest.warns(DeprecationWarning, match="disco_tpu.obs"):
+        importlib.reload(prof)
+    from disco_tpu.obs.metrics import StageTimer
+
+    assert prof.StageTimer is StageTimer
+
+
+# -- obs CLI: report --------------------------------------------------------
+def _synthetic_log(tmp_path):
+    log = tmp_path / "run.jsonl"
+    with obs.recording(log):
+        obs.write_manifest(config={"rir": 1}, tool="test")
+        for name, dur in (("stft", 0.01), ("masks", 0.002), ("mwf", 0.05),
+                          ("istft", 0.004)):
+            obs.record("stage_end", stage=name, dur_s=dur, fences=1)
+        obs.record("stage_end", stage="mwf", dur_s=0.03, fences=2)
+        obs.record("jit_trace", stage="run_batch", n_new_programs=1)
+        obs.record("sentinel", stage="mwf", name="yf", n_nonfinite=3,
+                   shape=[2, 2], n_nan=3, n_inf=0)
+        obs.record("clip", rir=1, noise="ssn")
+        obs.record("counters", **obs.REGISTRY.snapshot())
+    return log
+
+
+def test_obs_report_renders_stage_table_and_fences(tmp_path, capsys):
+    log = _synthetic_log(tmp_path)
+    summary = obs_cli.main(["report", str(log)])
+    out = capsys.readouterr().out
+    # stage totals: two mwf events aggregate
+    assert summary["stages"]["mwf"] == pytest.approx(
+        {"calls": 2, "total_s": 0.08, "fences": 3, "mean_s": 0.04}
+    )
+    assert summary["n_fences"] >= 6
+    assert summary["est_rpc_s"] == pytest.approx(summary["n_fences"] * 0.08)
+    assert summary["clips"] == 1
+    for token in ("stft", "masks", "mwf", "istft", "fences:", "SENTINEL",
+                  "recompiles: run_batch×1"):
+        assert token in out, token
+
+
+# -- obs CLI: compare -------------------------------------------------------
+def _bench_record(rtf):
+    return {
+        "metric": "rtf_8node_mwf_enhancement", "value": rtf,
+        "unit": "x_realtime", "value_single_dispatch": rtf * 0.7,
+        "stage_ms": {"full_pipeline": 1280e3 / rtf},
+    }
+
+
+def test_obs_compare_flags_ten_percent_regression(tmp_path, capsys):
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text(json.dumps(_bench_record(6700.0)))
+    new.write_text(json.dumps(_bench_record(6030.0)))  # -10%
+    with pytest.raises(SystemExit) as exc:
+        obs_cli.main(["compare", str(old), str(new)])
+    assert exc.value.code == 1
+    assert "VERDICT: REGRESSION" in capsys.readouterr().out
+
+
+def test_obs_compare_ok_within_noise_and_improved(tmp_path, capsys):
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text(json.dumps(_bench_record(6700.0)))
+    new.write_text(json.dumps(_bench_record(6710.0)))
+    diff = obs_cli.main(["compare", str(old), str(new)])
+    assert diff["verdict"] == "OK"
+    old2 = tmp_path / "old2.json"
+    old2.write_text(json.dumps(_bench_record(5000.0)))
+    diff = obs_cli.main(["compare", str(old2), str(new)])
+    assert diff["verdict"] == "IMPROVED"
+    assert "VERDICT" in capsys.readouterr().out
+
+
+def test_obs_compare_reads_bench_r_wrappers_and_null_candidate(tmp_path):
+    """The committed BENCH_r04→r05 trajectory must read as OK (this is the
+    exact invocation `make obs-check` gates CI with), and a null candidate
+    value must be a REGRESSION, not a crash."""
+    root = Path(__file__).resolve().parents[1]
+    diff = obs_cli.main(
+        ["compare", str(root / "BENCH_r04.json"), str(root / "BENCH_r05.json")]
+    )
+    assert diff["verdict"] == "OK"
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"metric": "rtf", "value": None}))
+    with pytest.raises(SystemExit):
+        obs_cli.main(["compare", str(root / "BENCH_r04.json"), str(bad)])
+
+
+def test_obs_compare_reads_event_log_bench_result(tmp_path):
+    log = tmp_path / "run.jsonl"
+    with obs.recording(log):
+        obs.record("bench_result", stage="bench", **_bench_record(6000.0))
+    old = tmp_path / "old.json"
+    old.write_text(json.dumps(_bench_record(6000.0)))
+    diff = obs_cli.main(["compare", str(old), str(log)])
+    assert diff["verdict"] == "OK"
+
+
+# -- bench.py contract ------------------------------------------------------
+def _canned_bench_jax(**_):
+    return {
+        "rtf": 6700.0, "rtf_single_dispatch": 4900.0, "rtf_eigh": 4800.0,
+        "rtf_jacobi": 3900.0, "jacobi_error": None,
+        "rtf_covfused": 6800.0, "covfused_error": None,
+        "dispatch_overhead_ms": 70.0, "flops_per_clip": 3.5e10, "mfu": 0.03,
+        "stage_ms": {"full_pipeline": 190.0},
+    }
+
+
+def test_bench_single_json_line_stdout_with_obs_log(tmp_path, monkeypatch, capsys):
+    """Tier-1 contract: with --obs-log the full event stream goes to the
+    file and stdout stays EXACTLY one parseable JSON line."""
+    import bench
+
+    monkeypatch.setattr(bench, "bench_jax", _canned_bench_jax)
+    monkeypatch.setattr(bench, "bench_streaming", lambda **_: (0.85, 16.0, 18.9))
+    monkeypatch.setattr(bench, "bench_numpy", lambda: 3.0)
+    log = tmp_path / "bench_events.jsonl"
+    bench.main(["--obs-log", str(log)])
+    out_lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(out_lines) == 1, out_lines
+    record = json.loads(out_lines[0])
+    assert record["metric"] == "rtf_8node_mwf_enhancement"
+    assert record["value"] == 6700.0
+    events = obs.read_events(log)  # schema-validating read
+    kinds = [e["kind"] for e in events]
+    assert kinds[0] == "manifest"
+    assert "bench_result" in kinds and "counters" in kinds
+    stages = {e["stage"] for e in events if e["kind"] == "stage_end"}
+    assert {"bench_jax", "bench_streaming", "bench_numpy"} <= stages
+    # the sideband mirrors the stdout record
+    (br,) = [e for e in events if e["kind"] == "bench_result"]
+    assert br["attrs"]["value"] == record["value"]
+    # recorder released: bench.main disabled it on exit
+    assert not obs.enabled()
+
+
+def test_bench_stdout_unchanged_without_obs_log(monkeypatch, capsys):
+    import bench
+
+    monkeypatch.setattr(bench, "bench_jax", _canned_bench_jax)
+    monkeypatch.setattr(bench, "bench_streaming", lambda **_: (0.85, 16.0, 18.9))
+    monkeypatch.setattr(bench, "bench_numpy", lambda: 3.0)
+    bench.main([])
+    out_lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(out_lines) == 1
+    assert json.loads(out_lines[0])["vs_baseline"] == pytest.approx(6700.0 / 3.0, rel=0.01)
+
+
+def test_bench_error_path_records_event_and_one_line(tmp_path, monkeypatch, capsys):
+    import bench
+
+    def boom(**_):
+        raise RuntimeError("UNAVAILABLE: tunnel down")
+
+    monkeypatch.setattr(bench, "bench_jax", boom)
+    log = tmp_path / "err.jsonl"
+    with pytest.raises(SystemExit) as exc:
+        bench.main(["--obs-log", str(log)])
+    assert exc.value.code == 2
+    out_lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(out_lines) == 1
+    assert "UNAVAILABLE" in json.loads(out_lines[0])["error"]
+    events = obs.read_events(log)
+    (br,) = [e for e in events if e["kind"] == "bench_result"]
+    assert "UNAVAILABLE" in br["attrs"]["error"]
+    assert not obs.enabled()
+
+
+def test_bench_watchdog_emits_event_and_diagnostic_line(tmp_path, monkeypatch, capsys):
+    """The watchdog diagnostic goes through the event schema (satellite):
+    when it fires, a `watchdog` event lands in the log before the process
+    exits, alongside the parseable stdout line."""
+    import bench
+
+    exited = threading.Event()
+    monkeypatch.setattr(bench.os, "_exit", lambda code: exited.set())
+    log = tmp_path / "wd.jsonl"
+    obs.enable(log)
+    done = bench._start_watchdog(0.05)
+    assert exited.wait(5.0), "watchdog did not fire"
+    done.set()
+    time.sleep(0.05)  # let the thread finish its print
+    out = capsys.readouterr().out
+    (line,) = [l for l in out.splitlines() if l.strip()]
+    assert json.loads(line)["value"] is None
+    events = obs.read_events(log)
+    (wd,) = [e for e in events if e["kind"] == "watchdog"]
+    assert wd["stage"] == "bench"
+    assert wd["attrs"]["timeout_s"] == pytest.approx(0.05)
+    assert "counters" in wd["attrs"]  # final registry snapshot rides along
+
+
+# -- training telemetry -----------------------------------------------------
+def test_fit_records_epoch_events(tmp_path):
+    from disco_tpu.nn.crnn import build_rnn
+    from disco_tpu.nn.training import create_train_state, fit
+
+    model, tx = build_rnn(n_ch=1, win_len=11, n_freq=17, rnn_units=(16,), ff_units=(17,))
+    x = np.random.default_rng(0).standard_normal((4, 11, 17)).astype(np.float32)
+    y = np.abs(np.random.default_rng(1).standard_normal((4, 11, 17))).astype(np.float32)
+    state = create_train_state(model, tx, x[:1])
+
+    def batches():
+        yield x, y
+
+    log = tmp_path / "train.jsonl"
+    with obs.recording(log):
+        fit(model, state, batches, batches, n_epochs=3,
+            save_path=str(tmp_path / "m"), verbose=False)
+    events = obs.read_events(log)
+    epochs = [e for e in events if e["kind"] == "epoch"]
+    assert [e["attrs"]["epoch"] for e in epochs] == [0, 1, 2]
+    a = epochs[0]["attrs"]
+    assert a["steps"] == 1 and np.isfinite(a["train_loss"]) and np.isfinite(a["val_loss"])
+    # epoch 0 traces train+eval (and epoch 1 may retrace train_step once:
+    # the init state's weak types canonicalize after the first
+    # apply_gradients); by epoch 2 the programs must be cache-stable —
+    # exactly the per-epoch recompile drift this event exists to expose.
+    assert a["recompiles"] >= 2
+    assert epochs[2]["attrs"]["recompiles"] == 0
+    assert obs.REGISTRY.gauge("val_loss").value == pytest.approx(
+        epochs[2]["attrs"]["val_loss"]
+    )

@@ -200,7 +200,7 @@ def test_ring_all_gather_order():
     import jax
     from jax.sharding import PartitionSpec as P
 
-    from disco_tpu.parallel import make_mesh, ring_all_gather
+    from disco_tpu.parallel import make_mesh, ring_all_gather, shard_map_compat
 
     mesh = make_mesh(n_node=4)
     x = np.arange(8, dtype=np.float32).reshape(8, 1)  # 2 rows per device
@@ -208,7 +208,7 @@ def test_ring_all_gather_order():
     def f(xs):
         return ring_all_gather(xs, "node"), jax.lax.all_gather(xs, "node", axis=0, tiled=True)
 
-    ring, ref = jax.shard_map(
+    ring, ref = shard_map_compat(
         f, mesh=mesh, in_specs=P("node"), out_specs=P("node")
     )(x)
     np.testing.assert_array_equal(np.asarray(ring), np.asarray(ref))

@@ -71,6 +71,80 @@ def test_prefetch_complex_batches():
     np.testing.assert_array_equal(np.asarray(to_host(got)), z)
 
 
+def test_stage_timer_sync_calls_block_until_ready(monkeypatch):
+    """stage(block_on=...) must actually fence: the sync path calls
+    jax.block_until_ready on the handed tensor (on real hardware that is
+    what keeps the timing honest), and sync=False must not."""
+    import jax
+
+    blocked = []
+    monkeypatch.setattr(jax, "block_until_ready", blocked.append)
+    t = StageTimer(sync=True)
+    x = jnp.ones((2,))
+    with t.stage("fenced", block_on=x):
+        pass
+    assert len(blocked) == 1 and blocked[0] is x
+    with t.stage("unfenced"):
+        pass
+    assert len(blocked) == 1  # no block_on -> no fence
+    t_async = StageTimer(sync=False)
+    with t_async.stage("async", block_on=x):
+        pass
+    assert len(blocked) == 1  # sync=False -> never fences
+    # the fenced stage still accumulated its timing
+    assert t.report()["fenced"]["calls"] == 1
+
+
+def test_stage_timer_sync_fences_even_on_body_exception(monkeypatch):
+    """The finally-path must fence before recording, or the timing of a
+    raising stage silently loses the device wait."""
+    import jax
+
+    blocked = []
+    monkeypatch.setattr(jax, "block_until_ready", blocked.append)
+    t = StageTimer()
+    with pytest.raises(RuntimeError, match="boom"):
+        with t.stage("explodes", block_on=jnp.ones(())):
+            raise RuntimeError("boom")
+    assert len(blocked) == 1
+    assert t.report()["explodes"]["calls"] == 1
+
+
+def test_trace_to_success_path_starts_and_stops(monkeypatch, tmp_path):
+    import jax
+
+    calls = []
+    monkeypatch.setattr(
+        jax.profiler, "start_trace", lambda d: calls.append(("start", d))
+    )
+    monkeypatch.setattr(
+        jax.profiler, "stop_trace", lambda: calls.append(("stop", None))
+    )
+    logdir = str(tmp_path / "trace")
+    with trace_to(logdir):
+        assert calls == [("start", logdir)]
+    assert calls == [("start", logdir), ("stop", None)]
+
+
+def test_trace_to_failure_is_noop_that_still_yields(monkeypatch, capsys):
+    """A profiler that cannot start must not break the pipeline: the body
+    still runs, stop_trace is never called, and the note goes to stdout."""
+    import jax
+
+    def broken_start(logdir):
+        raise RuntimeError("profiler busy")
+
+    stops = []
+    monkeypatch.setattr(jax.profiler, "start_trace", broken_start)
+    monkeypatch.setattr(jax.profiler, "stop_trace", lambda: stops.append(1))
+    ran = []
+    with trace_to("/nonexistent/dir"):
+        ran.append(1)
+    assert ran == [1]
+    assert stops == []  # never started -> never stopped
+    assert "trace unavailable" in capsys.readouterr().out
+
+
 def test_trace_to_noop_on_failure(tmp_path):
     # nested trace (or unavailable backend) must not raise
     with trace_to(str(tmp_path / "t1")):
