@@ -3,9 +3,9 @@
 
 PYTHON ?= python
 
-.PHONY: test coverage doc install native clean bench milestone-corpus dryrun obs-check
+.PHONY: test coverage doc install native clean bench milestone-corpus dryrun obs-check fault-check
 
-test: obs-check
+test: obs-check fault-check
 	$(PYTHON) -m pytest tests/ -q
 
 # Telemetry gates (run before the suite so drift fails fast):
@@ -18,6 +18,13 @@ test: obs-check
 obs-check:
 	$(PYTHON) -m disco_tpu.cli.obs compare $$(ls BENCH_r*.json | sort | tail -2)
 	$(PYTHON) -m pytest tests/test_obs.py -q -k "schema"
+
+# Fault-tolerance gate: inject a node dropout + a NaN z on a synthetic CPU
+# scene, assert finite degraded-mode output and the expected obs fault
+# events (disco_tpu/fault/check.py).  CPU forced: a bare python run would
+# otherwise claim the tunneled chip (environment contract).
+fault-check:
+	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= $(PYTHON) -m disco_tpu.fault.check
 
 coverage:
 	$(PYTHON) -m coverage run --branch --source=disco_tpu -m pytest tests/ -q

@@ -5,7 +5,9 @@ Two subcommands:
 * ``report LOG.jsonl`` — aggregate a JSONL event log (``disco_tpu.obs``
   schema) into a manifest summary, a per-stage time/call/fence table with
   the estimated tunnel-RPC overhead (n_fences × ~80 ms — the Axon cost
-  model, CLAUDE.md), recompile and sentinel listings, and the final counter
+  model, CLAUDE.md), recompile and sentinel listings, the fault-tolerance
+  story (injected faults, retry recoveries, degraded-mode entries —
+  ``disco_tpu.fault`` / ``utils.resilience``), and the final counter
   snapshot.
 * ``compare OLD.json NEW.json`` — diff two bench records (either the
   driver-captured ``BENCH_r*.json`` wrapper with its ``parsed`` field, a raw
@@ -84,6 +86,9 @@ def summarize(events: list[dict]) -> dict:
         "epochs": [e for e in events if e["kind"] == "epoch"],
         "clips": sum(1 for e in events if e["kind"] == "clip"),
         "watchdogs": [e for e in events if e["kind"] == "watchdog"],
+        "faults": [e for e in events if e["kind"] == "fault"],
+        "recoveries": [e for e in events if e["kind"] == "recovery"],
+        "degraded": [e for e in events if e["kind"] == "degraded"],
         "n_events": len(events),
         "n_fences": n_fences,
         "est_rpc_s": n_fences * RPC_MS_ESTIMATE / 1e3,
@@ -152,6 +157,40 @@ def render_report(summary: dict) -> str:
         )
     for e in summary["watchdogs"]:
         lines.append(f"WATCHDOG fired: {e['attrs'].get('suspected_cause')}")
+    if summary["faults"]:
+        # injected faults grouped by kind; transient_error retries listed
+        # individually would drown the report, so they are counted per label
+        by_kind: dict[str, int] = {}
+        for e in summary["faults"]:
+            key = e["attrs"].get("fault", "?")
+            if key == "transient_error":
+                key = f"transient_error@{e['stage']}"
+            by_kind[key] = by_kind.get(key, 0) + 1
+        lines.append(
+            "faults: " + "  ".join(f"{k}×{v}" for k, v in sorted(by_kind.items()))
+        )
+        for e in summary["faults"]:
+            a = e["attrs"]
+            if a.get("fault") == "transient_error":
+                continue
+            detail = "  ".join(
+                f"{k}={v}" for k, v in a.items() if k not in ("fault", "blocks")
+            )
+            lines.append(f"  FAULT {a.get('fault')}: {detail}")
+    if summary["recoveries"]:
+        by_stage: dict[str, int] = {}
+        for e in summary["recoveries"]:
+            by_stage[e["stage"] or "?"] = by_stage.get(e["stage"] or "?", 0) + 1
+        lines.append(
+            "recoveries: "
+            + "  ".join(f"{k}×{v}" for k, v in sorted(by_stage.items()))
+        )
+    for e in summary["degraded"]:
+        a = e["attrs"]
+        lines.append(
+            f"DEGRADED mode at stage {e['stage']!r}: "
+            + "  ".join(f"{k}={v}" for k, v in a.items())
+        )
     return "\n".join(lines)
 
 

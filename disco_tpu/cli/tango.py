@@ -63,6 +63,16 @@ def build_parser():
                         "mesh (clips sharded over 'batch', nodes over 'node', "
                         "GSPMD-placed collectives); needs BATCH*NODE devices and "
                         "--batch_size divisible by BATCH")
+    p.add_argument("--fault-spec", default=None,
+                   help="YAML/JSON fault scenario (disco_tpu.fault.FaultSpec "
+                        "fields: node_dropout, dropout_prob, link_loss_prob, "
+                        "stale_prob, nan_z, nan_prob, seed): inject seeded "
+                        "faults at the z-exchange and run degraded-mode "
+                        "beamforming; every fault lands in the obs event log "
+                        "(doc/source/robustness.rst)")
+    p.add_argument("--fault-seed", type=int, default=None,
+                   help="override the fault spec's seed (ablation sweeps over "
+                        "fault realizations without editing the file)")
     p.add_argument("--obs-log", default=None,
                    help="record structured run telemetry (manifest, per-stage "
                         "events, fence/RPC accounting, numerics sentinels) to "
@@ -169,6 +179,26 @@ def resolve_solver(args):
         raise SystemExit(f"--config {args.config}: enhance.solver: {e}")
 
 
+def resolve_fault_spec(args):
+    """Load --fault-spec (with the optional --fault-seed override) into a
+    FaultSpec, converting file/format errors into clean CLI errors."""
+    if args.fault_spec is None:
+        if args.fault_seed is not None:
+            raise SystemExit("--fault-seed needs --fault-spec")
+        return None
+    import dataclasses
+
+    from disco_tpu.fault import load_fault_spec
+
+    try:
+        spec = load_fault_spec(args.fault_spec)
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"--fault-spec {args.fault_spec}: {e}")
+    if args.fault_seed is not None:
+        spec = dataclasses.replace(spec, seed=args.fault_seed)
+    return spec
+
+
 def main(argv=None):
     args = build_parser().parse_args(argv)
     args.solver = resolve_solver(args)
@@ -176,6 +206,7 @@ def main(argv=None):
         raise SystemExit("one of --rir or --rirs is required")
     if args.mesh is not None and args.rirs is None:
         raise SystemExit("--mesh needs batched corpus mode (--rirs)")
+    args.fault_spec = resolve_fault_spec(args)
     policy = none_str(args.mask_z) or "none"
 
     if args.obs_log:
@@ -244,6 +275,7 @@ def _run(args, policy):
                 max_batch=args.batch_size, models=models,
                 z_sigs=args.zsigs[0] if len(args.zsigs) == 1 else "zs&zn",
                 solver=args.solver, cov_impl=args.cov_impl, mesh=mesh,
+                fault_spec=args.fault_spec,
             )
         print(f"{len(results)} RIRs enhanced (batched)")
         return results
@@ -255,6 +287,7 @@ def _run(args, policy):
             out_root=args.out_root, streaming=args.streaming, bucket=args.bucket or 0,
             z_sigs=args.zsigs[0] if len(args.zsigs) == 1 else "zs&zn",
             solver=args.solver, cov_impl=args.cov_impl,
+            fault_spec=args.fault_spec,
         )
     if results is None:
         print(f"Conf {args.rir} with {args.noise} noise already processed")

@@ -11,6 +11,7 @@ from disco_tpu.enhance.inference import (
 )
 from disco_tpu.enhance.tango import (
     TangoResult,
+    finite_z_guard,
     oracle_masks,
     others_index,
     tango,
@@ -18,11 +19,13 @@ from disco_tpu.enhance.tango import (
     tango_step2,
 )
 from disco_tpu.enhance.separation import separate_sources, separate_with_masks
-from disco_tpu.enhance.streaming import streaming_step1, streaming_tango
+from disco_tpu.enhance.streaming import hold_last_good, streaming_step1, streaming_tango
 from disco_tpu.enhance.zexport import compute_z_signals, export_z
 
 __all__ = [
     "TangoResult",
+    "finite_z_guard",
+    "hold_last_good",
     "oracle_masks",
     "tango",
     "tango_step1",

@@ -40,7 +40,36 @@ from disco_tpu.obs import accounting as obs_accounting
 from disco_tpu.obs import events as obs_events
 from disco_tpu.obs import sentinels as obs_sentinels
 from disco_tpu.obs.metrics import REGISTRY as obs_registry
-from disco_tpu.utils import to_host
+from disco_tpu.utils import resilient_to_host
+
+
+def _record_degraded(fault_plan, streaming: bool = False, **attrs):
+    """Record the pipeline's degraded-mode entry for one clip: a
+    ``degraded`` obs event naming what was lost plus the ``degraded_clips``
+    counter.  No-op when the plan injects nothing (an all-defaults spec)."""
+    if fault_plan is None or not fault_plan.any_fault():
+        return
+    obs_registry.counter("degraded_clips").inc()
+    if not obs_events.enabled():
+        return
+    import numpy as _np
+
+    if streaming:
+        lost = fault_plan.avail_streaming < 1.0
+        obs_events.record(
+            "degraded", stage="mwf", mode="streaming",
+            n_blocks_held=int(lost.sum()),
+            nodes=_np.flatnonzero(lost.any(axis=1)).tolist(),
+            **attrs,
+        )
+    else:
+        excluded = _np.flatnonzero(fault_plan.avail_offline < 1.0).tolist()
+        obs_events.record(
+            "degraded", stage="mwf", mode="offline",
+            n_streams_excluded=len(excluded), nodes=excluded,
+            nan_nodes=_np.flatnonzero(fault_plan.z_nan).tolist(),
+            **attrs,
+        )
 
 
 def load_input_signals(layout: DatasetLayout, rir: int, noise: str, snr_range, n_nodes=4, mics_per_node=4):
@@ -62,6 +91,21 @@ def dset_of_rir(rir: int) -> str:
 
 def results_root(scenario: str, dset: str, save_dir: str) -> Path:
     return Path("results") / scenario / dset / save_dir
+
+
+#: Keys of the per-node metric dicts below — the degraded-mode NaN fill
+#: must produce exactly this set so `stack_keys` can stack healthy and
+#: corrupted nodes together (pinned by tests/test_fault.py).
+_NODE_METRIC_KEYS = (
+    "sdr_cnv", "sir_cnv", "sar_cnv", "sdr_dry", "sir_dry", "sar_dry",
+    "sdr_in_cnv", "sir_in_cnv", "sdr_in_dry", "sir_in_dry", "sar_in_dry",
+    "si_sdr_cnv", "si_sir_cnv", "si_sar_cnv",
+    "si_sdr_dry", "si_sir_dry", "si_sar_dry",
+    "si_sdr_in_cnv", "si_sir_in_cnv",
+    "si_sdr_in_dry", "si_sir_in_dry", "si_sar_in_dry",
+    "delta_stoi_cnv", "delta_stoi_dry",
+    "snr_out", "snr_in_cnv", "snr_in_dry", "fw_sd_cnv", "fw_sd_dry",
+)
 
 
 def _node_metrics_pair(y0, s0, n0, sh_t, szh_t, s_dry, n_dry, sf_t, nf_t,
@@ -92,6 +136,13 @@ def _node_metrics_pair(y0, s0, n0, sh_t, szh_t, s_dry, n_dry, sf_t, nf_t,
     _, fw_snr_in_dry, _ = fw_snr(s_dry[sl], n_dry[sl], fs)
 
     def one_output(est, s_filt, n_filt):
+        if not np.isfinite(est[sl]).all():
+            # Degraded mode (disco_tpu.fault): a corrupted/NaN stream — e.g.
+            # the saved MWF output of a NaN-z node, whose enhanced TANGO
+            # output is still fine — scores as NaN metrics, never a crash
+            # (the 512-tap BSS projector's cho_solve rejects non-finite
+            # input with a raw ValueError otherwise).
+            return dict.fromkeys(_NODE_METRIC_KEYS, float("nan"))
         sdr_dry, sir_dry, sar_dry = proj_dry.score(est[sl])
         sdr_cnv, sir_cnv, sar_cnv = proj_cnv.score(est[sl])
         si_sdr_dry, si_sir_dry, si_sar_dry = si_bss(est[sl], refs_dry, 0)
@@ -231,7 +282,10 @@ def _persist_and_score(
         write_wav(out / "WAV" / str(rir) / f"out_tar-{tag}.wav", sf_t[k], fs)
         np.save(out / "MASK" / str(rir) / f"step1_{tag}", np.asarray(res.masks_z[k, :, :T_true]))
         np.save(out / "MASK" / str(rir) / f"step2_{tag}", np.asarray(res.mask_w[k, :, :T_true]))
-        np.save(zdir / f"{rir}_{tag}", to_host(res.z_y[k, :, :T_true]))
+        # resilient: the z export is this function's one direct device
+        # readback (complex-split over the tunnel) — a dropped RPC retries
+        # in-process instead of aborting the clip (utils.resilience)
+        np.save(zdir / f"{rir}_{tag}", resilient_to_host(res.z_y[k, :, :T_true]))
 
     def stack_keys(dicts):
         return {k: np.array([d[k] for d in dicts]) for k in dicts[0]}
@@ -288,12 +342,22 @@ def enhance_rir(
     z_sigs: str = "zs_hat",
     solver: str | None = None,
     cov_impl: str = "xla",
+    fault_spec=None,
 ):
     """Enhance one RIR end-to-end and persist everything (reference
     tango.py:460-641).  ``models``: per-step CRNN params or None for the
     oracle masks of ``mask_type``.  ``streaming=True`` runs the
     frame-recursive online pipeline (exponential-smoothing covariances,
     block filter refresh) instead of the offline frame-mean one.
+
+    ``fault_spec``: optional ``disco_tpu.fault.FaultSpec`` (or dict/path
+    accepted by ``load_fault_spec``) — inject the seeded fault scenario at
+    the z-exchange seam and run the pipeline in degraded mode: offline,
+    unavailable/corrupted streams are excluded from the step-2 MWF;
+    streaming, lost blocks are bridged by the last-good-z hold.  Every
+    injected fault and the degraded-mode entry are recorded as obs
+    events/counters.  ``None`` (default) leaves the pipeline byte-identical
+    to the fault-free path.
 
     ``solver=None`` resolves per mode: 'power' offline (measured fastest
     at SDR parity — round-3 solver_ab, exp/tpu_validation_r3.jsonl) but
@@ -338,6 +402,21 @@ def enhance_rir(
     with obs_events.stage("masks", rir=rir):
         masks_z, mask_w = estimate_masks(Y, S, N, models, mask_type, n_nodes, mu=mu, z_sigs=z_sigs)
     obs_sentinels.check_finite("masks", (masks_z, mask_w), stage="masks")
+
+    fault_plan = None
+    if fault_spec is not None:
+        from disco_tpu.enhance.streaming import DEFAULT_UPDATE_EVERY
+        from disco_tpu.fault import plan_faults
+
+        T_frames = Y.shape[-1]
+        n_blocks = -(-T_frames // DEFAULT_UPDATE_EVERY) if streaming else 1
+        fault_plan = plan_faults(fault_spec, n_nodes, n_blocks)
+        fault_plan.record(mode="streaming" if streaming else "offline")
+        _record_degraded(fault_plan, rir=rir, streaming=streaming)
+        if not fault_plan.any_fault():
+            # The seeded plan drew nothing: stay on the fault-free fast
+            # path (no guard, no masked step-2 program, no extra jit entry)
+            fault_plan = None
     if streaming:
         # The online pipeline implements the 'local'/'distant'/'none'
         # mask-for-z policies; the oracle policies are offline-only.
@@ -359,7 +438,9 @@ def enhance_rir(
 
         with obs_events.stage("mwf", rir=rir, mode="streaming", solver=solver):
             st = streaming_tango(Y, masks_z, mask_w, mu=mu, S=S, N=N,
-                                 with_diagnostics=True, policy=policy, solver=solver)
+                                 with_diagnostics=True, policy=policy, solver=solver,
+                                 z_avail=None if fault_plan is None
+                                 else fault_plan.avail_streaming)
         # ONE filter everywhere: every saved wav, mask, z and metric below
         # describes the online beamformer (sf/nf come from the same
         # per-block filters applied to the clean components).
@@ -370,8 +451,14 @@ def enhance_rir(
         )
     else:
         with obs_events.stage("mwf", rir=rir, mode="offline", solver=solver):
-            res = tango(Y, S, N, masks_z, mask_w, mu=mu, policy=policy, mask_type=mask_type,
-                        solver=solver, cov_impl=cov_impl)
+            if fault_plan is None:
+                res = tango(Y, S, N, masks_z, mask_w, mu=mu, policy=policy,
+                            mask_type=mask_type, solver=solver, cov_impl=cov_impl)
+            else:
+                res = tango(Y, S, N, masks_z, mask_w, mu=mu, policy=policy,
+                            mask_type=mask_type, solver=solver, cov_impl=cov_impl,
+                            z_mask=fault_plan.avail_offline,
+                            z_nan=fault_plan.z_nan if fault_plan.z_nan.any() else None)
     obs_sentinels.check_finite("mwf_yf", res.yf, stage="mwf")
 
     out_results = _persist_and_score(
@@ -473,6 +560,7 @@ def enhance_rirs_batched(
     cov_impl: str = "xla",
     score_workers: int = 4,
     mesh=None,
+    fault_spec=None,
 ):
     """Corpus-scale enhancement: many RIRs per jitted launch.
 
@@ -492,6 +580,11 @@ def enhance_rirs_batched(
     launch; only one chunk of futures is in flight (memory bound), and 1
     means inline scoring.  The metric math is identical either way.
 
+    ``fault_spec``: optional fault scenario (``disco_tpu.fault``) — the
+    same seeded plan (offline semantics: per-node availability + NaN
+    corruption at the z-exchange) applies to every clip in the run, so a
+    corpus sweep measures degradation under a FIXED network condition.
+
     ``mesh``: optional (batch, node) ``jax.sharding.Mesh`` — each chunk
     then runs as ``disco_tpu.parallel.tango_batch_sharded`` (clips over
     'batch', nodes over 'node', GSPMD-placed collectives) instead of the
@@ -508,6 +601,19 @@ def enhance_rirs_batched(
     import jax.numpy as jnp
 
     from disco_tpu.core.dsp import bucket_length, n_stft_frames, stft
+
+    fault_plan = None
+    z_mask_arr = z_nan_arr = None
+    if fault_spec is not None:
+        from disco_tpu.fault import plan_faults
+
+        fault_plan = plan_faults(fault_spec, n_nodes, 1)
+        fault_plan.record(mode="offline")
+        if fault_plan.any_fault():
+            z_mask_arr = fault_plan.avail_offline
+            z_nan_arr = fault_plan.z_nan if fault_plan.z_nan.any() else None
+        else:  # nothing drawn: keep every chunk on the fault-free fast path
+            fault_plan = None
 
     out_base = out_root  # per-RIR dset split resolved below
 
@@ -537,9 +643,16 @@ def enhance_rirs_batched(
         )
 
         def run_batch_with_masks(Yb, Sb, Nb, Mz, Mw):
+            zmb = znb = None
+            if z_mask_arr is not None:
+                B = Yb.shape[0]
+                zmb = jnp.broadcast_to(jnp.asarray(z_mask_arr), (B, n_nodes))
+                if z_nan_arr is not None:
+                    znb = jnp.broadcast_to(jnp.asarray(z_nan_arr), (B, n_nodes))
             return tango_batch_sharded(
                 Yb, Sb, Nb, Mz, Mw, mesh, mu=mu, policy=policy,
                 mask_type=mask_type, solver=solver, cov_impl=cov_impl,
+                z_mask_b=zmb, z_nan_b=znb,
             )
 
         def run_batch(Yb, Sb, Nb):
@@ -555,7 +668,8 @@ def enhance_rirs_batched(
             def one(Y, S, N):
                 m = oracle_masks(S, N, mask_type)
                 return tango(Y, S, N, m, m, mu=mu, policy=policy, mask_type=mask_type,
-                             solver=solver, cov_impl=cov_impl)
+                             solver=solver, cov_impl=cov_impl,
+                             z_mask=z_mask_arr, z_nan=z_nan_arr)
 
             return jax.vmap(one)(Yb, Sb, Nb)
 
@@ -563,7 +677,8 @@ def enhance_rirs_batched(
         def run_batch_with_masks(Yb, Sb, Nb, Mz, Mw):
             def one(Y, S, N, mz, mw):
                 return tango(Y, S, N, mz, mw, mu=mu, policy=policy, mask_type=mask_type,
-                             solver=solver, cov_impl=cov_impl)
+                             solver=solver, cov_impl=cov_impl,
+                             z_mask=z_mask_arr, z_nan=z_nan_arr)
 
             return jax.vmap(one)(Yb, Sb, Nb, Mz, Mw)
 
@@ -622,6 +737,7 @@ def enhance_rirs_batched(
                 for i in range(n_real):
                     rir, out, layout = chunk[i]
                     y, s, n, s_dry, n_dry, fs, rnd_snrs = sigs[i]
+                    _record_degraded(fault_plan, rir=rir)
                     res_i = jax.tree_util.tree_map(lambda x: x[i], res_b)
                     L = y.shape[-1]
                     score = partial(

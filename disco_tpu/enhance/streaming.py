@@ -41,6 +41,12 @@ from disco_tpu.beam.filters import rank1_gevd
 from disco_tpu.enhance.tango import others_index
 from disco_tpu.obs.accounting import counted_jit
 
+#: Default filter-refresh block length (frames).  Shared with the driver's
+#: fault wiring: a streaming availability mask is per-block, so the block
+#: count B = ceil(T / update_every) must agree between the fault plan and
+#: this module's reshape.
+DEFAULT_UPDATE_EVERY = 4
+
 
 def _outer(x):
     """(..., F, D) frame -> (..., F, D, D) outer product."""
@@ -181,7 +187,7 @@ def streaming_step1(
     Y,
     mask_z,
     lambda_cor: float = 0.99,
-    update_every: int = 4,
+    update_every: int = DEFAULT_UPDATE_EVERY,
     mu: float = 1.0,
     ref_mic: int = 0,
     S=None,
@@ -227,6 +233,67 @@ def streaming_step1(
     return out
 
 
+def hold_last_good(z, avail, update_every: int, fallback=None, carry=None,
+                   return_carry: bool = False):
+    """Last-good-z hold over refresh blocks: the degraded-mode delivery
+    policy for transient link loss (``disco_tpu.fault``).
+
+    The exchanged stream is processed in blocks of ``update_every`` frames
+    (the filter-refresh granularity of this module).  A block whose z was
+    not delivered (``avail[k, b] == 0``) is bridged with the most recent
+    delivered block's frames — the standard hold policy of adaptive
+    beamformers under packet loss.  Blocks lost before ANY delivery fall
+    back to the matching ``fallback`` block (the producer's ``zn = y_ref -
+    z`` noise estimate in the pipeline wiring) or, with ``fallback=None``,
+    keep their original frames (used for the diagnostic streams, which are
+    held only once a good block exists).
+
+    ``jnp.where`` selects throughout, so a lost block full of NaN can never
+    leak into the output.
+
+    Args:
+      z: (K, F, T) exchanged stream.
+      avail: (K, B) per-block availability, B = ceil(T / update_every).
+      fallback: optional (K, F, T) stream substituted for leading losses.
+      carry: optional ``(last_block, seen)`` continuation state from a
+        previous chunk's ``return_carry=True`` call — chunked runs then
+        bridge a loss at a chunk boundary with the PREVIOUS chunk's last
+        good block, exactly like the unchunked run.
+      return_carry: also return the end-of-stream ``(last_block, seen)``.
+
+    Returns:
+      (K, F, T) held stream — and the carry when ``return_carry``.
+    """
+    K, F, T = z.shape
+    u = update_every
+    pad = (-T) % u
+    B = (T + pad) // u
+    avail = jnp.asarray(avail)
+    if avail.ndim == 1:  # (K,) shorthand: constant over blocks
+        avail = avail[:, None]
+    avail = jnp.broadcast_to(avail, (K, B))
+
+    def blocks(a):  # (K, F, T) -> (B, K, F, u)
+        ap = jnp.pad(a, ((0, 0), (0, 0), (0, pad))) if pad else a
+        return jnp.moveaxis(ap.reshape(K, F, B, u), 2, 0)
+
+    zb = blocks(z)
+    fb = blocks(fallback) if fallback is not None else zb
+    ok = (avail > 0).T  # (B, K)
+
+    def step(carry, inp):
+        last, seen = carry  # (K, F, u) last emitted block, (K,) any-good flag
+        blk, fblk, a = inp
+        subst = jnp.where(seen[:, None, None], last, fblk)
+        out = jnp.where(a[:, None, None], blk, subst)
+        return (out, seen | a), out
+
+    init = (jnp.zeros_like(zb[0]), jnp.zeros(K, bool)) if carry is None else carry
+    carry_out, held = jax.lax.scan(step, init, (zb, fb, ok))
+    out = jnp.moveaxis(held, 0, 2).reshape(K, F, B * u)[..., :T]
+    return (out, carry_out) if return_carry else out
+
+
 def _stream_stats(Y, all_z, zn, mask_w, oth, policy):
     """Step-2 speech/noise statistic streams per node under the mask-for-z
     policy — the streaming mirror of the offline ``_z_stats``
@@ -270,7 +337,7 @@ def streaming_tango(
     masks_z,
     mask_w,
     lambda_cor: float = 0.99,
-    update_every: int = 4,
+    update_every: int = DEFAULT_UPDATE_EVERY,
     mu: float = 1.0,
     ref_mic: int = 0,
     S=None,
@@ -279,6 +346,7 @@ def streaming_tango(
     policy: str | None = "local",
     state=None,
     solver: str = "eigh",
+    z_avail=None,
 ):
     """Full two-step streaming TANGO over all nodes (mixture-only by
     default: the deployment path needs no oracle S/N).
@@ -299,6 +367,17 @@ def streaming_tango(
       state: optional continuation state (the previous chunk's returned
         ``state``) — chunk-by-chunk online deployment of BOTH steps; exact
         across refresh-block-aligned boundaries (tests/test_streaming.py).
+      z_avail: optional per-block availability of the exchanged streams —
+        (K, B) with B = ceil(T / update_every), or (K,) broadcast over
+        blocks.  Lost/stale blocks are bridged by :func:`hold_last_good`
+        (previous good block, falling back to the producer's ``zn``
+        estimate before the first delivery); the diagnostic streams are
+        held with the same availability.  The hold carries ride the
+        returned ``state`` (key ``"hold"``), so chunked continuation —
+        pass per-chunk masks — bridges a chunk-boundary loss with the
+        previous chunk's last good block, matching the unchunked run
+        across refresh-block-aligned boundaries.  None (default) is the
+        fault-free path, byte-identical to before.
 
     Returns:
       dict with yf (K, F, T) enhanced outputs, z_y/zn (K, F, T) streams,
@@ -318,6 +397,30 @@ def streaming_tango(
     n_in = N if with_diagnostics else Y
     s1 = step1(Y, masks_z, s_in, n_in, st1_in)
     all_z = s1["z_y"]  # (K, F, T)
+    zn = s1["zn"]
+    z_s, z_n = (s1["z_s"], s1["z_n"]) if with_diagnostics else (None, None)
+    hold_state = None
+    if z_avail is not None:
+        # Degraded-mode delivery: lost/stale blocks reuse the last good z
+        # (zn-estimate fallback before the first delivery); zn and the
+        # diagnostic streams are held with the same availability so every
+        # downstream statistic describes the stream the consumer actually
+        # used.  The per-stream hold carries ride the continuation state so
+        # a loss at a chunk boundary is bridged with the previous chunk's
+        # last good block, exactly like the unchunked run.
+        hin = (state or {}).get("hold", {}) or {}
+        all_z, h_zy = hold_last_good(all_z, z_avail, update_every, fallback=zn,
+                                     carry=hin.get("z_y"), return_carry=True)
+        zn, h_zn = hold_last_good(zn, z_avail, update_every,
+                                  carry=hin.get("zn"), return_carry=True)
+        hold_state = {"z_y": h_zy, "zn": h_zn}
+        if with_diagnostics:
+            z_s, h_zs = hold_last_good(z_s, z_avail, update_every,
+                                       carry=hin.get("z_s"), return_carry=True)
+            z_n, h_znn = hold_last_good(z_n, z_avail, update_every,
+                                        carry=hin.get("z_n"), return_carry=True)
+            hold_state["z_s"] = h_zs
+            hold_state["z_n"] = h_znn
 
     oth = jnp.asarray(others_index(K))  # (K, K-1)
 
@@ -328,11 +431,11 @@ def streaming_tango(
         return jnp.moveaxis(a, -1, 1).swapaxes(-1, -2)  # (K, D, F, T) -> (K, T, F, D)
 
     X = ktfd(stack_streams(Y, all_z))
-    XS, XN = _stream_stats(Y, all_z, s1["zn"], mask_w, oth, policy)
+    XS, XN = _stream_stats(Y, all_z, zn, mask_w, oth, policy)
     XS, XN = ktfd(XS), ktfd(XN)
     if with_diagnostics:
-        Xs = ktfd(stack_streams(S, s1["z_s"]))
-        Xn = ktfd(stack_streams(N, s1["z_n"]))
+        Xs = ktfd(stack_streams(S, z_s))
+        Xn = ktfd(stack_streams(N, z_n))
         stream2 = jax.vmap(
             lambda x, xs_st, xn_st, xs, xn, st: _stream_filter(
                 x, xs_st, xn_st, lambda_cor, update_every, mu, ref=ref_mic, extras=[xs, xn],
@@ -341,16 +444,19 @@ def streaming_tango(
             in_axes=(0, 0, 0, 0, 0, 0 if st2_in is not None else None),
         )
         yf, w2, Rss2, Rnn2, (sf, nf) = stream2(X, XS, XN, Xs, Xn, st2_in)
+        out_state = {"step1": (s1["Rss"], s1["Rnn"], s1["w"]),
+                     "step2": (Rss2, Rnn2, w2)}
+        if hold_state is not None:
+            out_state["hold"] = hold_state
         return {
             "yf": jnp.moveaxis(yf, 1, -1),
             "sf": jnp.moveaxis(sf, 1, -1),
             "nf": jnp.moveaxis(nf, 1, -1),
             "z_y": all_z,
-            "zn": s1["zn"],
-            "z_s": s1["z_s"],
-            "z_n": s1["z_n"],
-            "state": {"step1": (s1["Rss"], s1["Rnn"], s1["w"]),
-                      "step2": (Rss2, Rnn2, w2)},
+            "zn": zn,
+            "z_s": z_s,
+            "z_n": z_n,
+            "state": out_state,
         }
     stream2 = jax.vmap(
         lambda x, xs_st, xn_st, st: _stream_filter(
@@ -360,10 +466,13 @@ def streaming_tango(
         in_axes=(0, 0, 0, 0 if st2_in is not None else None),
     )
     yf, w2, Rss2, Rnn2 = stream2(X, XS, XN, st2_in)  # yf (K, T, F)
+    out_state = {"step1": (s1["Rss"], s1["Rnn"], s1["w"]),
+                 "step2": (Rss2, Rnn2, w2)}
+    if hold_state is not None:
+        out_state["hold"] = hold_state
     return {
         "yf": jnp.moveaxis(yf, 1, -1),
         "z_y": all_z,
-        "zn": s1["zn"],
-        "state": {"step1": (s1["Rss"], s1["Rnn"], s1["w"]),
-                  "step2": (Rss2, Rnn2, w2)},
+        "zn": zn,
+        "state": out_state,
     }

@@ -22,6 +22,23 @@ Capability parity with reference ``speech_enhancement/tango.py:252-457``
 Masks are *inputs* here (shape (K, F, T)): oracle masks come from
 :func:`oracle_masks`, CRNN masks from ``disco_tpu.nn`` — keeping this module
 independent of the mask source and fully jittable.
+
+Fault tolerance (no reference counterpart — the reference assumes every z
+arrives intact): ``tango``/``tango_step2`` accept an optional availability
+mask over the exchanged z channels (``z_mask``/``z_avail``).  Unavailable
+channels are excluded from the step-2 MWF by jittable channel masking —
+their stat and application channels are zeroed via a NaN-safe select and
+the noise covariance gets trace-relative diagonal loading on the excluded
+channels, which decouples them from the GEVD exactly (their generalized
+eigenvalue collapses to the clamp floor, so the rank-1 filter assigns them
+~zero gain and the surviving channels see precisely the K-1-subset
+problem; pinned against the subset float64 oracle in tests/test_fault.py).
+With every other node unavailable this degrades to local-only beamforming
+on the node's own mics.  A finiteness guard at the exchange seam
+additionally excludes any node whose z carries non-finite values
+(``z_nan`` injects exactly that fault for testing — see
+``disco_tpu.fault``).  With ``z_mask=None`` and ``z_nan=None`` (the
+defaults) every code path is byte-identical to the fault-free pipeline.
 """
 from __future__ import annotations
 
@@ -134,6 +151,44 @@ def tango_step1(
 
 
 # ------------------------------------------------------------------ step 2
+def _masked_select(z_oth, a_oth):
+    """Zero the unavailable z channels of a gathered (K-1, F, T) stack.
+
+    ``jnp.where`` (a select), NOT multiplication: a corrupted stream can
+    carry NaN/Inf, and ``0 * nan`` is ``nan`` — the select guarantees an
+    excluded channel contributes exact zeros no matter what it holds.
+    """
+    return jnp.where(a_oth[:, None, None] > 0, z_oth, jnp.zeros((), z_oth.dtype))
+
+
+def _regularize_excluded(Rnn, n_mics: int, a_oth):
+    """Trace-relative diagonal loading on the EXCLUDED z channels of a
+    (F, D, D) noise covariance (D = n_mics + K - 1).
+
+    A zeroed channel leaves a zero row/column in both covariances; loading
+    its Rnn diagonal entry (Rss stays zero) decouples it exactly: the
+    whitened matrix becomes block-diagonal with a zero block, the channel's
+    generalized eigenvalue hits the EIG_FLOOR clamp, and its Wiener gain is
+    ~0 — the surviving channels solve precisely the subset MWF.  Scaled by
+    the mean Rnn diagonal so the loading conditions the Cholesky at any
+    signal level (warm-up streaming covariances are ~1e-12).
+    """
+    D = Rnn.shape[-1]
+    reg = jnp.concatenate([jnp.zeros(n_mics), 1.0 - (a_oth > 0)]).astype(Rnn.real.dtype)
+    tr = jnp.trace(Rnn, axis1=-2, axis2=-1).real / D
+    load = jnp.maximum(tr, jnp.finfo(tr.dtype).tiny)[..., None] * reg
+    return Rnn + load[..., None] * jnp.eye(D, dtype=Rnn.dtype)
+
+
+def finite_z_guard(z_y):
+    """(K,) availability flags from finiteness of the exchanged streams: a
+    node whose compressed signal carries any non-finite value is treated as
+    unavailable (the z-exchange seam's corruption detector).  Jittable —
+    runs inside the step-2 program, so the sharded paths get it too."""
+    fin = jnp.isfinite(z_y.real) & jnp.isfinite(z_y.imag)
+    return fin.all(axis=(-2, -1)).astype(z_y.real.dtype)
+
+
 def _z_stats(policy: Policy, mask_w_k, all_z, all_masks_w, all_S_ref, all_N_ref, mask_type):
     """Speech/noise statistic versions of the exchanged z streams, per the
     mask-for-z policy matrix (tango.py:396-429).  Returns (K, F, T) stat
@@ -182,6 +237,7 @@ def tango_step2(
     frame_axis: str | None = None,
     solver: str = "power",
     cov_impl: str = "xla",
+    z_avail=None,
 ):
     """Step 2 at ONE node k: global rank-1 GEVD-MWF on ``[y_k ‖ z_{j≠k}]``
     (tango.py:380-455).
@@ -195,35 +251,47 @@ def tango_step2(
       all_masks_w: (K, F, T) gathered step-2 masks (for the 'distant' policy).
       all_S_ref / all_N_ref: (K, F, T) gathered ref-mic clean components
         (for the 'use_oracle_refs' policy).
+      z_avail: optional (K,) availability of the exchanged streams as seen
+        by THIS consumer (1 = arrived intact).  Unavailable channels are
+        excluded from the MWF (module docstring); None (default) is the
+        fault-free fast path, byte-identical to the original pipeline.
 
     Returns:
       (yf, sf, nf): (F, T) filtered mixture / speech / noise at node k.
     """
     K = all_z["z_y"].shape[0]
+    C = Y.shape[0]
     # Ascending j != k (dynamic k — shard_map passes a traced axis_index).
     oth = jnp.arange(K - 1) + (jnp.arange(K - 1) >= k)
+    if z_avail is None:
+        sel = lambda v: v[oth]
+    else:
+        a_oth = z_avail[oth]  # (K-1,) availability of this node's others
+        sel = lambda v: _masked_select(v[oth], a_oth)
 
     if policy == "local":
         # 'local' masks every stacked channel — own mics AND incoming z's —
         # with node k's own mask (tango.py:418-420), i.e. the whole stat
         # stack is one masked covariance of [Y ‖ z_{j≠k}]: the fused
         # single-read kernel applies to the full C+K-1 stack.
-        stacked = jnp.concatenate([Y, all_z["z_y"][oth]], axis=0)  # (C+K-1, F, T)
+        stacked = jnp.concatenate([Y, sel(all_z["z_y"])], axis=0)  # (C+K-1, F, T)
         Rss, Rnn = _masked_cov_pair(stacked, mask_w_k, cov_impl, frame_axis)
     else:
         zs_stat_all, zn_stat_all = _z_stats(
             policy, mask_w_k, all_z, all_masks_w, all_S_ref, all_N_ref, mask_type
         )
         m = mask_w_k[None]
-        stat_s = jnp.concatenate([m * Y, zs_stat_all[oth]], axis=0)  # (C+K-1, F, T)
-        stat_n = jnp.concatenate([(1.0 - m) * Y, zn_stat_all[oth]], axis=0)
+        stat_s = jnp.concatenate([m * Y, sel(zs_stat_all)], axis=0)  # (C+K-1, F, T)
+        stat_n = jnp.concatenate([(1.0 - m) * Y, sel(zn_stat_all)], axis=0)
         Rss = frame_mean_covariance(stat_s, axis_name=frame_axis)
         Rnn = frame_mean_covariance(stat_n, axis_name=frame_axis)
+    if z_avail is not None:
+        Rnn = _regularize_excluded(Rnn, C, a_oth)
     w, _ = rank1_gevd(Rss, Rnn, mu=mu, solver=solver)  # (F, C+K-1)
 
-    in_y = jnp.concatenate([Y, all_z["z_y"][oth]], axis=0)
-    in_s = jnp.concatenate([S, all_z["z_s"][oth]], axis=0)
-    in_n = jnp.concatenate([N, all_z["z_n"][oth]], axis=0)
+    in_y = jnp.concatenate([Y, sel(all_z["z_y"])], axis=0)
+    in_s = jnp.concatenate([S, sel(all_z["z_s"])], axis=0)
+    in_n = jnp.concatenate([N, sel(all_z["z_n"])], axis=0)
     yf = jnp.einsum("fc,cft->ft", jnp.conj(w), in_y)
     sf = jnp.einsum("fc,cft->ft", jnp.conj(w), in_s)
     nf = jnp.einsum("fc,cft->ft", jnp.conj(w), in_n)
@@ -245,6 +313,8 @@ def tango(
     oracle_step1_stats: bool = False,
     solver: str = "power",
     cov_impl: str = "xla",
+    z_mask=None,
+    z_nan=None,
 ) -> TangoResult:
     """The full two-step pipeline on one device: ``vmap`` over the node axis,
     z-exchange by plain indexing (the in-process ``concatenate_signals`` of
@@ -254,6 +324,16 @@ def tango(
     Args:
       Y, S, N: (K, C, F, T) complex STFT stacks.
       masks_z, mask_w: (K, F, T) step-1 / step-2 masks.
+      z_mask: optional availability of the exchanged z streams — (K,) per
+        source node, or (K, K) with row k = what consumer k received
+        (asymmetric link loss).  Unavailable streams are excluded from the
+        step-2 MWF (module docstring); at K-1 = 0 available streams a node
+        degrades to local-only beamforming on its own mics.
+      z_nan: optional (K,) flags — corrupt node k's exchanged streams to
+        NaN after step 1 (fault injection at the exchange seam,
+        ``disco_tpu.fault``).  Activating either fault input also arms the
+        finiteness guard: any node whose z carries non-finite values is
+        excluded, injected or not.
 
     Batched use: ``jax.vmap(tango, in_axes=(0, 0, 0, 0, 0))`` over a rooms
     axis — rooms, nodes, freq and frames are all array axes.
@@ -267,15 +347,40 @@ def tango(
     all_z = step1(Y, S, N, masks_z)
 
     K = Y.shape[0]
-    step2 = jax.vmap(
-        lambda y, s, n, mw, k: tango_step2(
-            y, s, n, mw, k, all_z, mask_w, S[:, ref_mic], N[:, ref_mic],
-            mu=mu, policy=policy, ref_mic=ref_mic, mask_type=mask_type,
-            solver=solver, cov_impl=cov_impl,
-        ),
-        in_axes=(0, 0, 0, 0, 0),
-    )
-    yf, sf, nf = step2(Y, S, N, mask_w, jnp.arange(K))
+    if z_nan is not None:
+        # Injection at the exchange seam: every stream the corrupted node
+        # would have sent turns NaN, exactly what a garbled packet looks
+        # like to the consumers (the guard below must catch it).
+        bad = (jnp.asarray(z_nan) > 0)[:, None, None]
+        nanc = jnp.full((), jnp.nan + 1j * jnp.nan, all_z["z_y"].dtype)
+        all_z = {key: jnp.where(bad, nanc, val) for key, val in all_z.items()}
+    if z_mask is None and z_nan is None:
+        step2 = jax.vmap(
+            lambda y, s, n, mw, k: tango_step2(
+                y, s, n, mw, k, all_z, mask_w, S[:, ref_mic], N[:, ref_mic],
+                mu=mu, policy=policy, ref_mic=ref_mic, mask_type=mask_type,
+                solver=solver, cov_impl=cov_impl,
+            ),
+            in_axes=(0, 0, 0, 0, 0),
+        )
+        yf, sf, nf = step2(Y, S, N, mask_w, jnp.arange(K))
+    else:
+        fin = finite_z_guard(all_z["z_y"])  # (K,) corruption detector
+        if z_mask is None:
+            avail = jnp.broadcast_to(fin[None, :], (K, K))
+        else:
+            zm = jnp.asarray(z_mask, Y.real.dtype)
+            zm = jnp.broadcast_to(zm, (K, K)) if zm.ndim == 1 else zm
+            avail = zm * fin[None, :]  # rows = consumer, cols = source
+        step2 = jax.vmap(
+            lambda y, s, n, mw, k, za: tango_step2(
+                y, s, n, mw, k, all_z, mask_w, S[:, ref_mic], N[:, ref_mic],
+                mu=mu, policy=policy, ref_mic=ref_mic, mask_type=mask_type,
+                solver=solver, cov_impl=cov_impl, z_avail=za,
+            ),
+            in_axes=(0, 0, 0, 0, 0, 0),
+        )
+        yf, sf, nf = step2(Y, S, N, mask_w, jnp.arange(K), avail)
     return TangoResult(
         yf=yf, sf=sf, nf=nf,
         z_y=all_z["z_y"], z_s=all_z["z_s"], z_n=all_z["z_n"], zn=all_z["zn"],

@@ -1,0 +1,26 @@
+"""disco_tpu.fault — declarative fault injection and degraded-mode support.
+
+The DANSE-style z-exchange is the pipeline's only network seam: node k's
+step-2 MWF consumes the K-1 compressed streams of every other node.  This
+package makes that seam fault-tolerant end-to-end:
+
+* :mod:`disco_tpu.fault.spec`   — :class:`FaultSpec`, the declarative,
+  seeded fault scenario (node dropout, per-block link loss, stale delivery,
+  NaN-corrupted z) loadable from YAML/JSON via ``--fault-spec``.
+* :mod:`disco_tpu.fault.inject` — :func:`plan_faults` expands a spec into a
+  concrete :class:`FaultPlan` (``(K, B)`` availability + NaN flags + the
+  ``fault`` telemetry events).
+* :mod:`disco_tpu.fault.check`  — the ``make fault-check`` CPU smoke: inject
+  a dropout and a NaN z, assert finite outputs and the expected obs events.
+
+Consumers: ``enhance/tango.py`` (``z_mask``/``z_nan`` channel masking with
+covariance regularization, degrading to local-only beamforming), ``enhance/
+streaming.py`` (``(K, B)`` availability + last-good-z hold),
+``disco_tpu.parallel`` (the mask rides the z-exchange all_gather),
+``enhance/driver.py`` / ``cli/tango.py`` (``fault_spec`` wiring), and
+``utils/resilience.py`` (bounded retry around the flaky-tunnel side).
+"""
+from disco_tpu.fault.inject import FaultPlan, plan_faults
+from disco_tpu.fault.spec import FaultSpec, load_fault_spec
+
+__all__ = ["FaultPlan", "FaultSpec", "load_fault_spec", "plan_faults"]

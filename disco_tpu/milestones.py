@@ -45,7 +45,21 @@ def _fence(x) -> float:
 
     Every call ticks the obs fence counter (disco_tpu.obs.accounting): on
     the tunnel each fence is a fixed ~80 ms RPC, so the count IS the
-    host-traffic cost model that `obs report` renders."""
+    host-traffic cost model that `obs report` renders.  The readback runs
+    under bounded retry (utils.resilience): a dropped RPC is retried
+    in-process instead of killing the run — each attempt is a real
+    round-trip, so each attempt ticks the counter."""
+    from disco_tpu.utils.resilience import TRANSPORT_ERRORS, call_with_retries
+
+    return call_with_retries(_fence_readback, x, retries=2, base_delay_s=0.25,
+                             max_delay_s=1.0, label="fence",
+                             retry_on=TRANSPORT_ERRORS)
+
+
+def _fence_readback(x) -> float:
+    """One un-retried fence attempt (the raw RPC).  ``utils.resilience.
+    resilient_fence`` wraps THIS with caller-chosen budgets, so its retries
+    do not stack on :func:`_fence`'s defaults."""
     from disco_tpu.obs import accounting
 
     accounting.fence_tick()

@@ -40,6 +40,9 @@ EVENT_KINDS = frozenset(
         "counters",     # metrics-registry snapshot (usually last event of a run)
         "watchdog",     # bench watchdog fired (no-progress diagnostic)
         "bench_result", # the full bench record, mirrored off stdout
+        "fault",        # an injected or detected fault (attrs: fault, node, ...)
+        "recovery",     # a retried operation succeeded (utils.resilience)
+        "degraded",     # the pipeline entered degraded mode (excluded streams)
         "note",         # freeform annotation
     }
 )

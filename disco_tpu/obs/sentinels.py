@@ -67,18 +67,20 @@ def check_finite(name: str, tree, stage: str | None = None) -> bool:
         return True
     import jax
 
-    from disco_tpu.utils.transfer import to_host
+    from disco_tpu.utils.resilience import resilient_to_host
 
     ok = True
     leaves = jax.tree_util.tree_leaves(tree)
     for i, leaf in enumerate(leaves):
         # Device arrays: to_host (complex dtypes cannot cross the Axon tunnel
-        # directly, CLAUDE.md), and the readback is fenced — count it: two
-        # round-trips for complex (to_host splits into real+imag transfers,
-        # utils/transfer.py), one for real.  Host arrays are free: checking
-        # them must not inflate the RPC estimate.
+        # directly, CLAUDE.md) under bounded retry — a watchdog readback
+        # dropped by the tunnel must not kill the run it observes.  The
+        # readback is fenced — count it: two round-trips for complex
+        # (to_host splits into real+imag transfers, utils/transfer.py), one
+        # for real.  Host arrays are free: checking them must not inflate
+        # the RPC estimate.
         if isinstance(leaf, jax.Array):
-            arr = np.asarray(to_host(leaf))
+            arr = np.asarray(resilient_to_host(leaf, label="sentinel_readback"))
             _accounting.fence_tick(2 if np.iscomplexobj(arr) else 1)
         else:
             arr = np.asarray(leaf)

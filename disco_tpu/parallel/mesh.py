@@ -34,7 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from disco_tpu.enhance.tango import TangoResult, tango_step1, tango_step2
+from disco_tpu.enhance.tango import TangoResult, finite_z_guard, tango_step1, tango_step2
 
 
 def shard_map_compat(f=None, *, mesh, in_specs, out_specs, check_vma: bool = True):
@@ -126,7 +126,7 @@ def ring_all_gather(x, axis_name: str):
 def _tango_on_mesh(
     Y, S, N, masks_z, mask_w, mesh, frame_axis, mu, policy, ref_mic, mask_type,
     oracle_step1_stats, z_exchange: str = "all_gather", solver: str = "power",
-    cov_impl: str = "xla",
+    cov_impl: str = "xla", z_mask=None,
 ) -> TangoResult:
     """Shared shard_map body for the node-sharded and node+frame-sharded
     pipelines — identical math, different partition specs.
@@ -134,6 +134,13 @@ def _tango_on_mesh(
     ``z_exchange``: 'all_gather' (one XLA collective) or 'ring' (explicit
     K-1 ppermute hops, see :func:`ring_all_gather`) — bit-identical results,
     different collective schedules.
+
+    ``z_mask``: optional (K,) per-source availability of the exchanged
+    streams.  Each node holds its own flags (sharded over 'node' like the
+    z streams themselves) and the mask rides the z-exchange: it is
+    all_gathered alongside z, combined with the finiteness guard on the
+    gathered streams, and consumed by every node's step 2 — so a node
+    whose z was corrupted in flight is excluded consistently everywhere.
     """
     K = Y.shape[0]
     assert K % mesh.shape["node"] == 0, (K, dict(mesh.shape))
@@ -143,6 +150,7 @@ def _tango_on_mesh(
 
     spec4 = P("node", None, None, frame_axis)
     spec3 = P("node", None, frame_axis)
+    spec1 = P("node")
 
     gather = (
         (lambda v: ring_all_gather(v, "node"))
@@ -150,10 +158,17 @@ def _tango_on_mesh(
         else (lambda v: jax.lax.all_gather(v, "node", axis=0, tiled=True))
     )
 
+    faulty = z_mask is not None
+    if faulty:
+        z_mask = jnp.asarray(z_mask, Y.real.dtype)
+        assert z_mask.shape == (K,), (
+            f"sharded tango takes a (K,) = ({K},) per-source z_mask; got {z_mask.shape}"
+        )
+
     @partial(
         shard_map_compat,
         mesh=mesh,
-        in_specs=(spec4, spec4, spec4, spec3, spec3),
+        in_specs=(spec4, spec4, spec4, spec3, spec3) + ((spec1,) if faulty else ()),
         out_specs=(spec3,) * 7,
         # pallas_call's vma handling inside shard_map is incomplete in this
         # jax version (its interpreter hits "dynamic_slice requires varying
@@ -161,7 +176,7 @@ def _tango_on_mesh(
         # workaround) — disable the check only for the fused-cov variant.
         check_vma=cov_impl != "pallas",
     )
-    def _run(Yk, Sk, Nk, mzk, mwk):
+    def _run(Yk, Sk, Nk, mzk, mwk, *rest):
         # Local shard shapes: (K_local, C, F, T_local).
         step1 = jax.vmap(
             lambda y, s, n, m: tango_step1(
@@ -178,6 +193,18 @@ def _tango_on_mesh(
         all_S_ref = gather(Sk[:, ref_mic])
         all_N_ref = gather(Nk[:, ref_mic])
 
+        avail = None
+        if faulty:
+            # The availability flags ride the same collective as the z
+            # streams, then the finiteness guard on the GATHERED streams is
+            # folded in — corruption is judged on what actually arrived.
+            avail = gather(rest[0]) * finite_z_guard(all_z["z_y"])  # (K,)
+            if frame_axis is not None:
+                # A frame shard only sees its local frames; a stream with
+                # non-finite values in SOME shard must be excluded in ALL
+                # of them or the per-shard filters diverge.
+                avail = jax.lax.pmin(avail, frame_axis)
+
         k = jax.lax.axis_index("node")
         n_local = Yk.shape[0]  # nodes per device (1 when K == n_devices)
         ks = k * n_local + jnp.arange(n_local)
@@ -186,13 +213,15 @@ def _tango_on_mesh(
                 y, s, n, mw, kk, all_z, all_masks_w, all_S_ref, all_N_ref,
                 mu=mu, policy=policy, ref_mic=ref_mic, mask_type=mask_type,
                 frame_axis=frame_axis, solver=solver, cov_impl=cov_impl,
+                z_avail=avail,
             ),
             in_axes=(0, 0, 0, 0, 0),
         )
         yf, sf, nf = step2(Yk, Sk, Nk, mwk, ks)
         return yf, sf, nf, local_z["z_y"], local_z["z_s"], local_z["z_n"], local_z["zn"]
 
-    yf, sf, nf, z_y, z_s, z_n, zn = _run(Y, S, N, masks_z, mask_w)
+    args = (Y, S, N, masks_z, mask_w) + ((z_mask,) if faulty else ())
+    yf, sf, nf, z_y, z_s, z_n, zn = _run(*args)
     return TangoResult(
         yf=yf, sf=sf, nf=nf, z_y=z_y, z_s=z_s, z_n=z_n, zn=zn,
         masks_z=masks_z, mask_w=mask_w,
@@ -218,12 +247,18 @@ def tango_sharded(
     z_exchange: str = "all_gather",
     solver: str = "power",
     cov_impl: str = "xla",
+    z_mask=None,
 ) -> TangoResult:
     """Two-step TANGO with the node axis sharded over ``mesh``'s 'node' axis.
 
     Args:
       Y, S, N: (K, C, F, T) STFT stacks, K divisible by the 'node' size.
       masks_z, mask_w: (K, F, T) step-1/step-2 masks.
+      z_mask: optional (K,) per-source availability of the exchanged z
+        streams; it rides the z-exchange all_gather and arms the
+        finiteness guard (see ``_tango_on_mesh``).  Matches the
+        single-device ``tango(z_mask=...)`` results exactly
+        (tests/test_fault.py).
 
     Step 1 is embarrassingly node-parallel; the only cross-device collective
     is the all_gather of the compressed streams (+ masks / oracle refs needed
@@ -231,7 +266,7 @@ def tango_sharded(
     """
     return _tango_on_mesh(
         Y, S, N, masks_z, mask_w, mesh, None, mu, policy, ref_mic, mask_type,
-        oracle_step1_stats, z_exchange, solver, cov_impl,
+        oracle_step1_stats, z_exchange, solver, cov_impl, z_mask,
     )
 
 
@@ -252,6 +287,7 @@ def tango_frame_sharded(
     mask_type: str = "irm1",
     oracle_step1_stats: bool = False,
     solver: str = "power",
+    z_mask=None,
 ) -> TangoResult:
     """Two-step TANGO sharded over BOTH the node axis and the STFT frame
     axis — the framework's sequence-parallel mode (SURVEY.md §5.7).
@@ -260,13 +296,17 @@ def tango_frame_sharded(
       Y, S, N: (K, C, F, T) STFT stacks; K divisible by mesh 'node' size,
         T divisible by mesh 'frame' size.
       masks_z, mask_w: (K, F, T).
+      z_mask: optional (K,) per-source z availability (see
+        :func:`tango_sharded`); the finiteness guard's verdict is
+        pmin-combined across frame shards so a partially-corrupted stream
+        is excluded consistently on every shard.
 
     Contract (tests/test_parallel.py): bit-compatible with the single-device
     ``disco_tpu.enhance.tango`` for every policy.
     """
     return _tango_on_mesh(
         Y, S, N, masks_z, mask_w, mesh, "frame", mu, policy, ref_mic, mask_type,
-        oracle_step1_stats, solver=solver,
+        oracle_step1_stats, solver=solver, z_mask=z_mask,
     )
 
 
@@ -287,6 +327,8 @@ def tango_batch_sharded(
     mask_type: str = "irm1",
     solver: str = "power",
     cov_impl: str = "xla",
+    z_mask_b=None,
+    z_nan_b=None,
 ) -> TangoResult:
     """Corpus-scale TANGO on a (batch, node) mesh via GSPMD auto-partitioning:
     clips shard over 'batch' (the reference's ``--rirs`` data parallelism as a
@@ -305,18 +347,34 @@ def tango_batch_sharded(
       Yb, Sb, Nb: (B, K, C, F, T) STFT stacks; B divisible by the 'batch'
         mesh size, K by 'node'.
       masks_z_b, mask_w_b: (B, K, F, T).
+      z_mask_b: optional per-clip (B, K) or (B, K, K) z availability
+        (``tango``'s ``z_mask`` with a leading batch axis).
+      z_nan_b: optional (B, K) per-clip NaN-corruption flags
+        (``tango``'s ``z_nan``).
     """
     from disco_tpu.enhance.tango import tango
 
     sh = NamedSharding(mesh, P("batch", "node"))  # trailing dims replicated
     constrain = lambda t: jax.lax.with_sharding_constraint(t, sh)
     Yb, Sb, Nb, masks_z_b, mask_w_b = map(constrain, (Yb, Sb, Nb, masks_z_b, mask_w_b))
+    if z_mask_b is None and z_nan_b is None:
+        res = jax.vmap(
+            lambda Y, S, N, mz, mw: tango(
+                Y, S, N, mz, mw, mu=mu, policy=policy, ref_mic=ref_mic,
+                mask_type=mask_type, solver=solver, cov_impl=cov_impl,
+            )
+        )(Yb, Sb, Nb, masks_z_b, mask_w_b)
+        return jax.tree_util.tree_map(constrain, res)
+    B, K = Yb.shape[:2]
+    zmb = jnp.ones((B, K), Yb.real.dtype) if z_mask_b is None else jnp.asarray(z_mask_b)
+    znb = jnp.zeros((B, K), bool) if z_nan_b is None else jnp.asarray(z_nan_b)
     res = jax.vmap(
-        lambda Y, S, N, mz, mw: tango(
+        lambda Y, S, N, mz, mw, zm, zn: tango(
             Y, S, N, mz, mw, mu=mu, policy=policy, ref_mic=ref_mic,
             mask_type=mask_type, solver=solver, cov_impl=cov_impl,
+            z_mask=zm, z_nan=zn,
         )
-    )(Yb, Sb, Nb, masks_z_b, mask_w_b)
+    )(Yb, Sb, Nb, masks_z_b, mask_w_b, zmb, znb)
     return jax.tree_util.tree_map(constrain, res)
 
 

@@ -1,7 +1,37 @@
-from disco_tpu.utils.transfer import prefetch_to_device, to_device, to_host
+from disco_tpu.utils.transfer import (
+    TunnelTransferError,
+    guard_tunnel_complex,
+    prefetch_to_device,
+    to_device,
+    to_host,
+)
+from disco_tpu.utils.resilience import (
+    TRANSPORT_ERRORS,
+    DeadlineExceeded,
+    call_with_retries,
+    resilient_fence,
+    resilient_to_device,
+    resilient_to_host,
+    retrying,
+)
 # StageTimer/trace_to live in disco_tpu.obs.metrics since the obs subsystem
 # landed; re-exported here (and via the deprecated utils.profiling shim) so
 # existing `from disco_tpu.utils import StageTimer` call sites keep working.
 from disco_tpu.obs.metrics import StageTimer, trace_to
 
-__all__ = ["to_host", "to_device", "prefetch_to_device", "StageTimer", "trace_to"]
+__all__ = [
+    "DeadlineExceeded",
+    "StageTimer",
+    "TRANSPORT_ERRORS",
+    "TunnelTransferError",
+    "call_with_retries",
+    "guard_tunnel_complex",
+    "prefetch_to_device",
+    "resilient_fence",
+    "resilient_to_device",
+    "resilient_to_host",
+    "retrying",
+    "to_device",
+    "to_host",
+    "trace_to",
+]

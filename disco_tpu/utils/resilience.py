@@ -1,0 +1,191 @@
+"""Bounded retry/backoff around the flaky-tunnel seams.
+
+The tunneled single-chip attachment this framework is developed against
+(CLAUDE.md environment contract) fails in ways a directly-attached chip
+never does: the claim RPC can time out while another process holds the
+chip, fenced readbacks occasionally drop, and host<->device transfers can
+fail transiently.  The contract's hard rule is that a TPU process must
+NEVER be SIGKILLed (a killed holder wedges the remote claim for hours) —
+so recovery is always *in-process*: retry the failed call with bounded
+exponential backoff under an overall deadline, and if the budget runs out,
+raise and let the caller unwind cleanly.
+
+Every retry and recovery is first-class telemetry: each failed attempt
+records a ``fault`` event (kind ``transient_error``) and ticks the
+``retries`` counter; a success after >= 1 failure records a ``recovery``
+event and ticks ``retry_recoveries``; exhausting the budget ticks
+``retry_giveups`` — all through ``disco_tpu.obs`` (strict no-op while
+recording is disabled), rendered by ``cli/obs.py report``.
+
+The concrete seams wrapped here are the fenced dispatch
+(:func:`resilient_fence` around ``disco_tpu.milestones._fence``) and the
+complex-safe transfers (:func:`resilient_to_host` /
+:func:`resilient_to_device` around ``disco_tpu.utils.transfer``).
+"""
+from __future__ import annotations
+
+import functools
+import time
+
+from disco_tpu.obs import events as _events
+from disco_tpu.obs.metrics import REGISTRY as _REGISTRY
+
+_RETRIES = _REGISTRY.counter("retries")
+_RECOVERIES = _REGISTRY.counter("retry_recoveries")
+_GIVEUPS = _REGISTRY.counter("retry_giveups")
+
+
+def _transport_errors() -> tuple:
+    """Error types a tunnel transport failure can surface as — the
+    ``retry_on`` set for the ALWAYS-ON seams (fence, driver/sentinel
+    readbacks).  Deliberately excludes TypeError/ValueError and friends: a
+    deterministic programming error must raise immediately, not burn the
+    backoff budget and pollute the fault log with fake transients."""
+    errs: list[type] = [ConnectionError, TimeoutError, OSError]
+    try:
+        from jax.errors import JaxRuntimeError
+
+        errs.append(JaxRuntimeError)
+    except Exception:
+        try:  # older jax spells it at the jaxlib layer
+            from jaxlib.xla_extension import XlaRuntimeError
+
+            errs.append(XlaRuntimeError)
+        except Exception:
+            errs.append(RuntimeError)  # last resort: the XLA errors' base
+    return tuple(errs)
+
+
+#: Transport-layer exception types (see :func:`_transport_errors`).
+TRANSPORT_ERRORS: tuple = _transport_errors()
+
+
+class DeadlineExceeded(TimeoutError):
+    """The retry budget's wall-clock deadline ran out before a success."""
+
+
+def call_with_retries(
+    fn,
+    *args,
+    retries: int = 3,
+    base_delay_s: float = 0.1,
+    backoff: float = 2.0,
+    max_delay_s: float = 2.0,
+    deadline_s: float | None = None,
+    retry_on: type | tuple = Exception,
+    label: str | None = None,
+    sleep=time.sleep,
+    **kwargs,
+):
+    """Call ``fn(*args, **kwargs)``, retrying transient failures.
+
+    Args:
+      retries: maximum number of RE-tries (so at most ``retries + 1``
+        calls).
+      base_delay_s / backoff / max_delay_s: deterministic exponential
+        backoff ``min(base * backoff**i, max)`` between attempts — no
+        jitter, so a seeded run's retry schedule is reproducible.
+      deadline_s: overall wall budget from the first call; if the next
+        backoff sleep would cross it, :class:`DeadlineExceeded` is raised
+        (chained to the last error) instead of sleeping.
+      retry_on: exception type(s) considered transient.  ``KeyboardInterrupt``
+        and ``SystemExit`` are never caught (they do not inherit from
+        ``Exception``) — an operator abort must unwind immediately, never
+        hard-kill (environment contract: no SIGKILL on a TPU process).
+      label: telemetry name for the wrapped operation (events/``obs
+        report``); defaults to the function's ``__name__``.
+      sleep: injection point for tests.
+
+    Returns ``fn``'s value; raises the last error once the budget is spent.
+    """
+    if retries < 0:
+        raise ValueError(f"retries must be >= 0, got {retries}")
+    name = label or getattr(fn, "__name__", "call")
+    t0 = time.monotonic()
+    attempt = 0
+    while True:
+        try:
+            out = fn(*args, **kwargs)
+        except retry_on as e:
+            attempt += 1
+            _RETRIES.inc()
+            _events.record(
+                "fault", stage=name, fault="transient_error",
+                attempt=attempt, error=repr(e),
+            )
+            if attempt > retries:
+                _GIVEUPS.inc()
+                raise
+            delay = min(base_delay_s * backoff ** (attempt - 1), max_delay_s)
+            if deadline_s is not None and (time.monotonic() - t0) + delay > deadline_s:
+                _GIVEUPS.inc()
+                raise DeadlineExceeded(
+                    f"{name}: retry deadline of {deadline_s}s exhausted after "
+                    f"{attempt} failed attempt(s); last error: {e!r}"
+                ) from e
+            sleep(delay)
+        else:
+            if attempt:
+                _RECOVERIES.inc()
+                _events.record("recovery", stage=name, attempts=attempt + 1)
+            return out
+
+
+def retrying(**retry_opts):
+    """Decorator form of :func:`call_with_retries`::
+
+        @retrying(retries=5, deadline_s=30.0, label="fetch_chunk")
+        def fetch_chunk(i): ...
+
+    The wrapped function's kwargs are passed through a closure, NOT merged
+    into :func:`call_with_retries`'s namespace — so a decorated function may
+    freely take kwargs named ``retries``/``label``/``sleep``/... without
+    colliding with the retry options fixed at decoration time.
+    """
+
+    def deco(fn):
+        opts = dict(retry_opts)
+        opts.setdefault("label", getattr(fn, "__name__", "call"))
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return call_with_retries(lambda: fn(*args, **kwargs), **opts)
+
+        return wrapper
+
+    return deco
+
+
+def resilient_fence(x, **retry_opts) -> float:
+    """The 1-element host readback that is the only reliable execution
+    fence on the tunnel, under caller-chosen retry budgets.  Wraps the raw
+    un-retried attempt (``milestones._fence_readback``) — NOT ``_fence``,
+    whose own default retry budget would otherwise stack multiplicatively.
+    Each attempt ticks the fence counter via the wrapped call itself, so
+    the RPC cost model stays honest about retried round-trips."""
+    from disco_tpu.milestones import _fence_readback
+
+    retry_opts.setdefault("label", "fence")
+    retry_opts.setdefault("retry_on", TRANSPORT_ERRORS)
+    return call_with_retries(_fence_readback, x, **retry_opts)
+
+
+def resilient_to_host(x, **retry_opts):
+    """Complex-safe device->host transfer (``utils.transfer.to_host``) under
+    bounded retry of transport-layer failures (:data:`TRANSPORT_ERRORS` —
+    a dtype/shape bug raises straight through)."""
+    from disco_tpu.utils.transfer import to_host
+
+    retry_opts.setdefault("label", "to_host")
+    retry_opts.setdefault("retry_on", TRANSPORT_ERRORS)
+    return call_with_retries(to_host, x, **retry_opts)
+
+
+def resilient_to_device(x, **retry_opts):
+    """Complex-safe host->device transfer (``utils.transfer.to_device``)
+    under bounded retry of transport-layer failures (:data:`TRANSPORT_ERRORS`)."""
+    from disco_tpu.utils.transfer import to_device
+
+    retry_opts.setdefault("label", "to_device")
+    retry_opts.setdefault("retry_on", TRANSPORT_ERRORS)
+    return call_with_retries(to_device, x, **retry_opts)

@@ -15,6 +15,44 @@ import jax.numpy as jnp
 import numpy as np
 
 
+class TunnelTransferError(TypeError):
+    """A complex array was about to cross a tunnel attachment raw."""
+
+
+def _tunneled_attachment() -> bool:
+    """True when the default backend is a tunneled plugin attachment (a
+    platform name outside the standard set — e.g. the 'axon' single-chip
+    tunnel), whose host<->device path cannot move complex dtypes."""
+    try:
+        platform = jax.default_backend()
+    except Exception:
+        return False
+    return platform not in ("cpu", "gpu", "cuda", "rocm", "tpu", "metal")
+
+
+def guard_tunnel_complex(x, where: str = "transfer") -> None:
+    """Raise :class:`TunnelTransferError` if ``x`` is complex and the
+    active attachment cannot transfer complex dtypes.
+
+    The environment contract (CLAUDE.md): complex dtypes cannot cross the
+    tunnel — a raw ``np.asarray(device_array)`` / ``jnp.asarray(host_array)``
+    on complex data wedges or corrupts the transfer.  Call this at any seam
+    that moves raw arrays across the boundary; the sanctioned workaround is
+    :func:`to_host` / :func:`to_device` below, which split complex arrays
+    into two real transfers.
+    """
+    if _tunneled_attachment() and (
+        np.iscomplexobj(x) or (isinstance(x, jax.Array) and jnp.iscomplexobj(x))
+    ):
+        raise TunnelTransferError(
+            f"{where}: complex dtype {np.asarray(x).dtype if not isinstance(x, jax.Array) else x.dtype} "
+            "cannot cross the tunneled TPU attachment (environment contract: "
+            "complex dtypes cannot cross the tunnel). Use "
+            "disco_tpu.utils.transfer.to_host / to_device, which split complex "
+            "arrays into two real transfers."
+        )
+
+
 def to_host(x) -> np.ndarray:
     """Device array -> numpy, complex-safe (two real transfers if needed)."""
     if not isinstance(x, jax.Array):
@@ -32,6 +70,12 @@ def _combine(re, im):
 
 def to_device(x) -> jax.Array:
     """Numpy -> device array, complex-safe (combined on device)."""
+    if isinstance(x, jax.Array):
+        # Already device-resident: return as-is.  ``np.asarray`` here would
+        # round-trip the array through the host — for a complex array that
+        # is exactly the raw tunnel transfer the environment contract
+        # forbids (see :func:`guard_tunnel_complex`).
+        return x
     x = np.asarray(x)
     if np.iscomplexobj(x):
         re = np.ascontiguousarray(x.real, dtype=np.float32)

@@ -93,6 +93,37 @@ def test_tango_cli(generated, tmp_path):
     assert (tmp_path / "results" / "OIM" / "results_tango_1_ssn.p").exists()
 
 
+def test_tango_cli_fault_spec(generated, tmp_path):
+    """--fault-spec injects the scenario end-to-end: degraded-mode output is
+    still produced and finite, and the obs log carries the fault/degraded
+    events (+ --fault-seed overrides the file's seed; bare --fault-seed is
+    rejected)."""
+    import pytest
+
+    from disco_tpu import obs
+
+    spec = tmp_path / "faults.yaml"
+    spec.write_text("node_dropout: [1]\nnan_z: [2]\nseed: 4\n")
+    log = tmp_path / "fault_run.jsonl"
+    results = tango.main([
+        "--rir", "1", "--scenario", "random", "--noise", "ssn",
+        "--dataset", str(generated), "--sav_dir", "tf",
+        "--out_root", str(tmp_path / "results_fault"),
+        "--fault-spec", str(spec), "--fault-seed", "7",
+        "--obs-log", str(log),
+    ])
+    assert results is not None and np.isfinite(results["sdr_cnv"]).all()
+    events = obs.read_events(log)
+    faults = sorted(e["attrs"]["fault"] for e in events if e["kind"] == "fault")
+    assert faults == ["nan_z", "node_dropout"]
+    assert any(e["kind"] == "degraded" for e in events)
+    with pytest.raises(SystemExit, match="--fault-seed needs --fault-spec"):
+        tango.main([
+            "--rir", "1", "--scenario", "random", "--noise", "ssn",
+            "--dataset", str(generated), "--fault-seed", "7",
+        ])
+
+
 def test_lists_cli(generated, tmp_path):
     out = lists.main([
         "--scene", "random", "--noise", "ssn", "--n_files", "2",
@@ -127,6 +158,7 @@ def test_train_cli_single_channel(generated, tmp_path):
     assert any((tmp_path / "models").iterdir())
 
 
+@pytest.mark.slow
 def test_full_workflow_with_trained_models(generated, tmp_path):
     """The complete reference workflow through the CLIs: z export → train a
     multichannel CRNN on the z-augmented corpus → tango with the trained

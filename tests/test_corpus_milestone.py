@@ -1,6 +1,7 @@
 """The self-generated-corpus milestone (gen → mix → z → train → tango with
 oracle AND trained CRNN masks) runs end-to-end at tiny scale — the config-3/4
 numbers produced from real pipeline data (VERDICT round-1 item 5)."""
+import pytest
 import numpy as np
 
 from disco_tpu.milestones_corpus import corpus_milestone, meetit_corpus_milestone
@@ -17,6 +18,7 @@ def test_meetit_corpus_milestone_tiny(tmp_path):
     assert out["delta_si_sdr_mean"] > 1.0, out
 
 
+@pytest.mark.slow
 def test_corpus_milestone_tiny(tmp_path):
     out = corpus_milestone(tmp_path, n_rirs=2, n_epochs=1, max_order=4)
     assert out["config"] == "corpus_pipeline"

@@ -151,6 +151,7 @@ def test_crnn_masks_batched_matches_per_node_loop():
         np.testing.assert_allclose(batched[k], single, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_enhance_rirs_batched_crnn_matches_per_rir(processed_corpus, tmp_path):
     """The corpus driver's models path (VERDICT round-1 item 3): batched
     CRNN-mask enhancement reproduces the per-RIR CRNN path's metrics."""
@@ -266,6 +267,7 @@ def test_enhance_rirs_batched_ragged_lengths(tmp_path):
         assert np.all(results[rir]["sdr_cnv"] > results[rir]["sdr_in_cnv"])
 
 
+@pytest.mark.slow
 def test_enhance_rirs_batched_score_workers_identical(tmp_path):
     """Threaded scoring (score_workers>1) produces bit-identical metrics to
     inline scoring — the overlap changes scheduling, never math.  Three RIRs

@@ -31,7 +31,7 @@ def _check_eigpairs(A, lam, V, rtol=2e-4):
     assert (orth < 5e-4).all(), orth.max()
 
 
-@pytest.mark.parametrize("C", [2, 4, 11])
+@pytest.mark.parametrize("C", [2, 4, pytest.param(11, marks=pytest.mark.slow)])
 def test_jacobi_matches_lapack_complex(rng, C):
     # C=4 is the step-1 size, C=11 the 8-node step-2 size (mics + K-1)
     A = _random_hermitian(rng, 64, C)
@@ -85,17 +85,24 @@ def test_jacobi_batched_leading_axes(rng):
                     np.asarray(V).reshape(6, 4, 4))
 
 
-@pytest.mark.parametrize("B", [5, 300])
+@pytest.mark.parametrize("B", [5, pytest.param(300, marks=pytest.mark.slow)])
 def test_pallas_interpret_matches_xla(rng, B):
     """The pallas kernel (interpreter) is the same computation as the XLA
-    formulation, including the padded-tile path (B not a tile multiple)."""
+    formulation, including the padded-tile path (B not a tile multiple).
+
+    rtol, not pure atol: the interpreter and the XLA compile of the same
+    Jacobi schedule differ in FMA/reassociation on this jax version, so
+    eigenvalues of magnitude ~30 legitimately differ by ~1e-6 RELATIVE
+    (observed max 1.2e-6) while an absolute 1e-5 window is only meaningful
+    near zero."""
     A = _random_hermitian(rng, B, 6)
     lam_x, V_x = eigh_jacobi(A)
     lam_p, V_p = eigh_jacobi_pallas(A, tile=128, interpret=True)
-    np.testing.assert_allclose(np.asarray(lam_p), np.asarray(lam_x), atol=1e-5)
-    np.testing.assert_allclose(np.asarray(V_p), np.asarray(V_x), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(lam_p), np.asarray(lam_x), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(V_p), np.asarray(V_x), rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_gevd_mwf_jacobi_impl(rng):
     """gevd_mwf(eigh_impl='jacobi') reproduces the XLA-eigh filter."""
     import jax.numpy as jnp
@@ -167,6 +174,7 @@ def test_tango_jacobi_solver_end_to_end(rng):
         assert abs(sdr_e - sdr_j) < 0.1, (k, sdr_e, sdr_j)
 
 
+@pytest.mark.slow  # ~3 min on the 2-vCPU CI host (statically unrolled sweeps)
 def test_default_sweeps_adaptive_precision():
     """The size-adaptive default (None) must match np.linalg.eigh at the
     pipeline's matrix sizes — including the step-1 C=4 case where it halves
@@ -183,6 +191,7 @@ def test_default_sweeps_adaptive_precision():
         _check_eigpairs(A, np.asarray(lam), np.asarray(V), rtol=5e-4)
 
 
+@pytest.mark.slow
 def test_jacobi_sweep_spec_through_rank1_gevd():
     """'jacobi:N' solver specs reach the eigensolver: an insufficient sweep
     count visibly degrades the filter while 'jacobi:8' matches eigh."""

@@ -12,6 +12,7 @@ def test_entry_compiles():
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs the 8-device test mesh")
+@pytest.mark.slow
 def test_dryrun_multichip():
     from __graft_entry__ import dryrun_multichip
 

@@ -122,11 +122,26 @@ def test_emitted_log_conforms_to_schema(tmp_path):
         obs.record("epoch", stage="train", epoch=0, train_loss=0.5, val_loss=0.6)
         obs.record("watchdog", stage="bench", timeout_s=1.0)
         obs.record("bench_result", stage="bench", value=1.0)
+        # the fault-tolerance producers (disco_tpu.fault / utils.resilience)
+        from disco_tpu.fault import FaultSpec, plan_faults
+        from disco_tpu.utils.resilience import call_with_retries
+
+        plan_faults(FaultSpec(node_dropout=(0,)), n_nodes=2).record(mode="offline")
+        state = {"n": 0}
+
+        def flaky():
+            state["n"] += 1
+            if state["n"] == 1:
+                raise OSError("transient")
+            return 1
+
+        call_with_retries(flaky, retries=1, base_delay_s=0.0, sleep=lambda _: None)
+        obs.record("degraded", stage="mwf", mode="offline", nodes=[0])
         obs.record("counters", **obs.REGISTRY.snapshot())
     events = obs.read_events(log, validate=True)  # raises on any drift
     assert {e["kind"] for e in events} == {
         "manifest", "stage_end", "jit_trace", "sentinel", "clip", "epoch",
-        "watchdog", "bench_result", "counters",
+        "watchdog", "bench_result", "fault", "recovery", "degraded", "counters",
     }
 
 

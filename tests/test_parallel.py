@@ -140,6 +140,7 @@ def test_frame_sharded_matches_single_device():
         assert err < 1e-4, (key, err)
 
 
+@pytest.mark.slow
 def test_frame_sharded_all_policies():
     from disco_tpu.parallel import make_mesh_2d, tango_frame_sharded
 
@@ -214,6 +215,7 @@ def test_ring_all_gather_order():
     np.testing.assert_array_equal(np.asarray(ring), np.asarray(ref))
 
 
+@pytest.mark.slow
 def test_sharded_cov_impl_pallas_matches_vmap(scene8):
     """cov_impl='pallas' (fused masked-covariance kernel) under shard_map
     equals the single-device vmap path — the kernel composes with the

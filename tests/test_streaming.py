@@ -194,6 +194,7 @@ def test_streaming_tango_chunked_continuation(scene):
     np.testing.assert_allclose(chained, np.asarray(full["yf"]), atol=1e-4)
 
 
+@pytest.mark.slow
 def test_streaming_jacobi_solver_matches_eigh(scene):
     """Jacobi is a FULL eigendecomposition, so unlike power iteration it has
     no weak-eigengap handicap on the smoothed warm-up covariances: streaming

@@ -145,6 +145,7 @@ def test_trace_to_failure_is_noop_that_still_yields(monkeypatch, capsys):
     assert "trace unavailable" in capsys.readouterr().out
 
 
+@pytest.mark.slow
 def test_trace_to_noop_on_failure(tmp_path):
     # nested trace (or unavailable backend) must not raise
     with trace_to(str(tmp_path / "t1")):
