@@ -3,9 +3,9 @@
 
 PYTHON ?= python
 
-.PHONY: test coverage doc install native clean bench milestone-corpus dryrun obs-check fault-check
+.PHONY: test coverage doc install native clean bench milestone-corpus dryrun obs-check fault-check chaos-check
 
-test: obs-check fault-check
+test: obs-check fault-check chaos-check
 	$(PYTHON) -m pytest tests/ -q
 
 # Telemetry gates (run before the suite so drift fails fast):
@@ -25,6 +25,14 @@ obs-check:
 # otherwise claim the tunneled chip (environment contract).
 fault-check:
 	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= $(PYTHON) -m disco_tpu.fault.check
+
+# Crash-safety gate: interrupt a miniature corpus run at injected crash
+# seams (mid-write / between-clips), resume it, and assert the artifact
+# tree is byte-identical to an uninterrupted run with corrupt partials
+# requeued (disco_tpu/runs/check.py).  Zero SIGKILLs by construction —
+# crashes are simulated in-process (environment contract).
+chaos-check:
+	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= $(PYTHON) -m disco_tpu.runs.check
 
 coverage:
 	$(PYTHON) -m coverage run --branch --source=disco_tpu -m pytest tests/ -q

@@ -27,12 +27,24 @@ def build_parser():
     p.add_argument("--duration", nargs=2, type=float, default=[5, 10],
                    help="min/max clip duration in seconds (convolve_signals.py:404)")
     p.add_argument("--seed", type=int, default=30, help="global seed (convolve_signals.py:330)")
+    p.add_argument("--ledger", default=None,
+                   help="run-ledger JSONL path (disco_tpu.runs.ledger): record "
+                        "per-scene state + artifact digests for verified "
+                        "resume.  Default when --resume is set: "
+                        "<dir_out>/log/ledger_<scenario>_<dset>.jsonl")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the ledger: done scenes are VERIFIED "
+                        "against their artifact digests; corrupt/missing ones "
+                        "are regenerated (the infos probe alone already guards "
+                        "truncation; the ledger adds digest-level checks)")
     return p
 
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
     rir_start, n_rirs = args.rirs
+    if args.ledger is None and args.resume:
+        args.ledger = f"{args.dir_out}/log/ledger_{args.scenario}_{args.dset}.jsonl"
     rng = np.random.default_rng(args.seed)
     targets, talkers, noises = get_wavs_list(
         args.librispeech, args.freesound, dset=args.dset, cache_dir=f"{args.dir_out}/log/lists"
@@ -51,10 +63,17 @@ def main(argv=None):
         rng=rng,
     )
     layout = DatasetLayout(args.dir_out, args.scenario, args.dset)
-    done = generate_disco_rirs(
-        args.scenario, args.dset, rir_start, n_rirs, signal_setup, layout,
-        rng=rng, max_order=args.max_order,
-    )
+    from disco_tpu.runs import GracefulInterrupt
+
+    with GracefulInterrupt() as stopped:
+        done = generate_disco_rirs(
+            args.scenario, args.dset, rir_start, n_rirs, signal_setup, layout,
+            rng=rng, max_order=args.max_order,
+            ledger=args.ledger, resume=args.resume,
+        )
+    if stopped():
+        print("interrupted — generation is resumable: rerun the same command "
+              "(idempotent; add --resume for digest-verified skips)")
     print(f"generated {len(done)} RIRs: {done}")
     return done
 

@@ -89,6 +89,9 @@ def summarize(events: list[dict]) -> dict:
         "faults": [e for e in events if e["kind"] == "fault"],
         "recoveries": [e for e in events if e["kind"] == "recovery"],
         "degraded": [e for e in events if e["kind"] == "degraded"],
+        "runs": [e for e in events if e["kind"] in ("run_start", "run_resume")],
+        "interrupts": [e for e in events if e["kind"] == "interrupted"],
+        "warnings": [e for e in events if e["kind"] == "warning"],
         "n_events": len(events),
         "n_fences": n_fences,
         "est_rpc_s": n_fences * RPC_MS_ESTIMATE / 1e3,
@@ -191,6 +194,42 @@ def render_report(summary: dict) -> str:
             f"DEGRADED mode at stage {e['stage']!r}: "
             + "  ".join(f"{k}={v}" for k, v in a.items())
         )
+    for e in summary["runs"]:
+        a = e["attrs"]
+        if e["kind"] == "run_resume":
+            lines.append(
+                f"run resumed (stage {e['stage']}): {a.get('n_done')} done "
+                f"verified, {a.get('n_requeued')} requeued"
+                + (f" ({a.get('requeued')})" if a.get("requeued") else "")
+            )
+        else:
+            pf = a.get("preflight")
+            lines.append(
+                f"run started (tool {a.get('tool')})"
+                + (f"  preflight ok in {pf.get('dur_s')}s on "
+                   f"{pf.get('platform')} x{pf.get('device_count')}"
+                   if isinstance(pf, dict) else "")
+            )
+    for e in summary["interrupts"]:
+        lines.append(
+            f"INTERRUPTED: {e['attrs'].get('reason')} — run wound down "
+            f"gracefully (resumable)"
+        )
+    if summary["warnings"]:
+        by_stage: dict[str, int] = {}
+        for e in summary["warnings"]:
+            by_stage[e["stage"] or "?"] = by_stage.get(e["stage"] or "?", 0) + 1
+        lines.append(
+            "warnings: "
+            + "  ".join(f"{k}×{v}" for k, v in sorted(by_stage.items()))
+        )
+        for e in summary["warnings"]:
+            a = e["attrs"]
+            lines.append(
+                f"  WARNING at {e['stage']!r}: {a.get('reason')}"
+                + (f" ({a.get('unit')})" if a.get("unit") else "")
+                + (f" [{a.get('path')}]" if a.get("path") else "")
+            )
     return "\n".join(lines)
 
 

@@ -73,6 +73,23 @@ def build_parser():
     p.add_argument("--fault-seed", type=int, default=None,
                    help="override the fault spec's seed (ablation sweeps over "
                         "fault realizations without editing the file)")
+    p.add_argument("--ledger", default=None,
+                   help="run-ledger JSONL path (disco_tpu.runs.ledger): record "
+                        "per-clip state + artifact digests for verified resume. "
+                        "Default when --resume is set: "
+                        "<out_root or results>/ledger_<scenario>_<sav_dir>_<noise>.jsonl")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the ledger: done clips are VERIFIED "
+                        "against their artifact digests and skipped; corrupt/"
+                        "missing ones are requeued (truncated files are never "
+                        "trusted).  Graceful SIGTERM/SIGINT during a run exits "
+                        "resumable with this flag")
+    p.add_argument("--preflight", type=float, default=0.0, metavar="SECONDS",
+                   help="run a bounded-deadline device health probe (one tiny "
+                        "fenced dispatch, utils.resilience.preflight_probe) "
+                        "before the run claims the chip for hours; fail fast "
+                        "with a clean error if the attachment is wedged "
+                        "(0 = off)")
     p.add_argument("--obs-log", default=None,
                    help="record structured run telemetry (manifest, per-stage "
                         "events, fence/RPC accounting, numerics sentinels) to "
@@ -199,6 +216,22 @@ def resolve_fault_spec(args):
     return spec
 
 
+def resolve_ledger(args):
+    """--ledger / --resume resolution: an explicit path wins; --resume
+    without a path lands at a deterministic default under the results root
+    so interrupted-then-resumed invocations agree on the file."""
+    if args.ledger is None and not args.resume:
+        return None
+    if args.ledger is not None:
+        return args.ledger
+    from pathlib import Path
+
+    return str(
+        Path(args.out_root or "results")
+        / f"ledger_{args.scenario}_{args.sav_dir}_{args.noise}.jsonl"
+    )
+
+
 def main(argv=None):
     args = build_parser().parse_args(argv)
     args.solver = resolve_solver(args)
@@ -207,6 +240,7 @@ def main(argv=None):
     if args.mesh is not None and args.rirs is None:
         raise SystemExit("--mesh needs batched corpus mode (--rirs)")
     args.fault_spec = resolve_fault_spec(args)
+    args.ledger = resolve_ledger(args)
     policy = none_str(args.mask_z) or "none"
 
     if args.obs_log:
@@ -217,8 +251,27 @@ def main(argv=None):
             config={k: v for k, v in vars(args).items() if v is not None},
             tool="disco-tango",
         )
+    preflight = None
+    if args.preflight > 0:
+        from disco_tpu.utils.resilience import PreflightFailed, preflight_probe
+
+        try:
+            preflight = preflight_probe(deadline_s=args.preflight)
+        except PreflightFailed as e:
+            raise SystemExit(f"preflight: {e}")
+    from disco_tpu import obs as _obs
+
+    _obs.record("run_start", stage="enhance", tool="disco-tango",
+                preflight=preflight, ledger=args.ledger, resume=args.resume)
+    from disco_tpu.runs import GracefulInterrupt
+
     try:
-        return _run(args, policy)
+        with GracefulInterrupt() as stopped:
+            out = _run(args, policy)
+        if stopped():
+            print("interrupted — run is resumable: rerun with --resume "
+                  f"{'--ledger ' + args.ledger if args.ledger else ''}".rstrip())
+        return out
     finally:
         if args.obs_log:
             from disco_tpu import obs
@@ -276,6 +329,7 @@ def _run(args, policy):
                 z_sigs=args.zsigs[0] if len(args.zsigs) == 1 else "zs&zn",
                 solver=args.solver, cov_impl=args.cov_impl, mesh=mesh,
                 fault_spec=args.fault_spec,
+                ledger=args.ledger, resume=args.resume,
             )
         print(f"{len(results)} RIRs enhanced (batched)")
         return results
@@ -287,7 +341,7 @@ def _run(args, policy):
             out_root=args.out_root, streaming=args.streaming, bucket=args.bucket or 0,
             z_sigs=args.zsigs[0] if len(args.zsigs) == 1 else "zs&zn",
             solver=args.solver, cov_impl=args.cov_impl,
-            fault_spec=args.fault_spec,
+            fault_spec=args.fault_spec, ledger=args.ledger,
         )
     if results is None:
         print(f"Conf {args.rir} with {args.noise} noise already processed")

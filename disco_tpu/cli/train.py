@@ -40,6 +40,13 @@ def build_parser():
     p.add_argument("--single_channel", "-sc", action="store_true",
                    help="train the step-1 single-channel model (no z inputs)")
     p.add_argument("--seed", type=int, default=26, help="train.py:20 seed")
+    p.add_argument("--ledger", default=None,
+                   help="run-ledger JSONL path (disco_tpu.runs.ledger): record "
+                        "per-epoch state + artifact digests (losses npz, best "
+                        "checkpoint) for crash-safe audits of long runs")
+    p.add_argument("--preflight", type=float, default=0.0, metavar="SECONDS",
+                   help="bounded-deadline device health probe before the "
+                        "multi-hour run claims the chip (0 = off)")
     p.add_argument("--obs-log", default=None,
                    help="record structured run telemetry (manifest, per-epoch "
                         "events with losses/steps/recompiles) to this JSONL "
@@ -60,8 +67,33 @@ def main(argv=None):
             config={k: v for k, v in vars(args).items() if v is not None},
             tool="disco-train",
         )
+    preflight = None
+    if args.preflight > 0:
+        from disco_tpu.utils.resilience import PreflightFailed, preflight_probe
+
+        try:
+            preflight = preflight_probe(deadline_s=args.preflight)
+        except PreflightFailed as e:
+            raise SystemExit(f"preflight: {e}")
+    from disco_tpu import obs as _obs
+
+    _obs.record("run_start", stage="train", tool="disco-train",
+                preflight=preflight, ledger=args.ledger,
+                resume=none_str(args.weights) is not None)
+    from disco_tpu.nn.training import CheckpointError
+    from disco_tpu.runs import GracefulInterrupt
+
     try:
-        return _run(args)
+        with GracefulInterrupt() as stopped:
+            out = _run(args)
+        if stopped():
+            print("interrupted — training wound down between epochs; resume "
+                  "with --weights on the saved checkpoint")
+        return out
+    except CheckpointError as e:
+        # a corrupt/truncated --weights checkpoint is a clean CLI error
+        # naming the path, never a raw msgpack traceback
+        raise SystemExit(f"--weights: {e}")
     finally:
         if args.obs_log:
             from disco_tpu import obs
@@ -138,6 +170,7 @@ def _run(args):
             output_frames=cfg.output_frames,
             resume_from=none_str(args.weights),
             patience=cfg.early_stop_patience,
+            ledger=args.ledger,
         )
     print(f"run {run_name}: best val loss {np.nanmin(val_losses):.6f}")
     return run_name

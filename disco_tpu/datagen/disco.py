@@ -29,7 +29,8 @@ import numpy as np
 from disco_tpu.core.masks import vad_oracle_batch
 from disco_tpu.core.metrics import fw_snr
 from disco_tpu.core.sigproc import increase_to_snr
-from disco_tpu.io import DatasetLayout, write_wav
+from disco_tpu.io import DatasetLayout
+from disco_tpu.io.atomic import probe_npy, save_npy_atomic, write_wav_atomic
 from disco_tpu.sim import RoomSetup, fft_convolve, rir_length_for, shoebox_rirs
 
 
@@ -203,33 +204,37 @@ def save_scene(
 ):
     """Write the per-RIR corpus files in the reference layout
     (convolve_signals.py:285-326): dry sources, convolved images, extra
-    noises, infos log."""
+    noises, infos log.
+
+    All writes are atomic (``disco_tpu.io.atomic``) and the infos log —
+    the scene's completion marker, written LAST — lands only after every
+    wav it describes, so the validated idempotency guard in
+    :func:`generate_disco_rirs` can trust a complete infos file.
+
+    Returns the list of written artifact paths (what a run ledger digests
+    into the scene's ``done`` record)."""
     tags = [None, "ssn"] + [noise_tag(n).lstrip("_") for n in extra_names]
     kinds = ["target", "noise"]
+    written = []
     # Dry sources (target, SSN)
     for i_s, sig in enumerate(scene.sources):
         p = layout.dry_source(kinds[i_s], rir_id, i_s + 1, noise=tags[i_s])
-        layout.ensure_dir(p)
-        write_wav(p, np.asarray(sig, np.float32), fs)
+        written.append(write_wav_atomic(p, np.asarray(sig, np.float32), fs))
     # Extra dry noises (S-2 with their tag)
     for i_n in range(len(extra_dry)):
         p = layout.dry_source("noise", rir_id, 2, noise=tags[i_n + 2])
-        layout.ensure_dir(p)
-        write_wav(p, np.asarray(extra_dry[i_n], np.float32), fs)
+        written.append(write_wav_atomic(p, np.asarray(extra_dry[i_n], np.float32), fs))
     # Convolved images
     for i_s in range(len(scene.images)):
         for ch in range(scene.images.shape[1]):
             p = layout.cnv_image(kinds[i_s], rir_id, i_s + 1, ch + 1, noise=tags[i_s])
-            layout.ensure_dir(p)
-            write_wav(p, scene.images[i_s, ch], fs)
+            written.append(write_wav_atomic(p, scene.images[i_s, ch], fs))
     for i_n in range(len(extra_reverbed)):
         for ch in range(extra_reverbed.shape[1]):
             p = layout.cnv_image("noise", rir_id, 2, ch + 1, noise=tags[i_n + 2])
-            layout.ensure_dir(p)
-            write_wav(p, extra_reverbed[i_n, ch], fs)
-    info_path = layout.infos(rir_id)
-    layout.ensure_dir(info_path)
-    np.save(info_path, infos, allow_pickle=True)
+            written.append(write_wav_atomic(p, extra_reverbed[i_n, ch], fs))
+    written.append(save_npy_atomic(layout.infos(rir_id), infos, allow_pickle=True))
+    return written
 
 
 def generate_disco_rirs(
@@ -243,12 +248,25 @@ def generate_disco_rirs(
     max_order: int = 20,
     fs: int = 16000,
     max_redraws: int = 50,
+    ledger=None,
+    resume: bool = False,
 ):
     """The per-RIR-range generation driver (convolve_signals.py:418-448):
     idempotent, restartable, sentinel-driven redraw loop.
 
+    Crash safety (``disco_tpu.runs``): every scene artifact is written
+    atomically with the infos log last, and the idempotency guard
+    *validates* the infos file (integrity probe) instead of trusting bare
+    existence — a scene whose datagen run crashed mid-save is regenerated.
+    ``ledger``/``resume`` add per-scene digest records with verified
+    resume; a graceful SIGTERM/SIGINT stop finishes the current scene and
+    returns early, resumable.
+
     Returns the list of RIR ids actually generated (existing ones skipped).
     """
+    from disco_tpu.runs import chaos as run_chaos
+    from disco_tpu.runs import interrupt as run_interrupt
+    from disco_tpu.runs.ledger import RunLedger, unit_scene
     from disco_tpu.sim import make_setup
     from disco_tpu.sim.defaults import RoomDefaults
 
@@ -258,9 +276,45 @@ def generate_disco_rirs(
     generated = []
     i_file = (rir_start - 1) * 2  # distinct talker per RIR, with margin (convolve_signals.py:373)
 
+    if ledger is not None and not isinstance(ledger, RunLedger):
+        ledger = RunLedger(ledger)
+    if resume:
+        from disco_tpu.io.atomic import remove_tmp_litter
+
+        litter = remove_tmp_litter(layout.base)
+        if litter:
+            from disco_tpu.obs import events as _ev
+
+            _ev.record("warning", stage="resume",
+                       reason=f"removed {len(litter)} abandoned temp file(s) "
+                              f"from a crashed writer", files=litter[:20])
+    ledger_done: set = set()
+    requeued_units: set = set()
+    if ledger is not None and resume:
+        from disco_tpu.obs import events as obs_events
+
+        ledger_done, requeued = ledger.verified_done()
+        requeued_units = set(requeued)
+        obs_events.record(
+            "run_resume", stage="datagen", ledger=str(ledger.path),
+            n_done=len(ledger_done), n_requeued=len(requeued),
+            requeued=sorted(requeued),
+        )
+
     for rir_id in range(rir_start, rir_start + n_rirs):
-        if layout.infos(rir_id).exists():
-            continue  # idempotency guard (SURVEY.md §5.3)
+        if run_interrupt.stop_requested():
+            break  # graceful stop between scenes: everything saved, resumable
+        if unit_scene(rir_id) in ledger_done:
+            continue
+        if unit_scene(rir_id) not in requeued_units and probe_npy(layout.infos(rir_id)):
+            # validated idempotency guard (SURVEY.md §5.3): the infos log is
+            # written LAST and atomically, so a complete one certifies the
+            # scene; a truncated one (pre-atomic-era crash) is regenerated.
+            # A unit the verified resume just requeued (digest-level damage
+            # the infos probe cannot see) bypasses this skip and is redone.
+            continue
+        if ledger is not None:
+            ledger.mark_in_flight(unit_scene(rir_id))
         signal_setup.get_random_dry_snr()
         scene = None
         for _ in range(max_redraws):
@@ -298,11 +352,14 @@ def generate_disco_rirs(
             "noise_files": files,
             "noise_starts": starts,
         }
-        save_scene(
+        written = save_scene(
             scene, extra_dry, extra_rev, infos, rir_id, layout, fs,
             extra_names=list(signal_setup.noises_dict.keys()),
         )
+        if ledger is not None:
+            ledger.mark_done(unit_scene(rir_id), written)
         generated.append(rir_id)
+        run_chaos.tick("between_scenes", rir=rir_id)
         i_file += 1
     return generated
 

@@ -34,12 +34,22 @@ from disco_tpu.core.dsp import istft
 from disco_tpu.core.metrics import fw_sd, fw_snr, si_bss, stoi
 from disco_tpu.enhance.tango import oracle_masks, tango
 from disco_tpu.enhance.zexport import load_node_signals
-from disco_tpu.io.audio import read_wav, write_wav
+from disco_tpu.io.atomic import (
+    dump_pickle_atomic,
+    probe_npy,
+    probe_pickle,
+    save_npy_atomic,
+    write_wav_atomic,
+)
+from disco_tpu.io.audio import read_wav
 from disco_tpu.io.layout import DatasetLayout, case_of_rir, snr_dirname
 from disco_tpu.obs import accounting as obs_accounting
 from disco_tpu.obs import events as obs_events
 from disco_tpu.obs import sentinels as obs_sentinels
 from disco_tpu.obs.metrics import REGISTRY as obs_registry
+from disco_tpu.runs import chaos as run_chaos
+from disco_tpu.runs import interrupt as run_interrupt
+from disco_tpu.runs.ledger import RunLedger, unit_rir
 from disco_tpu.utils import resilient_to_host
 
 
@@ -52,22 +62,20 @@ def _record_degraded(fault_plan, streaming: bool = False, **attrs):
     obs_registry.counter("degraded_clips").inc()
     if not obs_events.enabled():
         return
-    import numpy as _np
-
     if streaming:
         lost = fault_plan.avail_streaming < 1.0
         obs_events.record(
             "degraded", stage="mwf", mode="streaming",
             n_blocks_held=int(lost.sum()),
-            nodes=_np.flatnonzero(lost.any(axis=1)).tolist(),
+            nodes=np.flatnonzero(lost.any(axis=1)).tolist(),
             **attrs,
         )
     else:
-        excluded = _np.flatnonzero(fault_plan.avail_offline < 1.0).tolist()
+        excluded = np.flatnonzero(fault_plan.avail_offline < 1.0).tolist()
         obs_events.record(
             "degraded", stage="mwf", mode="offline",
             n_streams_excluded=len(excluded), nodes=excluded,
-            nan_nodes=_np.flatnonzero(fault_plan.z_nan).tolist(),
+            nan_nodes=np.flatnonzero(fault_plan.z_nan).tolist(),
             **attrs,
         )
 
@@ -79,7 +87,20 @@ def load_input_signals(layout: DatasetLayout, rir: int, noise: str, snr_range, n
     s_dry, fs = read_wav(layout.dry_source("target", rir, 1))
     n_dry, _ = read_wav(layout.dry_source("noise", rir, 2, noise=noise))
     snr_path = layout.snr_log(snr_range, rir, noise)
-    rnd_snrs = np.load(snr_path) if snr_path.exists() else np.zeros(n_nodes)
+    if snr_path.exists():
+        rnd_snrs = np.load(snr_path)
+    else:
+        # Degraded input, made visible: the per-node logged SNRs land in
+        # every OIM pickle as snr_in_raw, so a silent zeros substitution
+        # poisons downstream aggregation invisibly.  The counter and the
+        # warning event surface it in `disco-obs report`.
+        rnd_snrs = np.zeros(n_nodes)
+        obs_registry.counter("snr_sidecar_missing").inc()
+        obs_events.record(
+            "warning", stage="load_input", rir=rir, noise=noise,
+            reason="SNR sidecar missing; substituting zeros for snr_in_raw",
+            path=str(snr_path),
+        )
     return y, s, n, s_dry, n_dry, fs, rnd_snrs
 
 
@@ -91,6 +112,50 @@ def dset_of_rir(rir: int) -> str:
 
 def results_root(scenario: str, dset: str, save_dir: str) -> Path:
     return Path("results") / scenario / dset / save_dir
+
+
+def _clip_done(out: Path, rir, noise: str) -> bool:
+    """Validated idempotency probe for one enhanced RIR: both OIM pickles
+    (the last artifacts ``_persist_and_score`` writes) must exist AND
+    unpickle to completion.  Replaces the existence-only guard that trusted
+    truncated files forever — a crash mid-run now reads as not-done and the
+    clip is redone (atomic writes make the redo safe)."""
+    for kind in ("mwf", "tango"):
+        p = out / "OIM" / f"results_{kind}_{rir}_{noise}.p"
+        if not probe_pickle(p):
+            if p.exists():
+                obs_registry.counter("corrupt_artifacts_detected").inc()
+                obs_events.record(
+                    "warning", stage="skip_probe", rir=rir, noise=noise,
+                    reason="existing OIM pickle failed its integrity probe; "
+                           "re-enhancing this clip", path=str(p),
+                )
+            return False
+    return True
+
+
+def clip_artifacts(out: Path, rir, noise: str, snr_range, n_nodes: int) -> list:
+    """The canonical artifact paths of one enhanced RIR — what the run
+    ledger digests into a ``done`` record and re-verifies on resume.  The
+    best-effort FIG render is deliberately absent (plotting may legally
+    fail)."""
+    paths = [
+        out / "OIM" / f"results_tango_{rir}_{noise}.p",
+        out / "OIM" / f"results_mwf_{rir}_{noise}.p",
+    ]
+    zdir = out / "STFT" / "z" / "raw" / snr_dirname(snr_range)
+    for k in range(n_nodes):
+        tag = f"{noise}_Node-{k + 1}"
+        paths += [
+            out / "WAV" / str(rir) / f"{stem}-{tag}.wav"
+            for stem in ("in_mix", "out_mix", "mid_z", "in_noi", "out_noi", "in_tar", "out_tar")
+        ]
+        paths += [
+            out / "MASK" / str(rir) / f"step1_{tag}.npy",
+            out / "MASK" / str(rir) / f"step2_{tag}.npy",
+            zdir / f"{rir}_{tag}.npy",
+        ]
+    return paths
 
 
 #: Keys of the per-node metric dicts below — the degraded-mode NaN fill
@@ -273,33 +338,36 @@ def _persist_and_score(
         per_node_mwf.append(mwf_d)
 
         tag = f"{noise}_Node-{k + 1}"
-        write_wav(out / "WAV" / str(rir) / f"in_mix-{tag}.wav", y0, fs)
-        write_wav(out / "WAV" / str(rir) / f"out_mix-{tag}.wav", sh_t[k], fs)
-        write_wav(out / "WAV" / str(rir) / f"mid_z-{tag}.wav", szh_t[k], fs)
-        write_wav(out / "WAV" / str(rir) / f"in_noi-{tag}.wav", n0, fs)
-        write_wav(out / "WAV" / str(rir) / f"out_noi-{tag}.wav", nf_t[k], fs)
-        write_wav(out / "WAV" / str(rir) / f"in_tar-{tag}.wav", s0, fs)
-        write_wav(out / "WAV" / str(rir) / f"out_tar-{tag}.wav", sf_t[k], fs)
-        np.save(out / "MASK" / str(rir) / f"step1_{tag}", np.asarray(res.masks_z[k, :, :T_true]))
-        np.save(out / "MASK" / str(rir) / f"step2_{tag}", np.asarray(res.mask_w[k, :, :T_true]))
+        # atomic (tmp+fsync+rename, io.atomic): a crash mid-persist leaves
+        # the final paths either complete or absent, never truncated — the
+        # invariant the verified-resume probes rely on
+        write_wav_atomic(out / "WAV" / str(rir) / f"in_mix-{tag}.wav", y0, fs)
+        write_wav_atomic(out / "WAV" / str(rir) / f"out_mix-{tag}.wav", sh_t[k], fs)
+        write_wav_atomic(out / "WAV" / str(rir) / f"mid_z-{tag}.wav", szh_t[k], fs)
+        write_wav_atomic(out / "WAV" / str(rir) / f"in_noi-{tag}.wav", n0, fs)
+        write_wav_atomic(out / "WAV" / str(rir) / f"out_noi-{tag}.wav", nf_t[k], fs)
+        write_wav_atomic(out / "WAV" / str(rir) / f"in_tar-{tag}.wav", s0, fs)
+        write_wav_atomic(out / "WAV" / str(rir) / f"out_tar-{tag}.wav", sf_t[k], fs)
+        save_npy_atomic(out / "MASK" / str(rir) / f"step1_{tag}", np.asarray(res.masks_z[k, :, :T_true]))
+        save_npy_atomic(out / "MASK" / str(rir) / f"step2_{tag}", np.asarray(res.mask_w[k, :, :T_true]))
         # resilient: the z export is this function's one direct device
         # readback (complex-split over the tunnel) — a dropped RPC retries
         # in-process instead of aborting the clip (utils.resilience)
-        np.save(zdir / f"{rir}_{tag}", resilient_to_host(res.z_y[k, :, :T_true]))
+        save_npy_atomic(zdir / f"{rir}_{tag}", resilient_to_host(res.z_y[k, :, :T_true]))
 
     def stack_keys(dicts):
         return {k: np.array([d[k] for d in dicts]) for k in dicts[0]}
 
     results = {"snr_in_raw": rnd_snrs, **stack_keys(per_node_tango)}
     resultsz = {"snr_in_raw": rnd_snrs, **stack_keys(per_node_mwf)}
-    with open(out / "OIM" / f"results_tango_{rir}_{noise}.p", "wb") as fh:
-        pickle.dump(results, fh)
-    with open(out / "OIM" / f"results_mwf_{rir}_{noise}.p", "wb") as fh:
-        pickle.dump(resultsz, fh)
+    dump_pickle_atomic(out / "OIM" / f"results_tango_{rir}_{noise}.p", results)
+    dump_pickle_atomic(out / "OIM" / f"results_mwf_{rir}_{noise}.p", resultsz)
 
     if save_fig:
         infos_path = layout.infos(rir)
-        if infos_path.exists():
+        # validated, not just exists(): a truncated infos .npy from a
+        # crashed datagen run would otherwise be trusted here forever
+        if probe_npy(infos_path):
             try:
                 from disco_tpu.enhance.inference import plot_conf
 
@@ -318,6 +386,7 @@ def _persist_and_score(
     if obs_events.enabled():
         obs_events.record("clip", rir=rir, noise=noise, n_nodes=n_nodes,
                           sdr_cnv_mean=float(np.mean(results["sdr_cnv"])))
+    run_chaos.tick("between_clips", rir=rir)
     return results
 
 
@@ -343,12 +412,20 @@ def enhance_rir(
     solver: str | None = None,
     cov_impl: str = "xla",
     fault_spec=None,
+    ledger=None,
 ):
     """Enhance one RIR end-to-end and persist everything (reference
     tango.py:460-641).  ``models``: per-step CRNN params or None for the
     oracle masks of ``mask_type``.  ``streaming=True`` runs the
     frame-recursive online pipeline (exponential-smoothing covariances,
     block filter refresh) instead of the offline frame-mean one.
+
+    ``ledger``: optional :class:`disco_tpu.runs.RunLedger` (or path) —
+    the clip's in_flight/done transitions and artifact digests are
+    recorded for verified resume (``disco_tpu.runs.ledger``).  All artifact
+    writes are atomic (``disco_tpu.io.atomic``), and the idempotency skip
+    validates the existing OIM pickles instead of trusting bare existence —
+    a truncated artifact from a crashed run is re-enhanced, never returned.
 
     ``fault_spec``: optional ``disco_tpu.fault.FaultSpec`` (or dict/path
     accepted by ``load_fault_spec``) — inject the seeded fault scenario at
@@ -374,9 +451,12 @@ def enhance_rir(
     from disco_tpu.core.dsp import stft
 
     out = Path(out_root) if out_root is not None else results_root(scenario, dset_of_rir(rir), save_dir)
-    oim_marker = out / "OIM" / f"results_mwf_{rir}_{noise}.p"
-    if oim_marker.exists() and not force:
+    if not force and _clip_done(out, rir, noise):
         return None
+    if ledger is not None and not isinstance(ledger, RunLedger):
+        ledger = RunLedger(ledger)
+    if ledger is not None:
+        ledger.mark_in_flight(unit_rir(rir, noise))
 
     layout = DatasetLayout(root, scenario, case_of_rir(rir))
     with obs_events.stage("load_input", rir=rir, noise=noise):
@@ -465,6 +545,11 @@ def enhance_rir(
         out, layout, rir, noise, snr_range, y, s, n, s_dry, n_dry, fs,
         rnd_snrs, res, L, T_true, n_nodes, save_fig,
     )
+    if ledger is not None:
+        ledger.mark_done(
+            unit_rir(rir, noise),
+            clip_artifacts(out, rir, noise, snr_range, n_nodes),
+        )
     if obs_events.enabled():
         obs_events.record("counters", **obs_registry.snapshot())
     return out_results
@@ -561,6 +646,8 @@ def enhance_rirs_batched(
     score_workers: int = 4,
     mesh=None,
     fault_spec=None,
+    ledger=None,
+    resume: bool = False,
 ):
     """Corpus-scale enhancement: many RIRs per jitted launch.
 
@@ -592,6 +679,20 @@ def enhance_rirs_batched(
     'batch' size and ``n_nodes`` by its 'node' size.  Results are
     identical (tests/test_driver.py).
 
+    ``ledger`` / ``resume``: the crash-safe run contract
+    (``disco_tpu.runs``).  ``ledger`` (a :class:`~disco_tpu.runs.RunLedger`
+    or path) records per-clip in_flight/done transitions with artifact
+    digests; with ``resume=True`` the ledger's done entries are *verified*
+    against those digests before being skipped and corrupt/missing units
+    are requeued.  With a ledger but ``resume=False`` its done records are
+    trusted as-recorded (no re-hash, no duplicate catch-up appends) —
+    ``--resume`` is the digest-verified path.  Without a ledger the skip
+    probe still validates the existing OIM pickles (``_clip_done``)
+    instead of trusting existence.
+    A graceful stop (SIGTERM/SIGINT via ``disco_tpu.runs.interrupt``)
+    finishes the in-flight chunk, drains scoring, flushes the ledger and
+    returns the partial results — the run is then resumable.
+
     Returns {rir: results dict} for the RIRs actually processed
     (already-done ones are skipped — same idempotency contract).
     """
@@ -617,14 +718,73 @@ def enhance_rirs_batched(
 
     out_base = out_root  # per-RIR dset split resolved below
 
+    if ledger is not None and not isinstance(ledger, RunLedger):
+        ledger = RunLedger(ledger)
+    ledger_done: set = set()
+    if resume:
+        # A REAL crash (process death, not an exception unwind) can leave
+        # abandoned *.tmp.<pid> partial writes; sweep them before probing.
+        from disco_tpu.io.atomic import remove_tmp_litter
+
+        roots = {
+            str(Path(out_base) if out_base is not None
+                else results_root(scenario, dset_of_rir(r), save_dir))
+            for r in rirs
+        }
+        litter = [p for root in sorted(roots) for p in remove_tmp_litter(root)]
+        if litter:
+            obs_events.record(
+                "warning", stage="resume",
+                reason=f"removed {len(litter)} abandoned temp file(s) from a "
+                       f"crashed writer", files=litter[:20],
+            )
+    requeued_units: set = set()
+    if ledger is not None and resume:
+        # Verified resume: done entries are re-checked against their
+        # artifact digests; corrupt/missing units are requeued (loudly) and
+        # fall through to the index pass below for re-enhancement.
+        ledger_done, requeued = ledger.verified_done()
+        requeued_units = set(requeued)
+        obs_events.record(
+            "run_resume", stage="enhance", ledger=str(ledger.path),
+            n_done=len(ledger_done), n_requeued=len(requeued),
+            requeued=sorted(requeued),
+        )
+    elif ledger is not None:
+        # No verification requested: trust the ledger's own done records so
+        # a plain rerun with --ledger neither re-hashes the done corpus nor
+        # appends a duplicate catch-up line per clip (--resume is the
+        # digest-verified path).
+        ledger_done = {
+            u for u, rec in ledger.replay().items() if rec["state"] == "done"
+        }
+
     # -- index pass: group pending RIRs by bucketed length. Only ONE channel
     # is read here to learn the clip length; full audio is loaded per chunk
     # below, so corpus-scale runs never hold the whole split in RAM.
     groups: dict[int, list] = {}
     for rir in rirs:
         out = Path(out_base) if out_base is not None else results_root(scenario, dset_of_rir(rir), save_dir)
-        if (out / "OIM" / f"results_mwf_{rir}_{noise}.p").exists() and not force:
-            continue
+        if not force:
+            if unit_rir(rir, noise) in ledger_done:
+                continue
+            # A unit the verified resume just REQUEUED must actually be
+            # redone: its digest-level damage (e.g. a deleted WAV) may not
+            # show in the pickle-only _clip_done probe, and "requeued" means
+            # never trusted — the atomic re-enhance regenerates everything.
+            if unit_rir(rir, noise) not in requeued_units and _clip_done(out, rir, noise):
+                # Complete on disk but absent from (or unverified by) the
+                # ledger — e.g. a crash landed between the final artifact
+                # rename and the done append.  Catch the ledger up so the
+                # next resume verifies by digest instead of re-probing.
+                # (Membership in ledger_done was already ruled out above.)
+                if ledger is not None:
+                    ledger.mark_done(
+                        unit_rir(rir, noise),
+                        clip_artifacts(out, rir, noise, snr_range, n_nodes),
+                        recovered="complete artifacts found without a done record",
+                    )
+                continue
         layout = DatasetLayout(root, scenario, case_of_rir(rir))
         probe = layout.wav_processed(snr_range, "mixture", rir, 1, noise=noise)
         if not probe.exists():
@@ -686,16 +846,39 @@ def enhance_rirs_batched(
 
     all_results = {}
     pending: list = []  # (rir, future) of the PREVIOUS chunk
+    stopping = False  # graceful interruption: wind down between chunks
 
     def drain():
         for rir_, fut in pending:
             all_results[rir_] = fut.result()
         pending.clear()
 
+    def score_unit(score_fn, rir_, out_):
+        """One clip's scoring + ledger completion (runs on a worker)."""
+        r = score_fn()
+        if ledger is not None:
+            ledger.mark_done(
+                unit_rir(rir_, noise),
+                clip_artifacts(out_, rir_, noise, snr_range, n_nodes),
+            )
+        return r
+
     with ThreadPoolExecutor(max_workers=max(score_workers, 1)) as ex:
         for Lp, items in groups.items():
+            if stopping:
+                break
             for start in range(0, len(items), max_batch):
+                if run_interrupt.stop_requested():
+                    # Graceful stop: no new chunk is dispatched; the
+                    # previous chunk's in-flight scoring drains below, its
+                    # done records land in the ledger, and the partial
+                    # results return — resumable by construction.
+                    stopping = True
+                    break
                 chunk = items[start : start + max_batch]
+                if ledger is not None:
+                    for rir, _out, _layout in chunk:
+                        ledger.mark_in_flight(unit_rir(rir, noise), bucket=Lp)
                 with obs_events.stage("chunk_load", n_clips=len(chunk), bucket=Lp):
                     sigs = [
                         load_input_signals(layout, rir, noise, snr_range, n_nodes, mics_per_node)
@@ -723,6 +906,7 @@ def enhance_rirs_batched(
                 # chunk_enhance wall time is dispatch-side only (jit returns
                 # before the device finishes); the recompile events and the
                 # fence deltas in score_persist carry the device-side story.
+                run_chaos.tick("pre_dispatch", bucket=Lp, n_clips=n_real)
                 with obs_events.stage("chunk_enhance", n_clips=n_real, bucket=Lp,
                                       batch=len(ys)):
                     Yb = stft(jnp.asarray(np.stack(ys)))
@@ -746,10 +930,17 @@ def enhance_rirs_batched(
                         fs, rnd_snrs, res_i, L, n_stft_frames(L), n_nodes, save_fig,
                     )
                     if score_workers <= 1:
-                        all_results[rir] = score()
+                        all_results[rir] = score_unit(score, rir, out)
                     else:
-                        pending.append((rir, ex.submit(score)))
+                        pending.append((rir, ex.submit(score_unit, score, rir, out)))
         drain()
+    if stopping:
+        obs_events.record(
+            "note", stage="enhance",
+            reason="graceful stop: partial corpus run; rerun with resume=True "
+                   "(--resume) to continue",
+            n_done=len(all_results),
+        )
     if obs_events.enabled():
         obs_events.record("counters", **obs_registry.snapshot())
     return all_results

@@ -383,7 +383,20 @@ def streaming_tango(
       dict with yf (K, F, T) enhanced outputs, z_y/zn (K, F, T) streams,
       a ``state`` entry for continuation, and sf/nf/z_s/z_n when
       diagnostics are requested.
+
+    Crash safety: a chunked deployment loop is exactly the shape the
+    crash-safe runs layer (``disco_tpu.runs``) targets — the returned
+    ``state`` is the continuation checkpoint, so a caller persisting it
+    atomically per chunk (``disco_tpu.io.atomic``) can resume a killed
+    stream at the last chunk boundary.  The ``between_blocks`` chaos seam
+    fires at each chunk-continuation entry (host-side, outside jit) so
+    ``make chaos-check``-style tests can interrupt a chunked run at the
+    boundary.
     """
+    if state is not None:
+        from disco_tpu.runs import chaos as _chaos
+
+        _chaos.tick("between_blocks")
     K, C, F, T = Y.shape
     st1_in, st2_in = (None, None) if state is None else (state["step1"], state["step2"])
     step1 = jax.vmap(

@@ -1,5 +1,30 @@
+from disco_tpu.io.atomic import (
+    atomic_write,
+    dump_pickle_atomic,
+    file_digest,
+    probe_artifact,
+    save_npy_atomic,
+    savez_atomic,
+    verify_digest,
+    write_bytes_atomic,
+    write_wav_atomic,
+)
 from disco_tpu.io.audio import read_wav, write_wav
 from disco_tpu.io.fastwav import read_wavs_batch
 from disco_tpu.io.layout import DatasetLayout
 
-__all__ = ["read_wav", "read_wavs_batch", "write_wav", "DatasetLayout"]
+__all__ = [
+    "DatasetLayout",
+    "atomic_write",
+    "dump_pickle_atomic",
+    "file_digest",
+    "probe_artifact",
+    "read_wav",
+    "read_wavs_batch",
+    "save_npy_atomic",
+    "savez_atomic",
+    "verify_digest",
+    "write_bytes_atomic",
+    "write_wav",
+    "write_wav_atomic",
+]

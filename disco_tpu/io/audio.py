@@ -109,12 +109,17 @@ def _encode(x: np.ndarray, subtype: str) -> tuple[bytes, int, int]:
 def write_wav(path, data, fs, subtype: str = "FLOAT"):
     """Write float audio in [-1, 1) as WAV.  ``subtype`` selects the sample
     format (soundfile naming): 'FLOAT' (default — preserves the reference's
-    float writes exactly), 'DOUBLE', or 'PCM_16'/'PCM_24'/'PCM_32'."""
+    float writes exactly), 'DOUBLE', or 'PCM_16'/'PCM_24'/'PCM_32'.
+
+    ``path`` may also be an open binary file object (the atomic writer in
+    ``disco_tpu.io.atomic`` encodes into memory, then renames into place).
+    """
     data = np.asarray(data)
     n_ch = 1 if data.ndim == 1 else data.shape[1]
     raw, fmt_code, bits = _encode(data.reshape(-1), subtype)
     align = n_ch * bits // 8
-    with open(path, "wb") as fh:
+
+    def emit(fh):
         fh.write(struct.pack("<4sI4s", b"RIFF", 36 + len(raw) + (len(raw) % 2), b"WAVE"))
         fh.write(struct.pack("<4sIHHIIHH", b"fmt ", 16, fmt_code, n_ch,
                              int(fs), int(fs) * align, align, bits))
@@ -122,3 +127,9 @@ def write_wav(path, data, fs, subtype: str = "FLOAT"):
         fh.write(raw)
         if len(raw) % 2:
             fh.write(b"\x00")
+
+    if hasattr(path, "write"):
+        emit(path)
+    else:
+        with open(path, "wb") as fh:
+            emit(fh)

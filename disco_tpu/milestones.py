@@ -59,9 +59,16 @@ def _fence(x) -> float:
 def _fence_readback(x) -> float:
     """One un-retried fence attempt (the raw RPC).  ``utils.resilience.
     resilient_fence`` wraps THIS with caller-chosen budgets, so its retries
-    do not stack on :func:`_fence`'s defaults."""
-    from disco_tpu.obs import accounting
+    do not stack on :func:`_fence`'s defaults.
 
+    ``pre_fence`` is a chaos seam (``disco_tpu.runs.chaos``): the injected
+    crash lands immediately before the readback — work enqueued on device,
+    nothing fenced back — the exact window a tunnel drop hits an unprepared
+    run."""
+    from disco_tpu.obs import accounting
+    from disco_tpu.runs import chaos
+
+    chaos.tick("pre_fence")
     accounting.fence_tick()
     return float(jnp.real(jnp.ravel(x)[0]))
 

@@ -13,6 +13,7 @@ from disco_tpu.nn.data import (
 )
 from disco_tpu.nn.losses import nanmean, reconstruction_loss
 from disco_tpu.nn.training import (
+    CheckpointError,
     SaveAndStop,
     TrainState,
     create_train_state,
@@ -30,7 +31,8 @@ __all__ = [
     "DiscoDataset", "DiscoPartialDataset", "RandomDataset",
     "batch_iterator", "get_input_lists", "load_input_lists", "write_input_lists",
     "nanmean", "reconstruction_loss",
-    "SaveAndStop", "TrainState", "create_train_state", "fit", "get_model_name",
+    "CheckpointError", "SaveAndStop", "TrainState", "create_train_state",
+    "fit", "get_model_name",
     "load_checkpoint", "load_params_for_inference", "make_step_fns", "save_checkpoint",
 ]
 from disco_tpu.nn import fastload

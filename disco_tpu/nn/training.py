@@ -147,9 +147,22 @@ def get_model_name(model_name=None):
 
 
 # -- checkpointing ----------------------------------------------------------
+class CheckpointError(RuntimeError):
+    """A checkpoint file could not be restored (missing, truncated, or not
+    a compatible msgpack payload).  Raised with the offending path in the
+    message so CLIs can fail cleanly instead of surfacing a raw msgpack
+    traceback."""
+
+
 def save_checkpoint(path, state: TrainState, train_losses, val_losses):
     """Serialize model+optimizer state and loss history to one msgpack file
-    (the torch.save dict of reference train.py:147-156)."""
+    (the torch.save dict of reference train.py:147-156).  Written
+    atomically (``disco_tpu.io.atomic``): a crash mid-save leaves the
+    previous best checkpoint intact, never a truncated msgpack — the
+    artifact a multi-hour training run resumes from must survive the crash
+    that interrupts it."""
+    from disco_tpu.io.atomic import write_bytes_atomic
+
     payload = {
         "params": state.params,
         "batch_stats": state.batch_stats,
@@ -158,14 +171,22 @@ def save_checkpoint(path, state: TrainState, train_losses, val_losses):
         "train_loss": np.asarray(train_losses),
         "val_loss": np.asarray(val_losses),
     }
-    Path(path).parent.mkdir(parents=True, exist_ok=True)
-    Path(path).write_bytes(serialization.to_bytes(payload))
+    write_bytes_atomic(path, serialization.to_bytes(payload))
 
 
 def load_checkpoint(path, state: TrainState):
     """Restore a checkpoint into a compatible TrainState; returns
     (state, train_losses, val_losses) with trailing zero-padding trimmed
-    (reference dnn/utils.py:155-175)."""
+    (reference dnn/utils.py:155-175).
+
+    Raises :class:`CheckpointError` naming ``path`` when the file is
+    missing, truncated or not a compatible payload — a corrupt resume
+    checkpoint must be a clean, actionable error, not an opaque msgpack
+    traceback from deep inside flax."""
+    try:
+        raw = Path(path).read_bytes()
+    except OSError as e:
+        raise CheckpointError(f"checkpoint {path}: cannot read: {e}") from e
     template = {
         "params": state.params,
         "batch_stats": state.batch_stats,
@@ -174,7 +195,15 @@ def load_checkpoint(path, state: TrainState):
         "train_loss": np.zeros(0, np.float64),
         "val_loss": np.zeros(0, np.float64),
     }
-    payload = serialization.from_bytes(template, Path(path).read_bytes())
+    try:
+        payload = serialization.from_bytes(template, raw)
+    except Exception as e:
+        raise CheckpointError(
+            f"checkpoint {path}: corrupt or incompatible msgpack payload "
+            f"({type(e).__name__}: {e}) — the file may be truncated by a "
+            f"crashed writer; delete it or point --weights at an intact "
+            f"checkpoint"
+        ) from e
     state = state.replace(
         params=payload["params"],
         batch_stats=payload["batch_stats"],
@@ -208,6 +237,7 @@ def fit(
     resume_from: str | None = None,
     patience: float | None = None,
     verbose: bool = True,
+    ledger=None,
 ):
     """Full training loop (reference train.py:110-158): per-epoch train +
     no-grad validation, loss history saved every epoch, best-model
@@ -216,7 +246,21 @@ def fit(
     ``train_batches`` / ``val_batches`` are callables returning an iterator
     of (x, y) numpy batches (fresh shuffle each epoch).
     Returns (state, train_losses, val_losses, run_name).
+
+    Crash safety (``disco_tpu.runs``): checkpoints and loss histories are
+    written atomically; an optional ``ledger``
+    (:class:`~disco_tpu.runs.RunLedger` or path) records per-epoch
+    in_flight/done transitions with artifact digests; a graceful stop
+    (SIGTERM/SIGINT) finishes the current epoch — its losses and any
+    improved checkpoint persist — and returns early, resumable via
+    ``resume_from``.
     """
+    from disco_tpu.runs import chaos as run_chaos
+    from disco_tpu.runs import interrupt as run_interrupt
+    from disco_tpu.runs.ledger import RunLedger, unit_epoch
+
+    if ledger is not None and not isinstance(ledger, RunLedger):
+        ledger = RunLedger(ledger)
     train_step, eval_step = make_step_fns(model, output_frames)
     save_dir = Path(save_path)
     save_dir.mkdir(parents=True, exist_ok=True)
@@ -234,7 +278,15 @@ def fit(
 
     gate = SaveAndStop(patience=patience if patience is not None else n_epochs, mode="min")
     recompiles0 = obs_registry.counter("jit_recompiles").value
+    interrupted = False
     for epoch in range(first_epoch, first_epoch + n_epochs):
+        if run_interrupt.stop_requested():
+            # Graceful stop between epochs: everything already on disk
+            # (atomic), resumable via resume_from on the saved checkpoint.
+            interrupted = True
+            break
+        if ledger is not None:
+            ledger.mark_in_flight(unit_epoch(epoch))
         t_epoch = time.perf_counter()
         # Losses stay ON DEVICE across the epoch as a running sum: a
         # float() per step would fence the pipeline (host sync per batch),
@@ -246,6 +298,9 @@ def fit(
             state, loss = train_step(state, x, y)
             tr = tr + loss
             nb += 1
+        # mid_epoch chaos seam: crash with the train pass done but nothing
+        # persisted — the whole epoch must be redone on resume, never half
+        run_chaos.tick("mid_epoch", epoch=int(epoch))
         va, nv = jnp.zeros(()), 0
         for x, y in prefetch_to_device(val_batches()):
             va = va + eval_step(state, x, y)
@@ -267,9 +322,39 @@ def fit(
             recompiles0 = recompiles
         if verbose:
             print(f"epoch {epoch}\tTrain\t{train_losses[epoch]:.6f}\tVal\t{val_losses[epoch]:.6f}")
-        np.savez(save_dir / f"{run_name}_losses.npz", train_loss=train_losses, val_loss=val_losses)
-        if gate.save_model_query(val_losses[epoch]):
-            save_checkpoint(save_dir / f"{run_name}_model.msgpack", state, train_losses, val_losses)
+        from disco_tpu.io.atomic import savez_atomic
+
+        losses_path = savez_atomic(
+            save_dir / f"{run_name}_losses.npz",
+            train_loss=train_losses, val_loss=val_losses,
+        )
+        ckpt_path = save_dir / f"{run_name}_model.msgpack"
+        improved = gate.save_model_query(val_losses[epoch])
+        if improved:
+            save_checkpoint(ckpt_path, state, train_losses, val_losses)
+        if ledger is not None:
+            # Epoch records are state-only (artifacts=None): the losses npz
+            # and best checkpoint are SHARED mutable files that later epochs
+            # overwrite, so digesting them into each epoch's done record
+            # would falsely void every epoch but the last on resume.  The
+            # current checkpoint digest rides along as informational attrs —
+            # it is exactly the file a --weights resume restarts from.
+            from disco_tpu.io.atomic import file_digest
+
+            ledger.record(
+                unit_epoch(epoch), "done",
+                train_loss=float(train_losses[epoch]),
+                val_loss=float(val_losses[epoch]), improved=improved,
+                losses=str(losses_path),
+                ckpt=str(ckpt_path) if improved else None,
+                ckpt_digest=file_digest(ckpt_path) if improved else None,
+            )
         if gate.early_stop_query():
             break
+    if interrupted:
+        obs_events.record(
+            "note", stage="train",
+            reason="graceful stop: training wound down between epochs; "
+                   "resume with --weights on the saved checkpoint",
+        )
     return state, train_losses, val_losses, run_name

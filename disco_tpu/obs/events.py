@@ -43,6 +43,10 @@ EVENT_KINDS = frozenset(
         "fault",        # an injected or detected fault (attrs: fault, node, ...)
         "recovery",     # a retried operation succeeded (utils.resilience)
         "degraded",     # the pipeline entered degraded mode (excluded streams)
+        "run_start",    # a crash-safe run began (attrs: preflight, ledger, ...)
+        "run_resume",   # a run resumed from its ledger (attrs: done/requeued counts)
+        "interrupted",  # graceful stop requested (SIGTERM/SIGINT; runs.interrupt)
+        "warning",      # degraded input / requeued unit — visible, non-fatal
         "note",         # freeform annotation
     }
 )

@@ -1,0 +1,43 @@
+"""disco_tpu.runs — crash-safe run management for the long-haul entry points.
+
+The process-layer complement to ``disco_tpu.fault`` (which made the
+*logical* comms layer fault-tolerant in PR 2): corpus enhancement, dataset
+generation and CRNN training are hours-long batch jobs on hardware where a
+process must never be SIGKILLed (CLAUDE.md), so crashes, preemptions and
+operator stops have to be survivable by construction:
+
+* :mod:`disco_tpu.runs.ledger`    — append-only JSONL per-work-unit state
+  with **verified resume**: done entries are re-checked against their
+  artifact digests and corrupt/missing units are requeued.
+* :mod:`disco_tpu.runs.interrupt` — graceful SIGTERM/SIGINT handling:
+  finish the in-flight unit, flush ledger + obs, exit resumable.
+* :mod:`disco_tpu.runs.chaos`     — deterministic in-process crash
+  injection at named seams, driving the ``make chaos-check`` gate
+  (:mod:`disco_tpu.runs.check`): interrupt a miniature corpus run, resume
+  it, assert the artifact tree is byte-identical to an uninterrupted run.
+
+Atomic artifact writes and integrity probes live in
+:mod:`disco_tpu.io.atomic`; preflight device health lives in
+:func:`disco_tpu.utils.resilience.preflight_probe`.
+"""
+from disco_tpu.runs.chaos import ChaosCrash
+from disco_tpu.runs.interrupt import GracefulInterrupt, request_stop, stop_requested
+from disco_tpu.runs.ledger import (
+    RunLedger,
+    digest_artifacts,
+    unit_epoch,
+    unit_rir,
+    unit_scene,
+)
+
+__all__ = [
+    "ChaosCrash",
+    "GracefulInterrupt",
+    "RunLedger",
+    "digest_artifacts",
+    "request_stop",
+    "stop_requested",
+    "unit_epoch",
+    "unit_rir",
+    "unit_scene",
+]

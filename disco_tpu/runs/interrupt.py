@@ -1,0 +1,150 @@
+"""Graceful interruption: SIGTERM/SIGINT → finish the unit, flush, exit
+resumable.
+
+The environment contract (CLAUDE.md) forbids SIGKILL on a TPU-holding
+process — a killed holder wedges the remote chip claim for hours.  The
+operational consequence: the ONLY way to stop a long run is to ask it
+nicely, so stopping nicely must actually work.  :class:`GracefulInterrupt`
+is that story:
+
+* the **first** SIGTERM/SIGINT sets a flag, records an ``interrupted`` obs
+  event and returns — no exception is raised into the pipeline, so the
+  in-flight fenced dispatch drains normally and the current work unit
+  completes and persists (atomically, ``disco_tpu.io.atomic``);
+* the long-running loops (batched enhancement chunks, datagen scenes,
+  training epochs) poll :func:`stop_requested` between units and wind down:
+  flush the run ledger, record final counters, return partial results —
+  the run is then resumable with ``--resume``;
+* a **second** SIGINT raises ``KeyboardInterrupt`` — the operator insists,
+  and an in-process unwind is still contract-safe (``utils.resilience``
+  never catches it).
+
+SIGTERM matters as much as Ctrl-C: it is what schedulers and container
+runtimes send before escalating, and handling it is what keeps the
+escalation (SIGKILL) from ever happening.
+
+Handlers install only in the main thread (Python's signal rule); from
+worker threads :class:`GracefulInterrupt` degrades to a pure poll flag that
+:func:`request_stop` can set programmatically (used by tests and the chaos
+harness).
+"""
+from __future__ import annotations
+
+import contextlib
+import signal
+import threading
+
+_lock = threading.Lock()
+_active: list["GracefulInterrupt"] = []
+
+
+def stop_requested() -> bool:
+    """True once a graceful stop was requested anywhere in the process.
+    The poll the long-running loops call between work units; False when no
+    :class:`GracefulInterrupt` scope is active.  Polling also flushes any
+    telemetry a signal handler deferred (see :meth:`GracefulInterrupt.
+    _flush_telemetry`)."""
+    with _lock:
+        scopes = list(_active)
+    for g in scopes:
+        g._flush_telemetry()
+    return any(g.stopped for g in scopes)
+
+
+def request_stop(reason: str = "programmatic") -> bool:
+    """Programmatically request a graceful stop on the innermost active
+    scope (tests, chaos harness, in-process embedders).  Returns False when
+    no scope is active."""
+    with _lock:
+        if not _active:
+            return False
+        scope = _active[-1]
+    scope._trip(reason)
+    return True
+
+
+class GracefulInterrupt(contextlib.AbstractContextManager):
+    """Scoped SIGTERM/SIGINT handler implementing the drain-and-exit
+    protocol.
+
+    >>> with GracefulInterrupt() as stop:
+    ...     for unit in work:
+    ...         if stop():          # or runs.interrupt.stop_requested()
+    ...             break           # ledger flushed by the caller; resumable
+    ...         process(unit)
+
+    ``as``-binds a zero-argument callable returning the stop flag, so deep
+    call sites can also poll the module-level :func:`stop_requested`.
+    """
+
+    def __init__(self, signals=(signal.SIGINT, signal.SIGTERM)):
+        self.signals = tuple(signals)
+        self.stopped = False
+        self.reason: str | None = None
+        self._prev: dict[int, object] = {}
+        self._installed = False
+        self._sigint_count = 0
+        self._telemetry_pending = False
+        self._telemetry_sent = False
+
+    # -- signal plumbing ----------------------------------------------------
+    def _trip(self, reason: str, in_signal_handler: bool = False) -> None:
+        self.stopped = True
+        self.reason = self.reason or reason
+        if in_signal_handler:
+            # A signal handler runs on the main thread at an arbitrary
+            # bytecode boundary — possibly INSIDE obs's non-reentrant locks
+            # (Recorder._lock, Counter._lock).  Emitting telemetry here
+            # could self-deadlock the interrupted frame, so only flag it;
+            # the next stop_requested() poll (normal code) emits.
+            self._telemetry_pending = True
+        else:
+            self._flush_telemetry()
+
+    def _flush_telemetry(self) -> None:
+        if not self.stopped or self._telemetry_sent:
+            return
+        self._telemetry_sent = True
+        self._telemetry_pending = False
+        from disco_tpu.obs import events as _events
+        from disco_tpu.obs.metrics import REGISTRY as _REGISTRY
+
+        _REGISTRY.counter("interrupts").inc()
+        _events.record("interrupted", reason=self.reason)
+
+    def _handler(self, signum, frame):
+        name = signal.Signals(signum).name
+        if signum == signal.SIGINT:
+            self._sigint_count += 1
+            if self._sigint_count >= 2:
+                # the operator insists: in-process unwind (contract-safe —
+                # never SIGKILL; resilience never catches KeyboardInterrupt)
+                raise KeyboardInterrupt(f"second {name}")
+        self._trip(name, in_signal_handler=True)
+
+    # -- context protocol ---------------------------------------------------
+    def __enter__(self):
+        if threading.current_thread() is threading.main_thread():
+            for sig in self.signals:
+                self._prev[sig] = signal.getsignal(sig)
+                signal.signal(sig, self._handler)
+            self._installed = True
+        with _lock:
+            _active.append(self)
+
+        def stopped():
+            self._flush_telemetry()
+            return self.stopped
+
+        return stopped
+
+    def __exit__(self, *exc):
+        with _lock:
+            with contextlib.suppress(ValueError):
+                _active.remove(self)
+        if self._installed:
+            for sig, prev in self._prev.items():
+                signal.signal(sig, prev)
+            self._installed = False
+        self._flush_telemetry()  # a trip no poll observed still records
+        return False

@@ -189,3 +189,58 @@ def resilient_to_device(x, **retry_opts):
     retry_opts.setdefault("label", "to_device")
     retry_opts.setdefault("retry_on", TRANSPORT_ERRORS)
     return call_with_retries(to_device, x, **retry_opts)
+
+
+class PreflightFailed(RuntimeError):
+    """The preflight device health probe could not complete a fenced
+    round-trip inside its deadline."""
+
+
+def preflight_probe(deadline_s: float = 60.0, retries: int = 2) -> dict:
+    """Bounded-deadline device health probe for long runs.
+
+    A corpus sweep or training run claims the tunneled chip at its first
+    jax use and then holds it for hours — if the attachment is wedged (a
+    prior holder was killed, the claim RPC hangs), the run discovers it
+    only after loading data, tracing programs and burning its own wall
+    budget.  The preflight pays one tiny fenced dispatch UP FRONT, under
+    :func:`resilient_fence`'s bounded retry and an overall ``deadline_s``,
+    so a sick attachment fails in seconds with a clean error instead.
+
+    Returns ``{"ok": True, "dur_s": ..., "platform": ..., "device_count":
+    ...}`` on success (the payload of the ``run_start`` obs event); raises
+    :class:`PreflightFailed` (chaining the underlying transport error) when
+    the round-trip cannot complete — the caller should NOT start the run.
+    """
+    t0 = time.monotonic()
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        # 1 + 1 through the device: the readback value doubles as a sanity
+        # check that the fence actually executed the dispatch.
+        val = resilient_fence(
+            jnp.ones((1,), jnp.float32) + 1.0,
+            retries=retries, deadline_s=deadline_s,
+        )
+        if val != 2.0:
+            raise PreflightFailed(
+                f"preflight readback returned {val!r}, expected 2.0 — the "
+                f"attachment is returning garbage; do not start the run"
+            )
+        devs = jax.devices()
+        return {
+            "ok": True,
+            "dur_s": round(time.monotonic() - t0, 6),
+            "platform": devs[0].platform,
+            "device_count": len(devs),
+            "device_kind": devs[0].device_kind,
+        }
+    except PreflightFailed:
+        raise
+    except Exception as e:
+        raise PreflightFailed(
+            f"preflight fenced dispatch failed within {deadline_s}s: {e!r} — "
+            f"the device attachment is not healthy; refusing to start the "
+            f"long run (recover the claim first, never SIGKILL the holder)"
+        ) from e

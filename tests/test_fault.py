@@ -568,6 +568,65 @@ def test_transport_errors_narrow_the_wired_seams():
     assert ConnectionError in TRANSPORT_ERRORS and TimeoutError in TRANSPORT_ERRORS
 
 
+def test_call_with_retries_zero_retries_single_attempt():
+    """retries=0 means exactly ONE attempt: success passes through, failure
+    raises immediately with no backoff sleep and a giveup tick."""
+    from disco_tpu.obs.metrics import REGISTRY
+    from disco_tpu.utils.resilience import call_with_retries
+
+    assert call_with_retries(lambda: 7, retries=0) == 7
+
+    calls = {"n": 0}
+
+    def fails():
+        calls["n"] += 1
+        raise ConnectionError("one shot")
+
+    def no_sleep(_):
+        raise AssertionError("retries=0 must never back off")
+
+    before = REGISTRY.counter("retry_giveups").value
+    with pytest.raises(ConnectionError, match="one shot"):
+        call_with_retries(fails, retries=0, sleep=no_sleep)
+    assert calls["n"] == 1
+    assert REGISTRY.counter("retry_giveups").value == before + 1
+
+
+def test_call_with_retries_negative_retries_rejected():
+    from disco_tpu.utils.resilience import call_with_retries
+
+    with pytest.raises(ValueError, match="retries must be >= 0"):
+        call_with_retries(lambda: 1, retries=-1)
+
+
+def test_deadline_expires_mid_backoff():
+    """The budget runs out BETWEEN attempts: earlier backoffs complete, the
+    sleep that would cross the deadline is never taken, and the raised
+    DeadlineExceeded chains the last underlying error."""
+    from disco_tpu.obs.metrics import REGISTRY
+    from disco_tpu.utils.resilience import DeadlineExceeded, call_with_retries
+
+    calls = {"n": 0}
+
+    def always_fails():
+        calls["n"] += 1
+        raise OSError(f"down {calls['n']}")
+
+    slept = []
+    before = REGISTRY.counter("retry_giveups").value
+    # delays would be 0.05, 0.10, 0.20; with ~0 elapsed wall time the 0.20
+    # sleep is the first to cross deadline_s=0.12 — two backoffs happen,
+    # the third is refused
+    with pytest.raises(DeadlineExceeded, match="3 failed attempt") as ei:
+        call_with_retries(always_fails, retries=100, base_delay_s=0.05,
+                          backoff=2.0, max_delay_s=10.0, deadline_s=0.12,
+                          sleep=slept.append)
+    assert slept == [0.05, 0.10]
+    assert calls["n"] == 3  # the refused sleep also ends the attempts
+    assert isinstance(ei.value.__cause__, OSError)
+    assert REGISTRY.counter("retry_giveups").value == before + 1
+
+
 # -- tunnel transfer guard ---------------------------------------------------
 def test_guard_tunnel_complex(monkeypatch):
     from disco_tpu.utils import transfer

@@ -137,11 +137,21 @@ def test_emitted_log_conforms_to_schema(tmp_path):
 
         call_with_retries(flaky, retries=1, base_delay_s=0.0, sleep=lambda _: None)
         obs.record("degraded", stage="mwf", mode="offline", nodes=[0])
+        # the crash-safe runs producers (disco_tpu.runs)
+        obs.record("run_start", stage="enhance", tool="test",
+                   preflight={"ok": True, "dur_s": 0.01})
+        obs.record("run_resume", stage="enhance", n_done=1, n_requeued=0)
+        from disco_tpu.runs import GracefulInterrupt, request_stop
+
+        with GracefulInterrupt():
+            request_stop("schema-test")  # emits "interrupted"
+        obs.record("warning", stage="load_input", reason="schema-test")
         obs.record("counters", **obs.REGISTRY.snapshot())
     events = obs.read_events(log, validate=True)  # raises on any drift
     assert {e["kind"] for e in events} == {
         "manifest", "stage_end", "jit_trace", "sentinel", "clip", "epoch",
-        "watchdog", "bench_result", "fault", "recovery", "degraded", "counters",
+        "watchdog", "bench_result", "fault", "recovery", "degraded",
+        "run_start", "run_resume", "interrupted", "warning", "counters",
     }
 
 

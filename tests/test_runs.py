@@ -1,0 +1,616 @@
+"""Crash-safe runs layer tests: atomic artifact I/O + integrity probes
+(disco_tpu.io.atomic), the run ledger with verified resume
+(disco_tpu.runs.ledger), graceful interruption (disco_tpu.runs.interrupt),
+deterministic chaos injection (disco_tpu.runs.chaos), the preflight health
+probe (utils.resilience), and the interrupt-and-resume integration of the
+corpus driver and the training loop (slow-marked; `make chaos-check` runs
+the full byte-identical-tree gate)."""
+import os
+import pickle
+import signal
+
+import numpy as np
+import pytest
+
+from disco_tpu.io import atomic
+from disco_tpu.io.audio import read_wav
+from disco_tpu.runs import (
+    ChaosCrash,
+    GracefulInterrupt,
+    RunLedger,
+    chaos,
+    request_stop,
+    stop_requested,
+    unit_rir,
+)
+
+
+@pytest.fixture(autouse=True)
+def _chaos_off():
+    """Every test starts and ends with chaos disarmed and no stale stop."""
+    chaos.disable()
+    yield
+    chaos.disable()
+
+
+# -- atomic writers ---------------------------------------------------------
+def test_atomic_write_success_and_crash(tmp_path):
+    p = tmp_path / "x.bin"
+    atomic.write_bytes_atomic(p, b"payload")
+    assert p.read_bytes() == b"payload"
+    assert not list(tmp_path.glob(f"*{atomic.TMP_SUFFIX}.*"))
+
+    # a crash inside the write (any exception) leaves the OLD content and
+    # no temp litter — the invariant every resume probe relies on
+    with pytest.raises(RuntimeError):
+        with atomic.atomic_write(p) as fh:
+            fh.write(b"half-writ")
+            raise RuntimeError("simulated crash")
+    assert p.read_bytes() == b"payload"
+    assert not list(tmp_path.glob(f"*{atomic.TMP_SUFFIX}.*"))
+
+
+def test_atomic_write_mid_write_chaos_leaves_no_final_file(tmp_path):
+    chaos.configure("mid_write", after=1)
+    with pytest.raises(ChaosCrash):
+        atomic.write_bytes_atomic(tmp_path / "never.bin", b"x")
+    chaos.disable()
+    assert not (tmp_path / "never.bin").exists()
+    assert not list(tmp_path.glob(f"*{atomic.TMP_SUFFIX}.*"))
+
+
+def test_write_wav_atomic_roundtrip(tmp_path):
+    x = np.linspace(-0.5, 0.5, 321).astype(np.float32)
+    p = atomic.write_wav_atomic(tmp_path / "a.wav", x, 16000)
+    y, fs = read_wav(p)
+    assert fs == 16000
+    np.testing.assert_array_equal(x, y)
+
+
+def test_save_npy_atomic_matches_np_save_suffix(tmp_path):
+    # np.save("foo") writes foo.npy; the atomic twin must agree so layout
+    # paths stay byte-compatible with the pre-atomic tree
+    p = atomic.save_npy_atomic(tmp_path / "m", np.arange(6).reshape(2, 3))
+    assert p == tmp_path / "m.npy"
+    np.testing.assert_array_equal(np.load(p), np.arange(6).reshape(2, 3))
+
+
+def test_savez_and_pickle_atomic(tmp_path):
+    z = atomic.savez_atomic(tmp_path / "h", a=np.ones(4), b=np.zeros(2))
+    with np.load(z) as d:
+        np.testing.assert_array_equal(d["a"], np.ones(4))
+    p = atomic.dump_pickle_atomic(tmp_path / "r.p", {"k": np.arange(3)})
+    with open(p, "rb") as fh:
+        np.testing.assert_array_equal(pickle.load(fh)["k"], np.arange(3))
+
+
+# -- integrity probes -------------------------------------------------------
+def _truncate(path, frac=0.5):
+    data = path.read_bytes()
+    path.write_bytes(data[: int(len(data) * frac)])
+    return path
+
+
+@pytest.mark.parametrize("make,probe", [
+    (lambda d: atomic.write_wav_atomic(d / "a.wav", np.zeros(100, np.float32), 16000),
+     atomic.probe_wav),
+    (lambda d: atomic.save_npy_atomic(d / "b.npy", np.arange(100.0)),
+     atomic.probe_npy),
+    (lambda d: atomic.savez_atomic(d / "c.npz", x=np.arange(100.0)),
+     atomic.probe_npz),
+    (lambda d: atomic.dump_pickle_atomic(d / "d.p", {"x": list(range(100))}),
+     atomic.probe_pickle),
+])
+def test_probes_pass_complete_fail_truncated(tmp_path, make, probe):
+    p = make(tmp_path)
+    assert probe(p)
+    assert atomic.probe_artifact(p)
+    _truncate(p)
+    assert not probe(p)
+    assert not atomic.probe_artifact(p)
+
+
+def test_probe_msgpack(tmp_path):
+    from flax import serialization
+
+    p = tmp_path / "ck.msgpack"
+    atomic.write_bytes_atomic(p, serialization.to_bytes({"w": np.ones((4, 4))}))
+    assert atomic.probe_msgpack(p)
+    _truncate(p)
+    assert not atomic.probe_msgpack(p)
+
+
+def test_probe_npy_object_array(tmp_path):
+    # the datagen infos files are object arrays (allow_pickle) — the probe
+    # must fall back to a full load and still catch truncation
+    p = atomic.save_npy_atomic(
+        tmp_path / "infos.npy", {"room": {"rt60": 0.3}, "mics": np.ones((3, 8))},
+        allow_pickle=True,
+    )
+    assert atomic.probe_npy(p)
+    _truncate(p)
+    assert not atomic.probe_npy(p)
+
+
+def test_probe_artifact_missing_and_unknown_suffix(tmp_path):
+    assert not atomic.probe_artifact(tmp_path / "ghost.wav")
+    unknown = tmp_path / "x.bin"
+    unknown.write_bytes(b"data")
+    assert atomic.probe_artifact(unknown)          # non-empty fallback
+    unknown.write_bytes(b"")
+    assert not atomic.probe_artifact(unknown)      # empty is never done
+
+
+def test_remove_tmp_litter(tmp_path):
+    sub = tmp_path / "sub"
+    sub.mkdir()
+    litter = sub / f"a.wav{atomic.TMP_SUFFIX}.12345"
+    litter.write_bytes(b"partial")
+    keep = sub / "a.wav"
+    keep.write_bytes(b"done")
+    not_ours = sub / "b.tmp.notapid"  # pid field not numeric: leave alone
+    not_ours.write_bytes(b"?")
+    removed = atomic.remove_tmp_litter(tmp_path)
+    assert removed == [str(litter)]
+    assert keep.exists() and not_ours.exists() and not litter.exists()
+    assert atomic.remove_tmp_litter(tmp_path / "missing") == []
+
+
+def test_file_digest_verify(tmp_path):
+    p = tmp_path / "a.txt"
+    p.write_bytes(b"abc")
+    d = atomic.file_digest(p)
+    assert d.startswith("sha256:") and atomic.verify_digest(p, d)
+    p.write_bytes(b"abd")
+    assert not atomic.verify_digest(p, d)
+    assert not atomic.verify_digest(tmp_path / "missing", d)
+
+
+# -- run ledger -------------------------------------------------------------
+def test_ledger_lifecycle_and_verified_resume(tmp_path):
+    art = atomic.save_npy_atomic(tmp_path / "out.npy", np.arange(8.0))
+    led = RunLedger(tmp_path / "led.jsonl")
+    u = unit_rir(3, "ssn")
+    led.mark_in_flight(u, bucket=8192)
+    assert led.replay()[u]["state"] == "in_flight"
+    led.mark_done(u, [art])
+    done, requeued = led.verified_done()
+    assert done == {u} and requeued == {}
+
+    # corrupt the artifact: the done claim must be voided and requeued
+    _truncate(art)
+    done, requeued = led.verified_done()
+    assert done == set() and u in requeued
+    assert "digest mismatch" in requeued[u]
+    assert led.replay()[u]["state"] == "requeued"
+
+    # regenerating the artifact and re-marking done re-verifies
+    atomic.save_npy_atomic(tmp_path / "out.npy", np.arange(8.0))
+    led.mark_done(u, [art])
+    done, _ = led.verified_done()
+    assert done == {u}
+
+
+def test_ledger_missing_artifact_requeues(tmp_path):
+    art = tmp_path / "gone.npy"
+    atomic.save_npy_atomic(art, np.zeros(3))
+    led = RunLedger(tmp_path / "led.jsonl")
+    led.mark_done("scene:1", [art])
+    art.unlink()
+    done, requeued = led.verified_done()
+    assert done == set() and "missing" in requeued["scene:1"]
+
+
+def test_ledger_torn_final_line_is_skipped(tmp_path):
+    led = RunLedger(tmp_path / "led.jsonl")
+    led.mark_done("a", [])
+    led.close()
+    with open(tmp_path / "led.jsonl", "a") as fh:
+        fh.write('{"t": 1, "unit": "b", "state": "do')  # crash mid-append
+    state = RunLedger(tmp_path / "led.jsonl").replay()
+    assert set(state) == {"a"}  # the torn line never poisons the history
+
+
+def test_ledger_rejects_unknown_state(tmp_path):
+    with pytest.raises(ValueError, match="unknown ledger state"):
+        RunLedger(tmp_path / "led.jsonl").record("u", "finished")
+
+
+def test_ledger_requeue_emits_warning_event_and_counter(tmp_path):
+    from disco_tpu import obs
+    from disco_tpu.obs.metrics import REGISTRY
+
+    art = atomic.save_npy_atomic(tmp_path / "x.npy", np.ones(4))
+    led = RunLedger(tmp_path / "led.jsonl")
+    led.mark_done("u1", [art])
+    _truncate(art)
+    before = REGISTRY.counter("units_requeued").value
+    log = tmp_path / "obs.jsonl"
+    with obs.recording(log):
+        led.verified_done()
+    assert REGISTRY.counter("units_requeued").value == before + 1
+    warns = [e for e in obs.read_events(log) if e["kind"] == "warning"]
+    assert warns and warns[0]["stage"] == "resume"
+    assert warns[0]["attrs"]["unit"] == "u1"
+
+
+# -- chaos ------------------------------------------------------------------
+def test_chaos_fires_at_nth_hit_only():
+    chaos.configure("seam_x", after=3)
+    chaos.tick("seam_x")
+    chaos.tick("seam_other")  # different seam never counts
+    chaos.tick("seam_x")
+    with pytest.raises(ChaosCrash) as ei:
+        chaos.tick("seam_x")
+    assert ei.value.seam == "seam_x" and ei.value.hit == 3
+    chaos.tick("seam_x")  # after the crash fired, the seam is spent
+
+
+def test_chaos_env_configuration(monkeypatch):
+    chaos._reset_for_tests()
+    monkeypatch.setenv(chaos.ENV_VAR, "env_seam:2")
+    chaos.tick("env_seam")
+    with pytest.raises(ChaosCrash):
+        chaos.tick("env_seam")
+    chaos.disable()
+
+
+def test_chaos_crash_passes_except_exception():
+    # ChaosCrash must behave like a process death: not catchable by the
+    # pipeline's own `except Exception` recovery
+    chaos.configure("s", after=1)
+    with pytest.raises(ChaosCrash):
+        try:
+            chaos.tick("s")
+        except Exception:  # noqa: BLE001 - the point of the test
+            pytest.fail("ChaosCrash was swallowed by `except Exception`")
+
+
+# -- graceful interruption --------------------------------------------------
+def test_graceful_interrupt_sigterm_sets_flag_only():
+    with GracefulInterrupt() as stopped:
+        assert not stopped()
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert stopped() and stop_requested()
+        os.kill(os.getpid(), signal.SIGTERM)  # repeated SIGTERM stays graceful
+        assert stopped()
+    assert not stop_requested()  # scope exit clears the process-wide view
+
+
+def test_graceful_interrupt_second_sigint_raises():
+    with pytest.raises(KeyboardInterrupt):
+        with GracefulInterrupt():
+            os.kill(os.getpid(), signal.SIGINT)   # first: graceful
+            assert stop_requested()
+            os.kill(os.getpid(), signal.SIGINT)   # second: operator insists
+
+
+def test_signal_telemetry_deferred_until_poll(tmp_path):
+    """A signal handler must not touch obs's non-reentrant locks (it could
+    interrupt a frame holding them): the handler only flags, and the next
+    stop_requested()/stopped() poll emits the `interrupted` event."""
+    from disco_tpu import obs
+
+    log = tmp_path / "o.jsonl"
+    with obs.recording(log):
+        with GracefulInterrupt() as stopped:
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert not [e for e in obs.read_events(log)
+                        if e["kind"] == "interrupted"]  # nothing from the handler
+            assert stopped()  # the poll flushes the deferred telemetry
+            evs = [e for e in obs.read_events(log) if e["kind"] == "interrupted"]
+            assert len(evs) == 1 and evs[0]["attrs"]["reason"] == "SIGTERM"
+
+
+def test_ledger_digest_tolerates_missing_secondary_artifacts(tmp_path):
+    """digest_artifacts omits already-missing paths (the catch-up path runs
+    on trees whose secondary artifacts were cleaned up) instead of raising."""
+    from disco_tpu.runs import digest_artifacts
+
+    present = atomic.save_npy_atomic(tmp_path / "kept.npy", np.ones(3))
+    d = digest_artifacts([present, tmp_path / "cleaned_up.wav"])
+    assert set(d) == {str(present)}
+
+
+def test_request_stop_without_scope_is_false():
+    assert not request_stop("nobody listening")
+    assert not stop_requested()
+
+
+def test_interrupt_records_event_and_counter(tmp_path):
+    from disco_tpu import obs
+    from disco_tpu.obs.metrics import REGISTRY
+
+    before = REGISTRY.counter("interrupts").value
+    log = tmp_path / "obs.jsonl"
+    with obs.recording(log):
+        with GracefulInterrupt():
+            request_stop("test")
+            request_stop("test-again")  # only the first transition records
+    assert REGISTRY.counter("interrupts").value == before + 1
+    evs = [e for e in obs.read_events(log) if e["kind"] == "interrupted"]
+    assert len(evs) == 1 and evs[0]["attrs"]["reason"] == "test"
+
+
+# -- preflight --------------------------------------------------------------
+def test_preflight_probe_ok_on_cpu():
+    from disco_tpu.utils.resilience import preflight_probe
+
+    out = preflight_probe(deadline_s=30.0)
+    assert out["ok"] and out["device_count"] >= 1 and out["dur_s"] >= 0
+
+
+def test_preflight_probe_failure_is_clean(monkeypatch):
+    from disco_tpu.utils import resilience
+
+    def broken_fence(x, **kw):
+        raise OSError("tunnel down")
+
+    monkeypatch.setattr(resilience, "resilient_fence", broken_fence)
+    with pytest.raises(resilience.PreflightFailed, match="never SIGKILL"):
+        resilience.preflight_probe(deadline_s=0.5)
+
+
+# -- driver integration -----------------------------------------------------
+from tests.test_driver import NOISE, RIR, SNR_RANGE, _build_corpus  # noqa: E402
+
+
+def test_corrupt_oim_pickle_is_reenhanced_not_skipped(tmp_path):
+    """Satellite: the idempotency guards must validate before skipping —
+    a truncated OIM pickle (crashed pre-atomic run) is re-enhanced."""
+    from disco_tpu.enhance.driver import enhance_rir
+    from disco_tpu.obs.metrics import REGISTRY
+
+    corpus = _build_corpus(tmp_path / "dataset", [RIR])
+    out_root = tmp_path / "results"
+    assert enhance_rir(str(corpus), "living", RIR, NOISE, snr_range=SNR_RANGE,
+                       out_root=str(out_root), save_fig=False) is not None
+    # intact artifacts: the validated skip returns None exactly as before
+    assert enhance_rir(str(corpus), "living", RIR, NOISE, snr_range=SNR_RANGE,
+                       out_root=str(out_root), save_fig=False) is None
+
+    victim = out_root / "OIM" / f"results_mwf_{RIR}_{NOISE}.p"
+    _truncate(victim)
+    before = REGISTRY.counter("corrupt_artifacts_detected").value
+    redo = enhance_rir(str(corpus), "living", RIR, NOISE, snr_range=SNR_RANGE,
+                       out_root=str(out_root), save_fig=False)
+    assert redo is not None  # requeued, never trusted
+    assert REGISTRY.counter("corrupt_artifacts_detected").value > before
+    with open(victim, "rb") as fh:
+        assert pickle.load(fh)  # regenerated complete
+
+
+def test_missing_snr_sidecar_warns(tmp_path):
+    """Satellite: the zeros substitution for a missing SNR sidecar is
+    visible — warning event + counter, not silent."""
+    from disco_tpu import obs
+    from disco_tpu.enhance.driver import load_input_signals
+    from disco_tpu.io.layout import DatasetLayout
+    from disco_tpu.obs.metrics import REGISTRY
+
+    corpus = _build_corpus(tmp_path / "dataset", [RIR])
+    layout = DatasetLayout(str(corpus), "living", "test")
+    layout.snr_log(SNR_RANGE, RIR, NOISE).unlink()
+    before = REGISTRY.counter("snr_sidecar_missing").value
+    log = tmp_path / "obs.jsonl"
+    with obs.recording(log):
+        *_, rnd_snrs = load_input_signals(layout, RIR, NOISE, SNR_RANGE)
+    np.testing.assert_array_equal(rnd_snrs, np.zeros(4))
+    assert REGISTRY.counter("snr_sidecar_missing").value == before + 1
+    warns = [e for e in obs.read_events(log) if e["kind"] == "warning"]
+    assert warns and warns[0]["stage"] == "load_input"
+    assert "SNR sidecar" in warns[0]["attrs"]["reason"]
+
+
+@pytest.mark.slow
+def test_batched_interrupt_then_resume_identical_tree(tmp_path, monkeypatch):
+    """Interrupt-and-resume integration: a graceful stop between chunks
+    returns partial results with the ledger consistent; the resumed run
+    completes to a tree byte-identical to an uninterrupted one."""
+    from disco_tpu.enhance.driver import enhance_rirs_batched
+
+    rirs = [RIR, RIR + 1]
+    corpus = _build_corpus(tmp_path / "dataset", rirs)
+    kw = dict(snr_range=SNR_RANGE, save_fig=False, max_batch=1, score_workers=1)
+
+    ref_root = tmp_path / "ref"
+    ref = enhance_rirs_batched(str(corpus), "living", rirs, NOISE,
+                               out_root=str(ref_root), **kw)
+    assert set(ref) == set(rirs)
+
+    # deterministic mid-run stop: first chunk proceeds, second sees a stop
+    from disco_tpu.enhance import driver as driver_mod
+
+    calls = {"n": 0}
+
+    def fake_stop():
+        calls["n"] += 1
+        return calls["n"] > 1
+
+    monkeypatch.setattr(driver_mod.run_interrupt, "stop_requested", fake_stop)
+    out_root, led = tmp_path / "out", tmp_path / "led.jsonl"
+    partial = enhance_rirs_batched(str(corpus), "living", rirs, NOISE,
+                                   out_root=str(out_root), ledger=str(led), **kw)
+    monkeypatch.undo()
+    assert len(partial) == 1  # wound down after one chunk
+
+    done, requeued = RunLedger(led).verified_done()
+    assert len(done) == 1 and not requeued  # the finished clip is verified
+
+    resumed = enhance_rirs_batched(str(corpus), "living", rirs, NOISE,
+                                   out_root=str(out_root), ledger=str(led),
+                                   resume=True, **kw)
+    assert set(partial) | set(resumed) == set(rirs)
+
+    ref_tree = {p.relative_to(ref_root): p.read_bytes()
+                for p in sorted(ref_root.rglob("*")) if p.is_file()}
+    out_tree = {p.relative_to(out_root): p.read_bytes()
+                for p in sorted(out_root.rglob("*")) if p.is_file()}
+    assert set(ref_tree) == set(out_tree)
+    assert all(ref_tree[k] == out_tree[k] for k in ref_tree)
+
+
+@pytest.mark.slow
+def test_batched_chaos_crash_then_resume(tmp_path):
+    """Crash (not graceful stop) inside the run: the between_clips chaos
+    crash aborts mid-corpus; --resume completes the remainder and the tree
+    matches the uninterrupted run."""
+    from disco_tpu.enhance.driver import enhance_rirs_batched
+
+    rirs = [RIR, RIR + 1]
+    corpus = _build_corpus(tmp_path / "dataset", rirs)
+    kw = dict(snr_range=SNR_RANGE, save_fig=False, max_batch=1, score_workers=1)
+
+    ref_root = tmp_path / "ref"
+    enhance_rirs_batched(str(corpus), "living", rirs, NOISE,
+                         out_root=str(ref_root), **kw)
+
+    out_root, led = tmp_path / "out", tmp_path / "led.jsonl"
+    chaos.configure("between_clips", after=1)
+    with pytest.raises(ChaosCrash):
+        enhance_rirs_batched(str(corpus), "living", rirs, NOISE,
+                             out_root=str(out_root), ledger=str(led), **kw)
+    chaos.disable()
+
+    resumed = enhance_rirs_batched(str(corpus), "living", rirs, NOISE,
+                                   out_root=str(out_root), ledger=str(led),
+                                   resume=True, **kw)
+    assert resumed  # at least the crashed remainder was processed
+    ref_tree = {p.relative_to(ref_root): p.read_bytes()
+                for p in sorted(ref_root.rglob("*")) if p.is_file()}
+    out_tree = {p.relative_to(out_root): p.read_bytes()
+                for p in sorted(out_root.rglob("*")) if p.is_file()}
+    assert ref_tree == out_tree
+
+
+@pytest.mark.slow
+def test_digest_requeued_unit_bypasses_pickle_probe(tmp_path):
+    """A deleted secondary artifact (WAV) does not show in the pickle-only
+    _clip_done probe — but a unit the verified resume requeued must be
+    REDONE, not re-certified by the catch-up path."""
+    from disco_tpu.enhance.driver import enhance_rirs_batched
+
+    corpus = _build_corpus(tmp_path / "dataset", [RIR])
+    out_root, led = tmp_path / "out", tmp_path / "led.jsonl"
+    kw = dict(snr_range=SNR_RANGE, save_fig=False, max_batch=1, score_workers=1)
+    first = enhance_rirs_batched(str(corpus), "living", [RIR], NOISE,
+                                 out_root=str(out_root), ledger=str(led), **kw)
+    assert set(first) == {RIR}
+
+    # a plain rerun with the ledger (no resume) trusts its done records:
+    # nothing re-enhanced, no re-hash, no duplicate catch-up lines appended
+    n_lines = len(led.read_text().splitlines())
+    again = enhance_rirs_batched(str(corpus), "living", [RIR], NOISE,
+                                 out_root=str(out_root), ledger=str(led), **kw)
+    assert again == {} and len(led.read_text().splitlines()) == n_lines
+
+    victim = out_root / "WAV" / str(RIR) / f"in_noi-{NOISE}_Node-2.wav"
+    victim.unlink()
+    resumed = enhance_rirs_batched(str(corpus), "living", [RIR], NOISE,
+                                   out_root=str(out_root), ledger=str(led),
+                                   resume=True, **kw)
+    assert set(resumed) == {RIR}   # requeued AND actually re-enhanced
+    assert atomic.probe_wav(victim)  # the deleted artifact is back
+    done, requeued = RunLedger(led).verified_done()
+    assert done == {unit_rir(RIR, NOISE)} and not requeued
+
+
+# -- training integration ---------------------------------------------------
+def _tiny_fit_setup(tmp_path):
+    from disco_tpu.nn import RandomDataset, batch_iterator, create_train_state
+    from tests.test_nn import _tiny_model
+
+    model, tx = _tiny_model()
+    ds = RandomDataset((21, 33), (33, 21), length=12, rng=np.random.default_rng(0))
+
+    def batches():
+        for x, y in batch_iterator(ds, 6, rng=np.random.default_rng(1)):
+            yield x, np.swapaxes(y, -2, -1)
+
+    state = create_train_state(model, tx, next(batches())[0])
+    return model, state, batches
+
+
+def test_load_checkpoint_corrupt_raises_clean_error(tmp_path):
+    """Satellite: a truncated/corrupt checkpoint is a CheckpointError
+    naming the path, not an opaque msgpack traceback."""
+    from disco_tpu.nn import CheckpointError, load_checkpoint, save_checkpoint
+
+    model, state, batches = _tiny_fit_setup(tmp_path)
+    ck = tmp_path / "ck.msgpack"
+    save_checkpoint(ck, state, np.zeros(2), np.zeros(2))
+    _truncate(ck)
+    with pytest.raises(CheckpointError, match=str(ck)):
+        load_checkpoint(ck, state)
+    with pytest.raises(CheckpointError, match="cannot read"):
+        load_checkpoint(tmp_path / "missing.msgpack", state)
+
+
+def test_cli_train_corrupt_weights_clean_exit(tmp_path, monkeypatch):
+    """Satellite: `disco-train --weights <corrupt>` fails with the clean
+    CheckpointError message, not a traceback."""
+    from disco_tpu.cli import train as train_cli
+
+    bad = tmp_path / "bad_model.msgpack"
+    bad.write_bytes(b"\x00\x01 not msgpack")
+
+    def fake_run(args):
+        # reproduce just the resume entry the full _run would hit, without
+        # needing a corpus on disk
+        from disco_tpu.nn.training import load_checkpoint
+
+        _, state, _ = _tiny_fit_setup(tmp_path)
+        load_checkpoint(args.weights, state)
+
+    monkeypatch.setattr(train_cli, "_run", fake_run)
+    with pytest.raises(SystemExit) as ei:
+        train_cli.main(["--weights", str(bad)])
+    assert "corrupt or incompatible" in str(ei.value) and str(bad) in str(ei.value)
+    assert not isinstance(ei.value.code, int)  # carries the message, not a code
+
+
+@pytest.mark.slow
+def test_fit_ledger_and_graceful_stop(tmp_path):
+    """Training epochs land in the ledger (state-only records carrying the
+    checkpoint digest as attrs — the shared losses/ckpt files are mutable,
+    so they are NOT per-epoch verified artifacts); a stop requested during
+    epoch 0 winds down before epoch 1 and stays resumable."""
+    from disco_tpu.nn import fit
+
+    model, state, batches = _tiny_fit_setup(tmp_path)
+    led = tmp_path / "led.jsonl"
+    state, tr, va, name = fit(model, state, batches, batches, n_epochs=2,
+                              save_path=tmp_path, verbose=False, ledger=str(led))
+    done, requeued = RunLedger(led).verified_done()
+    assert done == {"epoch:0", "epoch:1"} and not requeued
+    recs = RunLedger(led).replay()
+    assert recs["epoch:0"]["attrs"]["improved"]
+    assert recs["epoch:0"]["attrs"]["ckpt_digest"].startswith("sha256:")
+    # the LAST improved epoch's digest matches the checkpoint on disk — the
+    # exact file a --weights resume restarts from
+    last_improved = max(
+        (r for r in recs.values() if r["attrs"].get("improved")),
+        key=lambda r: r["t"],
+    )
+    assert atomic.verify_digest(tmp_path / f"{name}_model.msgpack",
+                                last_improved["attrs"]["ckpt_digest"])
+
+    # graceful stop: epoch 0 of a fresh run completes, epoch 1 never starts
+    model2, state2, batches2 = _tiny_fit_setup(tmp_path)
+    calls = {"n": 0}
+
+    def stop_after_first():
+        calls["n"] += 1
+        return calls["n"] > 1  # first poll (epoch 0): run; second: stop
+
+    import disco_tpu.runs.interrupt as ri
+
+    real = ri.stop_requested
+    ri.stop_requested = stop_after_first
+    try:
+        _, tr2, _, name2 = fit(model2, state2, batches2, batches2, n_epochs=3,
+                               save_path=tmp_path / "g", verbose=False)
+    finally:
+        ri.stop_requested = real
+    assert np.count_nonzero(tr2) == 1  # one epoch ran, then wound down
+    assert (tmp_path / "g" / f"{name2}_model.msgpack").exists()  # resumable
