@@ -3,9 +3,9 @@
 
 PYTHON ?= python
 
-.PHONY: test coverage doc install native clean bench milestone-corpus dryrun obs-check fault-check chaos-check
+.PHONY: test coverage doc install native clean bench milestone-corpus dryrun obs-check fault-check chaos-check perf-check
 
-test: obs-check fault-check chaos-check
+test: obs-check fault-check chaos-check perf-check
 	$(PYTHON) -m pytest tests/ -q
 
 # Telemetry gates (run before the suite so drift fails fast):
@@ -33,6 +33,15 @@ fault-check:
 # crashes are simulated in-process (environment contract).
 chaos-check:
 	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= $(PYTHON) -m disco_tpu.runs.check
+
+# Corpus-throughput-engine gate: run the miniature corpus through the
+# pipelined prefetch/dispatch/readback engine AND the sequential escape
+# hatch on CPU, assert byte-identical artifact trees, one batched readback
+# per chunk (device_get_batches), the overlap gauges recorded, and that
+# bench.py still prints exactly ONE JSON line now carrying
+# corpus_clips_per_s (disco_tpu/enhance/check.py).
+perf-check:
+	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= $(PYTHON) -m disco_tpu.enhance.check
 
 coverage:
 	$(PYTHON) -m coverage run --branch --source=disco_tpu -m pytest tests/ -q

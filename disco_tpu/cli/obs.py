@@ -12,9 +12,11 @@ Two subcommands:
 * ``compare OLD.json NEW.json`` — diff two bench records (either the
   driver-captured ``BENCH_r*.json`` wrapper with its ``parsed`` field, a raw
   ``bench.py`` stdout line, or an obs event log containing a
-  ``bench_result`` event) into a regression verdict on the headline RTF.
-  Exits nonzero on a regression beyond ``--threshold``, which is what lets
-  ``make obs-check`` gate CI on the bench trajectory.
+  ``bench_result`` event) into a regression verdict on the headline RTF
+  and — when the baseline carries the lane — on ``corpus_clips_per_s``,
+  the pipelined corpus engine's end-to-end throughput.  Exits nonzero on a
+  regression beyond ``--threshold``, which is what lets ``make obs-check``
+  gate CI on the bench trajectory.
 
 No reference counterpart (the reference has no observability, SURVEY.md
 §5.1) — this is the first-class reader the BENCH_r01–r05 trajectory never
@@ -274,6 +276,7 @@ def compare_records(old: dict, new: dict, threshold: float = 0.05) -> dict:
         ("rtf_jacobi_solver", True),
         ("rtf_covfused", True),
         ("streaming_rtf", True),
+        ("corpus_clips_per_s", True),
         ("latency_ms_frame", False),
         ("dispatch_overhead_ms", False),
         ("mfu", True),
@@ -303,6 +306,26 @@ def compare_records(old: dict, new: dict, threshold: float = 0.05) -> dict:
         else:
             verdict = "OK"
         detail = f"headline rtf {o:g} → {n:g} ({r:+.1%}, threshold ±{threshold:.0%})"
+
+    # Corpus-throughput verdict (the pipelined engine's end-to-end number)
+    # alongside the RTF one: only judged when the BASELINE carries the lane
+    # — pre-engine records don't, and their absence must not flag — but a
+    # candidate that LOST a measured lane is a regression, not a skip.
+    oc, nc = old.get("corpus_clips_per_s"), new.get("corpus_clips_per_s")
+    if oc is not None:
+        if nc is None:
+            corpus_verdict = "REGRESSION"
+            corpus_detail = "corpus_clips_per_s lost (null in candidate)"
+        else:
+            rc = (nc - oc) / oc
+            corpus_verdict = ("REGRESSION" if rc < -threshold
+                              else "IMPROVED" if rc > threshold else "OK")
+            corpus_detail = f"corpus {oc:g} → {nc:g} clips/s ({rc:+.1%})"
+        detail = f"{detail}; {corpus_detail}"
+        if corpus_verdict == "REGRESSION":
+            verdict = "REGRESSION"
+        elif corpus_verdict == "IMPROVED" and verdict == "OK":
+            verdict = "IMPROVED"
     return {"verdict": verdict, "detail": detail, "rows": rows}
 
 

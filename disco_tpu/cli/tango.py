@@ -84,6 +84,20 @@ def build_parser():
                         "missing ones are requeued (truncated files are never "
                         "trusted).  Graceful SIGTERM/SIGINT during a run exits "
                         "resumable with this flag")
+    p.add_argument("--no-pipeline", action="store_true",
+                   help="--rirs mode: disable the overlapped corpus engine "
+                        "(disco_tpu.enhance.pipeline — background chunk "
+                        "prefetch, donated device buffers, one batched "
+                        "readback per chunk) and fall back to the strictly "
+                        "sequential load→dispatch→score loop; outputs are "
+                        "byte-identical either way (make perf-check)")
+    p.add_argument("--compile-cache", default=None, metavar="DIR|off",
+                   help="persistent XLA compilation cache directory "
+                        "(disco_tpu.utils.compile_cache) so per-bucket "
+                        "programs compile once across runs/resumes; 'off' "
+                        "disables.  Default: $DISCO_TPU_COMPILE_CACHE, else "
+                        "~/.cache/disco_tpu/xla_cache (off on the tunneled "
+                        "attachment unless a directory is given)")
     p.add_argument("--preflight", type=float, default=0.0, metavar="SECONDS",
                    help="run a bounded-deadline device health probe (one tiny "
                         "fenced dispatch, utils.resilience.preflight_probe) "
@@ -286,6 +300,8 @@ def _run(args, policy):
     from disco_tpu.utils import trace_to
 
     trace_cm = trace_to(args.trace_dir) if args.trace_dir else contextlib.nullcontext()
+    compile_cache = (False if args.compile_cache in ("off", "0")
+                     else args.compile_cache)
     # step-2 model consumes [y_ref ‖ z exchanges]: 1 + (K-1)*len(zsigs)
     # channels (reference nodes_nbs, tango.py:492-494)
     n_ch2 = 1 + 3 * len(args.zsigs)
@@ -330,9 +346,16 @@ def _run(args, policy):
                 solver=args.solver, cov_impl=args.cov_impl, mesh=mesh,
                 fault_spec=args.fault_spec,
                 ledger=args.ledger, resume=args.resume,
+                pipeline=not args.no_pipeline,
+                compile_cache=compile_cache,
             )
         print(f"{len(results)} RIRs enhanced (batched)")
         return results
+    # --compile-cache applies to BOTH modes: the per-RIR path pays the same
+    # per-shape compile tax (stft/tango/istft programs) on every invocation
+    from disco_tpu.utils import compile_cache as _compile_cache
+
+    _compile_cache.ensure_enabled(compile_cache)
     with trace_cm:
         results = enhance_rir(
             args.dataset, args.scenario, args.rir, args.noise,

@@ -1,0 +1,169 @@
+"""``make perf-check`` — the corpus-throughput-engine gate.
+
+Runs the chaos-check miniature corpus through BOTH corpus drivers and
+asserts the acceptance contract of the overlapped
+prefetch/dispatch/readback engine (``disco_tpu.enhance.pipeline``):
+
+1. **Byte-identical artifacts**: the pipelined engine's artifact tree is
+   byte-for-byte the sequential path's tree — overlap changes scheduling,
+   never math (the engine's ISTFTs run with the sequential path's exact
+   shapes and the batched readback is a lossless transfer).
+2. **Ledger equivalence**: a pipelined run with a ledger replays to the
+   same per-unit end states (every unit ``done``) with the same artifact
+   digests as the byte-identical tree implies.
+3. **One batched readback per chunk**: the ``device_get_batches`` /
+   ``chunk_readbacks`` accounting counters advance once per chunk —
+   K×n_real per-clip readbacks are gone — and the overlap gauges
+   (``prefetch_stall_ms`` et al.) are recorded.
+4. **Bench contract**: ``bench.py`` still prints exactly ONE JSON line on
+   stdout, now carrying the ``corpus_clips_per_s`` corpus-mode metric (the
+   field ``disco-obs compare`` gates on).
+
+Runs on the CPU backend; wired into ``make test`` alongside ``obs-check``,
+``fault-check`` and ``chaos-check``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+
+def _enhance(corpus, out_root, **kw):
+    from disco_tpu.enhance.driver import enhance_rirs_batched
+    from disco_tpu.runs.check import NOISE, RIRS, SNR_RANGE
+    from disco_tpu.runs.check import C as MINI_C
+    from disco_tpu.runs.check import K as MINI_K
+
+    return enhance_rirs_batched(
+        str(corpus), "living", list(RIRS), NOISE, snr_range=SNR_RANGE,
+        out_root=str(out_root), save_fig=False, bucket=8192, max_batch=2,
+        n_nodes=MINI_K, mics_per_node=MINI_C, score_workers=2, **kw,
+    )
+
+
+def _check_bench_one_line(failures: list) -> dict | None:
+    """Run bench.py at smoke size and assert the ONE-JSON-line stdout
+    contract with the new corpus fields present."""
+    root = Path(__file__).resolve().parents[2]
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "BENCH_BATCH": "2",
+        "BENCH_DUR_S": "0.5",
+        "BENCH_ITERS": "2",
+        "BENCH_CORPUS_CLIPS": "2",
+        "BENCH_NP_DUR_S": "0",  # skip the minutes-long float64 baseline
+        "BENCH_WATCHDOG_S": "900",
+    }
+    proc = subprocess.run(
+        [sys.executable, "bench.py"], cwd=root, env=env,
+        capture_output=True, text=True, timeout=900,
+    )
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    if proc.returncode != 0:
+        failures.append(
+            f"bench: exited {proc.returncode}; stdout={proc.stdout[-300:]!r} "
+            f"stderr={proc.stderr[-300:]!r}"
+        )
+        return None
+    if len(lines) != 1:
+        failures.append(f"bench: stdout must be exactly ONE JSON line, got {len(lines)}")
+        return None
+    try:
+        rec = json.loads(lines[0])
+    except json.JSONDecodeError as e:
+        failures.append(f"bench: stdout line is not JSON: {e}")
+        return None
+    if not isinstance(rec.get("corpus_clips_per_s"), (int, float)):
+        failures.append(
+            f"bench: corpus_clips_per_s missing/null in the record "
+            f"(corpus_error={rec.get('corpus_error')!r})"
+        )
+    if not isinstance((rec.get("corpus_pipeline") or {}).get("prefetch_stall_ms"),
+                      (int, float)):
+        failures.append("bench: corpus_pipeline.prefetch_stall_ms missing/null")
+    return rec
+
+
+def main(argv=None) -> int:
+    # Hermetic gate: no persistent compile-cache writes under ~/.cache from
+    # CI (the bench subprocess inherits this too); an explicit env value
+    # still wins.
+    os.environ.setdefault("DISCO_TPU_COMPILE_CACHE", "off")
+    from disco_tpu import obs
+    from disco_tpu.obs.accounting import device_get_count
+    from disco_tpu.runs import RunLedger
+    from disco_tpu.runs.check import _mini_corpus, _trees_identical
+
+    failures: list[str] = []
+    with tempfile.TemporaryDirectory() as tmp:
+        tmp = Path(tmp)
+        corpus = _mini_corpus(tmp / "dataset")
+        obs_log = tmp / "perf_check.jsonl"
+        with obs.recording(obs_log):
+            obs.write_manifest(tool="perf-check")
+
+            # -- sequential reference (the --no-pipeline escape hatch) ------
+            seq = tmp / "sequential"
+            res_seq = _enhance(corpus, seq, pipeline=False)
+
+            # -- pipelined engine, with a ledger ----------------------------
+            pipe, led = tmp / "pipelined", tmp / "ledger.jsonl"
+            gets0 = device_get_count()
+            res_pipe = _enhance(corpus, pipe, pipeline=True, ledger=str(led))
+            n_chunks = device_get_count() - gets0
+
+            if set(res_seq) != set(res_pipe):
+                failures.append(
+                    f"result keys differ: sequential={sorted(res_seq)} "
+                    f"pipelined={sorted(res_pipe)}"
+                )
+            _trees_identical(seq, pipe, failures, "pipelined parity")
+
+            # 2 clips at max_batch=2 = exactly one chunk → one batched get
+            if n_chunks != 1:
+                failures.append(
+                    f"expected ONE batched device_get for the single chunk, "
+                    f"counted {n_chunks}"
+                )
+            gauges = obs.REGISTRY.snapshot()["gauges"]
+            for g in ("prefetch_stall_ms", "readback_ms", "overlap_efficiency"):
+                if gauges.get(g) is None:
+                    failures.append(f"overlap gauge {g!r} was not recorded")
+
+            # every unit done in the ledger (and verified against digests)
+            done, requeued = RunLedger(led).verified_done(requeue=False)
+            if len(done) != len(res_pipe) or requeued:
+                failures.append(
+                    f"ledger not clean after pipelined run: done={sorted(done)} "
+                    f"requeued={requeued}"
+                )
+            obs.record("counters", **obs.REGISTRY.snapshot())
+        events = obs.read_events(obs_log)  # schema-validating read
+        if not any(e["kind"] == "stage_end" and e["stage"] == "chunk_pipeline"
+                   for e in events):
+            failures.append("event log missing the chunk_pipeline stage event")
+
+    bench_rec = _check_bench_one_line(failures)
+
+    if failures:
+        for f in failures:
+            print(f"perf-check FAIL: {f}", file=sys.stderr)
+        return 1
+    print(json.dumps({
+        "perf_check": "ok",
+        "corpus_clips_per_s": bench_rec.get("corpus_clips_per_s"),
+        "prefetch_stall_ms": bench_rec.get("corpus_pipeline", {}).get("prefetch_stall_ms"),
+        "readback_ms": bench_rec.get("corpus_pipeline", {}).get("readback_ms"),
+        "overlap_efficiency": bench_rec.get("corpus_pipeline", {}).get("overlap_efficiency"),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -242,12 +242,20 @@ def crnn_masks_batched(
     if frame_to_pred == "all":
         raise NotImplementedError("'all' inference reshaping is not implemented (as in the reference)")
     if norm_type == "pcen":  # host-only IIR: fall back to per-stream prep
+        # ONE batched complex-safe device_get for the whole stream stack
+        # (and the exchanged z's) BEFORE the per-stream loop — the loop's
+        # crnn_mask(to_host(Ys[i])) calls were B separate tunnel crossings,
+        # the same per-item lazy-readback anti-pattern the corpus engine's
+        # fetch_chunk_host replaced in the driver.
+        from disco_tpu.utils.transfer import device_get_tree
+
+        Ys_h, zs_h = device_get_tree((Ys, zs))
         return np.stack([
-            crnn_mask(Ys[i], model, variables,
-                      z=None if zs is None else list(np.asarray(zs[i])),
+            crnn_mask(Ys_h[i], model, variables,
+                      z=None if zs_h is None else list(np.asarray(zs_h[i])),
                       win_len=win_len, frame_to_pred=frame_to_pred,
                       norm_type=norm_type, three_d_tensor=three_d_tensor)
-            for i in range(len(Ys))
+            for i in range(len(Ys_h))
         ])
     frames_lost = win_len - model.conv_output_hw()[0]
     pad = get_frames_to_pad(win_len, frame_to_pred, out_len=win_len - frames_lost)

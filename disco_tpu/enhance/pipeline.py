@@ -1,0 +1,250 @@
+"""Corpus throughput engine: overlapped prefetch / dispatch / readback.
+
+The batched corpus driver (:func:`disco_tpu.enhance.driver.
+enhance_rirs_batched`) historically ran its three phases strictly in
+sequence — load a chunk's wavs from disk, dispatch the jitted batch to the
+device, read the results back and score — so the device idled during disk
+I/O and the host idled during compute.  BENCH_r05 puts the per-clip
+pipeline at thousands of times realtime *on device*; corpus wall-clock was
+dominated by everything around the dispatch.  This module provides the two
+overlap primitives the driver now composes:
+
+* :class:`ChunkPrefetcher` — a double-buffered background loader: while the
+  device runs chunk N, a daemon thread loads and pads chunk N+1 (wav
+  decode, numpy padding, ledger ``in_flight`` marks and the ``chunk_load``
+  chaos seam all run *with the work*, on the loader thread, so crash-safe
+  resume semantics are preserved — an interrupted prefetched chunk is
+  simply in_flight-but-not-done and is redone on resume).  The loader does
+  host-only work (no jax), so it never contends for the device.
+* :func:`fetch_chunk_host` — ONE batched, complex-safe ``jax.device_get``
+  of everything a chunk's scoring needs (per-clip time-domain outputs,
+  step-1/2 masks, exported z streams).  The per-clip
+  ``tree_map(lambda x: x[i])`` lazy slices this replaces crossed the
+  tunnel K×n_real times per chunk at a fixed ~80 ms RPC each
+  (CLAUDE.md); the batched fetch crosses once.
+
+Observability: each chunk records a ``chunk_pipeline`` stage event (with
+the prefetch stall it paid as an attr), ``fetch_chunk_host`` a
+``chunk_readback`` stage event, and the ``prefetch_stall_ms`` /
+``readback_ms`` / ``overlap_efficiency`` gauges (plus stall/readback
+histograms and the ``chunks_pipelined`` / ``chunk_readbacks`` counters)
+land in every ``counters`` snapshot, so ``disco-obs report`` and the
+``corpus_clips_per_s`` bench lane can regress the overlap itself.
+
+No reference counterpart: the reference enhances clips one at a time in a
+Python loop (SURVEY.md §5.5); this is the layer that turns a fast kernel
+into a fast corpus run.
+"""
+from __future__ import annotations
+
+import queue as queue_mod
+import threading
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from disco_tpu.obs import events as obs_events
+from disco_tpu.obs.metrics import REGISTRY as obs_registry
+
+#: Scoring backpressure: at most this many chunks of pending scoring
+#: futures are kept in flight before the dispatch thread blocks on the
+#: oldest.  2 (not 1, the pre-engine ``drain()`` bound) lets chunk N-1's
+#: scoring overlap chunk N's dispatch AND chunk N+1's prefetch without
+#: unbounded host memory growth.
+MAX_PENDING_CHUNKS = 2
+
+
+@dataclass
+class LoadedChunk:
+    """One corpus chunk, loaded and padded, ready to dispatch."""
+
+    bucket: int          # padded clip length Lp (the compile bucket)
+    chunk: list          # [(rir, out_path, layout), ...] — n_real entries
+    sigs: list           # per-clip load_input_signals tuples (y, s, n, ...)
+    ys: np.ndarray       # (B, K, C, Lp) padded mixture stack (B >= n_real)
+    ss: np.ndarray       # (B, K, C, Lp) padded target stack
+    ns: np.ndarray       # (B, K, C, Lp) padded noise stack
+    n_real: int          # real clips in the batch (the rest is pad)
+
+    @property
+    def clip_lengths(self) -> list:
+        """True (unpadded) length per real clip — what ISTFT trims to."""
+        return [self.sigs[i][0].shape[-1] for i in range(self.n_real)]
+
+
+_END = object()
+
+
+class ChunkPrefetcher:
+    """Double-buffered background chunk loader.
+
+    Iterating yields ``(LoadedChunk, stall_s)`` where ``stall_s`` is how
+    long the consumer waited for the chunk — the number that tells you
+    whether disk I/O or the device is the bottleneck (``stall_s ≈ 0`` means
+    the prefetch fully hid the load behind the previous chunk's compute).
+
+    ``depth`` bounds lookahead: with the default 2, at most one chunk sits
+    ready in the queue while a second is being loaded — double buffering,
+    so host memory holds at most ``depth`` chunks beyond the one being
+    consumed.  Exceptions from the loader (including
+    :class:`~disco_tpu.runs.chaos.ChaosCrash`, a ``BaseException`` — an
+    injected crash must kill the run exactly like a process death) are
+    re-raised at the consuming site, and ``stop_requested`` (the graceful
+    SIGTERM/SIGINT flag of ``disco_tpu.runs.interrupt``) is polled between
+    chunks so an interrupted run stops marking new work ``in_flight``.
+
+    Always :meth:`close` in a ``finally``: a consumer that unwinds
+    mid-iteration (chaos crash, scoring error) would otherwise leave the
+    loader thread blocked on a full queue.  After ``close`` the loader
+    starts no new chunk (the stop flag is checked before every load, and a
+    chunk's ledger marks are written before its wav reads begin), so the
+    only residue a loader caught MID-load can emit is finishing that one
+    read — if it outlives the join timeout, ``close`` says so loudly (a
+    ``warning`` obs event + ``prefetch_orphaned`` counter) instead of
+    silently abandoning it.
+    """
+
+    def __init__(self, work, load_chunk, depth: int = 2, stop_requested=None):
+        if depth < 2:
+            raise ValueError(f"ChunkPrefetcher needs depth >= 2 (double buffering), got {depth}")
+        self._work = list(work)
+        self._load = load_chunk
+        self._stop = threading.Event()
+        self._stop_requested = stop_requested or (lambda: False)
+        # depth - 1 queued + 1 being loaded = depth chunks of lookahead
+        self._q: queue_mod.Queue = queue_mod.Queue(maxsize=depth - 1)
+        self._thread = threading.Thread(
+            target=self._run, name="disco-chunk-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        """Blocking put that stays responsive to :meth:`close`."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue_mod.Full:
+                continue
+        return False
+
+    def _run(self):
+        try:
+            for work_item in self._work:
+                if self._stop.is_set() or self._stop_requested():
+                    break
+                loaded = self._load(*work_item)
+                if not self._put(loaded):
+                    return
+            self._put(_END)
+        except BaseException as e:  # ChaosCrash included — re-raised at get()
+            self._put(e)
+
+    def __iter__(self):
+        while True:
+            t0 = time.perf_counter()
+            item = self._q.get()
+            stall_s = time.perf_counter() - t0
+            if item is _END:
+                self._thread.join(timeout=5.0)
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item, stall_s
+
+    def close(self, join_timeout: float = 5.0) -> bool:
+        """Stop the loader and release it: set the stop flag, drain the
+        queue (unblocking a pending put) and join.  Idempotent.
+
+        Returns True when the loader actually exited.  A loader stuck
+        inside one slow chunk read cannot observe the flag mid-call; it
+        will start nothing new afterwards, but if it outlives the timeout
+        that is recorded (warning event + counter), never swallowed — a
+        caller resuming in-process deserves to know a stale read is still
+        draining."""
+        self._stop.set()
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue_mod.Empty:
+                break
+        self._thread.join(timeout=join_timeout)
+        if self._thread.is_alive():
+            obs_registry.counter("prefetch_orphaned").inc()
+            obs_events.record(
+                "warning", stage="chunk_load",
+                reason="prefetch loader still inside a chunk read after "
+                       f"close({join_timeout:g}s); it will exit after that "
+                       "read without starting new work",
+            )
+            return False
+        return True
+
+
+def fetch_chunk_host(res_b, clip_lengths, n_real: int) -> dict:
+    """Move one chunk's scoring inputs to host in ONE batched device_get.
+
+    The time-domain conversion happens here, on device, one clip at a time
+    with exactly the shapes and static lengths the sequential path uses
+    (``istft(res.yf[i], length=L_i)``) — bit-identical outputs by
+    construction, queued asynchronously with no readback between clips.
+    Then the whole payload — six time-domain arrays per clip, the step-1/2
+    masks and the exported z streams for the real clips — crosses the
+    host boundary as a single complex-safe
+    :func:`~disco_tpu.utils.transfer.device_get_tree` call.
+
+    This replaces the K×n_real lazy per-clip readbacks of the pre-engine
+    driver (``tree_map(lambda x: x[i])`` slices materialized one
+    ``np.asarray`` at a time inside scoring — see ``chunk_readbacks`` /
+    ``device_get_batches`` in the counters snapshot for the accounting).
+
+    Args:
+      res_b: batched :class:`~disco_tpu.enhance.tango.TangoResult`
+        (leaves ``(B, K, F, T)``), device-resident.
+      clip_lengths: true (unpadded) sample length per real clip.
+      n_real: number of real clips (pad clips are never fetched).
+
+    Returns:
+      dict with ``td`` (list of per-clip 6-tuples ``(sh_t, szh_t, sf_t,
+      nf_t, szf_t, nzf_t)``, each ``(K, L_i)`` float32 numpy), ``masks_z``
+      / ``mask_w`` (``(n_real, K, F, T)`` float numpy) and ``z_y``
+      (``(n_real, K, F, T)`` complex64 numpy).
+    """
+    from disco_tpu.core.dsp import istft
+    from disco_tpu.utils.transfer import device_get_tree
+
+    with obs_events.stage("chunk_readback", n_clips=n_real):
+        td = []
+        for i in range(n_real):
+            L = int(clip_lengths[i])
+            td.append(tuple(
+                istft(z[i], length=L)
+                for z in (res_b.yf, res_b.z_y, res_b.sf, res_b.nf, res_b.z_s, res_b.z_n)
+            ))
+        t0 = time.perf_counter()
+        host = device_get_tree({
+            "td": td,
+            "masks_z": res_b.masks_z[:n_real],
+            "mask_w": res_b.mask_w[:n_real],
+            "z_y": res_b.z_y[:n_real],
+        })
+        dt_ms = (time.perf_counter() - t0) * 1e3
+    obs_registry.gauge("readback_ms").set(dt_ms)
+    obs_registry.histogram("readback_ms").observe(dt_ms)
+    obs_registry.counter("chunk_readbacks").inc()
+    return host
+
+
+def note_chunk_overlap(stall_s: float, busy_s: float) -> None:
+    """Record one chunk's overlap economics: the stall the dispatch loop
+    paid waiting for the prefetcher and the busy time it then spent, folded
+    into the ``prefetch_stall_ms`` / ``overlap_efficiency`` gauges (last
+    chunk) and the stall histogram (whole run).  ``overlap_efficiency`` is
+    busy/(busy+stall): 1.0 means the prefetch fully hid the load."""
+    stall_ms = stall_s * 1e3
+    obs_registry.gauge("prefetch_stall_ms").set(stall_ms)
+    obs_registry.histogram("prefetch_stall_ms").observe(stall_ms)
+    total = busy_s + stall_s
+    obs_registry.gauge("overlap_efficiency").set(busy_s / total if total > 0 else 1.0)
+    obs_registry.counter("chunks_pipelined").inc()

@@ -18,7 +18,7 @@ import jax.numpy as jnp
 
 from disco_tpu.core.dsp import stft
 from disco_tpu.enhance.tango import oracle_masks, tango_step1
-from disco_tpu.utils import to_host
+from disco_tpu.utils import device_get_tree
 from disco_tpu.io.layout import DatasetLayout, case_of_rir
 
 
@@ -125,8 +125,13 @@ def export_z(
         Y = stft(jnp.asarray(y))
         masks_z = masks_fn(Y)
     out = compute_z_signals(y, s, n, masks_z=masks_z, mask_type=mask_type, Y=Y)
-    zs = to_host(out["z_y"]).astype("complex64")  # zs_hat = compressed mixture
-    zn = to_host(out["zn"]).astype("complex64")  # zn_hat = y_ref − z
+    # ONE batched complex-safe device_get for both exported stream stacks —
+    # the same single-readback-per-batch contract as the corpus engine's
+    # fetch_chunk_host (separate per-stream to_host crossings each paid a
+    # full tunnel round-trip).
+    zs, zn = device_get_tree((out["z_y"], out["zn"]))
+    zs = np.asarray(zs).astype("complex64")  # zs_hat = compressed mixture
+    zn = np.asarray(zn).astype("complex64")  # zn_hat = y_ref − z
 
     for k in range(n_nodes):
         for zsig, arr in (("zs_hat", zs[k]), ("zn_hat", zn[k])):

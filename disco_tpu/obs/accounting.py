@@ -58,6 +58,23 @@ def recompile_count() -> int:
     return _RECOMPILES.value
 
 
+_DEVICE_GETS = _metrics.REGISTRY.counter("device_get_batches")
+
+
+def device_get_tick() -> None:
+    """Count one BATCHED host readback (``utils.transfer.device_get_tree``):
+    a full pytree crossing the boundary in a single ``jax.device_get`` is
+    one fenced RPC round on the tunnel, however many leaves it carries —
+    the accounting that lets a test assert the corpus engine reads each
+    chunk back once instead of K×n_real times (``device_get_batches``)."""
+    fence_tick(1)
+    _DEVICE_GETS.inc()
+
+
+def device_get_count() -> int:
+    return _DEVICE_GETS.value
+
+
 def rpc_overhead_s(n_fences: int | None = None) -> float:
     """Estimated tunnel-RPC overhead: ``n_fences × ~80 ms``.  Defaults to the
     process-wide fence count."""

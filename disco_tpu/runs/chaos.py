@@ -25,6 +25,12 @@ Seams wired through the pipeline (each a named :func:`tick` call):
   unprepared run.
 * ``pre_dispatch``   — before a batched chunk is dispatched to the device
   (``enhance/driver.py``), i.e. crash with work enqueued but unscored.
+* ``chunk_load``     — at the start of a corpus chunk's wav ingest, right
+  after its ledger ``in_flight`` marks (``enhance/driver.py``).  Under the
+  pipelined engine this seam runs on the PREFETCH thread — the injected
+  ``ChaosCrash`` is re-delivered at the consuming dispatch loop
+  (``enhance/pipeline.ChunkPrefetcher``), so a crash during background
+  loading still kills the run like a process death would.
 
 Injection is armed either programmatically (:func:`configure`) or via the
 ``DISCO_TPU_CHAOS`` environment variable (``"seam"`` or ``"seam:N"`` —

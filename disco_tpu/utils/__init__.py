@@ -1,5 +1,6 @@
 from disco_tpu.utils.transfer import (
     TunnelTransferError,
+    device_get_tree,
     guard_tunnel_complex,
     prefetch_to_device,
     to_device,
@@ -25,6 +26,7 @@ __all__ = [
     "TRANSPORT_ERRORS",
     "TunnelTransferError",
     "call_with_retries",
+    "device_get_tree",
     "guard_tunnel_complex",
     "prefetch_to_device",
     "resilient_fence",

@@ -1,0 +1,112 @@
+"""Persistent XLA compilation cache seam.
+
+The corpus driver compiles one program per length bucket (plus one per
+remainder-chunk padded size), and before this seam existed that compile tax
+was paid again on EVERY run and every ``--resume`` — minutes of host-side
+tracing/lowering before the first chunk dispatches.  JAX ships a persistent
+compilation cache (serialized XLA executables keyed on program + flags +
+backend) that makes recompiles a disk read; this module is the one place
+the framework turns it on, so policy lives in one seam instead of being
+sprinkled through drivers and CLIs:
+
+* **Path-configurable**: explicit argument > ``DISCO_TPU_COMPILE_CACHE``
+  env var > ``~/.cache/disco_tpu/xla_cache``.
+* **Opt-out**: env var (or argument) set to ``0`` / ``off`` / ``none`` /
+  ``disabled`` disables it.
+* **Off on the axon tunnel unless forced**: the tunneled single-chip
+  attachment is a non-standard PJRT plugin whose executable serialization
+  support is unknown; the cache stays off there unless a path is given
+  explicitly (argument or env var), in which case the caller has opted in.
+* **Never fatal**: any failure to enable degrades to "no cache" with a
+  ``warning`` obs event — a caching optimization must not break the run it
+  was meant to speed up.
+
+No reference counterpart (the reference has no compiled programs to
+cache); the seam follows the standard production-JAX recipe
+(``jax.config.update("jax_compilation_cache_dir", ...)``).
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+#: Environment override: a cache directory, or 0/off/none/disabled.
+ENV_VAR = "DISCO_TPU_COMPILE_CACHE"
+
+_OFF_VALUES = ("0", "off", "none", "disabled", "false")
+
+_lock = threading.Lock()
+_state = {"resolved": False, "path": None}
+
+
+def default_path() -> str:
+    return os.path.join(os.path.expanduser("~"), ".cache", "disco_tpu", "xla_cache")
+
+
+def _tunneled() -> bool:
+    from disco_tpu.utils.transfer import _tunneled_attachment
+
+    return _tunneled_attachment()
+
+
+def ensure_enabled(path: str | bool | None = None) -> str | None:
+    """Enable JAX's persistent compilation cache once per process.
+
+    Args:
+      path: explicit cache directory; ``False`` (or an off-string) disables;
+        ``None`` resolves env var then the default path.
+
+    Returns:
+      The active cache directory, or ``None`` when disabled/unavailable.
+      Idempotent: later calls return the first resolution (JAX reads the
+      config at compile time; flip-flopping it mid-process would shear the
+      cache key space for no benefit).
+    """
+    with _lock:
+        if _state["resolved"]:
+            return _state["path"]
+        _state["resolved"] = True
+        _state["path"] = _resolve_and_enable(path)
+        return _state["path"]
+
+
+def _resolve_and_enable(path) -> str | None:
+    if path is False:
+        return None
+    env = os.environ.get(ENV_VAR)
+    explicit = path if isinstance(path, str) else env
+    if isinstance(explicit, str) and explicit.strip().lower() in _OFF_VALUES:
+        return None
+    try:
+        import jax
+
+        if explicit is None and _tunneled():
+            # Unknown serialization support on the tunneled plugin: default
+            # off there; an explicit path (arg/env) is the caller's opt-in.
+            return None
+        cache_dir = explicit or default_path()
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        from disco_tpu.obs import events as obs_events
+
+        obs_events.record("note", stage="compile_cache", path=cache_dir)
+        return cache_dir
+    except Exception as e:  # pragma: no cover - backend/version specific
+        try:
+            from disco_tpu.obs import events as obs_events
+
+            obs_events.record(
+                "warning", stage="compile_cache",
+                reason=f"persistent compilation cache unavailable: "
+                       f"{type(e).__name__}: {e}"[:300],
+            )
+        except Exception:
+            pass
+        return None
+
+
+def _reset_for_tests() -> None:
+    """Forget the process-wide resolution (test isolation only)."""
+    with _lock:
+        _state["resolved"] = False
+        _state["path"] = None

@@ -9,6 +9,12 @@ works as long as no backend has been initialised yet.
 """
 import os
 
+# Hermetic tests: the drivers enable the persistent XLA compile cache by
+# default (disco_tpu.utils.compile_cache) — keep the suite from writing
+# shared state under ~/.cache, and from coupling test runs through a warm
+# cache, unless a test opts in explicitly.
+os.environ.setdefault("DISCO_TPU_COMPILE_CACHE", "off")
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -272,8 +272,9 @@ def test_enhance_rirs_batched_score_workers_identical(tmp_path):
     """Threaded scoring (score_workers>1) produces bit-identical metrics to
     inline scoring — the overlap changes scheduling, never math.  Three RIRs
     with max_batch=1 force three chunks, so multiple futures and the
-    cross-chunk drain() ordering are actually exercised (results must stay
-    keyed to their RIR across chunk boundaries)."""
+    bounded cross-chunk drain ordering (pipeline.MAX_PENDING_CHUNKS) are
+    actually exercised (results must stay keyed to their RIR across chunk
+    boundaries)."""
     from disco_tpu.enhance.driver import enhance_rirs_batched
 
     rirs = [RIR, RIR + 1, RIR + 2]

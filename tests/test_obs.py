@@ -382,6 +382,12 @@ def test_obs_compare_reads_event_log_bench_result(tmp_path):
 
 
 # -- bench.py contract ------------------------------------------------------
+def _canned_bench_corpus(**_):
+    return 0.5, {"n_clips": 4, "clip_dur_s": 2.0, "prefetch_stall_ms": 12.0,
+                 "readback_ms": 80.0, "overlap_efficiency": 0.97,
+                 "batched_readbacks": 2}
+
+
 def _canned_bench_jax(**_):
     return {
         "rtf": 6700.0, "rtf_single_dispatch": 4900.0, "rtf_eigh": 4800.0,
@@ -399,7 +405,8 @@ def test_bench_single_json_line_stdout_with_obs_log(tmp_path, monkeypatch, capsy
 
     monkeypatch.setattr(bench, "bench_jax", _canned_bench_jax)
     monkeypatch.setattr(bench, "bench_streaming", lambda **_: (0.85, 16.0, 18.9))
-    monkeypatch.setattr(bench, "bench_numpy", lambda: 3.0)
+    monkeypatch.setattr(bench, "bench_corpus", _canned_bench_corpus)
+    monkeypatch.setattr(bench, "bench_numpy", lambda **_: 3.0)
     log = tmp_path / "bench_events.jsonl"
     bench.main(["--obs-log", str(log)])
     out_lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
@@ -425,11 +432,16 @@ def test_bench_stdout_unchanged_without_obs_log(monkeypatch, capsys):
 
     monkeypatch.setattr(bench, "bench_jax", _canned_bench_jax)
     monkeypatch.setattr(bench, "bench_streaming", lambda **_: (0.85, 16.0, 18.9))
-    monkeypatch.setattr(bench, "bench_numpy", lambda: 3.0)
+    monkeypatch.setattr(bench, "bench_corpus", _canned_bench_corpus)
+    monkeypatch.setattr(bench, "bench_numpy", lambda **_: 3.0)
     bench.main([])
     out_lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
     assert len(out_lines) == 1
-    assert json.loads(out_lines[0])["vs_baseline"] == pytest.approx(6700.0 / 3.0, rel=0.01)
+    record = json.loads(out_lines[0])
+    assert record["vs_baseline"] == pytest.approx(6700.0 / 3.0, rel=0.01)
+    # the corpus-mode metric of the pipelined engine rides the same line
+    assert record["corpus_clips_per_s"] == 0.5
+    assert record["corpus_pipeline"]["prefetch_stall_ms"] == 12.0
 
 
 def test_bench_error_path_records_event_and_one_line(tmp_path, monkeypatch, capsys):

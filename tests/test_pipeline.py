@@ -1,0 +1,315 @@
+"""Corpus throughput engine (disco_tpu.enhance.pipeline): prefetcher unit
+behavior, the single-batched-readback contract, the compile-cache seam, the
+corpus regression verdict in `disco-obs compare`, and — slow-marked — the
+pipelined-vs-sequential parity and chaos-crash-under-prefetch integration
+tests on the runs/check.py miniature-corpus harness."""
+import json
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from disco_tpu.enhance.pipeline import ChunkPrefetcher
+
+
+# -- ChunkPrefetcher --------------------------------------------------------
+def test_prefetcher_yields_in_order_with_stall():
+    loads = []
+
+    def load(i):
+        loads.append(i)
+        return i * 10
+
+    pf = ChunkPrefetcher([(i,) for i in range(5)], load)
+    try:
+        got = [(item, stall) for item, stall in pf]
+    finally:
+        pf.close()
+    assert [g[0] for g in got] == [0, 10, 20, 30, 40]
+    assert loads == [0, 1, 2, 3, 4]
+    assert all(g[1] >= 0.0 for g in got)
+
+
+def test_prefetcher_overlaps_load_with_consumption():
+    """While the consumer holds chunk N, the background thread loads ahead
+    — by the time the first slow consume finishes, later loads happened."""
+    t_load = {}
+
+    def load(i):
+        t_load[i] = time.perf_counter()
+        return i
+
+    pf = ChunkPrefetcher([(i,) for i in range(3)], load)
+    try:
+        it = iter(pf)
+        first, _ = next(it)
+        time.sleep(0.3)  # "device compute" for chunk 0
+        t_consumed = time.perf_counter()
+        rest = [item for item, _ in it]
+    finally:
+        pf.close()
+    assert first == 0 and rest == [1, 2]
+    # chunk 1 was loaded during chunk 0's consumption, not after it
+    assert t_load[1] < t_consumed
+
+
+def test_prefetcher_reraises_baseexception_at_consumer():
+    """A BaseException on the loader thread (the ChaosCrash contract) must
+    surface at the consuming site, after the items loaded before it."""
+
+    class FakeCrash(BaseException):
+        pass
+
+    def load(i):
+        if i == 1:
+            raise FakeCrash()
+        return i
+
+    pf = ChunkPrefetcher([(0,), (1,), (2,)], load)
+    try:
+        it = iter(pf)
+        assert next(it)[0] == 0
+        with pytest.raises(FakeCrash):
+            for _ in it:
+                pass
+    finally:
+        pf.close()
+
+
+def test_prefetcher_stop_requested_loads_nothing():
+    loads = []
+    pf = ChunkPrefetcher(
+        [(i,) for i in range(4)], lambda i: loads.append(i) or i,
+        stop_requested=lambda: True,
+    )
+    try:
+        assert [item for item, _ in pf] == []
+    finally:
+        pf.close()
+    assert loads == []
+
+
+def test_prefetcher_close_unblocks_pending_loader():
+    """close() must release a loader blocked on a full queue (a consumer
+    that crashed mid-iteration) — no orphan thread appending ledger marks
+    after its run is gone."""
+    pf = ChunkPrefetcher([(i,) for i in range(20)], lambda i: i)
+    item, _ = next(iter(pf))  # consume one, leave the queue full
+    assert item == 0
+    pf.close()
+    assert not pf._thread.is_alive()
+    pf.close()  # idempotent
+
+
+def test_prefetcher_rejects_single_buffering():
+    with pytest.raises(ValueError, match="depth"):
+        ChunkPrefetcher([], lambda: None, depth=1)
+
+
+# -- device_get_tree --------------------------------------------------------
+def test_device_get_tree_complex_roundtrip_single_batch(rng):
+    import jax.numpy as jnp
+
+    from disco_tpu.obs.accounting import device_get_count, fence_count
+    from disco_tpu.utils.transfer import device_get_tree
+
+    c = (rng.standard_normal((3, 5)) + 1j * rng.standard_normal((3, 5))).astype("complex64")
+    r = rng.standard_normal((2, 4)).astype("float32")
+    tree = {"c": jnp.asarray(c), "nested": [jnp.asarray(r), None], "host": r}
+    g0, f0 = device_get_count(), fence_count()
+    out = device_get_tree(tree)
+    # ONE batched get, one fenced RPC round — however many leaves
+    assert device_get_count() - g0 == 1
+    assert fence_count() - f0 == 1
+    assert isinstance(out["c"], np.ndarray) and out["c"].dtype == np.complex64
+    np.testing.assert_array_equal(out["c"], c)
+    np.testing.assert_array_equal(out["nested"][0], r)
+    assert out["nested"][1] is None
+    assert out["host"] is r  # host leaves pass through untouched
+
+
+def test_device_get_tree_pure_host_tree_counts_nothing(rng):
+    from disco_tpu.obs.accounting import device_get_count
+    from disco_tpu.utils.transfer import device_get_tree
+
+    tree = {"a": rng.standard_normal(3), "b": None}
+    g0 = device_get_count()
+    out = device_get_tree(tree)
+    assert device_get_count() == g0
+    assert out["a"] is tree["a"]
+
+
+# -- compile cache seam -----------------------------------------------------
+@pytest.fixture
+def _cache_state():
+    """Save/restore the process-wide compile-cache resolution and the jax
+    config value around each test."""
+    import jax
+
+    from disco_tpu.utils import compile_cache
+
+    prev = jax.config.jax_compilation_cache_dir
+    compile_cache._reset_for_tests()
+    yield compile_cache
+    compile_cache._reset_for_tests()
+    jax.config.update("jax_compilation_cache_dir", prev)
+
+
+def test_compile_cache_enables_at_explicit_path(_cache_state, tmp_path):
+    import jax
+
+    path = _cache_state.ensure_enabled(str(tmp_path / "xla"))
+    assert path == str(tmp_path / "xla")
+    assert Path(path).is_dir()
+    assert jax.config.jax_compilation_cache_dir == path
+    # idempotent: the first resolution wins for the whole process
+    assert _cache_state.ensure_enabled(str(tmp_path / "other")) == path
+
+
+def test_compile_cache_env_off(_cache_state, monkeypatch):
+    monkeypatch.setenv(_cache_state.ENV_VAR, "off")
+    assert _cache_state.ensure_enabled() is None
+
+
+def test_compile_cache_false_disables(_cache_state):
+    assert _cache_state.ensure_enabled(False) is None
+
+
+def test_compile_cache_env_path_wins(_cache_state, monkeypatch, tmp_path):
+    monkeypatch.setenv(_cache_state.ENV_VAR, str(tmp_path / "envcache"))
+    assert _cache_state.ensure_enabled() == str(tmp_path / "envcache")
+
+
+# -- disco-obs compare: corpus_clips_per_s verdict --------------------------
+def _rec(rtf=6700.0, corpus=None):
+    r = {"metric": "rtf_8node_mwf_enhancement", "value": rtf, "unit": "x_realtime"}
+    if corpus is not None:
+        r["corpus_clips_per_s"] = corpus
+    return r
+
+
+def test_compare_corpus_regression_flags():
+    from disco_tpu.cli.obs import compare_records
+
+    d = compare_records(_rec(corpus=10.0), _rec(corpus=8.0))  # -20% corpus
+    assert d["verdict"] == "REGRESSION"
+    assert "corpus" in d["detail"]
+    assert any(r["key"] == "corpus_clips_per_s" for r in d["rows"])
+
+
+def test_compare_corpus_ok_improved_and_absent_baseline():
+    from disco_tpu.cli.obs import compare_records
+
+    assert compare_records(_rec(corpus=10.0), _rec(corpus=9.8))["verdict"] == "OK"
+    assert compare_records(_rec(corpus=10.0), _rec(corpus=12.0))["verdict"] == "IMPROVED"
+    # pre-engine baselines have no corpus lane: its absence must not flag
+    assert compare_records(_rec(), _rec(corpus=12.0))["verdict"] == "OK"
+    # headline regression still dominates a corpus improvement
+    d = compare_records(_rec(rtf=6700.0, corpus=10.0), _rec(rtf=5000.0, corpus=12.0))
+    assert d["verdict"] == "REGRESSION"
+
+
+def test_compare_corpus_lane_lost_is_regression(tmp_path, capsys):
+    from disco_tpu.cli import obs as obs_cli
+
+    old, new = tmp_path / "old.json", tmp_path / "new.json"
+    old.write_text(json.dumps(_rec(corpus=10.0)))
+    new.write_text(json.dumps(_rec()))
+    with pytest.raises(SystemExit) as exc:
+        obs_cli.main(["compare", str(old), str(new)])
+    assert exc.value.code == 1
+    assert "REGRESSION" in capsys.readouterr().out
+
+
+# -- integration: parity and chaos under prefetch (miniature corpus) --------
+def _mini(tmp_path):
+    from disco_tpu.runs.check import _mini_corpus
+
+    return _mini_corpus(tmp_path / "dataset")
+
+
+def _enhance(corpus, out_root, **kw):
+    from disco_tpu.enhance.driver import enhance_rirs_batched
+    from disco_tpu.runs.check import C, K, NOISE, RIRS, SNR_RANGE
+
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("score_workers", 1)
+    return enhance_rirs_batched(
+        str(corpus), "living", list(RIRS), NOISE, snr_range=SNR_RANGE,
+        out_root=str(out_root), save_fig=False, bucket=8192,
+        n_nodes=K, mics_per_node=C, **kw,
+    )
+
+
+def _relative_digests(ledger_path, out_root):
+    """{unit: {relative artifact path: digest}} from a ledger's done records."""
+    from disco_tpu.runs.ledger import RunLedger
+
+    out = {}
+    for unit, rec in RunLedger(ledger_path).replay().items():
+        assert rec["state"] == "done", (unit, rec["state"])
+        out[unit] = {
+            str(Path(p).relative_to(out_root)): d
+            for p, d in (rec.get("artifacts") or {}).items()
+        }
+    return out
+
+
+@pytest.mark.slow
+def test_pipelined_matches_sequential_bytes_and_ledger(tmp_path):
+    """The engine's overlap changes scheduling, never artifacts: byte-
+    identical tree, ledger replaying to the same per-unit end states with
+    the same digests, and ONE batched readback per chunk (max_batch=1 →
+    two chunks → two batched gets, not K×n_real per-clip reads)."""
+    from disco_tpu.obs.accounting import device_get_count
+    from disco_tpu.runs.check import RIRS, _trees_identical
+
+    corpus = _mini(tmp_path)
+    seq, led_seq = tmp_path / "seq", tmp_path / "led_seq.jsonl"
+    pipe, led_pipe = tmp_path / "pipe", tmp_path / "led_pipe.jsonl"
+
+    res_seq = _enhance(corpus, seq, pipeline=False, ledger=str(led_seq), max_batch=1)
+    g0 = device_get_count()
+    res_pipe = _enhance(corpus, pipe, pipeline=True, ledger=str(led_pipe), max_batch=1)
+    assert device_get_count() - g0 == len(RIRS)  # one get per chunk
+    assert set(res_seq) == set(res_pipe) == set(RIRS)
+
+    failures = []
+    _trees_identical(seq, pipe, failures, "pipelined parity")
+    assert not failures, failures
+    assert _relative_digests(led_seq, seq) == _relative_digests(led_pipe, pipe)
+
+    # overlap gauges recorded
+    from disco_tpu.obs.metrics import REGISTRY
+
+    gauges = REGISTRY.snapshot()["gauges"]
+    for g in ("prefetch_stall_ms", "readback_ms", "overlap_efficiency"):
+        assert gauges.get(g) is not None, g
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seam,after", [("mid_write", 5), ("pre_dispatch", 1),
+                                        ("chunk_load", 1)])
+def test_pipelined_chaos_crash_resumes_byte_identical(tmp_path, seam, after):
+    """A crash under prefetch — inside an artifact write, before a dispatch
+    with a chunk already prefetched, or ON the prefetch thread mid-ingest —
+    resumes from the ledger to a byte-identical tree."""
+    from disco_tpu.runs import chaos
+    from disco_tpu.runs.check import _trees_identical
+
+    corpus = _mini(tmp_path)
+    ref = tmp_path / "ref"
+    _enhance(corpus, ref, pipeline=True)
+
+    out, led = tmp_path / "crashed", tmp_path / "led.jsonl"
+    chaos.configure(seam, after=after)
+    try:
+        with pytest.raises(chaos.ChaosCrash):
+            _enhance(corpus, out, pipeline=True, ledger=str(led))
+    finally:
+        chaos.disable()
+    _enhance(corpus, out, pipeline=True, ledger=str(led), resume=True)
+    failures = []
+    _trees_identical(ref, out, failures, f"{seam} resume")
+    assert not failures, failures

@@ -418,14 +418,18 @@ def test_batched_interrupt_then_resume_identical_tree(tmp_path, monkeypatch):
                                out_root=str(ref_root), **kw)
     assert set(ref) == set(rirs)
 
-    # deterministic mid-run stop: first chunk proceeds, second sees a stop
+    # deterministic mid-run stop: the flag raises once the first clip has
+    # been fully scored.  Tied to completed work, not to a poll count — the
+    # pipelined engine legitimately polls stop_requested from both the
+    # dispatch loop and the prefetch thread, so a call-count fake would
+    # stop the run before any chunk was processed.
     from disco_tpu.enhance import driver as driver_mod
+    from disco_tpu.obs.metrics import REGISTRY
 
-    calls = {"n": 0}
+    clips0 = REGISTRY.counter("clips_enhanced").value
 
     def fake_stop():
-        calls["n"] += 1
-        return calls["n"] > 1
+        return REGISTRY.counter("clips_enhanced").value - clips0 >= 1
 
     monkeypatch.setattr(driver_mod.run_interrupt, "stop_requested", fake_stop)
     out_root, led = tmp_path / "out", tmp_path / "led.jsonl"
