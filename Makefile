@@ -3,9 +3,9 @@
 
 PYTHON ?= python
 
-.PHONY: test coverage doc install native clean bench milestone-corpus dryrun obs-check fault-check chaos-check perf-check
+.PHONY: test coverage doc install native clean bench milestone-corpus dryrun obs-check fault-check chaos-check perf-check serve-check
 
-test: obs-check fault-check chaos-check perf-check
+test: obs-check fault-check chaos-check perf-check serve-check
 	$(PYTHON) -m pytest tests/ -q
 
 # Telemetry gates (run before the suite so drift fails fast):
@@ -42,6 +42,19 @@ chaos-check:
 # corpus_clips_per_s (disco_tpu/enhance/check.py).
 perf-check:
 	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= $(PYTHON) -m disco_tpu.enhance.check
+
+# Online-serving gate: run the enhancement server in-process on CPU with
+# >=4 concurrent numpy-only streaming clients over loopback and assert the
+# serve contract: every session's output bit-identical to the offline
+# streaming_tango run, ONE batched readback per scheduler tick, a graceful
+# drain with zero truncated/lost frames + atomic session checkpoints that
+# resume bit-exactly, and chaos crashes (serve_tick / mid_write) that never
+# corrupt a delivered frame or a checkpoint (disco_tpu/serve/check.py).
+# Hermetic like perf-check: compile cache off, loopback only, one JAX
+# process, zero SIGKILLs.
+serve-check:
+	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= DISCO_TPU_COMPILE_CACHE=off \
+	    $(PYTHON) -m disco_tpu.serve.check
 
 coverage:
 	$(PYTHON) -m coverage run --branch --source=disco_tpu -m pytest tests/ -q

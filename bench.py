@@ -280,6 +280,82 @@ def bench_corpus(n_clips=4):
     return n_clips / dt, stats
 
 
+def bench_serve(n_sessions=4, dur_s=4.0):
+    """Online-serving lane: loopback server (``disco_tpu.serve``), N
+    concurrent synthetic streaming sessions, continuous batching on the one
+    device.  The numbers the lane exists to move: ``serve_blocks_per_s``
+    (aggregate enhanced-block throughput across sessions, wall-clock) and
+    ``serve_p95_ms`` (per-block request latency p95 — enqueue at the
+    scheduler to host-side delivery, from the ``serve_block_latency_ms``
+    histogram's reservoir).  A compile warm-up session runs first and the
+    histogram is reset, so p95 measures serving, not XLA compiles.
+
+    Returns (serve_blocks_per_s, serve_p95_ms, stats).
+    """
+    import threading
+
+    from disco_tpu.core.dsp import stft
+    from disco_tpu.serve import EnhanceServer, ServeClient, SessionConfig
+
+    Ks, Cs, u = 4, 2, 4
+    block = 4 * u
+    rng = np.random.default_rng(7)
+    Y = np.asarray(stft(rng.standard_normal((Ks, Cs, int(dur_s * FS))).astype(np.float32)))
+    F, T = Y.shape[-2:]
+    m = rng.uniform(0.05, 0.95, size=(Ks, F, T)).astype(np.float32)
+    cfg = SessionConfig(n_nodes=Ks, mics_per_node=Cs, n_freq=F,
+                        block_frames=block, update_every=u)
+    n_blocks = -(-T // block)
+
+    srv = EnhanceServer(max_sessions=max(8, n_sessions))
+    addr = srv.start()
+    errors: list[str] = []
+
+    def worker(i):
+        try:
+            cl = ServeClient(addr)
+            cl.open(cfg, session_id=f"bench{i}")
+            cl.enhance_clip(Y, m, m)
+            cl.close()
+            cl.shutdown()
+        except Exception as e:
+            errors.append(f"serve session {i}: {type(e).__name__}: {e}")
+
+    try:
+        worker("warmup")  # compiles the bucket's programs once
+        if errors:
+            raise RuntimeError("; ".join(errors))
+        lat_hist = obs_registry.histogram("serve_block_latency_ms")
+        lat_hist.reset()
+        ticks0 = srv.scheduler.ticks_with_work
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n_sessions)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        ticks = srv.scheduler.ticks_with_work - ticks0
+    finally:
+        srv.stop()
+    if errors:
+        raise RuntimeError("; ".join(errors))
+    total_blocks = n_sessions * n_blocks
+    p95_ms = lat_hist.percentile(95.0)
+    stats = {
+        "n_sessions": n_sessions,
+        "blocks_per_session": n_blocks,
+        "block_frames": block,
+        "clip_dur_s": dur_s,
+        "ticks": ticks,
+        "p50_ms": lat_hist.percentile(50.0),
+        "p99_ms": lat_hist.percentile(99.0),
+        "mean_blocks_per_tick": total_blocks / ticks if ticks else None,
+    }
+    return total_blocks / dt, p95_ms, stats
+
+
 def bench_numpy(dur_s=2.0):
     from tests.reference_impls import tango_np
 
@@ -410,6 +486,19 @@ def main(argv=None):
                 corpus_cps, corpus_stats = bench_corpus(n_clips=n_corpus)
         except Exception as e:
             corpus_error = f"{type(e).__name__}: {e}"[:200]
+    # serve lane: online service throughput/latency over loopback
+    # (BENCH_SERVE_SESSIONS concurrent sessions; 0 disables the lane)
+    serve_bps = serve_p95 = serve_stats = serve_error = None
+    n_serve = int(os.environ.get("BENCH_SERVE_SESSIONS", 4))
+    if n_serve > 0:
+        try:
+            with obs_events.stage("bench_serve", n_sessions=n_serve):
+                serve_bps, serve_p95, serve_stats = bench_serve(
+                    n_sessions=n_serve,
+                    dur_s=float(os.environ.get("BENCH_SERVE_DUR_S", 4.0)),
+                )
+        except Exception as e:
+            serve_error = f"{type(e).__name__}: {e}"[:200]
     if done is not None:
         done.set()
     # BENCH_NP_DUR_S=0 skips the float64 NumPy baseline (CPU smoke runs —
@@ -441,10 +530,14 @@ def main(argv=None):
         "corpus_clips_per_s": round(corpus_cps, 3) if corpus_cps else None,
         "corpus_pipeline": corpus_stats,
         "corpus_error": corpus_error,
+        "serve_blocks_per_s": round(serve_bps, 2) if serve_bps else None,
+        "serve_p95_ms": round(serve_p95, 3) if serve_p95 is not None else None,
+        "serve_sessions": serve_stats,
+        "serve_error": serve_error,
         "mfu": round(r["mfu"], 6) if r["mfu"] else None,
         "flops_per_clip": round(r["flops_per_clip"]) if r["flops_per_clip"] else None,
         "stage_ms": r["stage_ms"],
-        "notes": "value = DEFAULT pipeline (solver=power since round 4; rtf_eigh_solver is the reference-bit-matching lane), on-device RTF via k-queued slope timing (tunnel adds ~80ms/dispatch, reported separately; value_single_dispatch includes it); stages timed as separate fenced programs (full pipeline fuses tighter); corpus_clips_per_s = end-to-end miniature-corpus throughput through the pipelined prefetch/dispatch/readback engine (load+scoring included); numpy baseline at 2s clips; MFU vs dense-f32 peak (pipeline is FFT/small-eig bound by design)",
+        "notes": "value = DEFAULT pipeline (solver=power since round 4; rtf_eigh_solver is the reference-bit-matching lane), on-device RTF via k-queued slope timing (tunnel adds ~80ms/dispatch, reported separately; value_single_dispatch includes it); stages timed as separate fenced programs (full pipeline fuses tighter); corpus_clips_per_s = end-to-end miniature-corpus throughput through the pipelined prefetch/dispatch/readback engine (load+scoring included); serve_blocks_per_s / serve_p95_ms = online-service continuous-batching throughput and request-latency p95 over loopback (BENCH_SERVE_SESSIONS concurrent streaming sessions, compile warm-up excluded); numpy baseline at 2s clips; MFU vs dense-f32 peak (pipeline is FFT/small-eig bound by design)",
     }
     # sideband first (mirror of the stdout record + final counter snapshot),
     # THEN the one stdout line — events go to the file, never stdout.

@@ -1,8 +1,20 @@
 """Shared CLI conventions of the reference's argparse entry points:
 the ``'None'``-string -> None convention (tango.py:682-688, train.py:63-65)
 and the ``--rirs start count`` pair every corpus-scale CLI takes for
-embarrassingly-parallel job arrays (SURVEY.md §2.9 DP row)."""
+embarrassingly-parallel job arrays (SURVEY.md §2.9 DP row).
+
+Also THE home of the production seams every long-running CLI shares —
+``--obs-log`` / ``--ledger`` / ``--resume`` / ``--preflight`` /
+``--fault-spec`` argparse declarations and their wiring
+(:func:`obs_session`, :func:`run_preflight`, :func:`resolve_fault_spec`) —
+factored out of ``disco-tango`` / ``disco-train`` / ``disco-gen`` so a new
+entry point (``disco-serve``) gets the whole story by adding five lines,
+and a fix to any seam lands in every CLI at once.  No reference
+counterpart: the reference CLIs have no telemetry, resume or health-probe
+story at all (SURVEY.md §5.1, §7)."""
 from __future__ import annotations
+
+import contextlib
 
 
 def none_str(v):
@@ -48,3 +60,127 @@ def solver_spec(v: str):
     except ValueError as e:
         raise argparse.ArgumentTypeError(str(e))
     return v
+
+
+# -- the shared production seams (obs / ledger / preflight / faults) ---------
+def add_obs_log_arg(parser, what: str = "run") -> None:
+    parser.add_argument(
+        "--obs-log", default=None,
+        help=f"record structured {what} telemetry (manifest, per-stage "
+             "events, fence/RPC accounting, counters) to this JSONL file; "
+             "render with `python -m disco_tpu.cli.obs report PATH`",
+    )
+
+
+def add_trace_dir_arg(parser) -> None:
+    parser.add_argument(
+        "--trace-dir", default=None,
+        help="capture a jax.profiler trace into this directory (view with "
+             "XProf/TensorBoard; no-op if the profiler is unavailable)",
+    )
+
+
+def add_preflight_arg(parser, what: str = "the run") -> None:
+    parser.add_argument(
+        "--preflight", type=float, default=0.0, metavar="SECONDS",
+        help="run a bounded-deadline device health probe (one tiny fenced "
+             "dispatch, utils.resilience.preflight_probe) before "
+             f"{what} claims the chip; fail fast with a clean error if the "
+             "attachment is wedged (0 = off)",
+    )
+
+
+def add_ledger_arg(parser, unit: str, default_hint: str | None = None) -> None:
+    """``--ledger``: the run-ledger JSONL path; ``unit`` names the work unit
+    the records track ('clip', 'epoch', 'scene', ...)."""
+    parser.add_argument(
+        "--ledger", default=None,
+        help=f"run-ledger JSONL path (disco_tpu.runs.ledger): record "
+             f"per-{unit} state + artifact digests for verified resume"
+             + (f".  Default when --resume is set: {default_hint}" if default_hint else ""),
+    )
+
+
+def add_resume_arg(parser, unit: str = "unit", regen: str = "requeued") -> None:
+    parser.add_argument(
+        "--resume", action="store_true",
+        help=f"resume from the ledger: done {unit}s are VERIFIED against "
+             f"their artifact digests and skipped; corrupt/missing ones are "
+             f"{regen} (truncated files are never trusted).  Graceful "
+             "SIGTERM/SIGINT during a run exits resumable with this flag",
+    )
+
+
+def add_fault_args(parser) -> None:
+    parser.add_argument(
+        "--fault-spec", default=None,
+        help="YAML/JSON fault scenario (disco_tpu.fault.FaultSpec fields: "
+             "node_dropout, dropout_prob, link_loss_prob, stale_prob, "
+             "nan_z, nan_prob, seed): inject seeded faults at the "
+             "z-exchange and run degraded-mode beamforming; every fault "
+             "lands in the obs event log (doc/source/robustness.rst)",
+    )
+    parser.add_argument(
+        "--fault-seed", type=int, default=None,
+        help="override the fault spec's seed (ablation sweeps over fault "
+             "realizations without editing the file)",
+    )
+
+
+def resolve_fault_spec(args):
+    """Load ``--fault-spec`` (with the optional ``--fault-seed`` override)
+    into a FaultSpec, converting file/format errors into clean CLI errors."""
+    if args.fault_spec is None:
+        if args.fault_seed is not None:
+            raise SystemExit("--fault-seed needs --fault-spec")
+        return None
+    import dataclasses
+
+    from disco_tpu.fault import load_fault_spec
+
+    try:
+        spec = load_fault_spec(args.fault_spec)
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"--fault-spec {args.fault_spec}: {e}")
+    if args.fault_seed is not None:
+        spec = dataclasses.replace(spec, seed=args.fault_seed)
+    return spec
+
+
+def run_preflight(args):
+    """Execute the ``--preflight`` probe (no-op at the 0.0 default).
+    Returns the probe's result dict (it rides the ``run_start`` event), or
+    exits with a clean error naming the failure — never a raw traceback."""
+    if not getattr(args, "preflight", 0):
+        return None
+    from disco_tpu.utils.resilience import PreflightFailed, preflight_probe
+
+    try:
+        return preflight_probe(deadline_s=args.preflight)
+    except PreflightFailed as e:
+        raise SystemExit(f"preflight: {e}")
+
+
+@contextlib.contextmanager
+def obs_session(args, tool: str):
+    """The ``--obs-log`` wiring every production CLI shares: enable the
+    recorder and write the run manifest (the full non-None arg vector as
+    config) on entry; flush a final counters snapshot and release the
+    recorder on exit, crash or not.  No-op without ``--obs-log``."""
+    obs_log = getattr(args, "obs_log", None)
+    if obs_log:
+        from disco_tpu import obs
+
+        obs.enable(obs_log)
+        obs.write_manifest(
+            config={k: v for k, v in vars(args).items() if v is not None},
+            tool=tool,
+        )
+    try:
+        yield
+    finally:
+        if obs_log:
+            from disco_tpu import obs
+
+            obs.record("counters", **obs.REGISTRY.snapshot())
+            obs.disable()

@@ -9,7 +9,12 @@ import argparse
 
 import numpy as np
 
-from disco_tpu.cli.common import add_rirs_arg, add_scenario_arg
+from disco_tpu.cli.common import (
+    add_ledger_arg,
+    add_resume_arg,
+    add_rirs_arg,
+    add_scenario_arg,
+)
 from disco_tpu.datagen.disco import generate_disco_rirs, get_wavs_list
 from disco_tpu.io.layout import DatasetLayout
 from disco_tpu.sim.signals import SpeechAndNoiseSetup
@@ -27,16 +32,9 @@ def build_parser():
     p.add_argument("--duration", nargs=2, type=float, default=[5, 10],
                    help="min/max clip duration in seconds (convolve_signals.py:404)")
     p.add_argument("--seed", type=int, default=30, help="global seed (convolve_signals.py:330)")
-    p.add_argument("--ledger", default=None,
-                   help="run-ledger JSONL path (disco_tpu.runs.ledger): record "
-                        "per-scene state + artifact digests for verified "
-                        "resume.  Default when --resume is set: "
-                        "<dir_out>/log/ledger_<scenario>_<dset>.jsonl")
-    p.add_argument("--resume", action="store_true",
-                   help="resume from the ledger: done scenes are VERIFIED "
-                        "against their artifact digests; corrupt/missing ones "
-                        "are regenerated (the infos probe alone already guards "
-                        "truncation; the ledger adds digest-level checks)")
+    add_ledger_arg(p, "scene",
+                   default_hint="<dir_out>/log/ledger_<scenario>_<dset>.jsonl")
+    add_resume_arg(p, "scene", regen="regenerated")
     return p
 
 

@@ -7,16 +7,19 @@ Two subcommands:
   the estimated tunnel-RPC overhead (n_fences × ~80 ms — the Axon cost
   model, CLAUDE.md), recompile and sentinel listings, the fault-tolerance
   story (injected faults, retry recoveries, degraded-mode entries —
-  ``disco_tpu.fault`` / ``utils.resilience``), and the final counter
-  snapshot.
+  ``disco_tpu.fault`` / ``utils.resilience``), a histogram table with
+  p50/p95/p99 percentiles, an online-serving section (session lifecycle,
+  admission/eviction counters, request-latency percentiles —
+  ``disco_tpu.serve``), and the final counter snapshot.
 * ``compare OLD.json NEW.json`` — diff two bench records (either the
   driver-captured ``BENCH_r*.json`` wrapper with its ``parsed`` field, a raw
   ``bench.py`` stdout line, or an obs event log containing a
   ``bench_result`` event) into a regression verdict on the headline RTF
-  and — when the baseline carries the lane — on ``corpus_clips_per_s``,
-  the pipelined corpus engine's end-to-end throughput.  Exits nonzero on a
-  regression beyond ``--threshold``, which is what lets ``make obs-check``
-  gate CI on the bench trajectory.
+  and — when the baseline carries the lane — on ``corpus_clips_per_s``
+  (the pipelined corpus engine's end-to-end throughput) and
+  ``serve_blocks_per_s`` (the online service's continuous-batching
+  throughput).  Exits nonzero on a regression beyond ``--threshold``,
+  which is what lets ``make obs-check`` gate CI on the bench trajectory.
 
 No reference counterpart (the reference has no observability, SURVEY.md
 §5.1) — this is the first-class reader the BENCH_r01–r05 trajectory never
@@ -79,6 +82,29 @@ def summarize(events: list[dict]) -> dict:
     n_fences = sum(s["fences"] for s in stages.values())
     if counters and "counters" in counters:
         n_fences = max(n_fences, int(counters["counters"].get("fences", 0)))
+    histograms = (counters or {}).get("histograms") or {}
+
+    # -- serve section: the online service's lifecycle + request telemetry
+    session_events = [e for e in events if e["kind"] == "session"]
+    cvals = (counters or {}).get("counters") or {}
+    gvals = (counters or {}).get("gauges") or {}
+    serve = None
+    if session_events or any(k.startswith("serve") for k in cvals):
+        actions: dict[str, int] = {}
+        for e in session_events:
+            a = e["attrs"].get("action", "?")
+            actions[a] = actions.get(a, 0) + 1
+        serve = {
+            "sessions": actions,
+            "admission_reject": int(cvals.get("admission_reject", 0)),
+            "session_evicted": int(cvals.get("session_evicted", 0)),
+            "serve_ticks": int(cvals.get("serve_ticks", 0)),
+            "serve_blocks": int(cvals.get("serve_blocks", 0)),
+            "sessions_active": gvals.get("sessions_active"),
+            "queue_depth": gvals.get("queue_depth"),
+            "batch_occupancy": gvals.get("batch_occupancy"),
+            "latency_ms": histograms.get("serve_block_latency_ms"),
+        }
     return {
         "manifest": manifest["attrs"] if manifest else None,
         "stages": dict(sorted(stages.items(), key=lambda kv: -kv[1]["total_s"])),
@@ -94,6 +120,8 @@ def summarize(events: list[dict]) -> dict:
         "runs": [e for e in events if e["kind"] in ("run_start", "run_resume")],
         "interrupts": [e for e in events if e["kind"] == "interrupted"],
         "warnings": [e for e in events if e["kind"] == "warning"],
+        "histograms": histograms,
+        "serve": serve,
         "n_events": len(events),
         "n_fences": n_fences,
         "est_rpc_s": n_fences * RPC_MS_ESTIMATE / 1e3,
@@ -132,6 +160,44 @@ def render_report(summary: dict) -> str:
     )
     if summary["clips"]:
         lines.append(f"clips enhanced: {summary['clips']}")
+
+    def fmtg(v):
+        return "-" if v is None else f"{v:g}"
+
+    if summary.get("histograms"):
+        lines.append("")
+        lines.append(
+            f"{'histogram':<28}{'count':>7}{'mean':>10}{'p50':>10}"
+            f"{'p95':>10}{'p99':>10}{'max':>10}"
+        )
+        for name, h in sorted(summary["histograms"].items()):
+            lines.append(
+                f"{name:<28}{h.get('count', 0):>7}{fmtg(h.get('mean')):>10}"
+                f"{fmtg(h.get('p50')):>10}{fmtg(h.get('p95')):>10}"
+                f"{fmtg(h.get('p99')):>10}{fmtg(h.get('max')):>10}"
+            )
+    sv = summary.get("serve")
+    if sv:
+        lines.append("")
+        sess = "  ".join(f"{k}×{v}" for k, v in sorted(sv["sessions"].items()))
+        lines.append(f"serve sessions: {sess or '(none recorded)'}")
+        lines.append(
+            f"serve: {sv['serve_blocks']} blocks over {sv['serve_ticks']} "
+            f"ticks  admission rejects={sv['admission_reject']}  "
+            f"evictions={sv['session_evicted']}"
+        )
+        lines.append(
+            f"serve gauges: sessions_active={fmtg(sv['sessions_active'])}  "
+            f"queue_depth={fmtg(sv['queue_depth'])}  "
+            f"batch_occupancy={fmtg(sv['batch_occupancy'])}"
+        )
+        lat = sv.get("latency_ms") or {}
+        if lat.get("count"):
+            lines.append(
+                f"serve request latency (ms): p50={fmtg(lat.get('p50'))}  "
+                f"p95={fmtg(lat.get('p95'))}  p99={fmtg(lat.get('p99'))}  "
+                f"max={fmtg(lat.get('max'))} over {lat['count']} blocks"
+            )
     if summary["recompiles"]:
         by_label: dict[str, int] = {}
         for e in summary["recompiles"]:
@@ -277,6 +343,8 @@ def compare_records(old: dict, new: dict, threshold: float = 0.05) -> dict:
         ("rtf_covfused", True),
         ("streaming_rtf", True),
         ("corpus_clips_per_s", True),
+        ("serve_blocks_per_s", True),
+        ("serve_p95_ms", False),
         ("latency_ms_frame", False),
         ("dispatch_overhead_ms", False),
         ("mfu", True),
@@ -307,24 +375,30 @@ def compare_records(old: dict, new: dict, threshold: float = 0.05) -> dict:
             verdict = "OK"
         detail = f"headline rtf {o:g} → {n:g} ({r:+.1%}, threshold ±{threshold:.0%})"
 
-    # Corpus-throughput verdict (the pipelined engine's end-to-end number)
-    # alongside the RTF one: only judged when the BASELINE carries the lane
-    # — pre-engine records don't, and their absence must not flag — but a
-    # candidate that LOST a measured lane is a regression, not a skip.
-    oc, nc = old.get("corpus_clips_per_s"), new.get("corpus_clips_per_s")
-    if oc is not None:
-        if nc is None:
-            corpus_verdict = "REGRESSION"
-            corpus_detail = "corpus_clips_per_s lost (null in candidate)"
+    # Secondary throughput lanes — the corpus engine's clips/s and the
+    # online service's blocks/s — judged alongside the RTF, each only when
+    # the BASELINE carries the lane: pre-engine/pre-serve records don't,
+    # and their absence must not flag — but a candidate that LOST a
+    # measured lane is a regression, not a skip.
+    for key, label, unit in (
+        ("corpus_clips_per_s", "corpus", "clips/s"),
+        ("serve_blocks_per_s", "serve", "blocks/s"),
+    ):
+        o_lane, n_lane = old.get(key), new.get(key)
+        if o_lane is None:
+            continue
+        if n_lane is None:
+            lane_verdict = "REGRESSION"
+            lane_detail = f"{key} lost (null in candidate)"
         else:
-            rc = (nc - oc) / oc
-            corpus_verdict = ("REGRESSION" if rc < -threshold
-                              else "IMPROVED" if rc > threshold else "OK")
-            corpus_detail = f"corpus {oc:g} → {nc:g} clips/s ({rc:+.1%})"
-        detail = f"{detail}; {corpus_detail}"
-        if corpus_verdict == "REGRESSION":
+            rl = (n_lane - o_lane) / o_lane
+            lane_verdict = ("REGRESSION" if rl < -threshold
+                            else "IMPROVED" if rl > threshold else "OK")
+            lane_detail = f"{label} {o_lane:g} → {n_lane:g} {unit} ({rl:+.1%})"
+        detail = f"{detail}; {lane_detail}"
+        if lane_verdict == "REGRESSION":
             verdict = "REGRESSION"
-        elif corpus_verdict == "IMPROVED" and verdict == "OK":
+        elif lane_verdict == "IMPROVED" and verdict == "OK":
             verdict = "IMPROVED"
     return {"verdict": verdict, "detail": detail, "rows": rows}
 

@@ -1,0 +1,114 @@
+"""``disco-serve`` — the online enhancement service CLI.
+
+Binds the continuous-batching enhancement server (:mod:`disco_tpu.serve`)
+on a TCP or unix socket and serves streaming sessions until interrupted.
+The production seams are the shared ones from :mod:`disco_tpu.cli.common`:
+
+* ``--preflight`` probes the device attachment before the server claims
+  the chip for its whole lifetime (a wedged tunnel fails in seconds, not
+  after clients connect);
+* the first SIGINT/SIGTERM triggers a graceful drain
+  (:class:`~disco_tpu.runs.interrupt.GracefulInterrupt`): admission stops,
+  every queued block is enhanced and delivered, live sessions are
+  checkpointed under ``--state-dir`` (atomic msgpack + digest) and closed
+  with their resume coordinates — zero truncated or lost frames;
+* ``--obs-log`` records the session lifecycle, the
+  ``sessions_active``/``queue_depth``/``batch_occupancy`` gauges,
+  ``admission_reject``/``session_evicted`` counters and the
+  ``serve_block_latency_ms`` histogram, rendered with percentiles by
+  ``disco-obs report``;
+* ``--fault-spec`` expands a per-session seeded fault plan at admission
+  (``disco_tpu.fault``) — degraded-mode beamforming flows through the
+  service unchanged.
+
+No reference counterpart: the reference pipeline is strictly offline
+(SURVEY.md §2); this is the ROADMAP's "serves heavy traffic" entry point.
+"""
+from __future__ import annotations
+
+import argparse
+
+from disco_tpu.cli.common import (
+    add_fault_args,
+    add_obs_log_arg,
+    add_preflight_arg,
+    obs_session,
+    resolve_fault_spec,
+    run_preflight,
+)
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        description="Online TANGO enhancement service: continuous batching "
+                    "of concurrent streaming sessions on one device"
+    )
+    p.add_argument("--host", default="127.0.0.1",
+                   help="TCP bind address (loopback by default; the protocol "
+                        "is unauthenticated)")
+    p.add_argument("--port", type=int, default=7433,
+                   help="TCP port (0 = ephemeral, printed at startup)")
+    p.add_argument("--unix", default=None, metavar="PATH",
+                   help="bind a unix domain socket at PATH instead of TCP")
+    p.add_argument("--max-sessions", type=int, default=16,
+                   help="admission bound on concurrently live sessions; "
+                        "opens past it get a clean 'capacity' error frame")
+    p.add_argument("--max-queue-blocks", type=int, default=8,
+                   help="per-session input-queue bound (backpressure error "
+                        "frames instead of unbounded host memory)")
+    p.add_argument("--max-backlog", type=int, default=64,
+                   help="per-connection output-frame bound: a client that "
+                        "stops reading its socket is evicted once this many "
+                        "enhanced frames are backed up")
+    p.add_argument("--max-blocks-per-tick", type=int, default=64,
+                   help="blocks enhanced per scheduler tick across all "
+                        "sessions (bounds one tick's device queue and its "
+                        "single batched readback)")
+    p.add_argument("--tick-interval", type=float, default=0.002,
+                   metavar="SECONDS",
+                   help="dispatch-thread sleep between idle ticks")
+    p.add_argument("--state-dir", default=None,
+                   help="directory for live-session checkpoints: a graceful "
+                        "drain saves every open session here (atomic msgpack "
+                        "+ sha256 digest) and a later server resumes them "
+                        "(client opens with resume=<session id>)")
+    add_fault_args(p)
+    add_preflight_arg(p, what="the server")
+    add_obs_log_arg(p, what="serving")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    args.fault_spec = resolve_fault_spec(args)
+    with obs_session(args, tool="disco-serve"):
+        preflight = run_preflight(args)
+        from disco_tpu.runs import GracefulInterrupt
+        from disco_tpu.serve import EnhanceServer
+
+        srv = EnhanceServer(
+            host=args.host, port=args.port, unix_path=args.unix,
+            max_sessions=args.max_sessions,
+            max_queue_blocks=args.max_queue_blocks,
+            max_blocks_per_tick=args.max_blocks_per_tick,
+            max_backlog=args.max_backlog,
+            tick_interval_s=args.tick_interval,
+            state_dir=args.state_dir,
+            fault_spec=args.fault_spec,
+            run_info={"preflight": preflight, "state_dir": args.state_dir,
+                      "max_sessions": args.max_sessions},
+        )
+        with GracefulInterrupt() as stopped:
+            srv.serve_forever()
+        if stopped():
+            n = len(srv.checkpoints)
+            where = f" under {args.state_dir}" if n else ""
+            print(f"interrupted — drained gracefully; {n} live session(s) "
+                  f"checkpointed{where}"
+                  + ("; clients resume by reopening with their session id"
+                     if n else ""))
+        return srv
+
+
+if __name__ == "__main__":
+    main()

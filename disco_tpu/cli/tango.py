@@ -9,7 +9,20 @@ from __future__ import annotations
 
 import argparse
 
-from disco_tpu.cli.common import none_str, snr_value, solver_spec
+from disco_tpu.cli.common import (
+    add_fault_args,
+    add_ledger_arg,
+    add_obs_log_arg,
+    add_preflight_arg,
+    add_resume_arg,
+    add_trace_dir_arg,
+    none_str,
+    obs_session,
+    resolve_fault_spec,
+    run_preflight,
+    snr_value,
+    solver_spec,
+)
 from disco_tpu.enhance.driver import enhance_rir
 
 _POLICIES = ["None", "local", "distant", "compressed", "use_oracle_refs", "use_oracle_zs"]
@@ -63,27 +76,10 @@ def build_parser():
                         "mesh (clips sharded over 'batch', nodes over 'node', "
                         "GSPMD-placed collectives); needs BATCH*NODE devices and "
                         "--batch_size divisible by BATCH")
-    p.add_argument("--fault-spec", default=None,
-                   help="YAML/JSON fault scenario (disco_tpu.fault.FaultSpec "
-                        "fields: node_dropout, dropout_prob, link_loss_prob, "
-                        "stale_prob, nan_z, nan_prob, seed): inject seeded "
-                        "faults at the z-exchange and run degraded-mode "
-                        "beamforming; every fault lands in the obs event log "
-                        "(doc/source/robustness.rst)")
-    p.add_argument("--fault-seed", type=int, default=None,
-                   help="override the fault spec's seed (ablation sweeps over "
-                        "fault realizations without editing the file)")
-    p.add_argument("--ledger", default=None,
-                   help="run-ledger JSONL path (disco_tpu.runs.ledger): record "
-                        "per-clip state + artifact digests for verified resume. "
-                        "Default when --resume is set: "
-                        "<out_root or results>/ledger_<scenario>_<sav_dir>_<noise>.jsonl")
-    p.add_argument("--resume", action="store_true",
-                   help="resume from the ledger: done clips are VERIFIED "
-                        "against their artifact digests and skipped; corrupt/"
-                        "missing ones are requeued (truncated files are never "
-                        "trusted).  Graceful SIGTERM/SIGINT during a run exits "
-                        "resumable with this flag")
+    add_fault_args(p)
+    add_ledger_arg(p, "clip", default_hint="<out_root or results>/"
+                   "ledger_<scenario>_<sav_dir>_<noise>.jsonl")
+    add_resume_arg(p, "clip")
     p.add_argument("--no-pipeline", action="store_true",
                    help="--rirs mode: disable the overlapped corpus engine "
                         "(disco_tpu.enhance.pipeline — background chunk "
@@ -98,21 +94,9 @@ def build_parser():
                         "disables.  Default: $DISCO_TPU_COMPILE_CACHE, else "
                         "~/.cache/disco_tpu/xla_cache (off on the tunneled "
                         "attachment unless a directory is given)")
-    p.add_argument("--preflight", type=float, default=0.0, metavar="SECONDS",
-                   help="run a bounded-deadline device health probe (one tiny "
-                        "fenced dispatch, utils.resilience.preflight_probe) "
-                        "before the run claims the chip for hours; fail fast "
-                        "with a clean error if the attachment is wedged "
-                        "(0 = off)")
-    p.add_argument("--obs-log", default=None,
-                   help="record structured run telemetry (manifest, per-stage "
-                        "events, fence/RPC accounting, numerics sentinels) to "
-                        "this JSONL file; render with `python -m "
-                        "disco_tpu.cli.obs report PATH`")
-    p.add_argument("--trace-dir", default=None,
-                   help="capture a jax.profiler trace into this directory "
-                        "(view with XProf/TensorBoard; no-op if the profiler "
-                        "is unavailable)")
+    add_preflight_arg(p, what="the run")
+    add_obs_log_arg(p)
+    add_trace_dir_arg(p)
     return p
 
 
@@ -210,26 +194,6 @@ def resolve_solver(args):
         raise SystemExit(f"--config {args.config}: enhance.solver: {e}")
 
 
-def resolve_fault_spec(args):
-    """Load --fault-spec (with the optional --fault-seed override) into a
-    FaultSpec, converting file/format errors into clean CLI errors."""
-    if args.fault_spec is None:
-        if args.fault_seed is not None:
-            raise SystemExit("--fault-seed needs --fault-spec")
-        return None
-    import dataclasses
-
-    from disco_tpu.fault import load_fault_spec
-
-    try:
-        spec = load_fault_spec(args.fault_spec)
-    except (OSError, ValueError) as e:
-        raise SystemExit(f"--fault-spec {args.fault_spec}: {e}")
-    if args.fault_seed is not None:
-        spec = dataclasses.replace(spec, seed=args.fault_seed)
-    return spec
-
-
 def resolve_ledger(args):
     """--ledger / --resume resolution: an explicit path wins; --resume
     without a path lands at a deterministic default under the results root
@@ -257,41 +221,20 @@ def main(argv=None):
     args.ledger = resolve_ledger(args)
     policy = none_str(args.mask_z) or "none"
 
-    if args.obs_log:
-        from disco_tpu import obs
+    with obs_session(args, tool="disco-tango"):
+        preflight = run_preflight(args)
+        from disco_tpu import obs as _obs
 
-        obs.enable(args.obs_log)
-        obs.write_manifest(
-            config={k: v for k, v in vars(args).items() if v is not None},
-            tool="disco-tango",
-        )
-    preflight = None
-    if args.preflight > 0:
-        from disco_tpu.utils.resilience import PreflightFailed, preflight_probe
+        _obs.record("run_start", stage="enhance", tool="disco-tango",
+                    preflight=preflight, ledger=args.ledger, resume=args.resume)
+        from disco_tpu.runs import GracefulInterrupt
 
-        try:
-            preflight = preflight_probe(deadline_s=args.preflight)
-        except PreflightFailed as e:
-            raise SystemExit(f"preflight: {e}")
-    from disco_tpu import obs as _obs
-
-    _obs.record("run_start", stage="enhance", tool="disco-tango",
-                preflight=preflight, ledger=args.ledger, resume=args.resume)
-    from disco_tpu.runs import GracefulInterrupt
-
-    try:
         with GracefulInterrupt() as stopped:
             out = _run(args, policy)
         if stopped():
             print("interrupted — run is resumable: rerun with --resume "
                   f"{'--ledger ' + args.ledger if args.ledger else ''}".rstrip())
         return out
-    finally:
-        if args.obs_log:
-            from disco_tpu import obs
-
-            obs.record("counters", **obs.REGISTRY.snapshot())
-            obs.disable()
 
 
 def _run(args, policy):

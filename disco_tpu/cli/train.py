@@ -11,7 +11,15 @@ import argparse
 
 import numpy as np
 
-from disco_tpu.cli.common import none_str
+from disco_tpu.cli.common import (
+    add_ledger_arg,
+    add_obs_log_arg,
+    add_preflight_arg,
+    add_trace_dir_arg,
+    none_str,
+    obs_session,
+    run_preflight,
+)
 from disco_tpu.config import TrainConfig
 from disco_tpu.nn.crnn import build_crnn
 from disco_tpu.nn.data import (
@@ -40,66 +48,36 @@ def build_parser():
     p.add_argument("--single_channel", "-sc", action="store_true",
                    help="train the step-1 single-channel model (no z inputs)")
     p.add_argument("--seed", type=int, default=26, help="train.py:20 seed")
-    p.add_argument("--ledger", default=None,
-                   help="run-ledger JSONL path (disco_tpu.runs.ledger): record "
-                        "per-epoch state + artifact digests (losses npz, best "
-                        "checkpoint) for crash-safe audits of long runs")
-    p.add_argument("--preflight", type=float, default=0.0, metavar="SECONDS",
-                   help="bounded-deadline device health probe before the "
-                        "multi-hour run claims the chip (0 = off)")
-    p.add_argument("--obs-log", default=None,
-                   help="record structured run telemetry (manifest, per-epoch "
-                        "events with losses/steps/recompiles) to this JSONL "
-                        "file; render with `python -m disco_tpu.cli.obs report`")
-    p.add_argument("--trace-dir", default=None,
-                   help="capture a jax.profiler trace into this directory "
-                        "(view with XProf/TensorBoard)")
+    add_ledger_arg(p, "epoch")
+    add_preflight_arg(p, what="the multi-hour run")
+    add_obs_log_arg(p, what="training")
+    add_trace_dir_arg(p)
     return p
 
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
-    if args.obs_log:
-        from disco_tpu import obs
+    with obs_session(args, tool="disco-train"):
+        preflight = run_preflight(args)
+        from disco_tpu import obs as _obs
 
-        obs.enable(args.obs_log)
-        obs.write_manifest(
-            config={k: v for k, v in vars(args).items() if v is not None},
-            tool="disco-train",
-        )
-    preflight = None
-    if args.preflight > 0:
-        from disco_tpu.utils.resilience import PreflightFailed, preflight_probe
+        _obs.record("run_start", stage="train", tool="disco-train",
+                    preflight=preflight, ledger=args.ledger,
+                    resume=none_str(args.weights) is not None)
+        from disco_tpu.nn.training import CheckpointError
+        from disco_tpu.runs import GracefulInterrupt
 
         try:
-            preflight = preflight_probe(deadline_s=args.preflight)
-        except PreflightFailed as e:
-            raise SystemExit(f"preflight: {e}")
-    from disco_tpu import obs as _obs
-
-    _obs.record("run_start", stage="train", tool="disco-train",
-                preflight=preflight, ledger=args.ledger,
-                resume=none_str(args.weights) is not None)
-    from disco_tpu.nn.training import CheckpointError
-    from disco_tpu.runs import GracefulInterrupt
-
-    try:
-        with GracefulInterrupt() as stopped:
-            out = _run(args)
-        if stopped():
-            print("interrupted — training wound down between epochs; resume "
-                  "with --weights on the saved checkpoint")
-        return out
-    except CheckpointError as e:
-        # a corrupt/truncated --weights checkpoint is a clean CLI error
-        # naming the path, never a raw msgpack traceback
-        raise SystemExit(f"--weights: {e}")
-    finally:
-        if args.obs_log:
-            from disco_tpu import obs
-
-            obs.record("counters", **obs.REGISTRY.snapshot())
-            obs.disable()
+            with GracefulInterrupt() as stopped:
+                out = _run(args)
+            if stopped():
+                print("interrupted — training wound down between epochs; resume "
+                      "with --weights on the saved checkpoint")
+            return out
+        except CheckpointError as e:
+            # a corrupt/truncated --weights checkpoint is a clean CLI error
+            # naming the path, never a raw msgpack traceback
+            raise SystemExit(f"--weights: {e}")
 
 
 def _run(args):

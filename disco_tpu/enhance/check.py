@@ -57,6 +57,8 @@ def _check_bench_one_line(failures: list) -> dict | None:
         "BENCH_DUR_S": "0.5",
         "BENCH_ITERS": "2",
         "BENCH_CORPUS_CLIPS": "2",
+        "BENCH_SERVE_SESSIONS": "2",
+        "BENCH_SERVE_DUR_S": "1.0",
         "BENCH_NP_DUR_S": "0",  # skip the minutes-long float64 baseline
         "BENCH_WATCHDOG_S": "900",
     }
@@ -87,6 +89,12 @@ def _check_bench_one_line(failures: list) -> dict | None:
     if not isinstance((rec.get("corpus_pipeline") or {}).get("prefetch_stall_ms"),
                       (int, float)):
         failures.append("bench: corpus_pipeline.prefetch_stall_ms missing/null")
+    for key in ("serve_blocks_per_s", "serve_p95_ms"):
+        if not isinstance(rec.get(key), (int, float)):
+            failures.append(
+                f"bench: {key} missing/null in the record "
+                f"(serve_error={rec.get('serve_error')!r})"
+            )
     return rec
 
 

@@ -47,11 +47,68 @@ from disco_tpu.obs.accounting import counted_jit
 #: this module's reshape.
 DEFAULT_UPDATE_EVERY = 4
 
+#: Signature defaults of the traced float parameters, named so callers that
+#: need bit-reproducibility (``disco_tpu.serve.scheduler``) can mirror the
+#: canonical calling convention: jax.jit applies an OMITTED default at trace
+#: time (a weak f64 Python constant, folded once), while a PASSED float is a
+#: traced f32 input computed at runtime — e.g. ``0.99 ** 3`` then differs in
+#: the last ulp between the two, and the warm-up GEVD refreshes run on
+#: near-degenerate covariances where one ulp flips the ffill hold guard and
+#: diverges the stream.  Same value, different program: omit when equal.
+DEFAULT_LAMBDA_COR = 0.99
+DEFAULT_MU = 1.0
+
 
 def _outer(x):
     """(..., F, D) frame -> (..., F, D, D) outer product."""
     return jnp.einsum("...fc,...fd->...fcd", x, jnp.conj(x),
                       precision=jax.lax.Precision.HIGHEST)
+
+
+def initial_stream_state(n_nodes: int, n_mics: int, n_freq: int,
+                         update_every: int = DEFAULT_UPDATE_EVERY,
+                         ref_mic: int = 0, dtype=None):
+    """The explicit warm-start continuation state of :func:`streaming_tango`
+    as a host (numpy) pytree — exactly the state the ``state=None`` /
+    ``z_avail=None`` defaults materialize internally (``R0 = 1e-6 I``
+    covariances, the ref-mic one-hot filter seed, an empty last-good-z hold
+    carry), so ``streaming_tango(..., state=initial_stream_state(...),
+    z_avail=ones)`` is bit-identical to the default first call (pinned in
+    tests/test_serve.py).
+
+    The online enhancement service (``disco_tpu.serve``) needs the state in
+    this explicit form from block 0: every session then carries a uniform,
+    serializable pytree (``disco_tpu.serve.session.save_session_state``)
+    instead of a ``None``-until-first-block special case.
+
+    Returns a dict with ``step1``/``step2`` ``(Rss, Rnn, w)`` triples
+    (leading node axis, matching the vmapped per-node streams) and the
+    ``hold`` carries for the ``z_y``/``zn`` exchanged streams.
+    """
+    import numpy as np
+
+    dtype = np.complex64 if dtype is None else np.dtype(dtype)
+    K, C, F, u = int(n_nodes), int(n_mics), int(n_freq), int(update_every)
+    D2 = C + K - 1  # step-2 stacks [local mics ‖ K-1 exchanged z's]
+    eps = 1e-6
+
+    def cov_w(D):
+        R = np.broadcast_to(eps * np.eye(D, dtype=dtype), (K, F, D, D)).copy()
+        w = np.zeros((K, F, D), dtype)
+        w[..., ref_mic] = 1.0
+        return R, w
+
+    R1, w1 = cov_w(C)
+    R2, w2 = cov_w(D2)
+
+    def hold_carry():
+        return (np.zeros((K, F, u), dtype), np.zeros((K,), bool))
+
+    return {
+        "step1": (R1, R1.copy(), w1),
+        "step2": (R2, R2.copy(), w2),
+        "hold": {"z_y": hold_carry(), "zn": hold_carry()},
+    }
 
 
 def _block_covariances(XSb, XNb, lam, Rss0=None, Rnn0=None):
@@ -186,9 +243,9 @@ def _stream_filter(X, XS, XN, lam, u, mu, ref: int = 0, extras=None, init_state=
 def streaming_step1(
     Y,
     mask_z,
-    lambda_cor: float = 0.99,
+    lambda_cor: float = DEFAULT_LAMBDA_COR,
     update_every: int = DEFAULT_UPDATE_EVERY,
-    mu: float = 1.0,
+    mu: float = DEFAULT_MU,
     ref_mic: int = 0,
     S=None,
     N=None,
@@ -336,9 +393,9 @@ def streaming_tango(
     Y,
     masks_z,
     mask_w,
-    lambda_cor: float = 0.99,
+    lambda_cor: float = DEFAULT_LAMBDA_COR,
     update_every: int = DEFAULT_UPDATE_EVERY,
-    mu: float = 1.0,
+    mu: float = DEFAULT_MU,
     ref_mic: int = 0,
     S=None,
     N=None,

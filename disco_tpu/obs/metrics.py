@@ -14,6 +14,7 @@ instrumentation is ad-hoc ``time.clock()`` prints, train.py:96-103).
 from __future__ import annotations
 
 import contextlib
+import random
 import threading
 import time
 
@@ -54,11 +55,21 @@ class Gauge:
         self.value = float(v)
 
 
-class Histogram:
-    """Streaming count/total/min/max summary (per-clip durations etc.) —
-    enough for a report table without binning policy."""
+#: Retained-sample cap per histogram.  Percentiles (``p50``/``p95``/``p99``
+#: in :meth:`Histogram.summary`) come from this bounded reservoir, so a
+#: long-lived process (the online enhancement server's request-latency
+#: histograms) cannot grow host memory without bound.  Below the cap the
+#: percentiles are exact over every observation; past it, classic reservoir
+#: sampling keeps a uniform subsample (deterministically seeded — the same
+#: observation stream always yields the same report).
+RESERVOIR_SIZE = 2048
 
-    __slots__ = ("name", "count", "total", "min", "max", "_lock")
+
+class Histogram:
+    """Streaming count/total/min/max summary plus p50/p95/p99 from a bounded
+    sample reservoir (per-clip durations, per-request serve latencies)."""
+
+    __slots__ = ("name", "count", "total", "min", "max", "_samples", "_rng", "_lock")
 
     def __init__(self, name: str):
         self.name = name
@@ -66,6 +77,8 @@ class Histogram:
         self.total = 0.0
         self.min = None
         self.max = None
+        self._samples: list[float] = []
+        self._rng = random.Random(0xD15C0)
         self._lock = threading.Lock()
 
     def observe(self, v) -> None:
@@ -75,14 +88,53 @@ class Histogram:
             self.total += v
             self.min = v if self.min is None else min(self.min, v)
             self.max = v if self.max is None else max(self.max, v)
+            if len(self._samples) < RESERVOIR_SIZE:
+                self._samples.append(v)
+            else:  # reservoir: keep each of the count observations w.p. R/count
+                j = self._rng.randrange(self.count)
+                if j < RESERVOIR_SIZE:
+                    self._samples[j] = v
+
+    @staticmethod
+    def _percentile(ordered: list[float], q: float):
+        """Linear-interpolated percentile over a sorted sample list (the
+        numpy default definition, so tests can pin against np.percentile)."""
+        if not ordered:
+            return None
+        pos = (q / 100.0) * (len(ordered) - 1)
+        lo = int(pos)
+        hi = min(lo + 1, len(ordered) - 1)
+        return ordered[lo] + (ordered[hi] - ordered[lo]) * (pos - lo)
+
+    def percentile(self, q: float):
+        """The q-th percentile of the retained samples (exact while count <=
+        RESERVOIR_SIZE; a uniform-subsample estimate beyond)."""
+        with self._lock:
+            ordered = sorted(self._samples)
+        return self._percentile(ordered, q)
+
+    def reset(self) -> None:
+        """Zero in place (the bench's serve lane resets the latency
+        histogram between the compile warm-up and the timed run, so p95
+        measures serving, not XLA compiles)."""
+        with self._lock:
+            self.count, self.total, self.min, self.max = 0, 0.0, None, None
+            self._samples.clear()
 
     def summary(self) -> dict:
+        with self._lock:
+            ordered = sorted(self._samples)
+            count, total = self.count, self.total
+            vmin, vmax = self.min, self.max
         return {
-            "count": self.count,
-            "total": self.total,
-            "mean": self.total / self.count if self.count else None,
-            "min": self.min,
-            "max": self.max,
+            "count": count,
+            "total": total,
+            "mean": total / count if count else None,
+            "min": vmin,
+            "max": vmax,
+            "p50": self._percentile(ordered, 50.0),
+            "p95": self._percentile(ordered, 95.0),
+            "p99": self._percentile(ordered, 99.0),
         }
 
 
@@ -133,9 +185,11 @@ class Registry:
         for name, v in sorted(snap["gauges"].items()):
             lines.append(f"gauge      {name:28s} {v if v is None else f'{v:g}'}")
         for name, s in sorted(snap["histograms"].items()):
-            mean = f"{s['mean']:g}" if s["mean"] is not None else "-"
+            fmt = lambda v: f"{v:g}" if v is not None else "-"
             lines.append(
-                f"histogram  {name:28s} n={s['count']} total={s['total']:g} mean={mean}"
+                f"histogram  {name:28s} n={s['count']} total={fmt(s['total'])} "
+                f"mean={fmt(s['mean'])} p50={fmt(s.get('p50'))} "
+                f"p95={fmt(s.get('p95'))} p99={fmt(s.get('p99'))}"
             )
         return "\n".join(lines)
 
@@ -146,7 +200,7 @@ class Registry:
             for g in self._gauges.values():
                 g.value = None
             for h in self._histograms.values():
-                h.count, h.total, h.min, h.max = 0, 0.0, None, None
+                h.reset()
 
 
 #: Process-global registry — the single place run counters accumulate.

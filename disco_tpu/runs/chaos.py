@@ -31,6 +31,11 @@ Seams wired through the pipeline (each a named :func:`tick` call):
   ``ChaosCrash`` is re-delivered at the consuming dispatch loop
   (``enhance/pipeline.ChunkPrefetcher``), so a crash during background
   loading still kills the run like a process death would.
+* ``serve_tick``     — at the top of every online-serving scheduler tick
+  (``serve/scheduler.py``), on the dispatch thread: the injected crash
+  kills the server mid-stream (connections drop, nothing more is
+  written), which is what lets ``make serve-check`` prove no client ever
+  observes a truncated frame and every checkpoint survives intact.
 
 Injection is armed either programmatically (:func:`configure`) or via the
 ``DISCO_TPU_CHAOS`` environment variable (``"seam"`` or ``"seam:N"`` —

@@ -1,0 +1,53 @@
+"""disco_tpu.serve — online enhancement: continuous batching of concurrent
+streaming sessions on one device.
+
+Everything before this package is offline: clips in, artifacts out.  The
+streaming TANGO pipeline (``enhance/streaming.py``) already processes audio
+in ``update_every``-frame blocks with an explicit continuation carry —
+exactly the per-session state an online service needs (DANSE's adaptive
+block-update design; Bertrand & Moonen 2010, Furnon et al. 2021).  This
+package is the subsystem that turns "one clip, one process" into "many
+concurrent sessions, one device":
+
+* :mod:`~disco_tpu.serve.protocol`  — length-prefixed msgpack frames over a
+  unix/TCP socket; numpy-only (clients never import jax).
+* :mod:`~disco_tpu.serve.session`   — per-stream state: config, streaming
+  carry, fault availability, queues; checkpoint/resume via atomic msgpack.
+* :mod:`~disco_tpu.serve.scheduler` — the continuous-batching tick: ready
+  blocks across sessions dispatched async through the SAME jitted program
+  as offline (bit-exact parity), ONE batched readback per tick.
+* :mod:`~disco_tpu.serve.server`    — asyncio I/O + one dispatch thread
+  (the single chip-claiming thread), graceful drain, chaos seams.
+* :mod:`~disco_tpu.serve.client`    — blocking numpy client.
+* :mod:`~disco_tpu.serve.check`     — the ``make serve-check`` gate.
+
+No reference counterpart: the reference repo has no online story at all
+(SURVEY.md §2); the ROADMAP north star — "serves heavy traffic" — starts
+here.
+"""
+from disco_tpu.serve.client import ServeClient, ServeError
+from disco_tpu.serve.scheduler import AdmissionError, QueueFull, Scheduler
+from disco_tpu.serve.server import EnhanceServer
+from disco_tpu.serve.session import (
+    Session,
+    SessionConfig,
+    SessionStateError,
+    load_session_state,
+    probe_session_state,
+    save_session_state,
+)
+
+__all__ = [
+    "AdmissionError",
+    "EnhanceServer",
+    "QueueFull",
+    "Scheduler",
+    "ServeClient",
+    "ServeError",
+    "Session",
+    "SessionConfig",
+    "SessionStateError",
+    "load_session_state",
+    "probe_session_state",
+    "save_session_state",
+]

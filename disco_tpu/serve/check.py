@@ -1,0 +1,338 @@
+"""``make serve-check`` — the online-serving gate.
+
+Runs the enhancement server in-process on the CPU backend (hermetic: no
+network beyond loopback, compile cache off, ONE jax process — the server;
+clients are numpy-only threads) and asserts the serve acceptance contract:
+
+1. **Concurrent parity**: ≥4 concurrent streaming clients with different
+   clips, smoothing factors and a per-session fault mask — every session's
+   output is **bit-identical** to the offline ``streaming_tango`` run of
+   the same clip, and the scheduler performed **exactly one batched
+   readback per tick-with-work** (``device_get_batches`` accounting, the
+   corpus-engine discipline).
+2. **Graceful drain**: a SIGINT-equivalent stop (``runs.interrupt``) with a
+   half-fed live session — the server stops admitting, finishes every
+   queued block, checkpoints the session atomically and closes with its
+   resume coordinates; **zero truncated or lost frames** (blocks delivered
+   == blocks accepted), and the resumed continuation on a fresh server is
+   bit-identical to the uninterrupted offline run.
+3. **Chaos**: an injected :class:`~disco_tpu.runs.chaos.ChaosCrash` at the
+   ``serve_tick`` seam kills the server mid-stream — every frame a client
+   received before the death is complete and bit-correct, nothing is
+   half-written; and a ``mid_write`` crash during the drain checkpoint
+   leaves **no truncated checkpoint at a final path** (the atomic-write
+   invariant), after which a clean drain still checkpoints and resumes.
+
+All crashes are simulated in-process; nothing is ever SIGKILLed
+(environment contract).  Wired into ``make test`` alongside ``obs-check``,
+``fault-check``, ``chaos-check`` and ``perf-check``.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import tempfile
+import threading
+from pathlib import Path
+
+K, C, U = 4, 2, 4
+BLOCK = 2 * U
+
+
+def _scene(seed, L=8000):
+    import numpy as np
+
+    from disco_tpu.core.dsp import stft
+
+    rng = np.random.default_rng(seed)
+    Y = np.asarray(stft(rng.standard_normal((K, C, L)).astype(np.float32)))
+    F, T = Y.shape[-2:]
+    m = rng.uniform(0.05, 0.95, size=(K, F, T)).astype(np.float32)
+    return Y, m
+
+
+def _offline(Y, m, **kw):
+    import numpy as np
+
+    from disco_tpu.enhance.streaming import streaming_tango
+
+    return np.asarray(streaming_tango(Y, m, m, update_every=U, policy="local", **kw)["yf"])
+
+
+def _config(F, **kw):
+    from disco_tpu.serve import SessionConfig
+
+    return SessionConfig(n_nodes=K, mics_per_node=C, n_freq=F,
+                         block_frames=BLOCK, update_every=U, **kw)
+
+
+def _check_parity(failures: list) -> dict:
+    """Experiment 1: 4 concurrent clients, bit-parity + readback accounting."""
+    import numpy as np
+
+    from disco_tpu.obs.accounting import device_get_count
+    from disco_tpu.serve import EnhanceServer, ServeClient
+
+    specs = [  # (seed, config kwargs, offline kwargs, z_mask)
+        (31, {}, {}, None),
+        (32, {"mu": 1.2}, {"mu": 1.2}, None),
+        (33, {"lambda_cor": 0.97}, {"lambda_cor": 0.97}, None),
+        (34, {}, {"z_avail": np.array([1, 0, 1, 1], np.float32)},
+         np.array([1, 0, 1, 1], np.float32)),
+    ]
+    scenes = [(_scene(seed), ckw, okw, zm) for seed, ckw, okw, zm in specs]
+    refs = [_offline(Y, m, **okw) for (Y, m), _ckw, okw, _zm in scenes]
+    F = scenes[0][0][0].shape[-2]
+
+    srv = EnhanceServer(max_sessions=8)
+    addr = srv.start()
+    gets0 = device_get_count()
+    results = [None] * len(scenes)
+    errors: list = []
+
+    def worker(i):
+        (Y, m), ckw, _okw, zm = scenes[i]
+        try:
+            cl = ServeClient(addr)
+            cl.open(_config(F, **ckw), z_mask=zm)
+            results[i] = cl.enhance_clip(Y, m, m)
+            cl.close()
+            cl.shutdown()
+        except Exception as e:  # surfaced below, with the session index
+            errors.append(f"client {i}: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(len(scenes))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    gets = device_get_count() - gets0
+    ticks = srv.scheduler.ticks_with_work
+    srv.stop()
+    failures.extend(errors)
+    for i, ref in enumerate(refs):
+        if results[i] is None:
+            failures.append(f"parity: session {i} returned nothing")
+        elif not np.array_equal(results[i], ref):
+            failures.append(
+                f"parity: session {i} output differs from offline streaming_tango "
+                f"(max abs diff {np.abs(results[i] - ref).max():g})"
+            )
+    if gets != ticks:
+        failures.append(
+            f"parity: {gets} batched readbacks for {ticks} scheduler ticks — "
+            "the one-device_get_tree-per-tick contract is broken"
+        )
+    return {"sessions": len(scenes), "ticks": ticks, "batched_readbacks": gets}
+
+
+def _check_drain_resume(failures: list, state_dir: Path) -> dict:
+    """Experiment 2: graceful stop drains, checkpoints, resumes bit-exact."""
+    import numpy as np
+
+    from disco_tpu.runs.interrupt import GracefulInterrupt, request_stop
+    from disco_tpu.serve import EnhanceServer, ServeClient
+    from disco_tpu.serve.session import probe_session_state
+
+    Y, m = _scene(41)
+    F, T = Y.shape[-2:]
+    ref = _offline(Y, m)
+    n_blocks = -(-T // BLOCK)
+    half = max(1, n_blocks // 2)
+
+    outs = {}
+    with GracefulInterrupt():  # the dispatch loop polls runs.interrupt
+        srv = EnhanceServer(max_sessions=4, state_dir=state_dir)
+        addr = srv.start()
+        cl = ServeClient(addr)
+        cl.open(_config(F), session_id="drainee")
+        for i in range(half):
+            lo, hi = i * BLOCK, (i + 1) * BLOCK
+            cl.send_block(Y[..., lo:hi], m[..., lo:hi], m[..., lo:hi])
+            outs[i] = cl.recv_enhanced(i)
+        request_stop("serve-check drain")  # the in-process SIGINT equivalent
+        info = cl.wait_closed(timeout_s=120)
+        srv.wait(timeout_s=120)
+        cl.shutdown()
+
+    if not cl.draining:
+        failures.append("drain: client never saw the 'draining' notice")
+    if info.get("blocks_done") != half:
+        failures.append(
+            f"drain: closed at blocks_done={info.get('blocks_done')}, "
+            f"expected {half} (lost frames)"
+        )
+    if len(outs) != half:
+        failures.append(f"drain: {len(outs)}/{half} enhanced blocks delivered")
+    state_path = info.get("state_path")
+    if not state_path or not probe_session_state(state_path):
+        failures.append(f"drain: checkpoint missing or fails its probe: {state_path}")
+
+    # resume on a fresh server (the GracefulInterrupt scope is gone, so the
+    # stop flag no longer trips the new dispatch loop)
+    srv2 = EnhanceServer(max_sessions=4, state_dir=state_dir)
+    addr2 = srv2.start()
+    try:
+        cl2 = ServeClient(addr2)
+        cl2.open(_config(F), resume="drainee")
+        if cl2.blocks_done != half:
+            failures.append(f"resume: server resumed at {cl2.blocks_done}, expected {half}")
+        rest = cl2.enhance_clip(Y, m, m)
+        cl2.close()
+        cl2.shutdown()
+    finally:
+        srv2.stop()
+    full = np.concatenate(
+        [np.concatenate([outs[i] for i in range(half)], axis=-1), rest], axis=-1
+    )
+    if not np.array_equal(full, ref):
+        failures.append(
+            f"resume: stitched drain+resume output differs from the offline run "
+            f"(max abs diff {np.abs(full - ref).max():g})"
+        )
+    return {"blocks_before_drain": half, "blocks_total": n_blocks}
+
+
+def _check_chaos(failures: list, state_dir: Path) -> dict:
+    """Experiment 3: chaos crashes — mid-serve and mid-checkpoint."""
+    import numpy as np
+
+    from disco_tpu.io.atomic import TMP_SUFFIX
+    from disco_tpu.runs import chaos
+    from disco_tpu.serve import EnhanceServer, ServeClient, ServeError
+    from disco_tpu.serve.session import probe_session_state
+
+    Y, m = _scene(51)
+    F, T = Y.shape[-2:]
+    ref = _offline(Y, m)
+    n_blocks = -(-T // BLOCK)
+    n_crashes = 0
+
+    # (a) crash the scheduler mid-stream: the 3rd tick dies like a process
+    srv = EnhanceServer(max_sessions=4)
+    addr = srv.start()
+    cl = ServeClient(addr)
+    cl.open(_config(F))
+    received: dict = {}
+    # arm AFTER block 0 is delivered: the dispatch loop ticks every
+    # tick_interval_s even when idle, so arming first would race the
+    # client's first send against 3 idle ticks (flaky under CI load)
+    cl.send_block(Y[..., :BLOCK], m[..., :BLOCK], m[..., :BLOCK])
+    received[0] = cl.recv_enhanced(0, timeout_s=60)
+    chaos.configure("serve_tick", after=3)
+    try:
+        for i in range(1, n_blocks):
+            lo, hi = i * BLOCK, min((i + 1) * BLOCK, T)
+            cl.send_block(Y[..., lo:hi], m[..., lo:hi], m[..., lo:hi])
+            received[i] = cl.recv_enhanced(i, timeout_s=60)
+        failures.append("chaos: serve_tick crash never fired")
+    except ServeError:
+        pass  # the connection died with the server — the expected shape
+    finally:
+        chaos.disable()
+    try:
+        srv.wait(timeout_s=60)
+        failures.append("chaos: dispatch thread survived the injected crash")
+    except chaos.ChaosCrash:
+        n_crashes += 1
+    cl.shutdown()
+    if not received:
+        failures.append("chaos: no blocks delivered before the injected crash")
+    for i, yf in received.items():
+        lo, hi = i * BLOCK, min((i + 1) * BLOCK, T)
+        if not np.array_equal(yf, ref[..., lo:hi]):
+            failures.append(
+                f"chaos: block {i} delivered before the crash is not "
+                "bit-correct — a truncated/corrupt frame reached a client"
+            )
+
+    # (b) crash INSIDE the drain checkpoint write: atomic-write invariant
+    srv = EnhanceServer(max_sessions=4, state_dir=state_dir)
+    addr = srv.start()
+    cl = ServeClient(addr)
+    cl.open(_config(F), session_id="chaotic")
+    cl.send_block(Y[..., :BLOCK], m[..., :BLOCK], m[..., :BLOCK])
+    cl.recv_enhanced(0, timeout_s=60)
+    chaos.configure("mid_write", after=1)
+    try:
+        srv.stop(timeout_s=120)
+        failures.append("chaos: mid_write crash never fired during checkpoint")
+    except chaos.ChaosCrash:
+        n_crashes += 1
+    finally:
+        chaos.disable()
+    cl.shutdown()
+    final = state_dir / "session_chaotic.state.msgpack"
+    if final.exists():
+        failures.append(
+            "chaos: a checkpoint reached its final path through a mid-write "
+            "crash (atomic-write invariant broken)"
+            if not probe_session_state(final)
+            else "chaos: mid_write crash fired after the rename (seam moved?)"
+        )
+    litter = [str(p) for p in state_dir.rglob(f"*{TMP_SUFFIX}.*")]
+    if litter:
+        failures.append(f"chaos: checkpoint temp litter not cleaned on unwind: {litter}")
+    return {"crashes_injected": n_crashes, "blocks_before_crash": len(received)}
+
+
+def main(argv=None) -> int:
+    import os
+
+    # Hermetic gate: no persistent compile-cache writes from CI (an
+    # explicit env value still wins), loopback sockets only, CPU backend
+    # (the Makefile forces JAX_PLATFORMS=cpu; a bare run would claim the
+    # tunneled chip).
+    os.environ.setdefault("DISCO_TPU_COMPILE_CACHE", "off")
+    from disco_tpu import obs
+
+    failures: list[str] = []
+    with tempfile.TemporaryDirectory() as tmp:
+        tmp = Path(tmp)
+        obs_log = tmp / "serve_check.jsonl"
+        with obs.recording(obs_log):
+            obs.write_manifest(tool="serve-check")
+            parity = _check_parity(failures)
+            drain = _check_drain_resume(failures, tmp / "state")
+            chaos_stats = _check_chaos(failures, tmp / "chaos_state")
+            obs.record("counters", **obs.REGISTRY.snapshot())
+        events = obs.read_events(obs_log)  # schema-validating read
+
+        session_events = [e for e in events if e["kind"] == "session"]
+        if not any(e["attrs"].get("action") == "open" for e in session_events):
+            failures.append("event log missing serve session open events")
+        if not any(e["attrs"].get("action") == "drain" for e in session_events):
+            failures.append("event log missing the drain session event")
+        if not any(e["kind"] == "interrupted" and e["stage"] == "serve" for e in events):
+            failures.append("event log missing the serve interrupted event")
+        chaos_events = [e for e in events if e["kind"] == "fault"
+                        and e["attrs"].get("fault") == "chaos_crash"]
+        if len(chaos_events) != chaos_stats["crashes_injected"]:
+            failures.append(
+                f"event log carries {len(chaos_events)} chaos_crash events, "
+                f"expected {chaos_stats['crashes_injected']}"
+            )
+        snap = obs.REGISTRY.snapshot()
+        lat = snap["histograms"].get("serve_block_latency_ms") or {}
+        if not lat.get("count"):
+            failures.append("serve_block_latency_ms histogram was never observed")
+
+    if failures:
+        for f in failures:
+            print(f"serve-check FAIL: {f}", file=sys.stderr)
+        return 1
+    print(json.dumps({
+        "serve_check": "ok",
+        "concurrent_sessions": parity["sessions"],
+        "ticks": parity["ticks"],
+        "batched_readbacks": parity["batched_readbacks"],
+        "drain_blocks": drain["blocks_before_drain"],
+        "crashes_injected": chaos_stats["crashes_injected"],
+        "jax_processes": 1,   # by construction: clients are numpy threads
+        "sigkills_issued": 0,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,240 @@
+"""Pure-NumPy streaming client for the online enhancement service.
+
+This module (with :mod:`disco_tpu.serve.protocol`) is the whole client-side
+dependency surface: **numpy + stdlib only, no jax import** — a client
+process must never contend for the single tunneled chip (environment
+contract; pinned by tests/test_serve.py).  A client holds one session per
+connection; open several clients for several streams.
+
+>>> client = ServeClient(("127.0.0.1", 7433))
+>>> client.open(SessionConfig(n_nodes=4, mics_per_node=2, n_freq=257,
+...                           block_frames=8))
+>>> yf = client.enhance_clip(Y, mask_z, mask_w)   # (K, F, T) enhanced STFT
+>>> client.close()
+
+Frames from the server are demultiplexed by a reader thread, so a client
+may stream blocks ahead of reading outputs (the server's admission control
+bounds how far: a ``backpressure`` error frame means wait and resend).
+"""
+from __future__ import annotations
+
+import queue as queue_mod
+import socket
+import threading
+
+import numpy as np
+
+from disco_tpu.serve import protocol
+from disco_tpu.serve.session import SessionConfig
+
+
+class ServeError(RuntimeError):
+    """An ``error`` frame from the server (code + message), or a dead
+    connection."""
+
+    def __init__(self, code: str, message: str):
+        super().__init__(f"{code}: {message}")
+        self.code = code
+
+
+class ServeClient:
+    """One streaming session over one socket connection."""
+
+    def __init__(self, address, timeout_s: float = 120.0):
+        self.timeout_s = timeout_s
+        self._sock = socket.socket(
+            socket.AF_UNIX if isinstance(address, (str, bytes)) else socket.AF_INET,
+            socket.SOCK_STREAM,
+        )
+        self._sock.connect(address if isinstance(address, (str, bytes)) else tuple(address))
+        self.session_id: str | None = None
+        self.config: SessionConfig | None = None
+        self.blocks_done = 0          # server-acknowledged start block on open
+        self.next_seq = 0
+        self.draining = False
+        self.resend_from: int | None = None   # lowest seq the server rejected
+        self.closed_info: dict | None = None
+        self._frames: "queue_mod.Queue" = queue_mod.Queue()
+        self._enhanced: dict[int, np.ndarray] = {}
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    # -- frame plumbing ------------------------------------------------------
+    def _read_loop(self):
+        try:
+            while True:
+                frame = protocol.recv_frame(self._sock)
+                if frame is None:
+                    self._frames.put(None)
+                    return
+                self._frames.put(frame)
+        except (OSError, protocol.ProtocolError) as e:
+            self._frames.put(e)
+
+    def _next_frame(self, timeout_s=None):
+        try:
+            item = self._frames.get(timeout=timeout_s or self.timeout_s)
+        except queue_mod.Empty:
+            raise ServeError("timeout", "no frame from server within timeout") from None
+        if item is None:
+            raise ServeError("eof", "server closed the connection")
+        if isinstance(item, BaseException):
+            raise ServeError("io", str(item))
+        return item
+
+    def _pump(self, timeout_s=None) -> dict:
+        """Read one frame, folding session-level notices into client state;
+        returns the frame (callers match on ``type``)."""
+        frame = self._next_frame(timeout_s)
+        kind = frame.get("type")
+        if kind == "enhanced":
+            self._enhanced[int(frame["seq"])] = frame["yf"]
+        elif kind == "draining":
+            self.draining = True
+        elif kind == "closed":
+            self.closed_info = frame
+        elif kind == "error":
+            seq = frame.get("seq")
+            if frame.get("code") == "backpressure" and seq is not None:
+                # the server's queue bound rejected this block — recoverable:
+                # remember the resend point and roll the auto-seq back so the
+                # stream resumes from the rejection (docstring contract above)
+                seq = int(seq)
+                if self.resend_from is None or seq < self.resend_from:
+                    self.resend_from = seq
+                self.next_seq = min(self.next_seq, seq)
+            else:
+                raise ServeError(frame.get("code", "?"), frame.get("message", ""))
+        return frame
+
+    # -- session lifecycle ---------------------------------------------------
+    def open(self, config: SessionConfig | dict, *, session_id: str | None = None,
+             z_mask=None, resume: str | None = None) -> str:
+        """Open (or resume) the session; returns the server session id."""
+        cfg = config if isinstance(config, SessionConfig) else SessionConfig.from_dict(config)
+        frame = {"type": "open", "config": cfg.to_dict()}
+        if session_id is not None:
+            frame["session"] = session_id
+        if z_mask is not None:
+            frame["z_mask"] = np.asarray(z_mask, np.float32)
+        if resume is not None:
+            frame["resume"] = resume
+        protocol.send_frame(self._sock, frame)
+        reply = self._pump()
+        if reply.get("type") != "open_ok":
+            raise ServeError("protocol", f"expected open_ok, got {reply.get('type')!r}")
+        self.session_id = reply["session"]
+        self.config = cfg
+        self.blocks_done = int(reply.get("blocks_done", 0))
+        self.next_seq = self.blocks_done
+        return self.session_id
+
+    def send_block(self, Y, mask_z, mask_w, seq: int | None = None) -> int:
+        """Stream one input block; returns its seq.  ``Y`` (K, C, F, T)
+        complex64, masks (K, F, T) float32; T = config.block_frames except
+        for a shorter final block."""
+        if self.session_id is None:
+            raise ServeError("protocol", "send_block before open")
+        seq = self.next_seq if seq is None else int(seq)
+        if self.resend_from is not None and seq <= self.resend_from:
+            self.resend_from = None      # resending from the rejection point
+        protocol.send_frame(self._sock, {
+            "type": "block", "seq": seq,
+            "Y": np.ascontiguousarray(Y, dtype=np.complex64),
+            "mask_z": np.ascontiguousarray(mask_z, dtype=np.float32),
+            "mask_w": np.ascontiguousarray(mask_w, dtype=np.float32),
+        })
+        self.next_seq = seq + 1
+        return seq
+
+    def recv_enhanced(self, seq: int, timeout_s=None) -> np.ndarray:
+        """Block until the enhanced output for ``seq`` arrives.
+
+        Raises a ``backpressure`` :class:`ServeError` if the server rejected
+        ``seq`` (or an earlier block) — the output would never arrive;
+        resend from :attr:`resend_from` (``send_block`` with ``seq=None``
+        already rolls back there) and call again."""
+        while seq not in self._enhanced:
+            if self.resend_from is not None and self.resend_from <= seq:
+                raise ServeError(
+                    "backpressure",
+                    f"block {self.resend_from} was rejected by the server's "
+                    f"queue bound; resend from seq {self.resend_from} before "
+                    f"waiting on {seq}",
+                )
+            self._pump(timeout_s)
+        return self._enhanced.pop(seq)
+
+    def close(self, timeout_s=None) -> dict:
+        """Finish the session: ask the server to flush, wait for the
+        ``closed`` frame.  Returns its payload (``blocks_done``,
+        ``state_path`` when the server checkpointed)."""
+        if self.session_id is None:
+            raise ServeError("protocol", "close before open")
+        protocol.send_frame(self._sock, {"type": "close", "session": self.session_id})
+        while self.closed_info is None:
+            self._pump(timeout_s)
+        return self.closed_info
+
+    def wait_closed(self, timeout_s=None) -> dict:
+        """Wait for a server-initiated close (a drain) without sending
+        anything — collects stray enhanced frames on the way."""
+        while self.closed_info is None:
+            self._pump(timeout_s)
+        return self.closed_info
+
+    def shutdown(self) -> None:
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+    # -- convenience ---------------------------------------------------------
+    def enhance_clip(self, Y, mask_z, mask_w, *, window: int = 4,
+                     on_block=None) -> np.ndarray:
+        """Stream a whole (K, C, F, T) clip through the open session and
+        return the (K, F, T) enhanced STFT.
+
+        Blocks of ``config.block_frames`` frames are kept at most
+        ``window`` in flight (sending everything first would trip the
+        server's queue bound on long clips); a ``backpressure`` rejection
+        (a window wider than the server's ``max_queue_blocks``) rolls the
+        send cursor back and the rejected blocks are resent once outputs
+        drain the queue.  Starts at the session's ``blocks_done``
+        (resume-aware).  ``on_block(seq, yf)`` observes each output as it
+        lands.
+        """
+        if self.config is None:
+            raise ServeError("protocol", "enhance_clip before open")
+        T = Y.shape[-1]
+        Tb = self.config.block_frames
+        n_blocks = -(-T // Tb)
+        outs: dict[int, np.ndarray] = {}
+        start = self.blocks_done
+        if start >= n_blocks:
+            # resumed checkpoint already covers the whole clip: nothing to
+            # stream, nothing to return (the earlier blocks were delivered
+            # to the pre-resume client)
+            return np.zeros(
+                (self.config.n_nodes, self.config.n_freq, 0), np.complex64
+            )
+        next_send = start
+        next_recv = start
+        while next_recv < n_blocks:
+            if self.resend_from is not None and self.resend_from < next_send:
+                next_send = self.resend_from
+            while next_send < n_blocks and next_send - next_recv < window:
+                lo, hi = next_send * Tb, min((next_send + 1) * Tb, T)
+                self.send_block(Y[..., lo:hi], mask_z[..., lo:hi], mask_w[..., lo:hi],
+                                seq=next_send)
+                next_send += 1
+            if next_recv in self._enhanced:
+                yf = self._enhanced.pop(next_recv)
+                outs[next_recv] = yf
+                if on_block is not None:
+                    on_block(next_recv, yf)
+                next_recv += 1
+                continue
+            self._pump()
+        return np.concatenate([outs[i] for i in range(start, n_blocks)], axis=-1)

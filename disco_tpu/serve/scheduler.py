@@ -1,0 +1,463 @@
+"""Continuous-batching scheduler: many concurrent sessions, one device.
+
+Each tick gathers the ready blocks across all live sessions and runs them
+as one device batch in the sense that matters on this hardware: every
+block's :func:`~disco_tpu.enhance.streaming.streaming_tango` step is
+dispatched *asynchronously* (no readback between sessions — dispatches
+queue on device), and the tick's outputs cross the host boundary in ONE
+complex-safe :func:`~disco_tpu.utils.transfer.device_get_tree` — the same
+discipline as the corpus engine (``enhance/pipeline.fetch_chunk_host``),
+where the fixed ~80 ms RPC per fenced readback, not per-op compute, is the
+cost model (CLAUDE.md).  ``device_get_batches`` therefore advances exactly
+once per tick-with-work, which is what ``make serve-check`` asserts.
+
+Why not one vmapped megabatch: a vmapped program compiles *different
+fusions* than the offline per-clip program, and the warm-up GEVD refreshes
+run on near-degenerate covariances where a one-ulp covariance difference
+flips the ``ffill`` hold guard and diverges the whole stream — measured at
+~1.0 relative error on synthetic CPU streams.  Per-session dispatch through
+the **same jitted callable the offline path uses** makes serve output
+bit-identical to ``streaming_tango`` by construction (the serve-check
+parity gate), while the *shape bucket* — sessions sharing a
+:class:`~disco_tpu.serve.session.SessionConfig` — still bounds compiles to
+one program per bucket via the jit cache (``counted_jit`` makes any drift
+visible as ``jit_trace`` events).  Off-CPU the step re-jits the same
+function with the carry donated (``donate_argnames=("state",)``): identical
+HLO math, buffers reused in place — the corpus engine's donation rule.
+
+Admission control is first-class: a bounded session count
+(``admission_reject`` counter), a bounded per-session input queue
+(backpressure errors instead of unbounded host memory), and slow-client
+eviction hooks (``session_evicted``).  Telemetry: ``sessions_active`` /
+``queue_depth`` / ``batch_occupancy`` gauges and the
+``serve_block_latency_ms`` histogram, all rendered by ``disco-obs report``.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from disco_tpu.obs import events as obs_events
+from disco_tpu.obs.metrics import REGISTRY as obs_registry
+from disco_tpu.serve.session import (
+    CLOSED,
+    DRAINING,
+    EVICTED,
+    OPEN,
+    Session,
+    SessionConfig,
+    load_session_state,
+)
+
+#: Default bound on blocks enhanced per tick across all sessions — keeps
+#: one tick's device queue (and its single readback payload) bounded, so a
+#: bursty client cannot starve the others for a whole tick.
+DEFAULT_MAX_BLOCKS_PER_TICK = 64
+
+#: Refresh-block horizon of a per-session fault plan drawn from a server
+#: ``--fault-spec`` (``plan_faults`` needs a concrete width; blocks past
+#: the horizon are treated as delivered).
+FAULT_PLAN_BLOCKS = 4096
+
+
+class AdmissionError(RuntimeError):
+    """Session rejected at the door (capacity, draining, bad config)."""
+
+    def __init__(self, code: str, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+class QueueFull(RuntimeError):
+    """Per-session input queue bound hit — backpressure, not a crash."""
+
+
+_STEP = None
+_STEP_LOCK = threading.Lock()
+
+
+def _serve_step():
+    """The per-block step callable.
+
+    CPU: literally ``enhance.streaming.streaming_tango`` — the offline
+    jitted wrapper itself, so serve and offline share one compiled program
+    per shape bucket and parity is true by construction.  Off-CPU: a
+    ``counted_jit`` of the same underlying function with the continuation
+    carry donated (aliasing metadata only — the HLO math is unchanged).
+    """
+    global _STEP
+    if _STEP is None:
+        with _STEP_LOCK:
+            if _STEP is None:
+                import jax
+
+                from disco_tpu.enhance import streaming
+                from disco_tpu.obs.accounting import counted_jit
+
+                if jax.default_backend() == "cpu":
+                    _STEP = streaming.streaming_tango
+                else:
+                    _STEP = counted_jit(
+                        streaming.streaming_tango.__wrapped__,
+                        label="serve_step",
+                        static_argnames=(
+                            "update_every", "ref_mic", "with_diagnostics",
+                            "policy", "solver",
+                        ),
+                        donate_argnames=("state",),
+                    )
+    return _STEP
+
+
+class Scheduler:
+    """Session registry + the per-tick continuous-batching loop body.
+
+    Thread model: ``open_session`` / ``push_block`` / ``request_close`` are
+    called from the server's I/O thread; :meth:`tick` runs on the single
+    dispatch thread (the ONLY place jax is entered — one chip claim per
+    process, per the environment contract).  The registry lock is never
+    held across device work.
+    """
+
+    def __init__(self, *, max_sessions: int = 16, max_queue_blocks: int = 8,
+                 max_blocks_per_tick: int = DEFAULT_MAX_BLOCKS_PER_TICK,
+                 fault_spec=None):
+        if max_sessions < 1 or max_queue_blocks < 1 or max_blocks_per_tick < 1:
+            raise ValueError("scheduler bounds must be >= 1")
+        self.max_sessions = max_sessions
+        self.max_queue_blocks = max_queue_blocks
+        self.max_blocks_per_tick = max_blocks_per_tick
+        self.fault_spec = fault_spec
+        self.draining = False
+        self._lock = threading.Lock()
+        self._sessions: dict[str, Session] = {}
+        self._session_seq = 0
+        self._rotate = 0
+        self.ticks_with_work = 0
+
+    # -- registry (I/O thread) ----------------------------------------------
+    def sessions(self) -> list:
+        with self._lock:
+            return list(self._sessions.values())
+
+    def get(self, session_id: str) -> Session | None:
+        with self._lock:
+            return self._sessions.get(session_id)
+
+    def open_session(self, config, *, session_id: str | None = None,
+                     z_mask=None, resume_from=None) -> Session:
+        """Admit one session (or resume a checkpointed one).
+
+        Raises :class:`AdmissionError` on capacity / draining / config
+        problems — the server turns those into clean ``error`` frames.
+        """
+        if self.draining:
+            obs_registry.counter("admission_reject").inc()
+            raise AdmissionError("draining", "server is draining; not admitting sessions")
+        if not isinstance(config, SessionConfig):
+            try:
+                config = SessionConfig.from_dict(config)
+            except ValueError as e:
+                obs_registry.counter("admission_reject").inc()
+                raise AdmissionError("bad_config", str(e)) from None
+
+        with self._lock:
+            if len(self._sessions) >= self.max_sessions:
+                obs_registry.counter("admission_reject").inc()
+                raise AdmissionError(
+                    "capacity",
+                    f"server at max_sessions={self.max_sessions}; retry later",
+                )
+            self._session_seq += 1
+            seq = self._session_seq
+
+        if resume_from is not None:
+            session = load_session_state(resume_from)
+            if session.config != config:
+                obs_registry.counter("admission_reject").inc()
+                raise AdmissionError(
+                    "config_mismatch",
+                    f"checkpoint {resume_from} was made with a different "
+                    f"session config; resume with the original one",
+                )
+            if session_id is not None:
+                session.id = session_id
+        else:
+            from disco_tpu.enhance.streaming import initial_stream_state
+
+            sid = session_id or f"s{seq:06d}"
+            z_avail = self._session_fault_plan(config, seq, z_mask)
+            session = Session(
+                sid, config,
+                z_avail=z_avail,
+                state=initial_stream_state(
+                    config.n_nodes, config.mics_per_node, config.n_freq,
+                    update_every=config.update_every, ref_mic=config.ref_mic,
+                ),
+            )
+        with self._lock:
+            if session.id in self._sessions:
+                obs_registry.counter("admission_reject").inc()
+                raise AdmissionError(
+                    "duplicate", f"session id {session.id!r} already live"
+                )
+            self._sessions[session.id] = session
+        obs_events.record(
+            "session", stage="serve", action="open", session=session.id,
+            resumed_blocks=session.blocks_done,
+            faulted=session.z_avail is not None,
+        )
+        self._set_gauges()
+        return session
+
+    def _session_fault_plan(self, config: SessionConfig, seq: int, z_mask):
+        """Per-session z availability: an explicit client mask wins; else a
+        server fault spec is expanded per session (seeded off the admission
+        sequence number, so every session draws its own deterministic
+        realization — ablation runs reproduce exactly)."""
+        if z_mask is not None:
+            mask = np.asarray(z_mask, np.float32)
+            if mask.shape not in ((config.n_nodes,),) and (
+                mask.ndim != 2 or mask.shape[0] != config.n_nodes
+            ):
+                obs_registry.counter("admission_reject").inc()
+                raise AdmissionError(
+                    "bad_config",
+                    f"z_mask shape {mask.shape} does not match n_nodes={config.n_nodes}",
+                )
+            return mask
+        if self.fault_spec is None or not self.fault_spec.any_fault():
+            return None
+        import dataclasses
+
+        from disco_tpu.fault.inject import plan_faults
+
+        spec = dataclasses.replace(self.fault_spec, seed=self.fault_spec.seed + seq)
+        plan = plan_faults(spec, config.n_nodes, n_blocks=FAULT_PLAN_BLOCKS)
+        plan.record(mode="serve")
+        if not plan.any_fault():
+            return None
+        return np.asarray(plan.avail_streaming, np.float32)
+
+    def push_block(self, session: Session, seq: int, Y, mask_z, mask_w) -> None:
+        """Accept one input block (I/O thread).  Validates shape/order and
+        enforces the queue bound (:class:`QueueFull` = backpressure)."""
+        cfg = session.config
+        if session.status not in (OPEN, DRAINING):
+            raise QueueFull(f"session {session.id} is {session.status}")
+        if seq != session.blocks_in:
+            raise QueueFull(
+                f"out-of-order block seq {seq} (expected {session.blocks_in}); "
+                "blocks must arrive in order"
+            )
+        Y = np.asarray(Y)
+        if not np.issubdtype(Y.dtype, np.number):
+            # the wire codec round-trips ANY declared dtype; a non-numeric
+            # block must die here as a bad_block, not inside the dispatch
+            # thread (where it would read as a server crash)
+            raise ValueError(f"block Y dtype {Y.dtype} is not numeric")
+        exp = cfg.block_shape
+        if Y.shape[:-1] != exp[:-1] or Y.shape[-1] > exp[-1] or Y.shape[-1] < 1:
+            raise QueueFull(
+                f"block shape {Y.shape} does not fit session shape {exp} "
+                "(only the final block may be shorter)"
+            )
+        for name, m in (("mask_z", mask_z), ("mask_w", mask_w)):
+            m = np.asarray(m)
+            if not np.issubdtype(m.dtype, np.number):
+                raise ValueError(f"{name} dtype {m.dtype} is not numeric")
+            if m.shape != (cfg.n_nodes, cfg.n_freq, Y.shape[-1]):
+                raise QueueFull(f"{name} shape {m.shape} does not match block {Y.shape}")
+        if session.queue_depth() >= self.max_queue_blocks:
+            raise QueueFull(
+                f"session {session.id} input queue at max_queue_blocks="
+                f"{self.max_queue_blocks}; wait for enhanced blocks"
+            )
+        session.push_block(seq, Y, np.asarray(mask_z), np.asarray(mask_w), time.time())
+        self._set_gauges()
+
+    def request_close(self, session: Session) -> None:
+        session.close_requested = True
+
+    def evict(self, session: Session, reason: str) -> None:
+        """Drop a session that is not keeping up (unread output backlog,
+        dead connection).  The server sends the clean ``error`` frame; this
+        records the decision and frees the slot."""
+        with self._lock:
+            self._sessions.pop(session.id, None)
+        session.status = EVICTED
+        session.error = reason
+        obs_registry.counter("session_evicted").inc()
+        obs_events.record("session", stage="serve", action="evict",
+                          session=session.id, reason=reason)
+        self._set_gauges()
+
+    def _finish(self, session: Session) -> None:
+        with self._lock:
+            self._sessions.pop(session.id, None)
+        session.status = CLOSED
+        obs_events.record("session", stage="serve", action="close",
+                          session=session.id, blocks=session.blocks_done)
+        self._set_gauges()
+
+    # -- dispatch (scheduler thread) ----------------------------------------
+    def tick(self) -> list:
+        """One continuous-batching step.
+
+        Returns ``[(session, seq, yf, latency_s), ...]`` host-side
+        deliveries (``yf`` numpy complex64), plus finishes sessions whose
+        close was requested and whose queues drained.  Exactly one batched
+        readback when any block ran; none on an idle tick.
+        """
+        from disco_tpu.runs import chaos
+
+        chaos.tick("serve_tick")
+        sessions = self.sessions()
+        if sessions:
+            # rotate the starting session each tick: under sustained overload
+            # the per-tick block budget runs out, and a fixed registry order
+            # would starve the sessions at the tail indefinitely
+            k = self._rotate % len(sessions)
+            self._rotate += 1
+            sessions = sessions[k:] + sessions[:k]
+        work: list = []        # (session, seq, yf_device)
+        budget = self.max_blocks_per_tick
+        n_busy = 0
+        t0 = time.perf_counter()
+        for session in sessions:
+            if session.status not in (OPEN, DRAINING) or budget <= 0:
+                continue
+            blocks = session.pop_blocks(budget)
+            if not blocks:
+                continue
+            n_busy += 1
+            budget -= len(blocks)
+            for seq, Y, mz, mw in blocks:
+                try:
+                    work.append(
+                        (session, seq, self._dispatch(session, seq, Y, mz, mw))
+                    )
+                except Exception as e:
+                    # per-session isolation: one block the device rejects
+                    # (validation can't anticipate every jax TypeError) must
+                    # not unwind the dispatch thread and kill every other
+                    # live session — evict the offender and keep serving.
+                    # ChaosCrash is a BaseException and still dies here.
+                    self.evict(
+                        session, f"dispatch failed: {type(e).__name__}: {e}"
+                    )
+                    break
+
+        deliveries = []
+        if work:
+            from disco_tpu.utils.transfer import device_get_tree
+
+            with obs_events.stage("serve_tick", n_blocks=len(work), n_sessions=n_busy):
+                host = device_get_tree([yf for (_, _, yf) in work])
+            now = time.time()
+            lat_hist = obs_registry.histogram("serve_block_latency_ms")
+            for (session, seq, _), yf in zip(work, host):
+                t_in = session.enqueued_at.pop(seq, None)
+                lat_s = (now - t_in) if t_in is not None else 0.0
+                lat_hist.observe(lat_s * 1e3)
+                session.blocks_done = max(session.blocks_done, seq + 1)
+                deliveries.append((session, seq, yf, lat_s))
+            self.ticks_with_work += 1
+            obs_registry.counter("serve_ticks").inc()
+            obs_registry.counter("serve_blocks").inc(len(work))
+            obs_registry.histogram("serve_tick_ms").observe(
+                (time.perf_counter() - t0) * 1e3
+            )
+        obs_registry.gauge("batch_occupancy").set(
+            n_busy / self.max_sessions if self.max_sessions else 0.0
+        )
+
+        for session in sessions:
+            if (session.close_requested and session.status in (OPEN, DRAINING)
+                    and session.queue_depth() == 0):
+                self._finish(session)
+        self._set_gauges()
+        return deliveries
+
+    def _dispatch(self, session: Session, seq: int, Y, mz, mw):
+        """Queue one block's streaming step on device (async — no
+        readback).  The call goes through the exact offline entry point
+        with the session's carry; only ``out["yf"]`` is fetched later, but
+        the whole program (z exchange, hold, both steps) runs as offline."""
+        import jax
+
+        from disco_tpu.utils.transfer import to_device
+
+        from disco_tpu.enhance.streaming import DEFAULT_LAMBDA_COR, DEFAULT_MU
+
+        cfg = session.config
+        u = cfg.update_every
+        n_refresh = -(-Y.shape[-1] // u)  # ceil: ragged final block
+        step = _serve_step()
+        state = jax.tree_util.tree_map(to_device, session.state)
+        # lambda_cor / mu are traced floats: jax.jit folds an OMITTED default
+        # at trace time but traces a PASSED value — same number, different
+        # program, and the warm-up GEVD refreshes amplify the last-ulp
+        # difference (see streaming.DEFAULT_LAMBDA_COR).  Mirror the
+        # canonical offline call: pass them only when non-default.
+        kw = {}
+        if cfg.lambda_cor != DEFAULT_LAMBDA_COR:
+            kw["lambda_cor"] = cfg.lambda_cor
+        if cfg.mu != DEFAULT_MU:
+            kw["mu"] = cfg.mu
+        out = step(
+            to_device(np.ascontiguousarray(Y)),
+            to_device(np.ascontiguousarray(mz)),
+            to_device(np.ascontiguousarray(mw)),
+            update_every=u,
+            ref_mic=cfg.ref_mic,
+            policy=cfg.policy,
+            state=state,
+            solver=cfg.solver,
+            z_avail=session.block_z_avail(seq, n_refresh),
+            **kw,
+        )
+        session.state = out["state"]
+        return out["yf"]
+
+    def pending_blocks(self) -> int:
+        return sum(s.queue_depth() for s in self.sessions())
+
+    def _set_gauges(self) -> None:
+        with self._lock:
+            n = len(self._sessions)
+            depth = sum(s.queue_depth() for s in self._sessions.values())
+        obs_registry.gauge("sessions_active").set(n)
+        obs_registry.gauge("queue_depth").set(depth)
+
+    # -- drain / checkpoint (dispatch thread) --------------------------------
+    def checkpoint_sessions(self, state_dir) -> dict:
+        """Checkpoint every live session's carry under ``state_dir`` —
+        states fetched in ONE batched readback, files placed atomically
+        (:func:`~disco_tpu.serve.session.save_session_state`).  Returns
+        {session_id: path}."""
+        from pathlib import Path
+
+        from disco_tpu.serve.session import fetch_state_host, save_session_state
+
+        state_dir = Path(state_dir)
+        sessions = [s for s in self.sessions() if s.status in (OPEN, DRAINING)]
+        if not sessions:
+            return {}
+        host_states = fetch_state_host({s.id: s.state for s in sessions})
+        paths = {}
+        for s in sessions:
+            path = state_dir / f"session_{s.id}.state.msgpack"
+            save_session_state(path, s, state_host=host_states[s.id])
+            paths[s.id] = str(path)
+        return paths
+
+    def start_drain(self) -> None:
+        """Stop admitting; mark every live session draining (their queued
+        blocks still run to completion on subsequent ticks)."""
+        self.draining = True
+        for s in self.sessions():
+            if s.status == OPEN:
+                s.status = DRAINING

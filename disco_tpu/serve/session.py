@@ -1,0 +1,316 @@
+"""Per-stream session state of the online enhancement service.
+
+A session is one client's audio stream: open → blocks in → enhanced blocks
+out → close.  It wraps exactly the state the streaming pipeline already
+defines — the :func:`~disco_tpu.enhance.streaming.streaming_tango`
+continuation carry (per-block covariance recursion + last-good-z hold,
+DANSE's adaptive block-update design) plus the per-session fault
+availability plan — and adds the bookkeeping a scheduler needs: input /
+output queues, block accounting, and lifecycle status.
+
+The carry is kept as an **explicit, serializable pytree** from block 0
+(:func:`~disco_tpu.enhance.streaming.initial_stream_state`), so a live
+session can be checkpointed at any block boundary
+(:func:`save_session_state`, atomic msgpack + digest probe) and resumed by
+a later server process (:func:`load_session_state`) with bit-identical
+continuation — the crash-safety story of ``disco_tpu.runs`` extended to
+streams that never had a file to begin with.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import threading
+from pathlib import Path
+
+import msgpack
+import numpy as np
+
+#: Session lifecycle states.
+OPEN, DRAINING, CLOSED, EVICTED = "open", "draining", "closed", "evicted"
+
+_STATE_VERSION = 1
+
+#: mask-for-z policies the streaming pipeline supports (the oracle policies
+#: are offline-only — enhance/streaming._stream_stats).
+SERVE_POLICIES = ("local", "distant", "none")
+
+
+@dataclasses.dataclass(frozen=True)
+class SessionConfig:
+    """Static per-session configuration — the shape-bucket key.
+
+    Two sessions with equal configs share one compiled program (the
+    ``streaming_tango`` jit cache keys on shapes + static args), which is
+    what bounds serve-side recompiles; ``block_frames`` is therefore fixed
+    per session and every block but the last must carry exactly that many
+    STFT frames (a shorter final block compiles one extra ragged program).
+    """
+
+    n_nodes: int
+    mics_per_node: int
+    n_freq: int
+    block_frames: int
+    update_every: int = 4
+    lambda_cor: float = 0.99
+    mu: float = 1.0
+    ref_mic: int = 0
+    policy: str = "local"
+    solver: str = "eigh"
+
+    def __post_init__(self):
+        for f in ("n_nodes", "mics_per_node", "n_freq", "block_frames", "update_every"):
+            v = getattr(self, f)
+            if not isinstance(v, int) or v < 1:
+                raise ValueError(f"session config {f!r}: expected a positive int, got {v!r}")
+        if self.n_nodes < 2:
+            raise ValueError(
+                f"session config n_nodes: the distributed exchange needs >= 2 "
+                f"nodes, got {self.n_nodes}"
+            )
+        if self.block_frames % self.update_every:
+            raise ValueError(
+                f"session config block_frames ({self.block_frames}) must be a "
+                f"multiple of update_every ({self.update_every}): chunk-exact "
+                f"streaming continuation needs refresh-aligned block boundaries"
+            )
+        if not 0 <= self.ref_mic < self.mics_per_node:
+            raise ValueError(
+                f"session config ref_mic {self.ref_mic} outside [0, "
+                f"{self.mics_per_node})"
+            )
+        if self.policy not in SERVE_POLICIES:
+            raise ValueError(
+                f"session config policy {self.policy!r} not servable; one of "
+                f"{SERVE_POLICIES} (oracle policies are offline-only)"
+            )
+        if not 0.0 < float(self.lambda_cor) < 1.0:
+            raise ValueError(
+                f"session config lambda_cor must be in (0, 1), got {self.lambda_cor!r}"
+            )
+
+    @property
+    def block_shape(self):
+        """(K, C, F, T) of one input block's mixture STFT."""
+        return (self.n_nodes, self.mics_per_node, self.n_freq, self.block_frames)
+
+    @property
+    def mask_shape(self):
+        return (self.n_nodes, self.n_freq, self.block_frames)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SessionConfig":
+        if not isinstance(d, dict):
+            raise ValueError(f"session config: expected a mapping, got {type(d).__name__}")
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = sorted(set(d) - known)
+        if unknown:
+            raise ValueError(f"session config: unknown field(s) {unknown}")
+        return cls(**d)
+
+
+class Session:
+    """One live stream: config + streaming carry + queues + accounting.
+
+    The scheduler owns ``state`` (a device pytree between ticks) and the
+    input queue; the server's connection handler owns the output delivery.
+    All queue operations are lock-protected — blocks arrive on the asyncio
+    I/O thread while the dispatch thread drains them.
+    """
+
+    def __init__(self, session_id: str, config: SessionConfig, *,
+                 z_avail=None, state=None, blocks_done: int = 0):
+        self.id = session_id
+        self.config = config
+        #: (K,) or (K, B_plan) float availability of the exchanged streams —
+        #: the per-session fault plan (``disco_tpu.fault``); None = fault-free.
+        self.z_avail = None if z_avail is None else np.asarray(z_avail, np.float32)
+        #: streaming_tango continuation carry (device pytree between ticks;
+        #: host pytree right after open/resume).
+        self.state = state
+        self.status = OPEN
+        self.blocks_done = int(blocks_done)   # blocks fully enhanced + delivered to the writer
+        self.blocks_in = int(blocks_done)     # highest contiguous seq accepted + 1
+        self.close_requested = False
+        self._lock = threading.Lock()
+        self._pending: list = []              # [(seq, Y, mask_z, mask_w)] FIFO
+        self.error: str | None = None
+        #: wall-clock enqueue time per pending seq (latency accounting)
+        self.enqueued_at: dict[int, float] = {}
+
+    # -- input side (I/O thread) --------------------------------------------
+    def push_block(self, seq: int, Y, mask_z, mask_w, t_wall: float) -> None:
+        with self._lock:
+            self._pending.append((int(seq), Y, mask_z, mask_w))
+            self.enqueued_at[int(seq)] = t_wall
+            self.blocks_in = max(self.blocks_in, int(seq) + 1)
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    # -- dispatch side (scheduler thread) -----------------------------------
+    def pop_blocks(self, max_n: int) -> list:
+        """Take up to ``max_n`` queued blocks, in arrival order."""
+        with self._lock:
+            take, self._pending = self._pending[:max_n], self._pending[max_n:]
+            return take
+
+    def block_z_avail(self, seq: int, n_blocks: int):
+        """Availability columns for input block ``seq`` (``n_blocks``
+        refresh blocks wide): slice of the per-session plan, all-ones when
+        fault-free or past the plan horizon (plan columns are per
+        ``update_every`` refresh block)."""
+        K = self.config.n_nodes
+        if self.z_avail is None:
+            return np.ones((K, n_blocks), np.float32)
+        if self.z_avail.ndim == 1:
+            return np.broadcast_to(self.z_avail[:, None], (K, n_blocks)).copy()
+        per_block = self.config.block_frames // self.config.update_every
+        b0 = seq * per_block
+        cols = np.ones((K, n_blocks), np.float32)
+        hi = min(self.z_avail.shape[1], b0 + n_blocks)
+        if b0 < hi:
+            cols[:, : hi - b0] = self.z_avail[:, b0:hi]
+        return cols
+
+
+# -- checkpointing -----------------------------------------------------------
+def _pack_tree(tree):
+    """Nested dict/tuple/list pytree of numpy arrays -> msgpack-ready
+    structure (arrays via the wire codec — complex-safe, self-describing)."""
+    from disco_tpu.serve.protocol import encode_array
+
+    if isinstance(tree, dict):
+        return {"__map__": {k: _pack_tree(v) for k, v in tree.items()}}
+    if isinstance(tree, (list, tuple)):
+        return {"__seq__": [_pack_tree(v) for v in tree]}
+    return encode_array(np.asarray(tree))
+
+
+def _unpack_tree(obj):
+    from disco_tpu.serve.protocol import decode_array
+
+    if isinstance(obj, dict) and "__map__" in obj:
+        return {k: _unpack_tree(v) for k, v in obj["__map__"].items()}
+    if isinstance(obj, dict) and "__seq__" in obj:
+        return tuple(_unpack_tree(v) for v in obj["__seq__"])
+    return decode_array(obj)
+
+
+class SessionStateError(ValueError):
+    """A session checkpoint failed its integrity probe or config check."""
+
+
+def save_session_state(path, session: Session, state_host=None) -> Path:
+    """Checkpoint one live session's continuation carry atomically.
+
+    The carry (``session.state``) is fetched to host complex-safely in one
+    batched readback if it still lives on device, packed as msgpack with a
+    sha256 digest of the state payload embedded, and placed with the
+    tmp+fsync+``os.replace`` protocol of :mod:`disco_tpu.io.atomic` — an
+    interrupted server can never leave a truncated checkpoint at the final
+    path (the ``mid_write`` chaos seam fires inside, so the serve chaos
+    cycle proves it).
+
+    ``state_host``: pass an already-fetched host pytree to skip the device
+    readback (the drain path fetches all sessions' states in one
+    ``device_get_tree``).
+    """
+    from disco_tpu.io.atomic import atomic_write
+
+    if state_host is None:
+        state_host = fetch_state_host(session.state)
+    state_bytes = msgpack.packb(_pack_tree(state_host), use_bin_type=True)
+    payload = msgpack.packb(
+        {
+            "version": _STATE_VERSION,
+            "session": session.id,
+            "config": session.config.to_dict(),
+            "blocks_done": session.blocks_done,
+            "z_avail": None if session.z_avail is None
+            else _pack_tree(session.z_avail),
+            "state": state_bytes,
+            "state_sha256": hashlib.sha256(state_bytes).hexdigest(),
+        },
+        use_bin_type=True,
+    )
+    path = Path(path)
+    with atomic_write(path) as fh:
+        fh.write(payload)
+    return path
+
+
+def probe_session_state(path) -> bool:
+    """True iff ``path`` holds a complete, digest-consistent checkpoint —
+    the validate-before-trust probe of the resume path (a checkpoint
+    truncated behind the atomic writer's back must read as not-done)."""
+    try:
+        load_session_state(path)
+        return True
+    except Exception:
+        return False
+
+
+def load_session_state(path) -> Session:
+    """Load a checkpoint into a fresh :class:`Session` (host-side state;
+    the scheduler devices it on the first tick).  Raises
+    :class:`SessionStateError` on truncation, digest mismatch, or a config
+    that no longer validates."""
+    try:
+        raw = Path(path).read_bytes()
+        d = msgpack.unpackb(raw, raw=False, strict_map_key=False)
+    except Exception as e:
+        raise SessionStateError(f"{path}: not a readable session checkpoint: {e}") from None
+    if not isinstance(d, dict) or d.get("version") != _STATE_VERSION:
+        raise SessionStateError(
+            f"{path}: unknown checkpoint version {d.get('version') if isinstance(d, dict) else d!r}"
+        )
+    state_bytes = d.get("state")
+    digest = d.get("state_sha256")
+    if not isinstance(state_bytes, bytes) or not digest:
+        raise SessionStateError(f"{path}: checkpoint missing state payload/digest")
+    if hashlib.sha256(state_bytes).hexdigest() != digest:
+        raise SessionStateError(
+            f"{path}: state digest mismatch — checkpoint corrupt, refusing to resume"
+        )
+    try:
+        state = _unpack_tree(msgpack.unpackb(state_bytes, raw=False, strict_map_key=False))
+        config = SessionConfig.from_dict(d["config"])
+    except (KeyError, ValueError) as e:
+        raise SessionStateError(f"{path}: bad checkpoint contents: {e}") from None
+    z_avail = d.get("z_avail")
+    return Session(
+        str(d.get("session")), config,
+        z_avail=None if z_avail is None else _unpack_tree(z_avail),
+        state=state, blocks_done=int(d.get("blocks_done", 0)),
+    )
+
+
+def fetch_state_host(state):
+    """Device carry -> host numpy pytree in ONE complex-safe batched
+    readback (:func:`disco_tpu.utils.transfer.device_get_tree`); host
+    pytrees pass through untouched (no jax import needed)."""
+    leaves_on_host = all(
+        isinstance(x, np.ndarray)
+        for x in _iter_leaves(state)
+    )
+    if leaves_on_host:
+        return state
+    from disco_tpu.utils.transfer import device_get_tree
+
+    return device_get_tree(state)
+
+
+def _iter_leaves(tree):
+    if isinstance(tree, dict):
+        for v in tree.values():
+            yield from _iter_leaves(v)
+    elif isinstance(tree, (list, tuple)):
+        for v in tree:
+            yield from _iter_leaves(v)
+    else:
+        yield tree

@@ -184,6 +184,46 @@ def test_registry_counters_gauges_histograms():
     assert "t_counter" in pretty and "t_gauge" in pretty and "t_hist" in pretty
 
 
+def test_histogram_percentiles_on_known_samples():
+    """p50/p95/p99 pin against numpy's linear-interpolation definition —
+    the numbers `disco-obs report` renders for serve request latency."""
+    from disco_tpu.obs.metrics import Histogram
+
+    h = Histogram("t")
+    values = list(range(1, 101))
+    for v in values:
+        h.observe(float(v))
+    s = h.summary()
+    for q, key in ((50, "p50"), (95, "p95"), (99, "p99")):
+        assert s[key] == pytest.approx(float(np.percentile(values, q)))
+        assert h.percentile(q) == pytest.approx(float(np.percentile(values, q)))
+    assert h.percentile(0) == 1.0 and h.percentile(100) == 100.0
+    # one sample: every percentile IS that sample; empty: None, not a crash
+    h1 = Histogram("one")
+    h1.observe(7.0)
+    assert h1.summary()["p50"] == 7.0 == h1.summary()["p99"]
+    empty = Histogram("none").summary()
+    assert empty["p50"] is None and empty["p95"] is None
+
+
+def test_histogram_reservoir_bounded_and_reset():
+    """A long-lived serving process must not grow histogram memory without
+    bound: retained samples cap at RESERVOIR_SIZE, the estimate stays sane,
+    and reset() zeroes in place."""
+    from disco_tpu.obs.metrics import RESERVOIR_SIZE, Histogram
+
+    h = Histogram("t")
+    n = 3 * RESERVOIR_SIZE
+    for i in range(n):
+        h.observe(float(i % 100))
+    assert h.count == n and h.total == sum(float(i % 100) for i in range(n))
+    assert len(h._samples) == RESERVOIR_SIZE
+    assert 30.0 <= h.percentile(50.0) <= 70.0  # uniform-subsample estimate
+    h.reset()
+    assert h.count == 0 and h.percentile(50.0) is None
+    assert h.summary()["p95"] is None
+
+
 def test_registry_reset_keeps_module_bindings_live():
     """reset() zeroes in place: the fence counter bound at accounting import
     time must keep counting after a reset."""
@@ -322,6 +362,47 @@ def test_obs_report_renders_stage_table_and_fences(tmp_path, capsys):
         assert token in out, token
 
 
+def test_obs_report_serve_section(tmp_path, capsys):
+    """Session lifecycle events + the serve counters/gauges/histogram from
+    the final snapshot render as a serve section with latency percentiles."""
+    log = tmp_path / "serve.jsonl"
+    with obs.recording(log):
+        obs.record("session", stage="serve", action="open", session="s1")
+        obs.record("session", stage="serve", action="open", session="s2")
+        obs.record("session", stage="serve", action="evict", session="s2",
+                   reason="slow client")
+        obs.record("session", stage="serve", action="close", session="s1", blocks=8)
+        obs.record("session", stage="serve", action="drain", n_checkpointed=0)
+        obs.record(
+            "counters",
+            counters={"serve_ticks": 5, "serve_blocks": 40,
+                      "admission_reject": 1, "session_evicted": 1},
+            gauges={"sessions_active": 0.0, "queue_depth": 0.0,
+                    "batch_occupancy": 0.25},
+            histograms={"serve_block_latency_ms": {
+                "count": 40, "total": 800.0, "mean": 20.0, "min": 5.0,
+                "max": 80.0, "p50": 18.0, "p95": 60.0, "p99": 75.0}},
+        )
+    summary = obs_cli.main(["report", str(log)])
+    out = capsys.readouterr().out
+    sv = summary["serve"]
+    assert sv["sessions"] == {"open": 2, "evict": 1, "close": 1, "drain": 1}
+    assert sv["admission_reject"] == 1 and sv["session_evicted"] == 1
+    assert sv["serve_blocks"] == 40 and sv["serve_ticks"] == 5
+    assert sv["latency_ms"]["p95"] == 60.0
+    for token in ("serve sessions:", "open×2", "admission rejects=1",
+                  "evictions=1", "p50=18", "p95=60", "p99=75",
+                  "serve_block_latency_ms"):
+        assert token in out, token
+
+
+def test_obs_report_without_serve_events_has_no_serve_section(tmp_path):
+    log = tmp_path / "plain.jsonl"
+    with obs.recording(log):
+        obs.record("stage_end", stage="stft", dur_s=0.01, fences=1)
+    assert obs_cli.summarize(obs.read_events(log))["serve"] is None
+
+
 # -- obs CLI: compare -------------------------------------------------------
 def _bench_record(rtf):
     return {
@@ -371,6 +452,37 @@ def test_obs_compare_reads_bench_r_wrappers_and_null_candidate(tmp_path):
         obs_cli.main(["compare", str(root / "BENCH_r04.json"), str(bad)])
 
 
+def test_obs_compare_serve_lane_judged_only_with_baseline(tmp_path):
+    """serve_blocks_per_s: same rule as the corpus lane — judged only when
+    the baseline carries it (pre-serve records must not flag), a candidate
+    that lost the measured lane is a REGRESSION, and an improved lane can
+    lift an otherwise-OK verdict."""
+    def rec(path, rtf, serve=None, p95=None):
+        d = _bench_record(rtf)
+        if serve is not None:
+            d["serve_blocks_per_s"] = serve
+            d["serve_p95_ms"] = p95
+        p = tmp_path / path
+        p.write_text(json.dumps(d))
+        return str(p)
+
+    old = rec("old.json", 6700.0, serve=100.0, p95=40.0)
+    with pytest.raises(SystemExit):  # -20% serve throughput
+        obs_cli.main(["compare", old, rec("slow.json", 6700.0, serve=80.0, p95=55.0)])
+    with pytest.raises(SystemExit):  # lane lost entirely
+        obs_cli.main(["compare", old, rec("lost.json", 6700.0)])
+    diff = obs_cli.main(["compare", old, rec("fast.json", 6700.0, serve=120.0, p95=30.0)])
+    assert diff["verdict"] == "IMPROVED"
+    rows = {r["key"]: r for r in diff["rows"]}
+    assert rows["serve_blocks_per_s"]["rel"] == pytest.approx(0.2)
+    assert rows["serve_p95_ms"]["higher_is_better"] is False
+    # baseline WITHOUT the lane: candidate's serve numbers ride along
+    # unjudged
+    pre = rec("pre.json", 6700.0)
+    diff = obs_cli.main(["compare", pre, rec("cand.json", 6700.0, serve=50.0, p95=90.0)])
+    assert diff["verdict"] == "OK"
+
+
 def test_obs_compare_reads_event_log_bench_result(tmp_path):
     log = tmp_path / "run.jsonl"
     with obs.recording(log):
@@ -386,6 +498,13 @@ def _canned_bench_corpus(**_):
     return 0.5, {"n_clips": 4, "clip_dur_s": 2.0, "prefetch_stall_ms": 12.0,
                  "readback_ms": 80.0, "overlap_efficiency": 0.97,
                  "batched_readbacks": 2}
+
+
+def _canned_bench_serve(**_):
+    return 120.0, 35.0, {"n_sessions": 4, "blocks_per_session": 8,
+                         "block_frames": 16, "clip_dur_s": 4.0, "ticks": 10,
+                         "p50_ms": 20.0, "p99_ms": 50.0,
+                         "mean_blocks_per_tick": 3.2}
 
 
 def _canned_bench_jax(**_):
@@ -406,6 +525,7 @@ def test_bench_single_json_line_stdout_with_obs_log(tmp_path, monkeypatch, capsy
     monkeypatch.setattr(bench, "bench_jax", _canned_bench_jax)
     monkeypatch.setattr(bench, "bench_streaming", lambda **_: (0.85, 16.0, 18.9))
     monkeypatch.setattr(bench, "bench_corpus", _canned_bench_corpus)
+    monkeypatch.setattr(bench, "bench_serve", _canned_bench_serve)
     monkeypatch.setattr(bench, "bench_numpy", lambda **_: 3.0)
     log = tmp_path / "bench_events.jsonl"
     bench.main(["--obs-log", str(log)])
@@ -419,7 +539,7 @@ def test_bench_single_json_line_stdout_with_obs_log(tmp_path, monkeypatch, capsy
     assert kinds[0] == "manifest"
     assert "bench_result" in kinds and "counters" in kinds
     stages = {e["stage"] for e in events if e["kind"] == "stage_end"}
-    assert {"bench_jax", "bench_streaming", "bench_numpy"} <= stages
+    assert {"bench_jax", "bench_streaming", "bench_serve", "bench_numpy"} <= stages
     # the sideband mirrors the stdout record
     (br,) = [e for e in events if e["kind"] == "bench_result"]
     assert br["attrs"]["value"] == record["value"]
@@ -433,6 +553,7 @@ def test_bench_stdout_unchanged_without_obs_log(monkeypatch, capsys):
     monkeypatch.setattr(bench, "bench_jax", _canned_bench_jax)
     monkeypatch.setattr(bench, "bench_streaming", lambda **_: (0.85, 16.0, 18.9))
     monkeypatch.setattr(bench, "bench_corpus", _canned_bench_corpus)
+    monkeypatch.setattr(bench, "bench_serve", _canned_bench_serve)
     monkeypatch.setattr(bench, "bench_numpy", lambda **_: 3.0)
     bench.main([])
     out_lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
@@ -442,6 +563,10 @@ def test_bench_stdout_unchanged_without_obs_log(monkeypatch, capsys):
     # the corpus-mode metric of the pipelined engine rides the same line
     assert record["corpus_clips_per_s"] == 0.5
     assert record["corpus_pipeline"]["prefetch_stall_ms"] == 12.0
+    # ... and so do the online-serving lane's numbers
+    assert record["serve_blocks_per_s"] == 120.0
+    assert record["serve_p95_ms"] == 35.0
+    assert record["serve_sessions"]["n_sessions"] == 4
 
 
 def test_bench_error_path_records_event_and_one_line(tmp_path, monkeypatch, capsys):

@@ -1,0 +1,627 @@
+"""Tests for disco_tpu.serve — the online enhancement service.
+
+The load-bearing claim is *serve/offline parity*: every block a session
+streams through the continuous-batching scheduler must come back
+bit-identical to the offline ``streaming_tango`` run of the same clip
+(``make serve-check`` gates the full concurrent-clients version; these
+tests pin the pieces at unit size).
+"""
+from __future__ import annotations
+
+import ast
+import socket
+import threading
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from disco_tpu.core.dsp import stft
+from disco_tpu.enhance.streaming import initial_stream_state, streaming_tango
+from disco_tpu.serve import protocol
+from disco_tpu.serve.scheduler import AdmissionError, QueueFull, Scheduler
+from disco_tpu.serve.session import (
+    Session,
+    SessionConfig,
+    SessionStateError,
+    load_session_state,
+    probe_session_state,
+    save_session_state,
+)
+
+K, C, U = 4, 2, 4
+BLOCK = 2 * U  # frames per serve block
+
+
+@pytest.fixture(scope="module")
+def stream():
+    """A small (K, C, F, T) STFT stream + masks + its offline reference."""
+    rng = np.random.default_rng(3)
+    y = rng.standard_normal((K, C, 6000)).astype(np.float32)
+    Y = np.asarray(stft(y))
+    F, T = Y.shape[-2:]
+    m = rng.uniform(0.05, 0.95, size=(K, F, T)).astype(np.float32)
+    ref = np.asarray(streaming_tango(Y, m, m, update_every=U, policy="local")["yf"])
+    return Y, m, ref
+
+
+def _config(F, **kw):
+    return SessionConfig(n_nodes=K, mics_per_node=C, n_freq=F,
+                         block_frames=BLOCK, update_every=U, **kw)
+
+
+def _run_scheduler(sched, session, Y, m):
+    """Feed a whole stream through one scheduler session block by block."""
+    T = Y.shape[-1]
+    outs = {}
+    n_blocks = -(-T // BLOCK)
+    for i in range(n_blocks):
+        lo, hi = i * BLOCK, min((i + 1) * BLOCK, T)
+        sched.push_block(session, i, Y[..., lo:hi], m[..., lo:hi], m[..., lo:hi])
+        for _s, seq, yf, _lat in sched.tick():
+            outs[seq] = yf
+    return np.concatenate([outs[i] for i in range(n_blocks)], axis=-1)
+
+
+# -- protocol ----------------------------------------------------------------
+def test_protocol_array_roundtrip():
+    rng = np.random.default_rng(0)
+    for arr in (
+        rng.standard_normal((3, 5)).astype(np.float32),
+        (rng.standard_normal((2, 4)) + 1j * rng.standard_normal((2, 4))).astype(np.complex64),
+        np.zeros((4,), bool),
+        np.arange(6, dtype=np.int64).reshape(2, 3),
+    ):
+        back = protocol.decode_array(protocol.encode_array(arr))
+        assert back.dtype == arr.dtype and back.shape == arr.shape
+        np.testing.assert_array_equal(back, arr)
+
+
+def test_protocol_frame_roundtrip():
+    frame = {"type": "block", "seq": 3,
+             "Y": (np.ones((2, 2)) + 1j * np.ones((2, 2))).astype(np.complex64),
+             "nested": {"mask": np.zeros((2, 3), np.float32)}}
+    data = protocol.pack_frame(frame)
+    back = protocol.unpack_payload(data[protocol.frame_header_size():])
+    assert back["type"] == "block" and back["seq"] == 3
+    np.testing.assert_array_equal(back["Y"], frame["Y"])
+    np.testing.assert_array_equal(back["nested"]["mask"], frame["nested"]["mask"])
+
+
+def test_protocol_rejects_bad_payloads():
+    bad = protocol.encode_array(np.ones((3, 3), np.float32))
+    bad["shape"] = [3, 4]  # declared shape no longer matches payload
+    with pytest.raises(protocol.ProtocolError):
+        protocol.decode_array(bad)
+    with pytest.raises(protocol.ProtocolError):
+        protocol.unpack_payload(b"\xc3")  # bare msgpack `true`: not a map
+    with pytest.raises(protocol.ProtocolError, match="payload"):
+        # non-bytes data field: TypeError inside np.frombuffer must still
+        # surface as a clean ProtocolError, not a numpy internal error
+        protocol.decode_array({"__nd__": 1, "dtype": "<f4", "shape": [1], "data": 5})
+
+
+def test_protocol_truncated_frame_is_an_error():
+    a, b = socket.socketpair()
+    try:
+        data = protocol.pack_frame({"type": "close", "session": "x"})
+        a.sendall(data[: len(data) - 3])
+        a.close()
+        with pytest.raises(protocol.ProtocolError, match="mid-frame"):
+            protocol.recv_frame(b)
+    finally:
+        b.close()
+
+
+def test_client_modules_never_import_jax():
+    """The environment contract allows ONE chip-claiming process — serve
+    clients must be importable without jax.  Pinned structurally: no
+    module-level jax import in the client-side modules (the conftest has
+    already imported jax into this process, so sys.modules can't tell)."""
+    import disco_tpu.serve.client as client_mod
+    import disco_tpu.serve.protocol as protocol_mod
+
+    for mod in (client_mod, protocol_mod):
+        tree = ast.parse(Path(mod.__file__).read_text())
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                names = [a.name for a in node.names] if isinstance(node, ast.Import) \
+                    else [node.module or ""]
+                assert not any(n == "jax" or n.startswith("jax.") for n in names), (
+                    f"{mod.__name__} imports jax at line {node.lineno}"
+                )
+
+
+# -- session config / state --------------------------------------------------
+def test_session_config_validation():
+    with pytest.raises(ValueError, match="multiple of update_every"):
+        SessionConfig(n_nodes=4, mics_per_node=2, n_freq=9, block_frames=6, update_every=4)
+    with pytest.raises(ValueError, match=">= 2"):
+        SessionConfig(n_nodes=1, mics_per_node=2, n_freq=9, block_frames=8)
+    with pytest.raises(ValueError, match="offline-only"):
+        SessionConfig(n_nodes=4, mics_per_node=2, n_freq=9, block_frames=8,
+                      policy="use_oracle_refs")
+    with pytest.raises(ValueError, match="ref_mic"):
+        SessionConfig(n_nodes=4, mics_per_node=2, n_freq=9, block_frames=8, ref_mic=2)
+    with pytest.raises(ValueError, match="unknown field"):
+        SessionConfig.from_dict({"n_nodes": 4, "mics_per_node": 2, "n_freq": 9,
+                                 "block_frames": 8, "bogus": 1})
+
+
+def test_initial_stream_state_matches_default_warm_start(stream):
+    """streaming_tango(state=initial_stream_state, z_avail=ones) must be
+    bit-identical to the default call — the serve path's block-0 premise."""
+    Y, m, ref = stream
+    F, T = Y.shape[-2:]
+    st = initial_stream_state(K, C, F, update_every=U)
+    avail = np.ones((K, -(-T // U)), np.float32)
+    out = streaming_tango(Y, m, m, update_every=U, policy="local",
+                          state=st, z_avail=avail)
+    np.testing.assert_array_equal(np.asarray(out["yf"]), ref)
+
+
+def test_session_state_roundtrip(tmp_path, stream):
+    Y, m, _ = stream
+    F = Y.shape[-2]
+    cfg = _config(F)
+    s = Session("abc", cfg, state=initial_stream_state(K, C, F, update_every=U),
+                blocks_done=2, z_avail=np.ones(K, np.float32))
+    path = save_session_state(tmp_path / "abc.state.msgpack", s)
+    assert probe_session_state(path)
+    back = load_session_state(path)
+    assert back.id == "abc" and back.blocks_done == 2 and back.config == cfg
+    import jax
+
+    leaves0 = jax.tree_util.tree_leaves(s.state)
+    leaves1 = jax.tree_util.tree_leaves(back.state)
+    assert len(leaves0) == len(leaves1)
+    for a, b in zip(leaves0, leaves1):
+        assert np.asarray(a).dtype == np.asarray(b).dtype
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_session_state_corruption_detected(tmp_path, stream):
+    Y, _, _ = stream
+    F = Y.shape[-2]
+    s = Session("x", _config(F), state=initial_stream_state(K, C, F, update_every=U))
+    path = save_session_state(tmp_path / "x.state.msgpack", s)
+    raw = bytearray(path.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF  # bit rot inside the state payload
+    path.write_bytes(bytes(raw))
+    assert not probe_session_state(path)
+    with pytest.raises(SessionStateError):
+        load_session_state(path)
+    # truncation (the crash-mid-write shape the atomic writer prevents at
+    # the final path, but a copy could still suffer)
+    path2 = tmp_path / "y.state.msgpack"
+    path2.write_bytes(path.read_bytes()[: len(raw) // 3])
+    assert not probe_session_state(path2)
+
+
+# -- scheduler ---------------------------------------------------------------
+def test_scheduler_parity_two_interleaved_sessions(stream):
+    """Two sessions ticked together: each bit-identical to its offline
+    one-shot run, one batched readback per tick-with-work."""
+    from disco_tpu.obs.accounting import device_get_count
+
+    Y, m, ref = stream
+    F, T = Y.shape[-2:]
+    rng = np.random.default_rng(9)
+    Y2 = np.asarray(stft(rng.standard_normal((K, C, 6000)).astype(np.float32)))
+    m2 = rng.uniform(0.05, 0.95, size=(K, F, T)).astype(np.float32)
+    ref2 = np.asarray(
+        streaming_tango(Y2, m2, m2, update_every=U, policy="local", mu=1.2)["yf"]
+    )
+
+    sched = Scheduler(max_sessions=4, max_queue_blocks=8)
+    s1 = sched.open_session(_config(F))
+    s2 = sched.open_session(_config(F, mu=1.2))
+    outs = {s1.id: {}, s2.id: {}}
+    gets0 = device_get_count()
+    n_blocks = -(-T // BLOCK)
+    for i in range(n_blocks):
+        lo, hi = i * BLOCK, min((i + 1) * BLOCK, T)
+        sched.push_block(s1, i, Y[..., lo:hi], m[..., lo:hi], m[..., lo:hi])
+        sched.push_block(s2, i, Y2[..., lo:hi], m2[..., lo:hi], m2[..., lo:hi])
+        for sess, seq, yf, lat in sched.tick():
+            outs[sess.id][seq] = yf
+            assert lat >= 0.0
+    got1 = np.concatenate([outs[s1.id][i] for i in range(n_blocks)], axis=-1)
+    got2 = np.concatenate([outs[s2.id][i] for i in range(n_blocks)], axis=-1)
+    np.testing.assert_array_equal(got1, ref)
+    np.testing.assert_array_equal(got2, ref2)
+    assert device_get_count() - gets0 == sched.ticks_with_work == n_blocks
+
+
+def test_scheduler_parity_with_fault_mask(stream):
+    """A per-session (K,) z_mask degrades exactly like the offline
+    z_avail run — the fault path flows through the service unchanged."""
+    Y, m, _ = stream
+    F = Y.shape[-2]
+    mask = np.array([1, 0, 1, 1], np.float32)
+    ref = np.asarray(streaming_tango(Y, m, m, update_every=U, policy="local",
+                                     z_avail=mask)["yf"])
+    sched = Scheduler(max_sessions=2)
+    s = sched.open_session(_config(F), z_mask=mask)
+    got = _run_scheduler(sched, s, Y, m)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_scheduler_resume_equivalence(tmp_path, stream):
+    """Checkpoint mid-stream, reload into a fresh scheduler, continue:
+    the stitched outputs equal the uninterrupted offline run bit-for-bit."""
+    Y, m, ref = stream
+    F, T = Y.shape[-2:]
+    n_blocks = -(-T // BLOCK)
+    half = n_blocks // 2
+
+    sched = Scheduler(max_sessions=2)
+    s = sched.open_session(_config(F), session_id="resume-me")
+    outs = {}
+    for i in range(half):
+        lo, hi = i * BLOCK, (i + 1) * BLOCK
+        sched.push_block(s, i, Y[..., lo:hi], m[..., lo:hi], m[..., lo:hi])
+        for _s, seq, yf, _lat in sched.tick():
+            outs[seq] = yf
+    paths = sched.checkpoint_sessions(tmp_path)
+    assert set(paths) == {"resume-me"}
+
+    sched2 = Scheduler(max_sessions=2)
+    s2 = sched2.open_session(_config(F), resume_from=paths["resume-me"])
+    assert s2.blocks_done == half and s2.id == "resume-me"
+    for i in range(half, n_blocks):
+        lo, hi = i * BLOCK, min((i + 1) * BLOCK, T)
+        sched2.push_block(s2, i, Y[..., lo:hi], m[..., lo:hi], m[..., lo:hi])
+        for _s, seq, yf, _lat in sched2.tick():
+            outs[seq] = yf
+    got = np.concatenate([outs[i] for i in range(n_blocks)], axis=-1)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_scheduler_resume_config_mismatch_rejected(tmp_path, stream):
+    Y, m, _ = stream
+    F = Y.shape[-2]
+    sched = Scheduler(max_sessions=2)
+    s = sched.open_session(_config(F), session_id="a")
+    paths = sched.checkpoint_sessions(tmp_path)
+    sched2 = Scheduler(max_sessions=2)
+    with pytest.raises(AdmissionError, match="different"):
+        sched2.open_session(_config(F, mu=2.0), resume_from=paths["a"])
+
+
+def test_scheduler_admission_and_queue_bounds(stream):
+    from disco_tpu.obs.metrics import REGISTRY
+
+    Y, m, _ = stream
+    F = Y.shape[-2]
+    sched = Scheduler(max_sessions=1, max_queue_blocks=2)
+    s = sched.open_session(_config(F))
+    rejects0 = REGISTRY.counter("admission_reject").value
+    with pytest.raises(AdmissionError, match="max_sessions"):
+        sched.open_session(_config(F))
+    assert REGISTRY.counter("admission_reject").value == rejects0 + 1
+
+    blk = (Y[..., :BLOCK], m[..., :BLOCK], m[..., :BLOCK])
+    sched.push_block(s, 0, *blk)
+    sched.push_block(s, 1, *blk)
+    with pytest.raises(QueueFull, match="max_queue_blocks"):
+        sched.push_block(s, 2, *blk)
+    with pytest.raises(QueueFull, match="out-of-order"):
+        sched.push_block(s, 5, *blk)
+    with pytest.raises(QueueFull, match="shape"):
+        sched.push_block(s, 2, Y[..., :BLOCK], m[..., : BLOCK - 1], m[..., :BLOCK])
+    # draining: no new sessions
+    sched.start_drain()
+    with pytest.raises(AdmissionError, match="draining"):
+        sched.open_session(_config(F))
+
+
+def test_scheduler_eviction_counter(stream):
+    from disco_tpu.obs.metrics import REGISTRY
+
+    Y, m, _ = stream
+    F = Y.shape[-2]
+    sched = Scheduler(max_sessions=2)
+    s = sched.open_session(_config(F))
+    ev0 = REGISTRY.counter("session_evicted").value
+    sched.evict(s, "slow client")
+    assert REGISTRY.counter("session_evicted").value == ev0 + 1
+    assert sched.get(s.id) is None
+    with pytest.raises(QueueFull, match="evicted"):
+        sched.push_block(s, 0, Y[..., :BLOCK], m[..., :BLOCK], m[..., :BLOCK])
+
+
+# -- server / client end-to-end ----------------------------------------------
+def _serve_scene(seed, L=6000):
+    rng = np.random.default_rng(seed)
+    Y = np.asarray(stft(rng.standard_normal((K, C, L)).astype(np.float32)))
+    F, T = Y.shape[-2:]
+    m = rng.uniform(0.05, 0.95, size=(K, F, T)).astype(np.float32)
+    return Y, m
+
+
+def test_server_single_client_parity(stream):
+    from disco_tpu.serve import EnhanceServer, ServeClient
+
+    Y, m, ref = stream
+    F = Y.shape[-2]
+    srv = EnhanceServer(max_sessions=2)
+    addr = srv.start()
+    try:
+        cl = ServeClient(addr)
+        cl.open(_config(F))
+        yf = cl.enhance_clip(Y, m, m)
+        info = cl.close()
+        cl.shutdown()
+        assert info["blocks_done"] == -(-Y.shape[-1] // BLOCK)
+        np.testing.assert_array_equal(yf, ref)
+    finally:
+        srv.stop()
+
+
+def test_server_rejects_over_capacity(stream):
+    from disco_tpu.serve import EnhanceServer, ServeClient, ServeError
+
+    Y, m, _ = stream
+    F = Y.shape[-2]
+    srv = EnhanceServer(max_sessions=1)
+    addr = srv.start()
+    try:
+        c1 = ServeClient(addr)
+        c1.open(_config(F))
+        c2 = ServeClient(addr)
+        with pytest.raises(ServeError, match="max_sessions"):
+            c2.open(_config(F))
+        c2.shutdown()
+        c1.close()
+        c1.shutdown()
+    finally:
+        srv.stop()
+
+
+def test_server_evicts_slow_client(stream):
+    """A client that streams blocks without draining its socket is evicted
+    with a clean error frame once the output backlog bound is hit."""
+    from disco_tpu.serve import EnhanceServer
+    from disco_tpu.serve.session import EVICTED
+
+    Y, m, _ = stream
+    F = Y.shape[-2]
+    srv = EnhanceServer(max_sessions=2, max_backlog=1, max_queue_blocks=16)
+    addr = srv.start()
+    sock = socket.create_connection(addr)
+    try:
+        protocol.send_frame(sock, {"type": "open", "config": _config(F).to_dict()})
+        opened = protocol.recv_frame(sock)
+        assert opened["type"] == "open_ok"
+        blk = {"Y": Y[..., :BLOCK].astype(np.complex64),
+               "mask_z": m[..., :BLOCK], "mask_w": m[..., :BLOCK]}
+        for seq in range(6):  # never read -> backlog grows past max_backlog=1
+            protocol.send_frame(sock, {"type": "block", "seq": seq, **blk})
+        frames = []
+        while True:
+            f = protocol.recv_frame(sock)
+            if f is None:
+                break
+            frames.append(f)
+            if f["type"] == "error":
+                break
+        errors = [f for f in frames if f["type"] == "error"]
+        assert errors and errors[0]["code"] == "evicted"
+        session = srv.scheduler  # registry slot freed
+        assert all(s.status != EVICTED for s in session.sessions())
+    finally:
+        sock.close()
+        srv.stop()
+
+
+def test_server_survives_non_numeric_block(stream):
+    """A shape-correct block with a non-numeric dtype (the wire codec
+    round-trips ANY declared dtype) must die as a clean ``bad_block`` on
+    the I/O thread — not crash the dispatch thread and take every other
+    live session down with it."""
+    from disco_tpu.serve import EnhanceServer, ServeClient
+
+    Y, m, ref = stream
+    F = Y.shape[-2]
+    srv = EnhanceServer(max_sessions=4)
+    addr = srv.start()
+    try:
+        good = ServeClient(addr)
+        good.open(_config(F))
+        sock = socket.create_connection(addr)
+        try:
+            protocol.send_frame(sock, {"type": "open", "config": _config(F).to_dict()})
+            assert protocol.recv_frame(sock)["type"] == "open_ok"
+            evil = np.full(Y[..., :BLOCK].shape, "x", dtype="<U1")
+            protocol.send_frame(sock, {"type": "block", "seq": 0, "Y": evil,
+                                       "mask_z": m[..., :BLOCK],
+                                       "mask_w": m[..., :BLOCK]})
+            err = protocol.recv_frame(sock)
+            assert err is not None and err["type"] == "error"
+            assert err["code"] == "bad_block"
+        finally:
+            sock.close()
+        # the innocent concurrent session is still served, bit-exact
+        yf = good.enhance_clip(Y, m, m)
+        np.testing.assert_array_equal(yf, ref)
+        good.close()
+        good.shutdown()
+        assert srv.crashed is None
+    finally:
+        srv.stop()
+
+
+def test_enhance_clip_resumed_fully_done_returns_empty(stream):
+    """Resuming a session whose checkpoint already covers the whole clip
+    returns an empty (K, F, 0) result instead of crashing on an empty
+    concatenate."""
+    from disco_tpu.serve import EnhanceServer, ServeClient
+
+    Y, m, _ = stream
+    F = Y.shape[-2]
+    srv = EnhanceServer(max_sessions=2)
+    addr = srv.start()
+    try:
+        cl = ServeClient(addr)
+        cl.open(_config(F))
+        cl.blocks_done = -(-Y.shape[-1] // BLOCK)  # as a fully-done resume reports
+        out = cl.enhance_clip(Y, m, m)
+        assert out.shape == (K, F, 0) and out.dtype == np.complex64
+        cl.close()
+        cl.shutdown()
+    finally:
+        srv.stop()
+
+
+# -- disco-serve CLI ---------------------------------------------------------
+def test_serve_cli_parser_defaults_and_fault_seam():
+    from disco_tpu.cli import serve as serve_cli
+
+    args = serve_cli.build_parser().parse_args([])
+    assert args.port == 7433 and args.max_sessions == 16
+    assert args.preflight == 0.0 and args.obs_log is None and args.unix is None
+    # the shared fault seam: --fault-seed without --fault-spec is a clean
+    # CLI error (cli.common.resolve_fault_spec), not a crash mid-serve
+    with pytest.raises(SystemExit, match="--fault-seed needs --fault-spec"):
+        serve_cli.main(["--fault-seed", "3"])
+
+
+@pytest.mark.slow
+def test_serve_cli_end_to_end_unix_socket_drain(tmp_path, stream):
+    """disco-serve over a unix socket with the shared production seams:
+    serve blocks bit-exactly, then a graceful stop (the in-process SIGINT
+    equivalent) drains, checkpoints into --state-dir, and the --obs-log
+    carries the serve lifecycle + latency telemetry."""
+    import time
+
+    from disco_tpu import obs
+    from disco_tpu.cli import serve as serve_cli
+    from disco_tpu.runs.interrupt import request_stop
+    from disco_tpu.serve import ServeClient
+
+    Y, m, ref = stream
+    F = Y.shape[-2]
+    sock = tmp_path / "serve.sock"
+    log = tmp_path / "serve.jsonl"
+    th = threading.Thread(
+        target=serve_cli.main,
+        args=([
+            "--unix", str(sock), "--state-dir", str(tmp_path / "state"),
+            "--obs-log", str(log), "--max-sessions", "2",
+        ],),
+        daemon=True,
+    )
+    th.start()
+    deadline = time.time() + 30
+    while not sock.exists() and time.time() < deadline:
+        time.sleep(0.02)
+    assert sock.exists(), "disco-serve never bound its unix socket"
+
+    cl = ServeClient(str(sock))
+    cl.open(_config(F), session_id="cli-sess")
+    outs = {}
+    for i in range(2):
+        cl.send_block(Y[..., i * BLOCK:(i + 1) * BLOCK],
+                      m[..., i * BLOCK:(i + 1) * BLOCK],
+                      m[..., i * BLOCK:(i + 1) * BLOCK])
+        outs[i] = cl.recv_enhanced(i)
+    assert request_stop("test drain")  # the CLI's GracefulInterrupt scope
+    info = cl.wait_closed(timeout_s=60)
+    th.join(60)
+    assert not th.is_alive()
+    cl.shutdown()
+
+    assert info["blocks_done"] == 2 and info.get("resumable")
+    got = np.concatenate([outs[0], outs[1]], axis=-1)
+    np.testing.assert_array_equal(got, ref[..., : 2 * BLOCK])
+    from disco_tpu.serve.session import probe_session_state
+
+    assert probe_session_state(info["state_path"])
+
+    events = obs.read_events(log)  # schema-validating read
+    kinds = [e["kind"] for e in events]
+    assert kinds[0] == "manifest" and "counters" in kinds
+    (start,) = [e for e in events if e["kind"] == "run_start"]
+    assert start["attrs"]["tool"] == "disco-serve"
+    assert start["attrs"]["state_dir"] == str(tmp_path / "state")
+    actions = [e["attrs"]["action"] for e in events if e["kind"] == "session"]
+    assert "open" in actions and "drain" in actions
+    (counters,) = [e for e in events if e["kind"] == "counters"]
+    lat = counters["attrs"]["histograms"]["serve_block_latency_ms"]
+    # >= : the latency histogram is process-global, earlier tests feed it too
+    assert lat["count"] >= 2 and lat["p95"] is not None
+
+
+@pytest.mark.slow
+def test_server_concurrent_sessions_parity_and_drain(tmp_path):
+    """Four concurrent threads stream different clips with different
+    params; all outputs bit-match offline.  Then a drain mid-stream
+    checkpoints a live session and the resumed continuation still
+    bit-matches."""
+    from disco_tpu.serve import EnhanceServer, ServeClient
+
+    scenes = []
+    for i, kw in enumerate(({}, {"mu": 1.2}, {"lambda_cor": 0.97}, {})):
+        Y, m = _serve_scene(20 + i)
+        okw = {k: v for k, v in kw.items()}
+        ref = np.asarray(streaming_tango(Y, m, m, update_every=U,
+                                         policy="local", **okw)["yf"])
+        scenes.append((Y, m, kw, ref))
+    F = scenes[0][0].shape[-2]
+
+    srv = EnhanceServer(max_sessions=8, state_dir=tmp_path)
+    addr = srv.start()
+    results = [None] * len(scenes)
+
+    def worker(i):
+        Y, m, kw, _ = scenes[i]
+        cl = ServeClient(addr)
+        cl.open(_config(F, **kw))
+        results[i] = cl.enhance_clip(Y, m, m)
+        cl.close()
+        cl.shutdown()
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(len(scenes))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    for i, (_, _, _, ref) in enumerate(scenes):
+        np.testing.assert_array_equal(results[i], ref)
+
+    # drain with a live half-fed session
+    Y, m = _serve_scene(99)
+    ref = np.asarray(streaming_tango(Y, m, m, update_every=U, policy="local")["yf"])
+    n_blocks = -(-Y.shape[-1] // BLOCK)
+    half = n_blocks // 2
+    cl = ServeClient(addr)
+    cl.open(_config(F), session_id="drainee")
+    outs = {}
+    for i in range(half):
+        cl.send_block(Y[..., i * BLOCK:(i + 1) * BLOCK],
+                      m[..., i * BLOCK:(i + 1) * BLOCK],
+                      m[..., i * BLOCK:(i + 1) * BLOCK])
+        outs[i] = cl.recv_enhanced(i)
+    stopper = threading.Thread(target=srv.stop)
+    stopper.start()
+    info = cl.wait_closed()
+    stopper.join(timeout=60)
+    cl.shutdown()
+    assert info["blocks_done"] == half and info.get("resumable")
+
+    srv2 = EnhanceServer(max_sessions=8, state_dir=tmp_path)
+    addr2 = srv2.start()
+    try:
+        cl2 = ServeClient(addr2)
+        cl2.open(_config(F), resume="drainee")
+        assert cl2.blocks_done == half
+        rest = cl2.enhance_clip(Y, m, m)
+        cl2.close()
+        cl2.shutdown()
+    finally:
+        srv2.stop()
+    full = np.concatenate(
+        [np.concatenate([outs[i] for i in range(half)], axis=-1), rest], axis=-1
+    )
+    np.testing.assert_array_equal(full, ref)
