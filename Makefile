@@ -3,9 +3,9 @@
 
 PYTHON ?= python
 
-.PHONY: test coverage doc install native clean bench milestone-corpus dryrun obs-check fault-check chaos-check perf-check serve-check
+.PHONY: test coverage doc install native clean bench milestone-corpus dryrun obs-check fault-check chaos-check perf-check serve-check stream-check
 
-test: obs-check fault-check chaos-check perf-check serve-check
+test: obs-check fault-check chaos-check perf-check stream-check serve-check
 	$(PYTHON) -m pytest tests/ -q
 
 # Telemetry gates (run before the suite so drift fails fast):
@@ -42,6 +42,18 @@ chaos-check:
 # corpus_clips_per_s (disco_tpu/enhance/check.py).
 perf-check:
 	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= $(PYTHON) -m disco_tpu.enhance.check
+
+# Super-tick gate: the scanned multi-block streaming driver
+# (streaming_tango_scan) must be bit-identical to the per-block host loop —
+# fault-free, under z_avail holds spanning super-tick edges, through state
+# continuation and a non-multiple-of-N tail — and a super-tick serve
+# scheduler must satisfy the readback-count invariant (device_get_batches
+# == super-ticks: fenced dispatches per block <= 1/N + the per-block tail).
+# Hermetic: CPU, compile cache off, one JAX process, zero SIGKILLs
+# (disco_tpu/enhance/stream_check.py).
+stream-check:
+	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= DISCO_TPU_COMPILE_CACHE=off \
+	    $(PYTHON) -m disco_tpu.enhance.stream_check
 
 # Online-serving gate: run the enhancement server in-process on CPU with
 # >=4 concurrent numpy-only streaming clients over loopback and assert the

@@ -16,9 +16,10 @@ Two subcommands:
   ``bench.py`` stdout line, or an obs event log containing a
   ``bench_result`` event) into a regression verdict on the headline RTF
   and — when the baseline carries the lane — on ``corpus_clips_per_s``
-  (the pipelined corpus engine's end-to-end throughput) and
+  (the pipelined corpus engine's end-to-end throughput),
   ``serve_blocks_per_s`` (the online service's continuous-batching
-  throughput).  Exits nonzero on a regression beyond ``--threshold``,
+  throughput) and ``streaming_rtf_scan`` (the amortized super-tick
+  streaming deployment).  Exits nonzero on a regression beyond ``--threshold``,
   which is what lets ``make obs-check`` gate CI on the bench trajectory.
 
 No reference counterpart (the reference has no observability, SURVEY.md
@@ -342,6 +343,9 @@ def compare_records(old: dict, new: dict, threshold: float = 0.05) -> dict:
         ("rtf_jacobi_solver", True),
         ("rtf_covfused", True),
         ("streaming_rtf", True),
+        ("streaming_rtf_scan", True),
+        ("streaming_rtf_block", True),
+        ("dispatches_per_block", False),
         ("corpus_clips_per_s", True),
         ("serve_blocks_per_s", True),
         ("serve_p95_ms", False),
@@ -381,6 +385,7 @@ def compare_records(old: dict, new: dict, threshold: float = 0.05) -> dict:
     # and their absence must not flag — but a candidate that LOST a
     # measured lane is a regression, not a skip.
     for key, label, unit in (
+        ("streaming_rtf_scan", "streaming-scan", "x realtime"),
         ("corpus_clips_per_s", "corpus", "clips/s"),
         ("serve_blocks_per_s", "serve", "blocks/s"),
     ):

@@ -64,6 +64,24 @@ def build_parser():
                    help="blocks enhanced per scheduler tick across all "
                         "sessions (bounds one tick's device queue and its "
                         "single batched readback)")
+    p.add_argument("--blocks-per-super-tick", type=int, default=1,
+                   help="N: dispatch each run of N consecutive full queued "
+                        "blocks of a session as ONE scanned on-device "
+                        "program (streaming_tango_scan), amortizing the "
+                        "fixed ~80 ms tunnel RPC per fenced readback across "
+                        "N blocks; sub-N remainders (and ragged final "
+                        "blocks) fall back to the per-block path.  Raises "
+                        "per-block latency by up to N-1 blocks of admission "
+                        "wait in exchange for ~N× dispatch throughput — "
+                        "results stay bit-exact either way (1 = per-block "
+                        "serving, the default; must be <= "
+                        "--max-blocks-per-tick)")
+    p.add_argument("--no-overlap-readback", dest="overlap_readback",
+                   action="store_false", default=None,
+                   help="disable the double-buffered tick state (with "
+                        "super-ticks, tick T+1's dispatch normally overlaps "
+                        "tick T's batched readback; this forces read-after-"
+                        "dispatch within each tick)")
     p.add_argument("--tick-interval", type=float, default=0.002,
                    metavar="SECONDS",
                    help="dispatch-thread sleep between idle ticks")
@@ -91,12 +109,15 @@ def main(argv=None):
             max_sessions=args.max_sessions,
             max_queue_blocks=args.max_queue_blocks,
             max_blocks_per_tick=args.max_blocks_per_tick,
+            blocks_per_super_tick=args.blocks_per_super_tick,
+            overlap_readback=args.overlap_readback,
             max_backlog=args.max_backlog,
             tick_interval_s=args.tick_interval,
             state_dir=args.state_dir,
             fault_spec=args.fault_spec,
             run_info={"preflight": preflight, "state_dir": args.state_dir,
-                      "max_sessions": args.max_sessions},
+                      "max_sessions": args.max_sessions,
+                      "blocks_per_super_tick": args.blocks_per_super_tick},
         )
         with GracefulInterrupt() as stopped:
             srv.serve_forever()

@@ -68,9 +68,11 @@ def build_parser():
                         "streaming mode too).  Default: 'power' offline / "
                         "'eigh' with --streaming (measured on-device, round-3 "
                         "solver_ab)")
-    p.add_argument("--cov_impl", choices=["xla", "pallas"], default="xla",
-                   help="masked-covariance stage: 'xla' (einsum) or 'pallas' "
-                        "(fused single-read kernel, ops/cov_ops.py)")
+    p.add_argument("--cov_impl", choices=["auto", "xla", "pallas"], default="auto",
+                   help="masked-covariance stage: 'auto' (fused pallas kernel "
+                        "on TPU, einsum elsewhere — DISCO_TPU_COV_IMPL env "
+                        "overrides), 'xla' (einsum) or 'pallas' (fused "
+                        "single-read kernel, ops/cov_ops.py)")
     p.add_argument("--mesh", nargs=2, type=int, default=None, metavar=("BATCH", "NODE"),
                    help="--rirs mode only: run each chunk on a (BATCH, NODE) device "
                         "mesh (clips sharded over 'batch', nodes over 'node', "

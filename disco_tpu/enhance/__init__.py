@@ -19,7 +19,9 @@ from disco_tpu.enhance.tango import (
     tango_step2,
 )
 from disco_tpu.enhance.separation import separate_sources, separate_with_masks
-from disco_tpu.enhance.streaming import hold_last_good, streaming_step1, streaming_tango
+from disco_tpu.enhance.streaming import (hold_last_good, initial_stream_state,
+                                          streaming_step1, streaming_tango,
+                                          streaming_tango_scan)
 from disco_tpu.enhance.zexport import compute_z_signals, export_z
 
 __all__ = [
@@ -42,8 +44,10 @@ __all__ = [
     "vad_mask",
     "compute_z_signals",
     "export_z",
+    "initial_stream_state",
     "streaming_step1",
     "streaming_tango",
+    "streaming_tango_scan",
     "separate_sources",
     "separate_with_masks",
 ]

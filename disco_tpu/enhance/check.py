@@ -57,6 +57,9 @@ def _check_bench_one_line(failures: list) -> dict | None:
         "BENCH_DUR_S": "0.5",
         "BENCH_ITERS": "2",
         "BENCH_CORPUS_CLIPS": "2",
+        # pinned (not inherited): an exported =0 would null the scan lane
+        # this gate asserts, and a large N cannot fit the 0.5 s smoke clip
+        "BENCH_BLOCKS_PER_DISPATCH": "4",
         "BENCH_SERVE_SESSIONS": "2",
         "BENCH_SERVE_DUR_S": "1.0",
         "BENCH_NP_DUR_S": "0",  # skip the minutes-long float64 baseline
@@ -94,6 +97,12 @@ def _check_bench_one_line(failures: list) -> dict | None:
             failures.append(
                 f"bench: {key} missing/null in the record "
                 f"(serve_error={rec.get('serve_error')!r})"
+            )
+    for key in ("streaming_rtf_scan", "streaming_rtf_block", "dispatches_per_block"):
+        if not isinstance(rec.get(key), (int, float)):
+            failures.append(
+                f"bench: {key} missing/null in the record "
+                f"(streaming_scan_error={rec.get('streaming_scan_error')!r})"
             )
     return rec
 

@@ -422,7 +422,7 @@ def enhance_rir(
     bucket: int = 0,
     z_sigs: str = "zs_hat",
     solver: str | None = None,
-    cov_impl: str = "xla",
+    cov_impl: str = "auto",
     fault_spec=None,
     ledger=None,
 ):
@@ -517,10 +517,12 @@ def enhance_rir(
                 f"streaming mode implements the 'local'/'distant'/'none' "
                 f"mask-for-z policies; got {policy!r}"
             )
-        if cov_impl != "xla":
+        if cov_impl not in ("xla", "auto"):
             # the online estimator is exponential smoothing, not a frame
-            # mean — the fused offline kernel does not apply; reject rather
-            # than silently compare xla against itself in an A/B
+            # mean — the fused offline kernel does not apply; reject an
+            # EXPLICIT pallas request rather than silently compare xla
+            # against itself in an A/B ('auto' just means "pipeline
+            # default", which for streaming is its own estimator)
             raise ValueError(
                 f"streaming mode uses the smoothed-covariance estimator; "
                 f"cov_impl={cov_impl!r} applies to the offline pipeline only"
@@ -654,7 +656,7 @@ def enhance_rirs_batched(
     models=(None, None),
     z_sigs: str = "zs_hat",
     solver: str | None = None,
-    cov_impl: str = "xla",
+    cov_impl: str = "auto",
     score_workers: int = 4,
     mesh=None,
     fault_spec=None,

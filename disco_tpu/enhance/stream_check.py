@@ -1,0 +1,240 @@
+"""``make stream-check`` — the device-resident super-tick gate.
+
+Hermetic (CPU backend, compile cache off, one JAX process, no sockets, no
+SIGKILLs) check of the scanned multi-block streaming driver
+(:func:`disco_tpu.enhance.streaming.streaming_tango_scan`) against its
+acceptance contract:
+
+1. **Scan parity**: a stream driven through scanned super-ticks
+   (``blocks_per_dispatch`` = N refresh-aligned blocks per dispatch) is
+   **bit-identical** to the per-block host loop — fault-free AND under a
+   ``z_avail`` plan whose losses span super-tick edges (the hold carries
+   ride the scan carry), including the continuation state, a ``state=``
+   handoff mid-stream, and a non-multiple-of-N tail served by the
+   per-block fallback.
+2. **Readback-count invariant**: over a serve scheduler run with
+   ``blocks_per_super_tick=N``, the batched-readback accounting
+   (``device_get_batches``) advances once per super-tick — fenced
+   dispatches per delivered block ≤ 1/N plus the per-block ragged tail —
+   and every delivered block is byte-identical to the per-block scheduler
+   path.
+
+Wired into ``make test`` alongside ``obs-check``/``fault-check``/
+``chaos-check``/``perf-check``/``serve-check``.
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+K, C, U = 4, 2, 4
+BLOCK = 2 * U       # serve-style block_frames
+N_SUPER = 4         # blocks per scanned dispatch
+
+
+def _scene(seed=7, L=30000):
+    import numpy as np
+
+    from disco_tpu.core.dsp import stft
+
+    rng = np.random.default_rng(seed)
+    Y = np.asarray(stft(rng.standard_normal((K, C, L)).astype(np.float32)))
+    F, T = Y.shape[-2:]
+    m = rng.uniform(0.05, 0.95, size=(K, F, T)).astype(np.float32)
+    return Y, m
+
+
+def per_block_reference(Y, m, *, block, update_every, state, plan=None):
+    """The per-block host loop every scan-parity gate compares against
+    (the serve dispatch shape): explicit ``state=`` continuation,
+    per-block ``z_avail`` availability columns.  THE bit-exactness oracle —
+    tests/test_streaming.py imports it rather than re-implementing it, so
+    the per-block calling convention is pinned in exactly one place.
+
+    No reference counterpart: the reference has no streaming driver to
+    chunk (see the disco_tpu.enhance.streaming module docstring); this
+    loop is the port's own per-block deployment shape, restated as an
+    oracle."""
+    import numpy as np
+
+    from disco_tpu.enhance.streaming import streaming_tango
+
+    K, T = Y.shape[0], Y.shape[-1]
+    per = block // update_every
+    outs = []
+    for i in range(T // block):
+        lo, hi = i * block, (i + 1) * block
+        avail = (np.ones((K, per), np.float32) if plan is None
+                 else plan[:, i * per:(i + 1) * per])
+        o = streaming_tango(Y[..., lo:hi], m[..., lo:hi], m[..., lo:hi],
+                            update_every=update_every, state=state,
+                            z_avail=avail)
+        state = o["state"]
+        outs.append(np.asarray(o["yf"]))
+    return np.concatenate(outs, axis=-1), state
+
+
+def _per_block(Y, m, plan=None):
+    from disco_tpu.enhance.streaming import initial_stream_state
+
+    F = Y.shape[-2]
+    return per_block_reference(
+        Y, m, block=BLOCK, update_every=U, plan=plan,
+        state=initial_stream_state(K, C, F, update_every=U),
+    )
+
+
+def _check_scan_parity(failures: list) -> dict:
+    import numpy as np
+
+    from disco_tpu.enhance.streaming import (
+        initial_stream_state,
+        streaming_tango,
+        streaming_tango_scan,
+    )
+
+    Y, m = _scene()
+    F, T = Y.shape[-2:]
+    n_blocks = T // BLOCK
+    window = N_SUPER * BLOCK
+    nw = n_blocks // N_SUPER
+    per = BLOCK // U
+    cols = window // U
+
+    # a fault plan with losses inside a window, across a super-tick edge,
+    # and before the first delivery (zn fallback)
+    plan = np.ones((K, n_blocks * per), np.float32)
+    plan[1, cols - 2:cols + 3] = 0
+    plan[3, 0:2] = 0
+    plan[2, 5:6] = 0
+
+    for label, p in (("fault-free", None), ("faulted", plan)):
+        ref, ref_state = _per_block(Y, m, plan=p)
+        state = initial_stream_state(K, C, F, update_every=U)
+        outs = []
+        for w in range(nw):
+            lo, hi = w * window, (w + 1) * window
+            avail = (np.ones((K, cols), np.float32) if p is None
+                     else p[:, w * cols:(w + 1) * cols])
+            o = streaming_tango_scan(
+                Y[..., lo:hi], m[..., lo:hi], m[..., lo:hi], update_every=U,
+                state=state, z_avail=avail, blocks_per_dispatch=N_SUPER,
+            )
+            state = o["state"]
+            outs.append(np.asarray(o["yf"]))
+        # non-multiple-of-N tail: per-block fallback continues the state
+        for i in range(nw * N_SUPER, n_blocks):
+            lo, hi = i * BLOCK, (i + 1) * BLOCK
+            avail = (np.ones((K, per), np.float32) if p is None
+                     else p[:, i * per:(i + 1) * per])
+            o = streaming_tango(Y[..., lo:hi], m[..., lo:hi], m[..., lo:hi],
+                                update_every=U, state=state, z_avail=avail)
+            state = o["state"]
+            outs.append(np.asarray(o["yf"]))
+        got = np.concatenate(outs, axis=-1)
+        if not np.array_equal(got, ref):
+            failures.append(
+                f"scan parity ({label}): scanned+tail output differs from the "
+                f"per-block loop (max abs diff {np.abs(got - ref).max():g})"
+            )
+        import jax
+
+        for a, b in zip(jax.tree_util.tree_leaves(state),
+                        jax.tree_util.tree_leaves(ref_state)):
+            if not np.array_equal(np.asarray(a), np.asarray(b)):
+                failures.append(
+                    f"scan parity ({label}): continuation state diverged"
+                )
+                break
+    return {"blocks": n_blocks, "super_ticks": nw, "tail_blocks": n_blocks - nw * N_SUPER}
+
+
+def _check_readback_invariant(failures: list) -> dict:
+    """Serve scheduler with super-ticks: device_get_batches == super-ticks,
+    fenced readbacks per block ≤ 1/N + the ragged/partial tail, outputs
+    byte-identical to the per-block scheduler."""
+    import numpy as np
+
+    from disco_tpu.obs.accounting import device_get_count
+    from disco_tpu.serve.scheduler import Scheduler
+    from disco_tpu.serve.session import SessionConfig
+
+    Y, m = _scene(seed=11)
+    F, T = Y.shape[-2:]
+    cfg = SessionConfig(n_nodes=K, mics_per_node=C, n_freq=F,
+                        block_frames=BLOCK, update_every=U)
+    n_blocks = -(-T // BLOCK)
+
+    def run(sched):
+        s = sched.open_session(cfg)
+        outs = {}
+        gets0 = device_get_count()
+        i = 0
+        while i < n_blocks:
+            for _ in range(sched.blocks_per_super_tick):
+                if i < n_blocks:
+                    lo, hi = i * BLOCK, min((i + 1) * BLOCK, T)
+                    sched.push_block(s, i, Y[..., lo:hi], m[..., lo:hi], m[..., lo:hi])
+                    i += 1
+            for _s, seq, yf, _lat in sched.tick():
+                outs[seq] = yf
+        while sched.pending_blocks():
+            for _s, seq, yf, _lat in sched.tick():
+                outs[seq] = yf
+        if len(outs) != n_blocks:
+            failures.append(f"scheduler delivered {len(outs)}/{n_blocks} blocks")
+            return None, 0
+        return (np.concatenate([outs[i] for i in range(n_blocks)], axis=-1),
+                device_get_count() - gets0)
+
+    ref, gets_block = run(Scheduler(max_sessions=2, max_queue_blocks=2 * N_SUPER))
+    got, gets_scan = run(Scheduler(max_sessions=2, max_queue_blocks=2 * N_SUPER,
+                                   blocks_per_super_tick=N_SUPER))
+    if ref is None or got is None:
+        return {}
+    if not np.array_equal(got, ref):
+        failures.append(
+            "super-tick scheduler output differs from the per-block scheduler "
+            f"(max abs diff {np.abs(got - ref).max():g})"
+        )
+    full = n_blocks - 1 if T % BLOCK else n_blocks
+    expected = full // N_SUPER + (full % N_SUPER) + (1 if T % BLOCK else 0)
+    if gets_scan > expected:
+        failures.append(
+            f"readback invariant: {gets_scan} batched readbacks for {n_blocks} "
+            f"blocks at N={N_SUPER} (expected <= {expected}: one per super-tick "
+            "plus the per-block tail)"
+        )
+    if gets_scan >= gets_block:
+        failures.append(
+            f"super-ticks did not reduce readbacks: {gets_scan} vs "
+            f"{gets_block} per-block"
+        )
+    return {"blocks": n_blocks, "readbacks_per_block_path": gets_block,
+            "readbacks_supertick_path": gets_scan}
+
+
+def main(argv=None) -> int:
+    import os
+
+    os.environ.setdefault("DISCO_TPU_COMPILE_CACHE", "off")
+    failures: list[str] = []
+    parity = _check_scan_parity(failures)
+    readback = _check_readback_invariant(failures)
+    if failures:
+        for f in failures:
+            print(f"stream-check FAIL: {f}", file=sys.stderr)
+        return 1
+    print(json.dumps({
+        "stream_check": "ok",
+        "blocks_per_dispatch": N_SUPER,
+        **{f"parity_{k}": v for k, v in parity.items()},
+        **readback,
+        "jax_processes": 1,
+        "sigkills_issued": 0,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -387,9 +387,7 @@ def _stream_stats(Y, all_z, zn, mask_w, oth, policy):
     )
 
 
-@partial(counted_jit, label="streaming_tango",
-         static_argnames=("update_every", "ref_mic", "with_diagnostics", "policy", "solver"))
-def streaming_tango(
+def _streaming_tango_body(
     Y,
     masks_z,
     mask_w,
@@ -405,55 +403,11 @@ def streaming_tango(
     solver: str = "eigh",
     z_avail=None,
 ):
-    """Full two-step streaming TANGO over all nodes (mixture-only by
-    default: the deployment path needs no oracle S/N).
-
-    Step 1 streams per node (vmapped); the z-exchange is array indexing on
-    one device (an all_gather over 'node' when mesh-sharded); step 2 streams
-    the stacked [y_k ‖ z_{j≠k}] under the 'local', 'distant' or 'none'
-    mask-for-z policy (see :func:`_stream_stats`; the oracle policies are
-    offline-only features).
-
-    Args:
-      Y: (K, C, F, T) mixture STFTs.
-      masks_z, mask_w: (K, F, T) step-1 / step-2 masks.
-      S, N: optional (K, C, F, T) clean components; with
-        ``with_diagnostics=True`` the SAME online filters are applied to
-        them, yielding sf/nf/z_s/z_n — every diagnostic then describes the
-        one deployed filter (no second offline pass).
-      state: optional continuation state (the previous chunk's returned
-        ``state``) — chunk-by-chunk online deployment of BOTH steps; exact
-        across refresh-block-aligned boundaries (tests/test_streaming.py).
-      z_avail: optional per-block availability of the exchanged streams —
-        (K, B) with B = ceil(T / update_every), or (K,) broadcast over
-        blocks.  Lost/stale blocks are bridged by :func:`hold_last_good`
-        (previous good block, falling back to the producer's ``zn``
-        estimate before the first delivery); the diagnostic streams are
-        held with the same availability.  The hold carries ride the
-        returned ``state`` (key ``"hold"``), so chunked continuation —
-        pass per-chunk masks — bridges a chunk-boundary loss with the
-        previous chunk's last good block, matching the unchunked run
-        across refresh-block-aligned boundaries.  None (default) is the
-        fault-free path, byte-identical to before.
-
-    Returns:
-      dict with yf (K, F, T) enhanced outputs, z_y/zn (K, F, T) streams,
-      a ``state`` entry for continuation, and sf/nf/z_s/z_n when
-      diagnostics are requested.
-
-    Crash safety: a chunked deployment loop is exactly the shape the
-    crash-safe runs layer (``disco_tpu.runs``) targets — the returned
-    ``state`` is the continuation checkpoint, so a caller persisting it
-    atomically per chunk (``disco_tpu.io.atomic``) can resume a killed
-    stream at the last chunk boundary.  The ``between_blocks`` chaos seam
-    fires at each chunk-continuation entry (host-side, outside jit) so
-    ``make chaos-check``-style tests can interrupt a chunked run at the
-    boundary.
-    """
-    if state is not None:
-        from disco_tpu.runs import chaos as _chaos
-
-        _chaos.tick("between_blocks")
+    """The one-block state transition of :func:`streaming_tango` — the
+    traced computation, shared verbatim with the :func:`streaming_tango_scan`
+    scan body so the scanned path is the per-block program by construction
+    (the serve scheduler already proved a *restructured* program — the
+    vmapped megabatch — diverges through the warm-up GEVD + ffill hold)."""
     K, C, F, T = Y.shape
     st1_in, st2_in = (None, None) if state is None else (state["step1"], state["step2"])
     step1 = jax.vmap(
@@ -546,3 +500,339 @@ def streaming_tango(
         "zn": zn,
         "state": out_state,
     }
+
+
+def _float_kw(lambda_cor, mu):
+    """Forward the traced floats ONLY when they differ from the signature
+    defaults — the canonical calling convention (module docstring): jax.jit
+    folds an omitted default at trace time but traces a passed value, and
+    the two programs differ in the last ulp where the warm-up GEVD amplifies
+    it.  The host-side wrappers below must not turn every omitted default
+    into a passed value."""
+    kw = {}
+    if not (isinstance(lambda_cor, float) and lambda_cor == DEFAULT_LAMBDA_COR):
+        kw["lambda_cor"] = lambda_cor
+    if not (isinstance(mu, float) and mu == DEFAULT_MU):
+        kw["mu"] = mu
+    return kw
+
+
+def _chaos_between_blocks(state):
+    """Fire the ``between_blocks`` chaos seam on a chunk-continuation entry
+    — host-side, OUTSIDE the jitted program, so it fires on every
+    continuation call (a tick inside the traced function would fire only at
+    trace time and silently skip every cached call)."""
+    if state is not None:
+        from disco_tpu.runs import chaos as _chaos
+
+        _chaos.tick("between_blocks")
+
+
+@partial(counted_jit, label="streaming_tango",
+         static_argnames=("update_every", "ref_mic", "with_diagnostics", "policy", "solver"))
+def _streaming_tango_jit(
+    Y,
+    masks_z,
+    mask_w,
+    lambda_cor: float = DEFAULT_LAMBDA_COR,
+    update_every: int = DEFAULT_UPDATE_EVERY,
+    mu: float = DEFAULT_MU,
+    ref_mic: int = 0,
+    S=None,
+    N=None,
+    with_diagnostics: bool = False,
+    policy: str | None = "local",
+    state=None,
+    solver: str = "eigh",
+    z_avail=None,
+):
+    """The jitted :func:`_streaming_tango_body` (the public
+    :func:`streaming_tango` wrapper adds the host-side chaos seam)."""
+    return _streaming_tango_body(
+        Y, masks_z, mask_w, lambda_cor=lambda_cor, update_every=update_every,
+        mu=mu, ref_mic=ref_mic, S=S, N=N, with_diagnostics=with_diagnostics,
+        policy=policy, state=state, solver=solver, z_avail=z_avail,
+    )
+
+
+def streaming_tango(
+    Y,
+    masks_z,
+    mask_w,
+    lambda_cor: float = DEFAULT_LAMBDA_COR,
+    update_every: int = DEFAULT_UPDATE_EVERY,
+    mu: float = DEFAULT_MU,
+    ref_mic: int = 0,
+    S=None,
+    N=None,
+    with_diagnostics: bool = False,
+    policy: str | None = "local",
+    state=None,
+    solver: str = "eigh",
+    z_avail=None,
+):
+    """Full two-step streaming TANGO over all nodes (mixture-only by
+    default: the deployment path needs no oracle S/N).
+
+    Step 1 streams per node (vmapped); the z-exchange is array indexing on
+    one device (an all_gather over 'node' when mesh-sharded); step 2 streams
+    the stacked [y_k ‖ z_{j≠k}] under the 'local', 'distant' or 'none'
+    mask-for-z policy (see :func:`_stream_stats`; the oracle policies are
+    offline-only features).
+
+    Args:
+      Y: (K, C, F, T) mixture STFTs.
+      masks_z, mask_w: (K, F, T) step-1 / step-2 masks.
+      S, N: optional (K, C, F, T) clean components; with
+        ``with_diagnostics=True`` the SAME online filters are applied to
+        them, yielding sf/nf/z_s/z_n — every diagnostic then describes the
+        one deployed filter (no second offline pass).
+      state: optional continuation state (the previous chunk's returned
+        ``state``) — chunk-by-chunk online deployment of BOTH steps; exact
+        across refresh-block-aligned boundaries (tests/test_streaming.py).
+      z_avail: optional per-block availability of the exchanged streams —
+        (K, B) with B = ceil(T / update_every), or (K,) broadcast over
+        blocks.  Lost/stale blocks are bridged by :func:`hold_last_good`
+        (previous good block, falling back to the producer's ``zn``
+        estimate before the first delivery); the diagnostic streams are
+        held with the same availability.  The hold carries ride the
+        returned ``state`` (key ``"hold"``), so chunked continuation —
+        pass per-chunk masks — bridges a chunk-boundary loss with the
+        previous chunk's last good block, matching the unchunked run
+        across refresh-block-aligned boundaries.  None (default) is the
+        fault-free path, byte-identical to before.
+
+    Returns:
+      dict with yf (K, F, T) enhanced outputs, z_y/zn (K, F, T) streams,
+      a ``state`` entry for continuation, and sf/nf/z_s/z_n when
+      diagnostics are requested.
+
+    Crash safety: a chunked deployment loop is exactly the shape the
+    crash-safe runs layer (``disco_tpu.runs``) targets — the returned
+    ``state`` is the continuation checkpoint, so a caller persisting it
+    atomically per chunk (``disco_tpu.io.atomic``) can resume a killed
+    stream at the last chunk boundary.  The ``between_blocks`` chaos seam
+    fires at each chunk-continuation entry (host-side, outside jit) so
+    ``make chaos-check``-style tests can interrupt a chunked run at the
+    boundary.
+    """
+    _chaos_between_blocks(state)
+    return _streaming_tango_jit(
+        Y, masks_z, mask_w, update_every=update_every, ref_mic=ref_mic,
+        S=S, N=N, with_diagnostics=with_diagnostics, policy=policy,
+        state=state, solver=solver, z_avail=z_avail,
+        **_float_kw(lambda_cor, mu),
+    )
+
+
+#: the jit plumbing of the wrapped program, for callers that re-jit it with
+#: different options (the serve scheduler's donated off-CPU step uses
+#: ``__wrapped__``) or inspect the cache (tests, counted_jit accounting)
+streaming_tango.jitted = _streaming_tango_jit.jitted
+streaming_tango.lower = _streaming_tango_jit.lower
+streaming_tango.clear_cache = _streaming_tango_jit.clear_cache
+streaming_tango.__wrapped__ = _streaming_tango_jit.__wrapped__
+
+
+@partial(counted_jit, label="streaming_tango_scan",
+         static_argnames=("blocks_per_dispatch", "update_every", "ref_mic",
+                          "with_diagnostics", "policy", "solver"))
+def _streaming_tango_scan_jit(
+    Y,
+    masks_z,
+    mask_w,
+    lambda_cor: float = DEFAULT_LAMBDA_COR,
+    update_every: int = DEFAULT_UPDATE_EVERY,
+    mu: float = DEFAULT_MU,
+    ref_mic: int = 0,
+    S=None,
+    N=None,
+    with_diagnostics: bool = False,
+    policy: str | None = "local",
+    state=None,
+    solver: str = "eigh",
+    z_avail=None,
+    blocks_per_dispatch: int = 1,
+):
+    """Device-resident super-tick: ``blocks_per_dispatch`` refresh-aligned
+    streaming blocks per dispatch, via ``lax.scan`` over the per-block state
+    transition.
+
+    On the tunneled attachment every fenced dispatch pays a fixed ~80 ms RPC
+    round-trip (CLAUDE.md), so a per-block host loop is pure dispatch
+    overhead once the on-device per-frame latency beats the frame budget
+    (BENCH_r03–r05: ``streaming_rtf`` flat at 18.9× while offline RTF nearly
+    doubled).  This driver moves the block recursion on device: one program
+    runs N blocks back to back, so one fenced readback amortizes over N
+    blocks instead of gating each one.
+
+    Bit-exactness contract: the scan body is
+    :func:`_streaming_tango_body` — the *identical* per-block computation
+    :func:`streaming_tango` traces, with the same carry pytree as
+    ``initial_stream_state``/``state=`` and the same ``z_avail`` hold
+    semantics (a lost block is bridged identically inside a super-tick and
+    across its edges, because the hold carries ride the scan carry exactly
+    as they ride the returned ``state`` between per-block calls).  Pinned by
+    ``tests/test_streaming.py`` and the hermetic ``make stream-check`` gate;
+    a restructured program (the vmapped megabatch) is exactly what the serve
+    scheduler measured diverging (~1.0 rel err through the GEVD warm-up +
+    ffill hold), so the scan body being the per-block program is the load-
+    bearing design decision, not an implementation detail.  The scan runs
+    with ``unroll=N`` for the same reason: a *rolled* while-loop body
+    compiles with different FMA/fusion choices than the standalone per-block
+    program (measured ~2e-6 step-1 drift on CPU, amplified to ~3e-2 through
+    the warm-up GEVD), while the unrolled bodies compile exactly like the
+    standalone program — still ONE dispatch, which is the whole point.
+
+    Args:
+      Y: (K, C, F, T) mixture STFTs with ``T = blocks_per_dispatch * Tc``
+        and ``Tc`` a multiple of ``update_every`` — N equal refresh-aligned
+        blocks.  Streams that don't divide evenly fall back to the per-block
+        path for the remainder (the serve scheduler and ``bench.py`` do
+        exactly that).
+      masks_z, mask_w: (K, F, T) step-1 / step-2 masks.
+      state: optional continuation carry (same pytree as
+        :func:`streaming_tango`); ``None`` materializes
+        :func:`initial_stream_state` — bit-identical to the per-block
+        default first call (pinned in tests/test_serve.py).
+      z_avail: optional (K, B) availability over ALL ``B = T //
+        update_every`` refresh blocks of the window (or (K,) broadcast);
+        sliced per scanned block into exactly the columns the per-block
+        path would receive.
+      blocks_per_dispatch: N, the super-tick width (static: one compiled
+        program per N).
+
+    Returns:
+      the :func:`streaming_tango` dict — yf/z_y/zn (K, F, T) stitched over
+      the N blocks, plus the end-of-window ``state`` (and the diagnostics
+      when requested).
+    """
+    n = int(blocks_per_dispatch)
+    if n < 1:
+        raise ValueError(f"blocks_per_dispatch must be >= 1, got {blocks_per_dispatch}")
+    K, C, F, T = Y.shape
+    u = update_every
+    if T % n:
+        raise ValueError(
+            f"streaming_tango_scan: T={T} frames does not split into "
+            f"blocks_per_dispatch={n} equal blocks (run the remainder through "
+            "the per-block path)"
+        )
+    Tc = T // n
+    if Tc % u:
+        raise ValueError(
+            f"streaming_tango_scan: per-dispatch block length {Tc} must be a "
+            f"multiple of update_every={u} (refresh-aligned blocks)"
+        )
+    if with_diagnostics and (S is None or N is None):
+        raise ValueError("with_diagnostics=True needs S and N")
+    if state is None:
+        state = jax.tree_util.tree_map(
+            jnp.asarray,
+            initial_stream_state(K, C, F, update_every=u, ref_mic=ref_mic,
+                                 dtype=Y.dtype),
+        )
+
+    carry = {"step1": state["step1"], "step2": state["step2"]}
+    hold_keys = ("z_y", "zn") + (("z_s", "z_n") if with_diagnostics else ())
+    if z_avail is not None:
+        # Pre-fill any missing hold carry with the zero seed
+        # hold_last_good(carry=None) would materialize — bit-identical, and
+        # it keeps the scan carry structure fixed across iterations.
+        hin = (state.get("hold") or {}) if isinstance(state, dict) else {}
+        carry["hold"] = {
+            key: hin[key] if hin.get(key) is not None
+            else (jnp.zeros((K, F, u), Y.dtype), jnp.zeros((K,), bool))
+            for key in hold_keys
+        }
+
+    def chunk(a):  # (..., T) -> (n, ..., Tc) leading scan axis
+        a = jnp.asarray(a)
+        return jnp.moveaxis(a.reshape(a.shape[:-1] + (n, Tc)), -2, 0)
+
+    xs = {"Y": chunk(Y), "mz": chunk(masks_z), "mw": chunk(mask_w)}
+    if with_diagnostics:
+        xs["S"], xs["N"] = chunk(S), chunk(N)
+    if z_avail is not None:
+        Bc = Tc // u
+        za = jnp.asarray(z_avail)
+        if za.ndim == 1:
+            za = jnp.broadcast_to(za[:, None], (K, n * Bc))
+        if za.shape != (K, n * Bc):
+            raise ValueError(
+                f"z_avail shape {za.shape} does not cover the window: "
+                f"expected ({K}, {n * Bc}) refresh-block columns"
+            )
+        xs["za"] = jnp.moveaxis(za.reshape(K, n, Bc), 1, 0)  # (n, K, Bc)
+
+    def body(c, x):
+        st = {"step1": c["step1"], "step2": c["step2"]}
+        if "hold" in c:
+            st["hold"] = c["hold"]
+        out = _streaming_tango_body(
+            x["Y"], x["mz"], x["mw"], lambda_cor=lambda_cor, update_every=u,
+            mu=mu, ref_mic=ref_mic, S=x.get("S"), N=x.get("N"),
+            with_diagnostics=with_diagnostics, policy=policy, state=st,
+            solver=solver, z_avail=x.get("za"),
+        )
+        st_out = out.pop("state")
+        c_out = {"step1": st_out["step1"], "step2": st_out["step2"]}
+        if "hold" in st_out:
+            c_out["hold"] = st_out["hold"]
+        return c_out, out
+
+    carry_out, ys = jax.lax.scan(body, carry, xs, unroll=n)
+
+    def unchunk(a):  # (n, K, F, Tc) -> (K, F, n * Tc)
+        return jnp.moveaxis(a, 0, -2).reshape(a.shape[1:-1] + (T,))
+
+    out = {key: unchunk(val) for key, val in ys.items()}
+    out_state = {"step1": carry_out["step1"], "step2": carry_out["step2"]}
+    if "hold" in carry_out:
+        out_state["hold"] = carry_out["hold"]
+    out["state"] = out_state
+    return out
+
+
+def streaming_tango_scan(
+    Y,
+    masks_z,
+    mask_w,
+    lambda_cor: float = DEFAULT_LAMBDA_COR,
+    update_every: int = DEFAULT_UPDATE_EVERY,
+    mu: float = DEFAULT_MU,
+    ref_mic: int = 0,
+    S=None,
+    N=None,
+    with_diagnostics: bool = False,
+    policy: str | None = "local",
+    state=None,
+    solver: str = "eigh",
+    z_avail=None,
+    blocks_per_dispatch: int = 1,
+):
+    """Host entry of the scanned super-tick driver — fires the
+    ``between_blocks`` chaos seam on every chunk-continuation call (outside
+    the jitted program) and mirrors the canonical traced-float convention,
+    then dispatches :func:`_streaming_tango_scan_jit` (see its docstring
+    for the full contract).
+
+    No direct reference counterpart: the reference never wires its online
+    estimator (se_utils/internal_formulas.py:84-103, the recursion
+    :func:`streaming_tango` deploys) into any driver, and dispatch-RPC
+    amortization is a concern of this port's tunneled-TPU deployment only.
+    """
+    _chaos_between_blocks(state)
+    return _streaming_tango_scan_jit(
+        Y, masks_z, mask_w, update_every=update_every, ref_mic=ref_mic,
+        S=S, N=N, with_diagnostics=with_diagnostics, policy=policy,
+        state=state, solver=solver, z_avail=z_avail,
+        blocks_per_dispatch=blocks_per_dispatch,
+        **_float_kw(lambda_cor, mu),
+    )
+
+
+streaming_tango_scan.jitted = _streaming_tango_scan_jit.jitted
+streaming_tango_scan.lower = _streaming_tango_scan_jit.lower
+streaming_tango_scan.clear_cache = _streaming_tango_scan_jit.clear_cache
+streaming_tango_scan.__wrapped__ = _streaming_tango_scan_jit.__wrapped__

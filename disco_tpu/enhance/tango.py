@@ -98,12 +98,21 @@ def _masked_cov_pair(X, mask, cov_impl: str, frame_axis):
     """(Rss, Rnn) of ``mask * X`` / ``(1-mask) * X`` — the shared
     mask->covariance stage of both steps, routed by ``cov_impl``:
 
+    * 'auto' (the default since the round-6 promotion): the fused pallas
+      kernel on real TPU backends, the einsum path elsewhere —
+      ``ops.cov_ops.resolve_cov_impl``, ``DISCO_TPU_COV_IMPL`` env escape
+      hatch.  Parity stays gated by the float64 oracles in
+      tests/reference_impls.py and tests/test_ops.py.
     * 'xla': materialized masked copies + einsum (beam.covariance).
     * 'pallas': the fused single-read kernel (ops.cov_ops) — the masked
       copies never touch HBM (round-2 verdict #3).  Falls back to 'xla'
       under sequence parallelism (the psum over ``frame_axis`` needs the
       einsum path's axis_name plumbing).
     """
+    if cov_impl == "auto":
+        from disco_tpu.ops.cov_ops import resolve_cov_impl
+
+        cov_impl = resolve_cov_impl(cov_impl)
     if cov_impl == "pallas" and frame_axis is None:
         from disco_tpu.ops.cov_ops import masked_covariances_fused
 
@@ -118,7 +127,7 @@ def _masked_cov_pair(X, mask, cov_impl: str, frame_axis):
 @partial(jax.jit, static_argnames=("oracle_stats", "ref_mic", "frame_axis", "solver", "cov_impl"))
 def tango_step1(
     Y, S, N, mask_z, mu: float = 1.0, oracle_stats: bool = False, ref_mic: int = 0,
-    frame_axis: str | None = None, solver: str = "power", cov_impl: str = "xla",
+    frame_axis: str | None = None, solver: str = "power", cov_impl: str = "auto",
 ):
     """Step 1 at ONE node: local rank-1 GEVD-MWF -> compressed signals.
 
@@ -236,7 +245,7 @@ def tango_step2(
     mask_type: str = "irm1",
     frame_axis: str | None = None,
     solver: str = "power",
-    cov_impl: str = "xla",
+    cov_impl: str = "auto",
     z_avail=None,
 ):
     """Step 2 at ONE node k: global rank-1 GEVD-MWF on ``[y_k ‖ z_{j≠k}]``
@@ -312,7 +321,7 @@ def tango(
     mask_type: str = "irm1",
     oracle_step1_stats: bool = False,
     solver: str = "power",
-    cov_impl: str = "xla",
+    cov_impl: str = "auto",
     z_mask=None,
     z_nan=None,
 ) -> TangoResult:

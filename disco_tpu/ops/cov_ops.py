@@ -176,6 +176,45 @@ def masked_cov_pallas(
     return Rss.reshape(shape), Rnn.reshape(shape)
 
 
+#: Environment escape hatch for the default covariance kernel selection:
+#: ``DISCO_TPU_COV_IMPL=xla`` (or ``pallas``) overrides the ``'auto'``
+#: resolution everywhere the callers left ``cov_impl`` at its default.
+COV_IMPL_ENV = "DISCO_TPU_COV_IMPL"
+
+
+def resolve_cov_impl(impl: str = "auto") -> str:
+    """Resolve a ``cov_impl`` knob to a concrete kernel choice.
+
+    ``'auto'`` (the pipeline default since the round-6 promotion —
+    ``rtf_covfused`` 6829 vs 6735 default in BENCH_r05) resolves to the
+    fused pallas kernel on real TPU backends and to the einsum path
+    everywhere else (off-TPU the pallas interpreter is a correctness tool,
+    not a fast path), with the :data:`COV_IMPL_ENV` env var as the
+    operator escape hatch.  Explicit ``'xla'``/``'pallas'`` pass through
+    untouched.  Resolution happens when a program is *traced* (``cov_impl``
+    is a static jit argument), so flipping the env var mid-process does not
+    retrace already-compiled buckets.
+
+    No reference counterpart: kernel selection is a TPU-port concern — the
+    reference computes its covariances one way only (numpy einsum,
+    tango.py:347-364, the stage both kernels implement).
+    """
+    if impl != "auto":
+        if impl not in ("xla", "pallas"):
+            raise ValueError(f"unknown cov impl {impl!r}; expected 'auto', 'xla' or 'pallas'")
+        return impl
+    import os
+
+    env = os.environ.get(COV_IMPL_ENV, "").strip().lower()
+    if env:
+        if env not in ("xla", "pallas"):
+            raise ValueError(f"{COV_IMPL_ENV}={env!r}: expected 'xla' or 'pallas'")
+        return env
+    from disco_tpu.utils.backend import is_tpu
+
+    return "pallas" if is_tpu() else "xla"
+
+
 def masked_covariances_fused(y, mask, impl: str = "xla", interpret: bool | None = None):
     """Masked speech/noise covariance pair with implementation dispatch —
     the mask->covariance stage of reference tango.py:347-364.

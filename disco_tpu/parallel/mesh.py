@@ -35,6 +35,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from disco_tpu.enhance.tango import TangoResult, finite_z_guard, tango_step1, tango_step2
+from disco_tpu.ops.cov_ops import resolve_cov_impl
 
 
 def shard_map_compat(f=None, *, mesh, in_specs, out_specs, check_vma: bool = True):
@@ -126,7 +127,7 @@ def ring_all_gather(x, axis_name: str):
 def _tango_on_mesh(
     Y, S, N, masks_z, mask_w, mesh, frame_axis, mu, policy, ref_mic, mask_type,
     oracle_step1_stats, z_exchange: str = "all_gather", solver: str = "power",
-    cov_impl: str = "xla", z_mask=None,
+    cov_impl: str = "auto", z_mask=None,
 ) -> TangoResult:
     """Shared shard_map body for the node-sharded and node+frame-sharded
     pipelines — identical math, different partition specs.
@@ -173,8 +174,13 @@ def _tango_on_mesh(
         # pallas_call's vma handling inside shard_map is incomplete in this
         # jax version (its interpreter hits "dynamic_slice requires varying
         # manual axes to match"; upstream suggests check_vma=False as the
-        # workaround) — disable the check only for the fused-cov variant.
-        check_vma=cov_impl != "pallas",
+        # workaround) — disable the check ONLY when the pallas kernel will
+        # actually run: 'auto' resolved first (it may land on pallas on a
+        # TPU mesh), and under sequence parallelism (frame_axis set)
+        # _masked_cov_pair falls back to the einsum path, which must keep
+        # its vma validation.
+        check_vma=not (resolve_cov_impl(cov_impl) == "pallas"
+                       and frame_axis is None),
     )
     def _run(Yk, Sk, Nk, mzk, mwk, *rest):
         # Local shard shapes: (K_local, C, F, T_local).
@@ -246,7 +252,7 @@ def tango_sharded(
     oracle_step1_stats: bool = False,
     z_exchange: str = "all_gather",
     solver: str = "power",
-    cov_impl: str = "xla",
+    cov_impl: str = "auto",
     z_mask=None,
 ) -> TangoResult:
     """Two-step TANGO with the node axis sharded over ``mesh``'s 'node' axis.
@@ -326,7 +332,7 @@ def tango_batch_sharded(
     ref_mic: int = 0,
     mask_type: str = "irm1",
     solver: str = "power",
-    cov_impl: str = "xla",
+    cov_impl: str = "auto",
     z_mask_b=None,
     z_nan_b=None,
 ) -> TangoResult:

@@ -66,8 +66,11 @@ def _config(F, **kw):
                          block_frames=BLOCK, update_every=U, **kw)
 
 
-def _check_parity(failures: list) -> dict:
-    """Experiment 1: 4 concurrent clients, bit-parity + readback accounting."""
+def _check_parity(failures: list, server_kw: dict | None = None,
+                  label: str = "parity") -> dict:
+    """Experiment 1: 4 concurrent clients, bit-parity + readback accounting
+    (``server_kw``: extra EnhanceServer knobs — the super-tick cycle reruns
+    this with ``blocks_per_super_tick=2``)."""
     import numpy as np
 
     from disco_tpu.obs.accounting import device_get_count
@@ -84,7 +87,7 @@ def _check_parity(failures: list) -> dict:
     refs = [_offline(Y, m, **okw) for (Y, m), _ckw, okw, _zm in scenes]
     F = scenes[0][0][0].shape[-2]
 
-    srv = EnhanceServer(max_sessions=8)
+    srv = EnhanceServer(max_sessions=8, **(server_kw or {}))
     addr = srv.start()
     gets0 = device_get_count()
     results = [None] * len(scenes)
@@ -112,22 +115,25 @@ def _check_parity(failures: list) -> dict:
     failures.extend(errors)
     for i, ref in enumerate(refs):
         if results[i] is None:
-            failures.append(f"parity: session {i} returned nothing")
+            failures.append(f"{label}: session {i} returned nothing")
         elif not np.array_equal(results[i], ref):
             failures.append(
-                f"parity: session {i} output differs from offline streaming_tango "
+                f"{label}: session {i} output differs from offline streaming_tango "
                 f"(max abs diff {np.abs(results[i] - ref).max():g})"
             )
     if gets != ticks:
         failures.append(
-            f"parity: {gets} batched readbacks for {ticks} scheduler ticks — "
+            f"{label}: {gets} batched readbacks for {ticks} scheduler ticks — "
             "the one-device_get_tree-per-tick contract is broken"
         )
     return {"sessions": len(scenes), "ticks": ticks, "batched_readbacks": gets}
 
 
-def _check_drain_resume(failures: list, state_dir: Path) -> dict:
-    """Experiment 2: graceful stop drains, checkpoints, resumes bit-exact."""
+def _check_drain_resume(failures: list, state_dir: Path,
+                        server_kw: dict | None = None) -> dict:
+    """Experiment 2: graceful stop drains, checkpoints, resumes bit-exact.
+    With super-ticks on, the drain gate must flush the double-buffered
+    in-flight batch before checkpointing (block-boundary invariant)."""
     import numpy as np
 
     from disco_tpu.runs.interrupt import GracefulInterrupt, request_stop
@@ -142,7 +148,8 @@ def _check_drain_resume(failures: list, state_dir: Path) -> dict:
 
     outs = {}
     with GracefulInterrupt():  # the dispatch loop polls runs.interrupt
-        srv = EnhanceServer(max_sessions=4, state_dir=state_dir)
+        srv = EnhanceServer(max_sessions=4, state_dir=state_dir,
+                            **(server_kw or {}))
         addr = srv.start()
         cl = ServeClient(addr)
         cl.open(_config(F), session_id="drainee")
@@ -170,7 +177,8 @@ def _check_drain_resume(failures: list, state_dir: Path) -> dict:
 
     # resume on a fresh server (the GracefulInterrupt scope is gone, so the
     # stop flag no longer trips the new dispatch loop)
-    srv2 = EnhanceServer(max_sessions=4, state_dir=state_dir)
+    srv2 = EnhanceServer(max_sessions=4, state_dir=state_dir,
+                         **(server_kw or {}))
     addr2 = srv2.start()
     try:
         cl2 = ServeClient(addr2)
@@ -193,7 +201,8 @@ def _check_drain_resume(failures: list, state_dir: Path) -> dict:
     return {"blocks_before_drain": half, "blocks_total": n_blocks}
 
 
-def _check_chaos(failures: list, state_dir: Path) -> dict:
+def _check_chaos(failures: list, state_dir: Path,
+                 server_kw: dict | None = None) -> dict:
     """Experiment 3: chaos crashes — mid-serve and mid-checkpoint."""
     import numpy as np
 
@@ -209,7 +218,7 @@ def _check_chaos(failures: list, state_dir: Path) -> dict:
     n_crashes = 0
 
     # (a) crash the scheduler mid-stream: the 3rd tick dies like a process
-    srv = EnhanceServer(max_sessions=4)
+    srv = EnhanceServer(max_sessions=4, **(server_kw or {}))
     addr = srv.start()
     cl = ServeClient(addr)
     cl.open(_config(F))
@@ -247,7 +256,8 @@ def _check_chaos(failures: list, state_dir: Path) -> dict:
             )
 
     # (b) crash INSIDE the drain checkpoint write: atomic-write invariant
-    srv = EnhanceServer(max_sessions=4, state_dir=state_dir)
+    srv = EnhanceServer(max_sessions=4, state_dir=state_dir,
+                        **(server_kw or {}))
     addr = srv.start()
     cl = ServeClient(addr)
     cl.open(_config(F), session_id="chaotic")
@@ -295,6 +305,17 @@ def main(argv=None) -> int:
             parity = _check_parity(failures)
             drain = _check_drain_resume(failures, tmp / "state")
             chaos_stats = _check_chaos(failures, tmp / "chaos_state")
+            # super-tick cycle: the same concurrent-parity, drain/resume and
+            # chaos scenarios with blocks_per_super_tick=2 (scanned
+            # multi-block dispatch + double-buffered readback) — the serve
+            # contract must hold bit-for-bit in super-tick mode too
+            st_kw = {"blocks_per_super_tick": 2, "max_queue_blocks": 8}
+            st_parity = _check_parity(failures, server_kw=st_kw,
+                                      label="supertick-parity")
+            _check_drain_resume(failures, tmp / "st_state", server_kw=st_kw)
+            st_chaos = _check_chaos(failures, tmp / "st_chaos_state",
+                                    server_kw=st_kw)
+            chaos_stats["crashes_injected"] += st_chaos["crashes_injected"]
             obs.record("counters", **obs.REGISTRY.snapshot())
         events = obs.read_events(obs_log)  # schema-validating read
 
@@ -326,6 +347,8 @@ def main(argv=None) -> int:
         "concurrent_sessions": parity["sessions"],
         "ticks": parity["ticks"],
         "batched_readbacks": parity["batched_readbacks"],
+        "supertick_ticks": st_parity["ticks"],
+        "supertick_readbacks": st_parity["batched_readbacks"],
         "drain_blocks": drain["blocks_before_drain"],
         "crashes_injected": chaos_stats["crashes_injected"],
         "jax_processes": 1,   # by construction: clients are numpy threads

@@ -25,6 +25,16 @@ visible as ``jit_trace`` events).  Off-CPU the step re-jits the same
 function with the carry donated (``donate_argnames=("state",)``): identical
 HLO math, buffers reused in place — the corpus engine's donation rule.
 
+Super-ticks (``blocks_per_super_tick`` = N > 1) amortize the fenced RPC
+further: every run of N consecutive full queued blocks a session
+contributes to a tick rides ONE scanned program
+(:func:`~disco_tpu.enhance.streaming.streaming_tango_scan` — the per-block
+state transition under a fully-unrolled ``lax.scan``, bit-identical by
+construction), and the double-buffered tick state overlaps tick T+1's
+dispatch with tick T's batched readback.  Sub-N remainders and ragged final
+blocks fall back to the per-block path, bounding compiles to two programs
+per shape bucket.
+
 Admission control is first-class: a bounded session count
 (``admission_reject`` counter), a bounded per-session input queue
 (backpressure errors instead of unbounded host memory), and slow-client
@@ -74,41 +84,56 @@ class QueueFull(RuntimeError):
     """Per-session input queue bound hit — backpressure, not a crash."""
 
 
-_STEP = None
+_STEPS: dict = {}
 _STEP_LOCK = threading.Lock()
 
+_STEP_STATICS = ("update_every", "ref_mic", "with_diagnostics", "policy", "solver")
 
-def _serve_step():
-    """The per-block step callable.
 
-    CPU: literally ``enhance.streaming.streaming_tango`` — the offline
-    jitted wrapper itself, so serve and offline share one compiled program
-    per shape bucket and parity is true by construction.  Off-CPU: a
-    ``counted_jit`` of the same underlying function with the continuation
-    carry donated (aliasing metadata only — the HLO math is unchanged).
+def _resolve_step(attr: str, label: str, extra_static=()):
+    """The ONE step-resolution discipline, lazily cached per entry point.
+
+    CPU: literally the offline jitted wrapper (``enhance.streaming.<attr>``)
+    itself, so serve and offline share one compiled program per shape
+    bucket and parity is true by construction.  Off-CPU: a ``counted_jit``
+    of the same underlying function with the continuation carry donated
+    (aliasing metadata only — the HLO math is unchanged).
     """
-    global _STEP
-    if _STEP is None:
+    step = _STEPS.get(attr)
+    if step is None:
         with _STEP_LOCK:
-            if _STEP is None:
+            if attr not in _STEPS:
                 import jax
 
                 from disco_tpu.enhance import streaming
                 from disco_tpu.obs.accounting import counted_jit
 
+                wrapper = getattr(streaming, attr)
                 if jax.default_backend() == "cpu":
-                    _STEP = streaming.streaming_tango
+                    _STEPS[attr] = wrapper
                 else:
-                    _STEP = counted_jit(
-                        streaming.streaming_tango.__wrapped__,
-                        label="serve_step",
-                        static_argnames=(
-                            "update_every", "ref_mic", "with_diagnostics",
-                            "policy", "solver",
-                        ),
+                    _STEPS[attr] = counted_jit(
+                        wrapper.__wrapped__,
+                        label=label,
+                        static_argnames=tuple(extra_static) + _STEP_STATICS,
                         donate_argnames=("state",),
                     )
-    return _STEP
+            step = _STEPS[attr]
+    return step
+
+
+def _serve_step():
+    """The per-block step callable (see :func:`_resolve_step`)."""
+    return _resolve_step("streaming_tango", "serve_step")
+
+
+def _serve_scan_step():
+    """The super-tick step callable: the scanned multi-block driver
+    (:func:`~disco_tpu.enhance.streaming.streaming_tango_scan`), resolved
+    with exactly the :func:`_serve_step` discipline (shared program per
+    (shape bucket, N) on CPU, donated carry off-CPU)."""
+    return _resolve_step("streaming_tango_scan", "serve_scan_step",
+                         extra_static=("blocks_per_dispatch",))
 
 
 class Scheduler:
@@ -123,12 +148,41 @@ class Scheduler:
 
     def __init__(self, *, max_sessions: int = 16, max_queue_blocks: int = 8,
                  max_blocks_per_tick: int = DEFAULT_MAX_BLOCKS_PER_TICK,
+                 blocks_per_super_tick: int = 1,
+                 overlap_readback: bool | None = None,
                  fault_spec=None):
         if max_sessions < 1 or max_queue_blocks < 1 or max_blocks_per_tick < 1:
             raise ValueError("scheduler bounds must be >= 1")
+        if blocks_per_super_tick < 1:
+            raise ValueError("blocks_per_super_tick must be >= 1")
+        if blocks_per_super_tick > max_blocks_per_tick:
+            # no group of N could ever form inside the tick budget — the
+            # knob would be silently inert (same fail-at-startup rule as
+            # the --max-blocks-per-tick plumbing fix in PR 5)
+            raise ValueError(
+                f"blocks_per_super_tick={blocks_per_super_tick} exceeds "
+                f"max_blocks_per_tick={max_blocks_per_tick}: no super-tick "
+                "could ever form"
+            )
         self.max_sessions = max_sessions
         self.max_queue_blocks = max_queue_blocks
         self.max_blocks_per_tick = max_blocks_per_tick
+        #: N: every run of N consecutive full queued blocks of a session is
+        #: dispatched as ONE scanned super-tick program
+        #: (streaming_tango_scan) — one fenced readback share per N blocks.
+        #: The sub-N remainder (and a ragged final block) falls back to the
+        #: per-block path, so exactly two programs exist per shape bucket
+        #: (per-block + N-scan) and the last partial window never waits for
+        #: more input.
+        self.blocks_per_super_tick = blocks_per_super_tick
+        #: Double-buffered tick state: when on, tick T dispatches its work
+        #: FIRST and then reads back tick T-1's batch, so the device computes
+        #: super-tick T while the host drains super-tick T-1's readback (the
+        #: pipeline.py overlap pattern applied to serving).  Deliveries lag
+        #: one tick; an idle tick flushes the buffer.  Default: on exactly
+        #: when super-ticks are on.
+        self.overlap_readback = (blocks_per_super_tick > 1
+                                 if overlap_readback is None else overlap_readback)
         self.fault_spec = fault_spec
         self.draining = False
         self._lock = threading.Lock()
@@ -136,6 +190,9 @@ class Scheduler:
         self._session_seq = 0
         self._rotate = 0
         self.ticks_with_work = 0
+        #: dispatched-but-not-read-back units from the previous tick
+        #: (overlap_readback): [(session, [seq, ...], yf_device, t_dispatch)]
+        self._inflight: list = []
 
     # -- registry (I/O thread) ----------------------------------------------
     def sessions(self) -> list:
@@ -308,8 +365,15 @@ class Scheduler:
 
         Returns ``[(session, seq, yf, latency_s), ...]`` host-side
         deliveries (``yf`` numpy complex64), plus finishes sessions whose
-        close was requested and whose queues drained.  Exactly one batched
-        readback when any block ran; none on an idle tick.
+        close was requested and whose queues (and in-flight dispatches)
+        drained.  Exactly one batched readback per tick that reads work
+        back; none on an idle tick.  With super-ticks on
+        (``blocks_per_super_tick`` = N > 1), each session's popped blocks
+        ride scanned dispatches in groups of N (the sub-N remainder goes
+        per-block), and with ``overlap_readback``
+        the readback of the previous tick's batch happens *after* this
+        tick's dispatches are queued — the device computes super-tick T+1
+        while the host reads super-tick T.
         """
         from disco_tpu.runs import chaos
 
@@ -322,51 +386,74 @@ class Scheduler:
             k = self._rotate % len(sessions)
             self._rotate += 1
             sessions = sessions[k:] + sessions[:k]
-        work: list = []        # (session, seq, yf_device)
+        units: list = []       # (session, [seq, ...], yf_device, t_dispatch)
         budget = self.max_blocks_per_tick
+        n_super = self.blocks_per_super_tick
         n_busy = 0
         t0 = time.perf_counter()
         for session in sessions:
             if session.status not in (OPEN, DRAINING) or budget <= 0:
                 continue
-            blocks = session.pop_blocks(budget)
+            if n_super > 1:
+                # align the pop to a multiple of N: a deeper-than-budget
+                # queue must never shed a sub-N remainder through per-block
+                # dispatches every tick just because max_blocks_per_tick
+                # isn't a multiple of N — blocks left queued join the next
+                # tick's scan group instead.  A sub-N *queue* (stream tail /
+                # starved input) still pops in full below and rides the
+                # per-block fallback.  When the budget remainder is < N
+                # (later sessions of a crowded tick), skip — the per-tick
+                # rotation hands this session a full-width slot next tick.
+                cap = budget // n_super * n_super
+                if cap == 0:
+                    continue
+            else:
+                cap = budget
+            blocks = session.pop_blocks(cap)
             if not blocks:
                 continue
             n_busy += 1
             budget -= len(blocks)
-            for seq, Y, mz, mw in blocks:
-                try:
-                    work.append(
-                        (session, seq, self._dispatch(session, seq, Y, mz, mw))
-                    )
-                except Exception as e:
-                    # per-session isolation: one block the device rejects
-                    # (validation can't anticipate every jax TypeError) must
-                    # not unwind the dispatch thread and kill every other
-                    # live session — evict the offender and keep serving.
-                    # ChaosCrash is a BaseException and still dies here.
-                    self.evict(
-                        session, f"dispatch failed: {type(e).__name__}: {e}"
-                    )
-                    break
+            bf = session.config.block_frames
+            try:
+                # every run of N consecutive full blocks rides one scanned
+                # dispatch; the sub-N remainder (or a group holding the
+                # ragged final block — always the stream's last) goes
+                # per-block, so a deep queue amortizes at the same 1-fence-
+                # per-N rate as an exactly-N one (the scanned program only
+                # ever sees N full refresh-aligned blocks).
+                for g in range(0, len(blocks), n_super):
+                    group = blocks[g:g + n_super]
+                    if (n_super > 1 and len(group) == n_super
+                            and all(b[1].shape[-1] == bf for b in group)):
+                        yf = self._dispatch_scan(session, group)
+                        units.append(
+                            (session, [b[0] for b in group], yf, time.time())
+                        )
+                        session.inflight += len(group)
+                    else:
+                        for seq, Y, mz, mw in group:
+                            yf = self._dispatch(session, seq, Y, mz, mw)
+                            units.append((session, [seq], yf, time.time()))
+                            session.inflight += 1
+            except Exception as e:
+                # per-session isolation: one block the device rejects
+                # (validation can't anticipate every jax TypeError) must
+                # not unwind the dispatch thread and kill every other
+                # live session — evict the offender and keep serving.
+                # ChaosCrash is a BaseException and still dies here.
+                self.evict(
+                    session, f"dispatch failed: {type(e).__name__}: {e}"
+                )
 
-        deliveries = []
-        if work:
-            from disco_tpu.utils.transfer import device_get_tree
-
-            with obs_events.stage("serve_tick", n_blocks=len(work), n_sessions=n_busy):
-                host = device_get_tree([yf for (_, _, yf) in work])
-            now = time.time()
-            lat_hist = obs_registry.histogram("serve_block_latency_ms")
-            for (session, seq, _), yf in zip(work, host):
-                t_in = session.enqueued_at.pop(seq, None)
-                lat_s = (now - t_in) if t_in is not None else 0.0
-                lat_hist.observe(lat_s * 1e3)
-                session.blocks_done = max(session.blocks_done, seq + 1)
-                deliveries.append((session, seq, yf, lat_s))
-            self.ticks_with_work += 1
-            obs_registry.counter("serve_ticks").inc()
-            obs_registry.counter("serve_blocks").inc(len(work))
+        if self.overlap_readback:
+            # double buffer: read back the PREVIOUS tick's batch while this
+            # tick's dispatches compute; an idle tick flushes the buffer
+            to_read, self._inflight = self._inflight, units
+        else:
+            to_read = units
+        deliveries = self._readback(to_read) if to_read else []
+        if to_read:
             obs_registry.histogram("serve_tick_ms").observe(
                 (time.perf_counter() - t0) * 1e3
             )
@@ -376,9 +463,56 @@ class Scheduler:
 
         for session in sessions:
             if (session.close_requested and session.status in (OPEN, DRAINING)
-                    and session.queue_depth() == 0):
+                    and session.queue_depth() == 0 and session.inflight == 0):
                 self._finish(session)
         self._set_gauges()
+        return deliveries
+
+    def _readback(self, units: list) -> list:
+        """ONE batched readback over ``units`` and the per-block delivery
+        bookkeeping.  A super-tick unit's (K, F, N*block_frames) output is
+        split back into its N per-seq blocks host-side (pure slicing — the
+        scanned program computed them back to back along the frame axis).
+
+        The ``serve_block_latency_ms`` total is split into its two
+        components so super-tick tuning is observable:
+        ``serve_queue_wait_ms`` (enqueue → dispatch: admission wait) and
+        ``serve_dispatch_ms`` (dispatch → host delivery: device time plus
+        the fenced readback share — and, with ``overlap_readback`` on, the
+        deliberate one-tick buffering lag; the two components always sum to
+        the total, so the delivery cost of the overlap is charged here, not
+        hidden).
+        """
+        from disco_tpu.utils.transfer import device_get_tree
+
+        n_blocks = sum(len(seqs) for (_, seqs, _, _) in units)
+        n_sessions = len({s.id for (s, _, _, _) in units})
+        with obs_events.stage("serve_tick", n_blocks=n_blocks,
+                              n_sessions=n_sessions):
+            host = device_get_tree([yf for (_, _, yf, _) in units])
+        now = time.time()
+        lat_hist = obs_registry.histogram("serve_block_latency_ms")
+        wait_hist = obs_registry.histogram("serve_queue_wait_ms")
+        disp_hist = obs_registry.histogram("serve_dispatch_ms")
+        deliveries = []
+        for (session, seqs, _, t_disp), yf in zip(units, host):
+            bf = session.config.block_frames
+            for j, seq in enumerate(seqs):
+                blk = yf if len(seqs) == 1 else yf[..., j * bf:(j + 1) * bf]
+                t_in = session.enqueued_at.pop(seq, None)
+                lat_s = (now - t_in) if t_in is not None else 0.0
+                lat_hist.observe(lat_s * 1e3)
+                if t_in is not None:
+                    wait_hist.observe(max(t_disp - t_in, 0.0) * 1e3)
+                disp_hist.observe(max(now - t_disp, 0.0) * 1e3)
+                session.blocks_done = max(session.blocks_done, seq + 1)
+                session.inflight = max(session.inflight - 1, 0)
+                deliveries.append((session, seq, blk, lat_s))
+        self.ticks_with_work += 1
+        obs_registry.counter("serve_ticks").inc()
+        obs_registry.counter("serve_blocks").inc(n_blocks)
+        if any(len(seqs) > 1 for (_, seqs, _, _) in units):
+            obs_registry.counter("serve_super_ticks").inc()
         return deliveries
 
     def _dispatch(self, session: Session, seq: int, Y, mz, mw):
@@ -390,7 +524,7 @@ class Scheduler:
 
         from disco_tpu.utils.transfer import to_device
 
-        from disco_tpu.enhance.streaming import DEFAULT_LAMBDA_COR, DEFAULT_MU
+        from disco_tpu.enhance.streaming import _float_kw
 
         cfg = session.config
         u = cfg.update_every
@@ -400,13 +534,9 @@ class Scheduler:
         # lambda_cor / mu are traced floats: jax.jit folds an OMITTED default
         # at trace time but traces a PASSED value — same number, different
         # program, and the warm-up GEVD refreshes amplify the last-ulp
-        # difference (see streaming.DEFAULT_LAMBDA_COR).  Mirror the
-        # canonical offline call: pass them only when non-default.
-        kw = {}
-        if cfg.lambda_cor != DEFAULT_LAMBDA_COR:
-            kw["lambda_cor"] = cfg.lambda_cor
-        if cfg.mu != DEFAULT_MU:
-            kw["mu"] = cfg.mu
+        # difference (see streaming.DEFAULT_LAMBDA_COR).  _float_kw is the
+        # one canonical implementation of "pass only when non-default".
+        kw = _float_kw(cfg.lambda_cor, cfg.mu)
         out = step(
             to_device(np.ascontiguousarray(Y)),
             to_device(np.ascontiguousarray(mz)),
@@ -422,8 +552,50 @@ class Scheduler:
         session.state = out["state"]
         return out["yf"]
 
+    def _dispatch_scan(self, session: Session, blocks: list):
+        """Queue one super-tick on device: N contiguous full blocks through
+        the scanned program (async — no readback).  Identical calling
+        convention to :meth:`_dispatch` — same carry, same per-refresh-block
+        availability columns (the scan slices them back into exactly the
+        per-block chunks), same traced-float discipline — so the result is
+        bit-identical to N per-block dispatches (the stream-check gate)."""
+        import jax
+
+        from disco_tpu.utils.transfer import to_device
+
+        from disco_tpu.enhance.streaming import _float_kw
+
+        cfg = session.config
+        u = cfg.update_every
+        Y = np.concatenate([np.ascontiguousarray(b[1]) for b in blocks], axis=-1)
+        mz = np.concatenate([np.ascontiguousarray(b[2]) for b in blocks], axis=-1)
+        mw = np.concatenate([np.ascontiguousarray(b[3]) for b in blocks], axis=-1)
+        n_refresh = Y.shape[-1] // u  # grouped blocks are full: exact
+        step = _serve_scan_step()
+        state = jax.tree_util.tree_map(to_device, session.state)
+        kw = _float_kw(cfg.lambda_cor, cfg.mu)
+        out = step(
+            to_device(Y),
+            to_device(mz),
+            to_device(mw),
+            update_every=u,
+            ref_mic=cfg.ref_mic,
+            policy=cfg.policy,
+            state=state,
+            solver=cfg.solver,
+            z_avail=session.block_z_avail(blocks[0][0], n_refresh),
+            blocks_per_dispatch=len(blocks),
+            **kw,
+        )
+        session.state = out["state"]
+        return out["yf"]
+
     def pending_blocks(self) -> int:
-        return sum(s.queue_depth() for s in self.sessions())
+        """Blocks not yet delivered: queued plus dispatched-in-flight (the
+        drain gate must wait for the overlap buffer to flush before the
+        final checkpoint, so checkpoints land on delivered-block
+        boundaries)."""
+        return sum(s.queue_depth() + s.inflight for s in self.sessions())
 
     def _set_gauges(self) -> None:
         with self._lock:

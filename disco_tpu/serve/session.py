@@ -59,6 +59,20 @@ class SessionConfig:
     solver: str = "eigh"
 
     def __post_init__(self):
+        # lambda_cor / mu are traced floats with an omit-when-default calling
+        # convention (streaming._float_kw): coerce wire-decoded values here so
+        # a msgpack/JSON integer mu=1 still reads as the 1.0 default (omitted,
+        # shared program) instead of tracing a third int-typed program per
+        # shape bucket.
+        for f in ("lambda_cor", "mu"):
+            v = getattr(self, f)
+            if not isinstance(v, float):
+                try:
+                    object.__setattr__(self, f, float(v))
+                except (TypeError, ValueError):
+                    raise ValueError(
+                        f"session config {f!r}: expected a float, got {v!r}"
+                    ) from None
         for f in ("n_nodes", "mics_per_node", "n_freq", "block_frames", "update_every"):
             v = getattr(self, f)
             if not isinstance(v, int) or v < 1:
@@ -134,6 +148,10 @@ class Session:
         self.status = OPEN
         self.blocks_done = int(blocks_done)   # blocks fully enhanced + delivered to the writer
         self.blocks_in = int(blocks_done)     # highest contiguous seq accepted + 1
+        #: blocks dispatched on device but not yet read back (the scheduler's
+        #: double-buffered super-tick overlap) — dispatch-thread-only, so no
+        #: lock; a session only finishes once queue AND inflight are empty
+        self.inflight = 0
         self.close_requested = False
         self._lock = threading.Lock()
         self._pending: list = []              # [(seq, Y, mask_z, mask_w)] FIFO

@@ -483,6 +483,31 @@ def test_obs_compare_serve_lane_judged_only_with_baseline(tmp_path):
     assert diff["verdict"] == "OK"
 
 
+def test_obs_compare_streaming_scan_lane_judged_like_serve(tmp_path):
+    """streaming_rtf_scan: the amortized super-tick lane is judged exactly
+    like the corpus/serve lanes — only when the baseline carries it, and a
+    candidate that lost the measured lane is a REGRESSION."""
+    def rec(path, rtf, scan=None):
+        d = _bench_record(rtf)
+        if scan is not None:
+            d["streaming_rtf_scan"] = scan
+        p = tmp_path / path
+        p.write_text(json.dumps(d))
+        return str(p)
+
+    old = rec("old.json", 6700.0, scan=100.0)
+    with pytest.raises(SystemExit):  # -20% amortized streaming throughput
+        obs_cli.main(["compare", old, rec("slow.json", 6700.0, scan=80.0)])
+    with pytest.raises(SystemExit):  # lane lost entirely
+        obs_cli.main(["compare", old, rec("lost.json", 6700.0)])
+    diff = obs_cli.main(["compare", old, rec("fast.json", 6700.0, scan=130.0)])
+    assert diff["verdict"] == "IMPROVED"
+    # pre-scan baseline: candidate's lane rides along unjudged
+    diff = obs_cli.main(["compare", rec("pre.json", 6700.0),
+                         rec("cand.json", 6700.0, scan=50.0)])
+    assert diff["verdict"] == "OK"
+
+
 def test_obs_compare_reads_event_log_bench_result(tmp_path):
     log = tmp_path / "run.jsonl"
     with obs.recording(log):
@@ -524,6 +549,9 @@ def test_bench_single_json_line_stdout_with_obs_log(tmp_path, monkeypatch, capsy
 
     monkeypatch.setattr(bench, "bench_jax", _canned_bench_jax)
     monkeypatch.setattr(bench, "bench_streaming", lambda **_: (0.85, 16.0, 18.9))
+    monkeypatch.setattr(bench, "bench_streaming_scan",
+                        lambda **_: (95.0, 2.7, 0.125,
+                                     {"blocks_per_dispatch": 8}))
     monkeypatch.setattr(bench, "bench_corpus", _canned_bench_corpus)
     monkeypatch.setattr(bench, "bench_serve", _canned_bench_serve)
     monkeypatch.setattr(bench, "bench_numpy", lambda **_: 3.0)
@@ -552,6 +580,9 @@ def test_bench_stdout_unchanged_without_obs_log(monkeypatch, capsys):
 
     monkeypatch.setattr(bench, "bench_jax", _canned_bench_jax)
     monkeypatch.setattr(bench, "bench_streaming", lambda **_: (0.85, 16.0, 18.9))
+    monkeypatch.setattr(bench, "bench_streaming_scan",
+                        lambda **_: (95.0, 2.7, 0.125,
+                                     {"blocks_per_dispatch": 8}))
     monkeypatch.setattr(bench, "bench_corpus", _canned_bench_corpus)
     monkeypatch.setattr(bench, "bench_serve", _canned_bench_serve)
     monkeypatch.setattr(bench, "bench_numpy", lambda **_: 3.0)

@@ -331,6 +331,182 @@ def test_scheduler_eviction_counter(stream):
         sched.push_block(s, 0, Y[..., :BLOCK], m[..., :BLOCK], m[..., :BLOCK])
 
 
+def test_scheduler_supertick_parity_fewer_readbacks(stream):
+    """Super-ticks: N queued blocks ride ONE scanned dispatch + readback —
+    per-session results byte-identical to per-block ticks, with fewer
+    batched readbacks than delivered blocks."""
+    from disco_tpu.obs.accounting import device_get_count
+    from disco_tpu.obs.metrics import REGISTRY
+
+    Y, m, ref = stream
+    F, T = Y.shape[-2:]
+    n_blocks = -(-T // BLOCK)
+    N = 2
+
+    sched = Scheduler(max_sessions=2, max_queue_blocks=2 * N,
+                      blocks_per_super_tick=N)
+    assert sched.overlap_readback  # defaults on with super-ticks
+    s = sched.open_session(_config(F))
+    outs = {}
+    gets0 = device_get_count()
+    super0 = REGISTRY.counter("serve_super_ticks").value
+    i = 0
+    while i < n_blocks:
+        for _ in range(N):
+            if i < n_blocks:
+                lo, hi = i * BLOCK, min((i + 1) * BLOCK, T)
+                sched.push_block(s, i, Y[..., lo:hi], m[..., lo:hi], m[..., lo:hi])
+                i += 1
+        for _s, seq, yf, lat in sched.tick():
+            outs[seq] = yf
+            assert lat >= 0.0
+    for _ in range(3):  # flush the double-buffered readback
+        for _s, seq, yf, _lat in sched.tick():
+            outs[seq] = yf
+    gets = device_get_count() - gets0
+    assert len(outs) == n_blocks
+    got = np.concatenate([outs[i] for i in range(n_blocks)], axis=-1)
+    np.testing.assert_array_equal(got, ref)
+    # ceil(14 full / 2) scans + the ragged tail per-block: strictly fewer
+    # readbacks than delivered blocks, one per tick-with-work
+    assert gets < n_blocks
+    assert gets == sched.ticks_with_work
+    assert REGISTRY.counter("serve_super_ticks").value > super0
+    # queue accounting drained: nothing queued, nothing in flight
+    assert sched.pending_blocks() == 0 and s.inflight == 0
+
+
+def test_scheduler_supertick_resume_equivalence(tmp_path, stream):
+    """Checkpoint/resume across super-ticks stays bit-exact: checkpoints
+    land on delivered-block boundaries (the drain gate waits for the
+    in-flight buffer)."""
+    Y, m, ref = stream
+    F, T = Y.shape[-2:]
+    n_blocks = -(-T // BLOCK)
+    N = 2
+    half = (n_blocks // 2) // N * N  # a super-tick boundary
+
+    sched = Scheduler(max_sessions=2, max_queue_blocks=2 * N,
+                      blocks_per_super_tick=N)
+    s = sched.open_session(_config(F), session_id="st-resume")
+    outs = {}
+    i = 0
+    while i < half:
+        for _ in range(N):
+            sched.push_block(s, i, Y[..., i * BLOCK:(i + 1) * BLOCK],
+                             m[..., i * BLOCK:(i + 1) * BLOCK],
+                             m[..., i * BLOCK:(i + 1) * BLOCK])
+            i += 1
+        for _s, seq, yf, _lat in sched.tick():
+            outs[seq] = yf
+    while sched.pending_blocks():
+        for _s, seq, yf, _lat in sched.tick():
+            outs[seq] = yf
+    assert len(outs) == half
+    paths = sched.checkpoint_sessions(tmp_path)
+
+    sched2 = Scheduler(max_sessions=2, blocks_per_super_tick=N)
+    s2 = sched2.open_session(_config(F), resume_from=paths["st-resume"])
+    assert s2.blocks_done == half
+    for i in range(half, n_blocks):
+        lo, hi = i * BLOCK, min((i + 1) * BLOCK, T)
+        sched2.push_block(s2, i, Y[..., lo:hi], m[..., lo:hi], m[..., lo:hi])
+        for _s, seq, yf, _lat in sched2.tick():
+            outs[seq] = yf
+    while sched2.pending_blocks():
+        for _s, seq, yf, _lat in sched2.tick():
+            outs[seq] = yf
+    got = np.concatenate([outs[i] for i in range(n_blocks)], axis=-1)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_scheduler_supertick_deep_queue_groups_per_tick(stream):
+    """A queue deeper than N forms SEVERAL scanned groups in one tick (one
+    fence per N blocks even when everything is queued up front), instead of
+    capping the pop at N — and stays bit-identical to the per-block path."""
+    from disco_tpu.obs.accounting import device_get_count
+
+    Y, m, ref = stream
+    F, T = Y.shape[-2:]
+    n_blocks = -(-T // BLOCK)
+    N = 2
+
+    sched = Scheduler(max_sessions=2, max_queue_blocks=n_blocks,
+                      blocks_per_super_tick=N, overlap_readback=False)
+    s = sched.open_session(_config(F))
+    for i in range(n_blocks):
+        lo, hi = i * BLOCK, min((i + 1) * BLOCK, T)
+        sched.push_block(s, i, Y[..., lo:hi], m[..., lo:hi], m[..., lo:hi])
+    gets0 = device_get_count()
+    outs = {}
+    while sched.pending_blocks():
+        for _s, seq, yf, _lat in sched.tick():
+            outs[seq] = yf
+    gets = device_get_count() - gets0
+    assert len(outs) == n_blocks
+    got = np.concatenate([outs[i] for i in range(n_blocks)], axis=-1)
+    np.testing.assert_array_equal(got, ref)
+    # the whole queue fits one tick's budget: ONE readback covering all
+    # ceil(full/N) scan groups + the ragged tail, not one tick per group
+    assert gets == sched.ticks_with_work == 1
+
+
+def test_scheduler_supertick_misaligned_budget_stays_scanned(stream):
+    """max_blocks_per_tick not a multiple of N: a deep queue must keep
+    riding scan groups (the sub-N budget remainder stays queued for the
+    next tick) instead of shedding per-block dispatches every tick."""
+    Y, m, ref = stream
+    F, T = Y.shape[-2:]
+    n_blocks = -(-T // BLOCK)
+    N = 4
+    full = n_blocks - 1 if T % BLOCK else n_blocks
+
+    sched = Scheduler(max_sessions=2, max_queue_blocks=n_blocks,
+                      blocks_per_super_tick=N, max_blocks_per_tick=N + 2,
+                      overlap_readback=False)
+    s = sched.open_session(_config(F))
+    for i in range(n_blocks):
+        lo, hi = i * BLOCK, min((i + 1) * BLOCK, T)
+        sched.push_block(s, i, Y[..., lo:hi], m[..., lo:hi], m[..., lo:hi])
+    outs = {}
+    while sched.pending_blocks():
+        for _s, seq, yf, _lat in sched.tick():
+            outs[seq] = yf
+    assert len(outs) == n_blocks
+    got = np.concatenate([outs[i] for i in range(n_blocks)], axis=-1)
+    np.testing.assert_array_equal(got, ref)
+    # one tick per scan group + one for the (sub-N tail + ragged) remainder
+    assert sched.ticks_with_work == full // N + 1
+
+
+def test_scheduler_supertick_exceeding_tick_budget_rejected():
+    """blocks_per_super_tick > max_blocks_per_tick could never form a
+    group — fail at startup instead of silently serving per-block."""
+    with pytest.raises(ValueError, match="blocks_per_super_tick"):
+        Scheduler(max_blocks_per_tick=4, blocks_per_super_tick=8)
+
+
+def test_scheduler_supertick_close_waits_for_inflight(stream):
+    """A close request with blocks still in the double-buffer must not
+    finish the session before those blocks are delivered."""
+    Y, m, _ = stream
+    F = Y.shape[-2]
+    N = 2
+    sched = Scheduler(max_sessions=2, max_queue_blocks=2 * N,
+                      blocks_per_super_tick=N)
+    s = sched.open_session(_config(F))
+    for i in range(N):
+        sched.push_block(s, i, Y[..., i * BLOCK:(i + 1) * BLOCK],
+                         m[..., i * BLOCK:(i + 1) * BLOCK],
+                         m[..., i * BLOCK:(i + 1) * BLOCK])
+    sched.request_close(s)
+    first = sched.tick()   # dispatches the super-tick; delivery deferred
+    assert first == [] and s.inflight == N and s.status == "open"
+    second = sched.tick()  # flushes the buffer, then finishes the session
+    assert len(second) == N
+    assert s.inflight == 0 and sched.get(s.id) is None
+
+
 # -- server / client end-to-end ----------------------------------------------
 def _serve_scene(seed, L=6000):
     rng = np.random.default_rng(seed)

@@ -194,6 +194,176 @@ def test_streaming_tango_chunked_continuation(scene):
     np.testing.assert_allclose(chained, np.asarray(full["yf"]), atol=1e-4)
 
 
+# -- scanned super-ticks (device-resident multi-block driver) ----------------
+def _blocked_reference(Y, m, block, state, plan=None):
+    """Per-block serve-style loop — the one shared oracle from the
+    stream-check gate, so the per-block calling convention these parity
+    tests pin cannot drift from the one ``make stream-check`` pins."""
+    from disco_tpu.enhance.stream_check import per_block_reference
+
+    return per_block_reference(Y, m, block=block, update_every=4,
+                               state=state, plan=plan)
+
+
+@pytest.fixture(scope="module")
+def scan_scene(scene):
+    y, s, n, L = scene
+    Y = stft(y)
+    masks = np.asarray(oracle_masks(stft(s), stft(n), "irm1"))
+    return np.asarray(Y), masks
+
+
+def test_streaming_scan_bit_identical_to_per_block(scan_scene):
+    """The tentpole gate: N blocks through one scanned dispatch are
+    bit-identical to N per-block dispatches — output AND continuation
+    state."""
+    import jax
+
+    from disco_tpu.enhance.streaming import initial_stream_state, streaming_tango_scan
+
+    Y, m = scan_scene
+    K, C, F, T = Y.shape
+    u, N = 4, 4
+    block = 2 * u
+    window = N * block
+    nw = T // window
+
+    ref, ref_state = _blocked_reference(
+        Y[..., :nw * window], m[..., :nw * window], block,
+        initial_stream_state(K, C, F, update_every=u),
+    )
+    st = initial_stream_state(K, C, F, update_every=u)
+    outs = []
+    for w in range(nw):
+        lo, hi = w * window, (w + 1) * window
+        o = streaming_tango_scan(
+            Y[..., lo:hi], m[..., lo:hi], m[..., lo:hi], update_every=u,
+            state=st, z_avail=np.ones((K, window // u), np.float32),
+            blocks_per_dispatch=N,
+        )
+        st = o["state"]
+        outs.append(np.asarray(o["yf"]))
+    got = np.concatenate(outs, axis=-1)
+    np.testing.assert_array_equal(got, ref)
+    for a, b in zip(jax.tree_util.tree_leaves(st),
+                    jax.tree_util.tree_leaves(ref_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_streaming_scan_holds_bit_identical(scan_scene):
+    """z_avail hold semantics inside and across super-ticks: losses
+    bridged identically whether the lost block falls mid-window or at a
+    super-tick edge (the hold carries ride the scan carry)."""
+    from disco_tpu.enhance.streaming import initial_stream_state, streaming_tango_scan
+
+    Y, m = scan_scene
+    K, C, F, T = Y.shape
+    u, N = 4, 4
+    block = 2 * u
+    window = N * block
+    nw = T // window
+    per_block = block // u
+    B = nw * window // u
+    plan = np.ones((K, B), np.float32)
+    plan[1, 3:12] = 0    # loss spanning a super-tick edge (window = 8 cols)
+    plan[3, 0:2] = 0     # leading loss -> zn fallback
+    plan[2, 7:8] = 0     # single lost refresh block mid-window
+
+    ref, _ = _blocked_reference(
+        Y[..., :nw * window], m[..., :nw * window], block,
+        initial_stream_state(K, C, F, update_every=u), plan=plan,
+    )
+    st = initial_stream_state(K, C, F, update_every=u)
+    outs = []
+    cols = window // u
+    for w in range(nw):
+        lo, hi = w * window, (w + 1) * window
+        o = streaming_tango_scan(
+            Y[..., lo:hi], m[..., lo:hi], m[..., lo:hi], update_every=u,
+            state=st, z_avail=plan[:, w * cols:(w + 1) * cols],
+            blocks_per_dispatch=N,
+        )
+        st = o["state"]
+        outs.append(np.asarray(o["yf"]))
+    np.testing.assert_array_equal(np.concatenate(outs, axis=-1), ref)
+
+
+def test_streaming_scan_tail_falls_back_to_per_block(scan_scene):
+    """A stream that is not a multiple of N blocks: scanned head + per-block
+    tail == per-block all the way (the scheduler/bench fallback shape)."""
+    from disco_tpu.enhance.streaming import (
+        initial_stream_state,
+        streaming_tango,
+        streaming_tango_scan,
+    )
+
+    Y, m = scan_scene
+    K, C, F, T = Y.shape
+    u, N = 4, 4
+    block = 2 * u
+    window = N * block
+    n_blocks = T // block
+    assert n_blocks % N, "fixture must leave a partial final window"
+    nw = n_blocks // N
+
+    ref, _ = _blocked_reference(Y[..., :n_blocks * block], m[..., :n_blocks * block],
+                                block, initial_stream_state(K, C, F, update_every=u))
+    st = initial_stream_state(K, C, F, update_every=u)
+    outs = []
+    for w in range(nw):
+        lo, hi = w * window, (w + 1) * window
+        o = streaming_tango_scan(
+            Y[..., lo:hi], m[..., lo:hi], m[..., lo:hi], update_every=u,
+            state=st, z_avail=np.ones((K, window // u), np.float32),
+            blocks_per_dispatch=N,
+        )
+        st = o["state"]
+        outs.append(np.asarray(o["yf"]))
+    for i in range(nw * N, n_blocks):
+        lo, hi = i * block, (i + 1) * block
+        o = streaming_tango(Y[..., lo:hi], m[..., lo:hi], m[..., lo:hi],
+                            update_every=u, state=st,
+                            z_avail=np.ones((K, block // u), np.float32))
+        st = o["state"]
+        outs.append(np.asarray(o["yf"]))
+    np.testing.assert_array_equal(np.concatenate(outs, axis=-1), ref)
+
+
+def test_streaming_scan_default_state_matches_default_call(scan_scene):
+    """state=None in the scanned driver materializes the documented warm
+    start — one scanned window equals the one-shot default streaming_tango
+    over the same frames."""
+    from disco_tpu.enhance.streaming import streaming_tango, streaming_tango_scan
+
+    Y, m = scan_scene
+    T = Y.shape[-1]
+    u, N = 4, 4
+    window = N * 2 * u
+    ref = np.asarray(streaming_tango(Y[..., :window], m[..., :window],
+                                     m[..., :window], update_every=u)["yf"])
+    got = np.asarray(streaming_tango_scan(Y[..., :window], m[..., :window],
+                                          m[..., :window], update_every=u,
+                                          blocks_per_dispatch=N)["yf"])
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_streaming_scan_validates_window(scan_scene):
+    from disco_tpu.enhance.streaming import streaming_tango_scan
+
+    Y, m = scan_scene
+    u = 4
+    with pytest.raises(ValueError, match="does not split"):
+        streaming_tango_scan(Y[..., :3 * u], m[..., :3 * u], m[..., :3 * u],
+                             update_every=u, blocks_per_dispatch=5)
+    with pytest.raises(ValueError, match="multiple of update_every"):
+        streaming_tango_scan(Y[..., :2 * (u + 1)], m[..., :2 * (u + 1)],
+                             m[..., :2 * (u + 1)], update_every=u,
+                             blocks_per_dispatch=2)
+    with pytest.raises(ValueError, match=">= 1"):
+        streaming_tango_scan(Y[..., :u], m[..., :u], m[..., :u],
+                             update_every=u, blocks_per_dispatch=0)
+
+
 @pytest.mark.slow
 def test_streaming_jacobi_solver_matches_eigh(scene):
     """Jacobi is a FULL eigendecomposition, so unlike power iteration it has
