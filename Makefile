@@ -3,10 +3,23 @@
 
 PYTHON ?= python
 
-.PHONY: test coverage doc install native clean bench milestone-corpus dryrun obs-check fault-check chaos-check perf-check serve-check stream-check
+.PHONY: test coverage doc install native clean bench milestone-corpus dryrun lint-check obs-check fault-check chaos-check perf-check serve-check stream-check
 
-test: obs-check fault-check chaos-check perf-check stream-check serve-check
+test: lint-check obs-check fault-check chaos-check perf-check stream-check serve-check
 	$(PYTHON) -m pytest tests/ -q
+
+# Static-analysis gate (runs FIRST: it needs no jax, no device and ~2 s):
+# disco-lint walks disco_tpu/, bench.py and __graft_entry__.py and enforces
+# the repo's contracts as AST rules — fence discipline (DL001), batched
+# readbacks (DL002), complex-safe transfers (DL003), atomic-only artifact
+# writes (DL004), jax-free serve client / lazy-jax CLIs (DL005), reference
+# citations (DL006), traced-float literals (DL007), never-SIGKILL (DL008),
+# registered obs kinds / chaos seams (DL009/DL010).  Zero unsuppressed
+# findings, and every suppression must carry a justification (DL000).
+# Hermetic by construction: the linter is stdlib-only and never touches
+# the chip claim (doc/source/static_analysis.rst).
+lint-check:
+	$(PYTHON) -m disco_tpu.analysis.cli
 
 # Telemetry gates (run before the suite so drift fails fast):
 # 1. the bench trajectory must not regress between the last two committed

@@ -1,0 +1,44 @@
+"""disco_tpu.analysis — AST invariant checking for the repo's own contracts.
+
+The reproduction carries contracts the paper's single-process NumPy
+reference never needed — one fenced ~80 ms RPC per dispatch, complex dtypes
+that cannot cross the tunnel, atomic-only persistence for crash-safe
+resume, a jax-free serve client, registered telemetry kinds and chaos
+seams.  Until this package they were enforced by convention and review;
+``disco-lint`` turns each into a named rule checked at lint time, gated in
+CI via ``make lint-check`` (no jax import anywhere in the linter — the gate
+is hermetic and never touches the chip claim).
+
+* :mod:`.registry`     — Rule base class + ``DLnnn`` registry
+* :mod:`.rules`        — the ten rule implementations (catalog in its docstring)
+* :mod:`.suppressions` — ``# disco-lint: disable=... -- justification`` parsing
+* :mod:`.registries`   — AST extraction of EVENT_KINDS / SEAMS (no imports)
+* :mod:`.runner`       — file collection + the lint engine (:func:`lint_paths`)
+* :mod:`.report`       — text / JSON reporters
+* :mod:`.cli`          — the ``disco-lint`` console entry
+
+No reference counterpart: the reference repo has no static analysis of any
+kind (SURVEY.md documents no tooling beyond setup.py).
+"""
+from disco_tpu.analysis.findings import Finding
+from disco_tpu.analysis.registry import RULES, Rule, get_rules, register
+from disco_tpu.analysis.runner import (
+    DEFAULT_TARGETS,
+    LintResult,
+    lint_paths,
+    lint_source,
+    repo_root,
+)
+
+__all__ = [
+    "DEFAULT_TARGETS",
+    "Finding",
+    "LintResult",
+    "RULES",
+    "Rule",
+    "get_rules",
+    "lint_paths",
+    "lint_source",
+    "register",
+    "repo_root",
+]

@@ -1,0 +1,70 @@
+"""AST extraction of the repo's in-code string registries.
+
+The registry rules (DL009 obs event kinds, DL010 chaos seams) check string
+literals at call sites against the closed sets declared in
+``disco_tpu/obs/events.py`` (``EVENT_KINDS``) and
+``disco_tpu/runs/chaos.py`` (``SEAMS``).  The sets are read by PARSING
+those files, not importing them: the linter must stay importable with no
+jax (or any production dependency) in the process — ``make lint-check`` is
+a hermetic CPU gate.
+
+No reference counterpart: the reference repo has neither telemetry kinds
+nor chaos seams to register.
+"""
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+#: repo-relative file and assigned name per registry
+REGISTRY_SOURCES = {
+    "event_kinds": ("disco_tpu/obs/events.py", "EVENT_KINDS"),
+    "chaos_seams": ("disco_tpu/runs/chaos.py", "SEAMS"),
+}
+
+_cache: dict = {}
+
+
+class RegistryExtractionError(RuntimeError):
+    """The declared registry could not be located/parsed — the registry
+    moved or changed shape, and the lint rule would otherwise silently
+    check nothing."""
+
+
+def _extract_string_set(path: Path, name: str) -> frozenset:
+    tree = ast.parse(path.read_text())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == name for t in node.targets
+        ):
+            strings = {
+                c.value
+                for c in ast.walk(node.value)
+                if isinstance(c, ast.Constant) and isinstance(c.value, str)
+            }
+            if strings:
+                return frozenset(strings)
+    raise RegistryExtractionError(
+        f"could not extract {name} from {path} — if the registry moved, "
+        f"update disco_tpu.analysis.registries.REGISTRY_SOURCES"
+    )
+
+
+def load(root, which: str) -> frozenset:
+    """The named registry's string set, parsed from the repo at ``root``
+    (cached per (root, registry))."""
+    rel, name = REGISTRY_SOURCES[which]
+    key = (str(root), which)
+    if key not in _cache:
+        _cache[key] = _extract_string_set(Path(root) / rel, name)
+    return _cache[key]
+
+
+def event_kinds(root) -> frozenset:
+    """``EVENT_KINDS`` as declared in ``disco_tpu/obs/events.py``."""
+    return load(root, "event_kinds")
+
+
+def chaos_seams(root) -> frozenset:
+    """``SEAMS`` as declared in ``disco_tpu/runs/chaos.py``."""
+    return load(root, "chaos_seams")

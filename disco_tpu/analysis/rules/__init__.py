@@ -1,0 +1,32 @@
+"""Rule modules — importing this package populates the registry.
+
+Rule catalog (one module per contract; ids are stable, documentation order
+is registration order):
+
+* DL001 ``fence-discipline``      — :mod:`.fence`
+* DL002 ``host-readback-in-loop`` — :mod:`.readback`
+* DL003 ``raw-tunnel-transfer``   — :mod:`.transfer`
+* DL004 ``atomic-write``          — :mod:`.atomicio`
+* DL005 ``import-purity``         — :mod:`.purity`
+* DL006 ``reference-citation``    — :mod:`.citations`
+* DL007 ``traced-float-literal``  — :mod:`.tracedfloat`
+* DL008 ``never-sigkill``         — :mod:`.sigkill`
+* DL009 ``obs-event-kind``        — :mod:`.registered`
+* DL010 ``chaos-seam``            — :mod:`.registered`
+
+(DL000 ``lint-suppression`` is the engine's own hygiene rule — see
+:mod:`disco_tpu.analysis.suppressions`.)
+
+No reference counterpart: the reference repo has no static analysis.
+"""
+from disco_tpu.analysis.rules import (  # noqa: F401  (import = register)
+    atomicio,
+    citations,
+    fence,
+    purity,
+    readback,
+    registered,
+    sigkill,
+    tracedfloat,
+    transfer,
+)

@@ -1,0 +1,61 @@
+"""DL006 — every public function documents its reference counterpart.
+
+The repo convention (CLAUDE.md): every public function cites its reference
+counterpart (``file:line``) in the docstring, or states that it has none
+and why.  The rule checks every public module-level function under
+``disco_tpu/`` for (a) a docstring at all and (b) a citation — a
+``file:line`` pattern or an explicit reference-counterpart statement —
+in the function docstring or, for infrastructure modules whose whole file
+shares one provenance, in the module docstring.
+
+No reference counterpart: the reference repo does not document one.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from disco_tpu.analysis.registry import Rule, register
+
+#: "tango.py:189-225", "main:497", "SURVEY.md §5.1" all count as citations
+_CITE = re.compile(r"[\w./-]+\.\w+:\d+|\bmain:\d+")
+_MENTION = re.compile(r"\breference\b|\bSURVEY\.md\b|\bPARITY\.md\b", re.I)
+
+
+def _cited(doc: str) -> bool:
+    return bool(_CITE.search(doc) or _MENTION.search(doc))
+
+
+@register
+class ReferenceCitation(Rule):
+    id = "DL006"
+    name = "reference-citation"
+    summary = ("public function without a docstring, or whose docstring (and "
+               "module docstring) never cites a reference counterpart")
+
+    def applies(self, ctx) -> bool:
+        return ctx.in_dir("disco_tpu")
+
+    def check(self, ctx):
+        module_ok = _cited(ctx.module_docstring())
+        for node in ctx.tree.body:
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name.startswith("_"):
+                continue
+            doc = ast.get_docstring(node)
+            if doc is None:
+                yield self.finding(
+                    ctx, node,
+                    f"public function {node.name!r} has no docstring "
+                    "(CLAUDE.md: every public function cites its reference "
+                    "counterpart)",
+                )
+            elif not (_cited(doc) or module_ok):
+                yield self.finding(
+                    ctx, node,
+                    f"docstring of {node.name!r} cites no reference "
+                    "counterpart — add 'reference <file>:<line>' or state "
+                    "'No reference counterpart: <why>' (function or module "
+                    "docstring)",
+                )

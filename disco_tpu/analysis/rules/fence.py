@@ -1,0 +1,48 @@
+"""DL001 — fence discipline: no bare ``block_until_ready`` in pipeline code.
+
+On the tunneled TPU attachment ``jax.block_until_ready`` returns without
+waiting (~20 us — CLAUDE.md), so code that uses it as a fence measures
+nothing and synchronizes nothing.  The sanctioned fences are the 1-element
+readback in ``disco_tpu.milestones._fence`` / ``_fence_readback`` and
+``disco_tpu.utils.resilience.resilient_fence``; the obs package may touch
+``block_until_ready`` because it implements the accounting around those.
+
+No reference counterpart: the reference never leaves one host process.
+"""
+from __future__ import annotations
+
+import ast
+
+from disco_tpu.analysis.context import attr_chain
+from disco_tpu.analysis.registry import Rule, register
+
+#: modules allowed to reference block_until_ready
+_ALLOWED_DIRS = ("disco_tpu/obs",)
+_ALLOWED_FILES = ("disco_tpu/milestones.py", "disco_tpu/milestones_corpus.py")
+
+
+@register
+class FenceDiscipline(Rule):
+    id = "DL001"
+    name = "fence-discipline"
+    summary = ("bare jax.block_until_ready outside obs/milestones — it returns "
+               "without waiting on the tunnel; fence with a 1-element readback")
+
+    def applies(self, ctx) -> bool:
+        return not (ctx.in_dir(*_ALLOWED_DIRS) or ctx.is_file(*_ALLOWED_FILES))
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.Attribute, ast.Name)):
+                chain = attr_chain(node)
+                if chain and chain[-1] == "block_until_ready":
+                    # report the outermost reference once, not its Name child
+                    if isinstance(node, ast.Name) and chain != ("block_until_ready",):
+                        continue
+                    yield self.finding(
+                        ctx, node,
+                        "bare block_until_ready: on the tunneled attachment it "
+                        "returns without waiting (CLAUDE.md) — fence with "
+                        "milestones._fence / utils.resilience.resilient_fence "
+                        "(1-element readback) instead",
+                    )

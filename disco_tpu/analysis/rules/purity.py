@@ -1,0 +1,60 @@
+"""DL005 — import purity: serve clients and CLI wiring stay jax-free.
+
+The environment contract allows ONE chip-claiming process, so the
+numpy+stdlib serve client (``serve/client.py`` + ``serve/protocol.py``)
+must be importable with no jax anywhere — not even lazily, since any call
+path that reaches jax would claim (or block on) the chip from the client
+process.  The CLI modules may use jax, but only INSIDE ``main``-path
+functions: a module-level import would claim the chip at ``--help`` time
+and break the jax-free gates that shell out to argparse.
+
+Generalizes the bespoke AST walk formerly in ``tests/test_serve.py`` (the
+client purity contract now has exactly one implementation — this rule).
+
+No reference counterpart: the reference has no serve client.
+"""
+from __future__ import annotations
+
+from disco_tpu.analysis.context import imports_module
+from disco_tpu.analysis.registry import Rule, register
+
+_BANNED = ("jax", "jaxlib", "torch")
+#: no jax/torch ANYWHERE (module or function level)
+CLIENT_FILES = ("disco_tpu/serve/client.py", "disco_tpu/serve/protocol.py")
+#: no jax/torch at MODULE level (lazy in-function imports are the idiom)
+_CLI_DIR = "disco_tpu/cli"
+
+
+@register
+class ImportPurity(Rule):
+    id = "DL005"
+    name = "import-purity"
+    summary = ("jax/torch imported in the numpy-only serve client (anywhere) "
+               "or at module level in cli arg-parsing modules")
+
+    def applies(self, ctx) -> bool:
+        return ctx.is_file(*CLIENT_FILES) or ctx.in_dir(_CLI_DIR)
+
+    def check(self, ctx):
+        if ctx.is_file(*CLIENT_FILES):
+            import ast
+
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, (ast.Import, ast.ImportFrom)) and imports_module(
+                    node, *_BANNED
+                ):
+                    yield self.finding(
+                        ctx, node,
+                        "jax/torch import in a numpy-only serve-client module: "
+                        "the client must be importable and runnable without "
+                        "ever touching the chip claim (one-process contract)",
+                    )
+        else:
+            for node in ctx.module_level_imports():
+                if imports_module(node, *_BANNED):
+                    yield self.finding(
+                        ctx, node,
+                        "module-level jax/torch import in a CLI module claims "
+                        "the chip at --help time — import lazily inside the "
+                        "function that needs it",
+                    )

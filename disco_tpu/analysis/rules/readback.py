@@ -1,0 +1,54 @@
+"""DL002 — no per-item host readbacks inside loop bodies in hot packages.
+
+Every device->host crossing on the tunneled attachment is one fenced
+~80 ms RPC, so a readback inside a loop multiplies the fixed cost by the
+trip count — the exact anti-pattern behind the pre-engine driver's
+K x n_real per-chunk crossings (CHANGES.md PR 4).  The sanctioned shape is
+ONE batched :func:`disco_tpu.utils.transfer.device_get_tree` call before or
+after the loop.  ``np.asarray`` is included as a heuristic: on a device
+array it IS the raw crossing; in-loop uses on host arrays get a per-line
+suppression stating that.
+
+No reference counterpart: the reference never crosses a device boundary.
+"""
+from __future__ import annotations
+
+from disco_tpu.analysis.context import callee_name
+from disco_tpu.analysis.registry import Rule, register
+
+_SCOPE = ("disco_tpu/enhance", "disco_tpu/serve", "disco_tpu/nn")
+_READBACK = {"to_host", "resilient_to_host", "device_get"}
+_HEURISTIC = {"asarray"}
+
+
+@register
+class HostReadbackInLoop(Rule):
+    id = "DL002"
+    name = "host-readback-in-loop"
+    summary = ("device->host readback (to_host/device_get/np.asarray) inside a "
+               "loop body in enhance/serve/nn — each is a fenced ~80 ms RPC; "
+               "batch with ONE device_get_tree")
+
+    def applies(self, ctx) -> bool:
+        return ctx.in_dir(*_SCOPE)
+
+    def check(self, ctx):
+        for call, depth in ctx.calls_with_loop_depth():
+            if not depth:
+                continue
+            name = callee_name(call)
+            if name in _READBACK:
+                yield self.finding(
+                    ctx, call,
+                    f"{name}() inside a loop body: each crossing is a fenced "
+                    "~80 ms tunnel RPC — queue the per-item work on device and "
+                    "read it back in ONE batched utils.transfer.device_get_tree",
+                )
+            elif name in _HEURISTIC:
+                yield self.finding(
+                    ctx, call,
+                    f"{name}() inside a loop body: on a device array this is a "
+                    "raw per-item crossing (~80 ms fenced RPC each) — batch via "
+                    "device_get_tree, or suppress stating the operand is "
+                    "host-resident",
+                )

@@ -1,0 +1,90 @@
+"""DL009/DL010 — telemetry kinds and chaos seams come from their registries.
+
+Both the obs event log and the chaos harness are keyed by bare strings at
+the call site (``record("clip", ...)``, ``chaos.tick("mid_write")``).  A
+typo'd kind crashes only when the schema-validating reader runs; a typo'd
+seam is worse — it arms NOTHING and the chaos test silently tests nothing.
+These rules check every string literal at those call sites against the
+declared registries (``EVENT_KINDS`` in ``obs/events.py``, ``SEAMS`` in
+``runs/chaos.py``), parsed from source so the linter stays hermetic (no
+production import, no jax).
+
+No reference counterpart: the reference has neither telemetry nor chaos.
+"""
+from __future__ import annotations
+
+import ast
+
+from disco_tpu.analysis import registries
+from disco_tpu.analysis.context import attr_chain, str_literal
+from disco_tpu.analysis.registry import Rule, register
+
+#: receiver aliases under which obs.events' record() is called in-repo
+_OBS_ALIASES = {"obs", "_obs", "obs_events", "events", "_events", "ev", "_ev"}
+
+
+def _record_calls(ctx):
+    """Calls that are (by alias convention) obs.events.record invocations."""
+    bare_record = any(
+        isinstance(node, ast.ImportFrom)
+        and (node.module or "").startswith("disco_tpu.obs")
+        and any(a.name == "record" for a in node.names)
+        for node in ast.walk(ctx.tree)
+    )
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = attr_chain(node.func)
+        if not chain:
+            continue
+        if (len(chain) >= 2 and chain[-1] == "record" and chain[0] in _OBS_ALIASES) or (
+            chain == ("record",) and bare_record
+        ):
+            yield node
+
+
+@register
+class ObsEventKind(Rule):
+    id = "DL009"
+    name = "obs-event-kind"
+    summary = ("obs record() called with an event kind missing from "
+               "EVENT_KINDS — the schema-validating reader would reject the "
+               "log it produces")
+
+    def check(self, ctx):
+        kinds = registries.event_kinds(ctx.root)
+        for call in _record_calls(ctx):
+            kind = str_literal(call.args[0]) if call.args else None
+            if kind is not None and kind not in kinds:
+                yield self.finding(
+                    ctx, call,
+                    f"event kind {kind!r} is not in obs.events.EVENT_KINDS — "
+                    "register it there (and teach disco-obs report about it) "
+                    "or fix the typo; the JSONL reader rejects unknown kinds",
+                )
+
+
+@register
+class ChaosSeamName(Rule):
+    id = "DL010"
+    name = "chaos-seam"
+    summary = ("chaos tick() called with a seam missing from runs.chaos.SEAMS "
+               "— a typo'd seam arms nothing and the chaos gate silently "
+               "tests nothing")
+
+    def check(self, ctx):
+        seams = registries.chaos_seams(ctx.root)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if not chain or chain[-1] != "tick":
+                continue
+            seam = str_literal(node.args[0]) if node.args else None
+            if seam is not None and seam not in seams:
+                yield self.finding(
+                    ctx, node,
+                    f"chaos seam {seam!r} is not in runs.chaos.SEAMS — "
+                    "register the seam (and document it in the chaos module "
+                    "docstring) or fix the typo; an unknown seam never arms",
+                )

@@ -1,0 +1,61 @@
+"""DL008 — the never-SIGKILL contract, statically.
+
+A SIGKILLed TPU-holding process wedges the remote chip claim for hours
+(CLAUDE.md), so nothing in this repo may hard-kill a process: no
+``SIGKILL`` reference, no ``os.kill``, no ``Popen.kill()``/``terminate()``.
+The sanctioned stop path is ``disco_tpu.runs.interrupt`` (signal a graceful
+flag, drain between units, exit resumable) and, for subprocess tests, a
+SIGINT + wait.  Legitimate exceptions (there are currently none in
+production code) must carry a suppression explaining why the target can
+never be the chip holder.
+
+No reference counterpart: the reference has no process management at all.
+"""
+from __future__ import annotations
+
+import ast
+
+from disco_tpu.analysis.context import attr_chain
+from disco_tpu.analysis.registry import Rule, register
+
+
+@register
+class NeverSigkill(Rule):
+    id = "DL008"
+    name = "never-sigkill"
+    summary = ("SIGKILL reference or os.kill/.kill()/.terminate() call — a "
+               "killed chip holder wedges the remote claim; use "
+               "runs.interrupt graceful stops")
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.Attribute, ast.Name)):
+                chain = attr_chain(node)
+                if chain and chain[-1] == "SIGKILL" and (
+                    not isinstance(node, ast.Name) or len(chain) == 1
+                ):
+                    yield self.finding(
+                        ctx, node,
+                        "SIGKILL referenced: the environment contract forbids "
+                        "hard-killing a (potential) chip holder — a killed "
+                        "holder wedges the remote claim for hours",
+                    )
+            elif isinstance(node, ast.Call):
+                chain = attr_chain(node.func)
+                if not chain:
+                    continue
+                if chain == ("os", "kill"):
+                    yield self.finding(
+                        ctx, node,
+                        "os.kill(): signal delivery to another process risks "
+                        "the never-SIGKILL contract — use runs.interrupt "
+                        "(graceful flag + drain) or justify why the target "
+                        "can never hold the chip",
+                    )
+                elif len(chain) > 1 and chain[-1] in ("kill", "terminate"):
+                    yield self.finding(
+                        ctx, node,
+                        f".{chain[-1]}() on a process object: Popen.kill is "
+                        "SIGKILL and terminate skips the graceful drain — "
+                        "send SIGINT and wait for the resumable exit instead",
+                    )

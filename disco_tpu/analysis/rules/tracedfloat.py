@@ -1,0 +1,50 @@
+"""DL007 — traced-float seams take float literals, never ints.
+
+``lambda_cor`` and ``mu`` are traced floats through every jitted streaming/
+serve entry point: jit folds the OMITTED default at trace time, but a
+passed value becomes a traced argument typed by what was passed.  A literal
+``mu=1`` therefore traces a third, int-typed program per shape bucket
+instead of reusing the float one — the msgpack ``mu=1`` retrace trap that
+``SessionConfig`` now coerces at the wire (CHANGES.md PR 6).  This rule
+catches the same trap at every in-repo call site: int literals for these
+keywords must be written as floats (``mu=1.0``).
+
+No reference counterpart: the reference has no jit and no retrace hazard.
+"""
+from __future__ import annotations
+
+import ast
+
+from disco_tpu.analysis.registry import Rule, register
+
+#: the keyword seams with traced-float calling conventions
+_SEAMS = ("lambda_cor", "mu")
+
+
+@register
+class TracedFloatLiteral(Rule):
+    id = "DL007"
+    name = "traced-float-literal"
+    summary = ("literal int passed for lambda_cor=/mu= — traces an extra "
+               "int-typed jit program per shape bucket; write it as a float")
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            for kw in node.keywords:
+                # bool is an int subclass: True/False literals trip the same
+                # retrace and are flagged too
+                if (
+                    kw.arg in _SEAMS
+                    and isinstance(kw.value, ast.Constant)
+                    and isinstance(kw.value.value, int)
+                ):
+                    yield self.finding(
+                        ctx, kw.value,
+                        f"literal {kw.value.value!r} for traced-float seam "
+                        f"'{kw.arg}=': jit folds the omitted default but "
+                        "traces a distinct int-typed program for a passed "
+                        f"int — write {kw.arg}={float(kw.value.value)} "
+                        "(the mu=1 retrace trap, CHANGES.md PR 6)",
+                    )

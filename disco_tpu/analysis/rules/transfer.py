@@ -1,0 +1,57 @@
+"""DL003 — raw transfer primitives stay inside ``utils.transfer``.
+
+Complex dtypes cannot cross the tunnel (environment contract, CLAUDE.md):
+a raw ``jax.device_get``/``jax.device_put`` on complex data wedges or
+corrupts the transfer, and whether an array is complex is invisible at most
+call sites.  So the raw primitives are confined to
+``disco_tpu/utils/transfer.py``, whose ``to_host`` / ``to_device`` /
+``device_get_tree`` split complex arrays into two real transfers; everyone
+else calls those.
+
+No reference counterpart: the reference never crosses a device boundary.
+"""
+from __future__ import annotations
+
+import ast
+
+from disco_tpu.analysis.context import attr_chain, imports_module
+from disco_tpu.analysis.registry import Rule, register
+
+_RAW = {"device_get", "device_put"}
+_ALLOWED_FILE = "disco_tpu/utils/transfer.py"
+
+
+@register
+class RawTunnelTransfer(Rule):
+    id = "DL003"
+    name = "raw-tunnel-transfer"
+    summary = ("direct jax.device_get/device_put outside utils.transfer — raw "
+               "transfers are not complex-safe on the tunnel; use "
+               "to_host/to_device/device_get_tree")
+
+    def applies(self, ctx) -> bool:
+        return not ctx.is_file(_ALLOWED_FILE)
+
+    def check(self, ctx):
+        # bare names count only when actually imported from jax
+        bare = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom) and imports_module(node, "jax"):
+                bare.update(a.asname or a.name for a in node.names if a.name in _RAW)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if not chain:
+                continue
+            raw = (len(chain) >= 2 and chain[0] == "jax" and chain[-1] in _RAW) or (
+                len(chain) == 1 and chain[0] in bare
+            )
+            if raw:
+                yield self.finding(
+                    ctx, node,
+                    f"raw jax.{chain[-1]}: complex dtypes cannot cross the "
+                    "tunnel (environment contract) — use utils.transfer."
+                    "to_host/to_device/device_get_tree, which split complex "
+                    "arrays into two real transfers",
+                )

@@ -1,0 +1,156 @@
+"""Suppression comments: per-line and per-file, justification REQUIRED.
+
+Syntax (the ``--`` separator and a non-empty justification are mandatory —
+an unexplained suppression is itself a finding, ``DL000``)::
+
+    x = risky()  # disco-lint: disable=DL004 -- why this one is safe
+    # disco-lint: disable=DL002,DL003 -- applies to the NEXT line
+    # disco-lint: file-disable=DL001 -- whole-file waiver, stated once
+
+A trailing comment suppresses findings reported on its own line; a comment
+on a line of its own suppresses the next line (for calls too long to share
+a line).  ``file-disable`` waives the rule for the whole file.  Unknown
+rule ids and suppressions that no finding actually needed are reported as
+``DL000`` — dead waivers hide regressions exactly like dead code.
+
+No reference counterpart: the reference repo has no static analysis; the
+syntax follows the ``# noqa``/``# pylint: disable`` lineage with the
+justification made load-bearing instead of optional.
+"""
+from __future__ import annotations
+
+import dataclasses
+import io
+import re
+import tokenize
+
+from disco_tpu.analysis.findings import Finding
+from disco_tpu.analysis.registry import SUPPRESSION_RULE_ID, SUPPRESSION_RULE_NAME
+
+_PATTERN = re.compile(
+    r"#\s*disco-lint:\s*(?P<kind>file-disable|disable)\s*=\s*"
+    r"(?P<ids>[A-Za-z0-9_,\s-]*?)\s*(?:--\s*(?P<just>.*))?$"
+)
+_MARKER = re.compile(r"#\s*disco-lint\b")
+
+
+@dataclasses.dataclass
+class Suppression:
+    """One parsed waiver (line=None for file-wide)."""
+
+    rule_id: str
+    line: int | None     # the line findings must sit on; None = whole file
+    comment_line: int    # where the comment itself lives (for DL000 reports)
+    justification: str
+    used: bool = False
+
+
+def _hygiene(path, line, message) -> Finding:
+    return Finding(path=path, line=line, col=0, rule=SUPPRESSION_RULE_ID,
+                   name=SUPPRESSION_RULE_NAME, message=message)
+
+
+def parse(rel: str, source: str, known_ids: frozenset):
+    """Extract suppressions from ``source``.
+
+    Returns ``(suppressions, problems)`` — ``problems`` are DL000 findings
+    for malformed comments (bad syntax, unknown rule id, missing
+    justification).  A malformed comment suppresses nothing: failing open
+    would let a typo silently waive a rule.
+    """
+    sups: list = []
+    problems: list = []
+    code_lines = set()     # lines carrying non-comment tokens
+    comments = []          # (line, text)
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                comments.append((tok.start[0], tok.string))
+            elif tok.type not in (
+                tokenize.NL, tokenize.NEWLINE, tokenize.INDENT,
+                tokenize.DEDENT, tokenize.ENDMARKER,
+            ):
+                code_lines.add(tok.start[0])
+    except tokenize.TokenError:
+        # ast.parse succeeded upstream, so this should be unreachable;
+        # degrade to "no suppressions" rather than crash the linter.
+        return [], []
+
+    for line, text in comments:
+        if not _MARKER.search(text):
+            continue
+        m = _PATTERN.search(text)
+        if not m:
+            problems.append(_hygiene(
+                rel, line,
+                "malformed disco-lint comment (expected "
+                "'# disco-lint: disable=DLnnn[,DLnnn] -- justification')",
+            ))
+            continue
+        ids = [s.strip() for s in m.group("ids").split(",") if s.strip()]
+        just = (m.group("just") or "").strip()
+        ok = True
+        if not ids:
+            problems.append(_hygiene(rel, line, "suppression names no rule ids"))
+            ok = False
+        for rid in ids:
+            if rid not in known_ids:
+                problems.append(_hygiene(rel, line, f"suppression names unknown rule id {rid!r}"))
+                ok = False
+            elif rid == SUPPRESSION_RULE_ID:
+                problems.append(_hygiene(
+                    rel, line, f"{SUPPRESSION_RULE_ID} (suppression hygiene) cannot be suppressed"))
+                ok = False
+        if not just:
+            problems.append(_hygiene(
+                rel, line,
+                "suppression carries no justification (policy: every waiver "
+                "states WHY the flagged code honors the contract anyway)",
+            ))
+            ok = False
+        if not ok:
+            continue
+        if m.group("kind") == "file-disable":
+            target = None
+        else:
+            # trailing comment -> this line; standalone comment -> next line
+            target = line if line in code_lines else line + 1
+        for rid in ids:
+            sups.append(Suppression(rule_id=rid, line=target,
+                                    comment_line=line, justification=just))
+    return sups, problems
+
+
+def apply(findings, suppressions):
+    """Partition ``findings`` into (kept, suppressed-with-justification).
+
+    Marks each matching suppression ``used``; call :func:`unused_problems`
+    afterwards for the dead-waiver findings.
+    """
+    kept, suppressed = [], []
+    for f in findings:
+        hit = None
+        for s in suppressions:
+            if s.rule_id == f.rule and (s.line is None or s.line == f.line):
+                hit = s
+                s.used = True
+                break
+        if hit is None:
+            kept.append(f)
+        else:
+            suppressed.append((f, hit.justification))
+    return kept, suppressed
+
+
+def unused_problems(rel: str, suppressions) -> list:
+    """DL000 findings for waivers that matched nothing."""
+    return [
+        _hygiene(
+            rel, s.comment_line,
+            f"unused suppression of {s.rule_id} (no finding on "
+            f"{'this file' if s.line is None else f'line {s.line}'}): "
+            "remove it, or the contract it waives has silently drifted",
+        )
+        for s in suppressions
+        if not s.used
+    ]

@@ -16,6 +16,7 @@ import numpy as np
 
 
 def build_parser():
+    """Build the ``disco-aggregate`` argument parser."""
     p = argparse.ArgumentParser(description="Aggregate per-RIR OIM pickles: mean ± 95% CI per metric")
     p.add_argument("oim_dir", help="OIM directory of a results tree (…/{save_dir}/OIM)")
     p.add_argument("--kind", choices=["tango", "mwf"], default="tango",
@@ -43,6 +44,7 @@ def summarize(agg: dict, keys=None) -> dict:
 
 
 def main(argv=None):
+    """``disco-aggregate`` console entry point."""
     args = build_parser().parse_args(argv)
 
     from disco_tpu.enhance.driver import aggregate_results

@@ -1,6 +1,9 @@
 """Milestone benchmark CLI: run the BASELINE.json configurations 1-5 plus
 the streaming-latency config 6 (`disco_tpu.milestones`), and optionally the
-self-generated-corpus pipeline, printing one JSON line per config."""
+self-generated-corpus pipeline, printing one JSON line per config.
+
+No reference counterpart: the reference repo ships no benchmark CLI.
+"""
 from __future__ import annotations
 
 import argparse
@@ -10,6 +13,7 @@ from disco_tpu import milestones
 
 
 def build_parser():
+    """Build the ``disco-milestones`` argument parser."""
     p = argparse.ArgumentParser(description="Run the BASELINE milestone benchmark configs")
     p.add_argument("--tiny", action="store_true", help="small CPU-testable scales")
     p.add_argument("--configs", nargs="+", type=int, default=None,
@@ -22,6 +26,7 @@ def build_parser():
 
 
 def main(argv=None):
+    """``disco-milestones`` console entry point."""
     args = build_parser().parse_args(argv)
     if args.corpus:
         import tempfile

@@ -23,6 +23,7 @@ def none_str(v):
 
 
 def add_rirs_arg(parser, default=(1, 1)):
+    """Attach the shared ``--rirs`` range argument."""
     parser.add_argument(
         "--rirs", "-r", nargs=2, type=int, default=list(default),
         help="First RIR id and number of RIRs to process (job-array sharding)",
@@ -30,6 +31,7 @@ def add_rirs_arg(parser, default=(1, 1)):
 
 
 def add_scenario_arg(parser, default="random", choices=("random", "living", "meeting")):
+    """Attach the shared ``--scenario`` argument."""
     parser.add_argument(
         "--scenario", "-s", type=str, choices=list(choices), default=default,
         help="Spatial configuration",
@@ -37,6 +39,7 @@ def add_scenario_arg(parser, default="random", choices=("random", "living", "mee
 
 
 def add_noise_arg(parser, default="ssn", choices=("ssn", "fs", "it")):
+    """Attach the shared ``--noise`` argument."""
     parser.add_argument("--noise", "-n", type=str, choices=list(choices), default=default)
 
 
@@ -64,6 +67,7 @@ def solver_spec(v: str):
 
 # -- the shared production seams (obs / ledger / preflight / faults) ---------
 def add_obs_log_arg(parser, what: str = "run") -> None:
+    """Attach the shared ``--obs-log`` telemetry argument."""
     parser.add_argument(
         "--obs-log", default=None,
         help=f"record structured {what} telemetry (manifest, per-stage "
@@ -73,6 +77,7 @@ def add_obs_log_arg(parser, what: str = "run") -> None:
 
 
 def add_trace_dir_arg(parser) -> None:
+    """Attach the shared ``--trace-dir`` profiling argument."""
     parser.add_argument(
         "--trace-dir", default=None,
         help="capture a jax.profiler trace into this directory (view with "
@@ -81,6 +86,7 @@ def add_trace_dir_arg(parser) -> None:
 
 
 def add_preflight_arg(parser, what: str = "the run") -> None:
+    """Attach the shared ``--preflight`` device-probe flag."""
     parser.add_argument(
         "--preflight", type=float, default=0.0, metavar="SECONDS",
         help="run a bounded-deadline device health probe (one tiny fenced "
@@ -102,6 +108,7 @@ def add_ledger_arg(parser, unit: str, default_hint: str | None = None) -> None:
 
 
 def add_resume_arg(parser, unit: str = "unit", regen: str = "requeued") -> None:
+    """Attach the shared ``--resume`` flag (pairs with ``--ledger``)."""
     parser.add_argument(
         "--resume", action="store_true",
         help=f"resume from the ledger: done {unit}s are VERIFIED against "
@@ -112,6 +119,7 @@ def add_resume_arg(parser, unit: str = "unit", regen: str = "requeued") -> None:
 
 
 def add_fault_args(parser) -> None:
+    """Attach the shared ``--fault-spec``/``--fault-seed`` arguments."""
     parser.add_argument(
         "--fault-spec", default=None,
         help="YAML/JSON fault scenario (disco_tpu.fault.FaultSpec fields: "

@@ -24,6 +24,7 @@ from disco_tpu.datagen.download import (
 
 
 def build_parser():
+    """Build the ``disco-download`` argument parser."""
     p = argparse.ArgumentParser(description="Fetch DISCO corpus material (Freesound/LibriSpeech/Zenodo)")
     p.add_argument("--token", "-t", default=None, help="Freesound OAuth token")
     p.add_argument("--config", "-c", default=None, help="yaml download config")
@@ -37,6 +38,7 @@ def build_parser():
 
 
 def main(argv=None):
+    """``disco-download`` console entry point."""
     args = build_parser().parse_args(argv)
     logger = set_up_log(level=1)
 

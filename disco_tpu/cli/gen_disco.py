@@ -21,6 +21,7 @@ from disco_tpu.sim.signals import SpeechAndNoiseSetup
 
 
 def build_parser():
+    """Build the ``disco-gen`` argument parser."""
     p = argparse.ArgumentParser(description="Generate DISCO rooms + convolved signals")
     p.add_argument("--dset", choices=["train", "test"], default="test")
     add_scenario_arg(p)
@@ -39,6 +40,7 @@ def build_parser():
 
 
 def main(argv=None):
+    """``disco-gen`` console entry point."""
     args = build_parser().parse_args(argv)
     rir_start, n_rirs = args.rirs
     if args.ledger is None and args.resume:

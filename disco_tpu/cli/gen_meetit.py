@@ -17,6 +17,7 @@ from disco_tpu.sim.signals import InterferentSpeakersSetup
 
 
 def build_parser():
+    """Build the ``disco-gen-meetit`` argument parser."""
     p = argparse.ArgumentParser(description="Generate MEETIT meeting-room mixtures")
     p.add_argument("--dset", choices=["train", "val", "test"], default="test")
     add_rirs_arg(p)
@@ -31,6 +32,7 @@ def build_parser():
 
 
 def main(argv=None):
+    """``disco-gen-meetit`` console entry point."""
     args = build_parser().parse_args(argv)
     rir_start, n_rirs = args.rirs
     rng = np.random.default_rng(args.seed + rir_start)

@@ -13,6 +13,7 @@ from disco_tpu.enhance.zexport import export_z
 
 
 def build_parser():
+    """Build the ``disco-get-z`` argument parser."""
     p = argparse.ArgumentParser(description="Export compressed z signals (TANGO step 1)")
     p.add_argument("--vad_type", "-vt", default="irm1")
     p.add_argument("--sav_dir", "-sd", default="oracle", help="zfile name under stft_z/")
@@ -27,6 +28,7 @@ def build_parser():
 
 
 def main(argv=None):
+    """``disco-get-z`` console entry point."""
     args = build_parser().parse_args(argv)
     rirs = [args.rir] if args.rir is not None else range(args.rirs[0], args.rirs[0] + args.rirs[1])
     masks_fn = None

@@ -14,6 +14,7 @@ from disco_tpu.nn.data import get_input_lists, write_input_lists
 
 
 def build_parser():
+    """Build the ``disco-lists`` argument parser."""
     p = argparse.ArgumentParser(description="Write training input file lists")
     p.add_argument("--scene", nargs="+", default=["living"])
     p.add_argument("--noise", default="ssn")
@@ -27,6 +28,7 @@ def build_parser():
 
 
 def main(argv=None):
+    """``disco-lists`` console entry point."""
     args = build_parser().parse_args(argv)
     lists = get_input_lists(
         args.path_data,

@@ -13,6 +13,7 @@ from disco_tpu.datagen.postgen import PostGenerator
 
 
 def build_parser():
+    """Build the ``disco-mix`` argument parser."""
     p = argparse.ArgumentParser(description="Mix convolved signals into the processed corpus")
     add_rirs_arg(p)
     add_scenario_arg(p)
@@ -24,6 +25,7 @@ def build_parser():
 
 
 def main(argv=None):
+    """``disco-mix`` console entry point."""
     args = build_parser().parse_args(argv)
     rir_start, n_rirs = args.rirs
     pg = PostGenerator(

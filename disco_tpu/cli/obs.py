@@ -42,6 +42,7 @@ from disco_tpu.obs.events import read_events
 
 
 def build_parser():
+    """Build the ``disco-obs`` argument parser."""
     p = argparse.ArgumentParser(description="Render disco_tpu telemetry")
     sub = p.add_subparsers(dest="cmd", required=True)
 
@@ -130,6 +131,7 @@ def summarize(events: list[dict]) -> dict:
 
 
 def render_report(summary: dict) -> str:
+    """Render the ``disco-obs report`` tables from a parsed event list."""
     lines = []
     man = summary["manifest"]
     if man:
@@ -409,6 +411,7 @@ def compare_records(old: dict, new: dict, threshold: float = 0.05) -> dict:
 
 
 def render_compare(diff: dict) -> str:
+    """Render the ``disco-obs compare`` verdict lines."""
     lines = [f"{'metric':<28}{'old':>14}{'new':>14}{'delta':>10}"]
     for r in diff["rows"]:
         fmt = lambda v: "-" if v is None else f"{v:g}"
@@ -419,6 +422,7 @@ def render_compare(diff: dict) -> str:
 
 
 def main(argv=None):
+    """``disco-obs`` console entry point."""
     args = build_parser().parse_args(argv)
     if args.cmd == "report":
         summary = summarize(read_events(args.log))

@@ -39,6 +39,7 @@ from disco_tpu.cli.common import (
 
 
 def build_parser():
+    """Build the ``disco-serve`` argument parser."""
     p = argparse.ArgumentParser(
         description="Online TANGO enhancement service: continuous batching "
                     "of concurrent streaming sessions on one device"
@@ -97,6 +98,7 @@ def build_parser():
 
 
 def main(argv=None):
+    """``disco-serve`` console entry point."""
     args = build_parser().parse_args(argv)
     args.fault_spec = resolve_fault_spec(args)
     with obs_session(args, tool="disco-serve"):

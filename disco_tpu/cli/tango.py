@@ -29,6 +29,7 @@ _POLICIES = ["None", "local", "distant", "compressed", "use_oracle_refs", "use_o
 
 
 def build_parser():
+    """Build the ``disco-tango`` argument parser."""
     p = argparse.ArgumentParser(description="Two-step distributed GEVD-MWF (TANGO) enhancement")
     p.add_argument("--vad_type", "-vt", nargs=2, default=["irm1", "irm1"],
                    help="mask type per step: irm1/ibm1/iam/... (tango.py:189-225)")
@@ -213,6 +214,7 @@ def resolve_ledger(args):
 
 
 def main(argv=None):
+    """``disco-tango`` console entry point."""
     args = build_parser().parse_args(argv)
     args.solver = resolve_solver(args)
     if args.rir is None and args.rirs is None:

@@ -31,6 +31,7 @@ from disco_tpu.nn.training import create_train_state, fit
 
 
 def build_parser():
+    """Build the ``disco-train`` argument parser."""
     p = argparse.ArgumentParser(description="Train the mask-estimation CRNN")
     p.add_argument("--archi", choices=["crnn", "rnn"], default="crnn",
                    help="mask estimator: CRNN (3-D windows) or 2-D RNN (freq-stacked)")
@@ -56,6 +57,7 @@ def build_parser():
 
 
 def main(argv=None):
+    """``disco-train`` console entry point."""
     args = build_parser().parse_args(argv)
     with obs_session(args, tool="disco-train"):
         preflight = run_preflight(args)

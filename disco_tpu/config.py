@@ -189,11 +189,13 @@ def config_from_dict(d: dict) -> DiscoConfig:
 
 
 def load_config(path) -> DiscoConfig:
+    """Load a YAML file into a config (via :func:`config_from_dict`)."""
     with open(path) as fh:
         return config_from_dict(yaml.safe_load(fh) or {})
 
 
 def save_config(cfg: DiscoConfig, path) -> Path:
+    """Write the config back to YAML at ``path`` (inverse of :func:`load_config`)."""
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     with open(path, "w") as fh:

@@ -94,6 +94,7 @@ class WelfordState:
 
 
 def welford_init(feature_dim: int, dtype=jnp.float32) -> WelfordState:
+    """Zeroed Welford running-stats state for ``feature_dim`` features."""
     return WelfordState(
         mean=jnp.zeros(feature_dim, dtype),
         m2=jnp.zeros(feature_dim, dtype),

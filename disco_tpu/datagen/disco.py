@@ -30,7 +30,7 @@ from disco_tpu.core.masks import vad_oracle_batch
 from disco_tpu.core.metrics import fw_snr
 from disco_tpu.core.sigproc import increase_to_snr
 from disco_tpu.io import DatasetLayout
-from disco_tpu.io.atomic import probe_npy, save_npy_atomic, write_wav_atomic
+from disco_tpu.io.atomic import atomic_write, probe_npy, save_npy_atomic, write_wav_atomic
 from disco_tpu.sim import RoomSetup, fft_convolve, rir_length_for, shoebox_rirs
 
 
@@ -195,6 +195,7 @@ _NOISE_TAGS = {"ssn": "_ssn", "interferent_talker": "_it", "it": "_it", "freesou
 
 
 def noise_tag(name: str) -> str:
+    """Canonical filename tag for a noise kind."""
     return _NOISE_TAGS.get(name.lower(), f"_{name.lower()}")
 
 
@@ -389,7 +390,10 @@ def get_wavs_list(librispeech_root, freesound_root=None, dset="train", cache_dir
         np.random.default_rng(seed).shuffle(files)
         if cache_dir is not None and files:
             os.makedirs(cache_dir, exist_ok=True)
-            with open(os.path.join(cache_dir, f"{name}.txt"), "w") as fh:
+            # atomic: a half-written listing cache READS clean (every prefix
+            # of a line list parses), so a torn write would silently shrink
+            # the corpus on the next run instead of erroring
+            with atomic_write(os.path.join(cache_dir, f"{name}.txt"), "w") as fh:
                 fh.write("\n".join(files))
         return files
 

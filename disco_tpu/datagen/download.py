@@ -29,6 +29,8 @@ from multiprocessing import Pool
 import numpy as np
 import yaml
 
+from disco_tpu.io.atomic import atomic_write
+
 # The published corpus sources (download_librispeech.sh:1-21,
 # download_noises_from_zenodo.sh:1-14).
 LIBRISPEECH_URLS = [
@@ -191,7 +193,9 @@ def update_csv(data: dict, file_path, sort_label: str = "", sep: str = ","):
         col = header.index(sort_label)
         dedup.sort(key=lambda r: r[col])  # python sort IS mergesort-stable
     os.makedirs(os.path.dirname(file_path) or ".", exist_ok=True)
-    with open(file_path, "w", newline="") as fh:
+    # atomic: the CSV is the download ledger a resumed run trusts — a torn
+    # rewrite would re-download (or worse, skip) half the corpus
+    with atomic_write(file_path, "w", newline="") as fh:
         w = _csv.writer(fh, delimiter=sep)
         w.writerow(header)
         w.writerows(dedup)
@@ -276,7 +280,7 @@ def clean_info(csv_path, label="id", sep="\t"):
     header, body = rows[0], rows[1:]
     col = header.index(label)
     kept = [row for row in body if row and row[col] in on_disk]
-    with open(csv_path, "w", newline="") as fh:
+    with atomic_write(csv_path, "w", newline="") as fh:
         w = _csv.writer(fh, delimiter=sep)
         w.writerow(header)
         w.writerows(kept)

@@ -27,6 +27,7 @@ from disco_tpu.core.dsp import stft
 from disco_tpu.core.masks import tf_mask
 from disco_tpu.core.metrics import si_bss
 from disco_tpu.io import DatasetLayout, write_wav
+from disco_tpu.io.atomic import save_npy_atomic
 from disco_tpu.sim import RoomSetup, fft_convolve, rir_length_for, shoebox_rirs
 
 
@@ -173,7 +174,8 @@ def save_meetit_scene(scene: MeetitScene, infos, rir_id, layout: DatasetLayout, 
     # crash mid-save leaves a restartable (not silently-skipped) RIR.
     info_path = layout.infos(rir_id)
     layout.ensure_dir(info_path)
-    np.save(info_path, infos, allow_pickle=True)
+    # atomic: a crash mid-save must leave the marker absent, not truncated
+    save_npy_atomic(info_path, infos, allow_pickle=True)
 
 
 def generate_meetit_rirs(
@@ -234,11 +236,11 @@ def generate_meetit_rirs(
         for ch in range(mix.shape[0]):
             p = layout.base / "stft" / "mix" / f"{rir_id}_Ch-{ch + 1}.npy"
             layout.ensure_dir(p)
-            np.save(p, mix[ch].astype("complex64"))
+            save_npy_atomic(p, mix[ch].astype("complex64"))
             for i_s in range(masks.shape[0]):
                 p = layout.base / "mask" / f"{rir_id}_S-{i_s + 1}_Ch-{ch + 1}.npy"
                 layout.ensure_dir(p)
-                np.save(p, masks[i_s, ch].astype("float32"))
+                save_npy_atomic(p, masks[i_s, ch].astype("float32"))
         save_meetit_scene(scene, infos, rir_id, layout, fs=fs)
         generated.append(rir_id)
     return generated

@@ -16,6 +16,7 @@ from disco_tpu.utils import to_host
 from disco_tpu.core.dsp import stft
 from disco_tpu.core.masks import tf_mask
 from disco_tpu.io import DatasetLayout, read_wav, write_wav
+from disco_tpu.io.atomic import save_npy_atomic
 from disco_tpu.io.layout import case_of_rir, snr_dirname
 
 
@@ -135,17 +136,17 @@ class PostGenerator:
             if self.save_target:
                 p = lay.stft_processed(self.snr_range, "target", rir, c)
                 lay.ensure_dir(p)
-                np.save(p, ss[ch])
+                save_npy_atomic(p, ss[ch])
             for kind, spec in (("noise", ns[ch]), ("mixture", ms[ch])):
                 p = lay.stft_processed(self.snr_range, kind, rir, c, noise=self.noise)
                 lay.ensure_dir(p)
-                np.save(p, spec)
+                save_npy_atomic(p, spec)
             p = lay.stft_processed(self.snr_range, "mixture", rir, c, noise=self.noise, normed=True)
             lay.ensure_dir(p)
-            np.save(p, np.abs(ms[ch]))
+            save_npy_atomic(p, np.abs(ms[ch]))
             p = lay.mask_processed(self.snr_range, rir, c, self.noise)
             lay.ensure_dir(p)
-            np.save(p, masks[ch])
+            save_npy_atomic(p, masks[ch])
         p = lay.snr_log(self.snr_range, rir, self.noise)
         lay.ensure_dir(p)
-        np.save(p, self.snr_out[rir - self.rir_start])
+        save_npy_atomic(p, self.snr_out[rir - self.rir_start])

@@ -21,6 +21,9 @@ prefetch/dispatch/readback engine (``disco_tpu.enhance.pipeline``):
 
 Runs on the CPU backend; wired into ``make test`` alongside ``obs-check``,
 ``fault-check`` and ``chaos-check``.
+
+No reference counterpart: this is the corpus-engine CI gate (``make
+perf-check``); the reference repo has no CI tooling at all.
 """
 from __future__ import annotations
 
@@ -111,6 +114,7 @@ def main(argv=None) -> int:
     # Hermetic gate: no persistent compile-cache writes under ~/.cache from
     # CI (the bench subprocess inherits this too); an explicit env value
     # still wins.
+    """Run the corpus-throughput gate (``make perf-check``); exit 1 on failure."""
     os.environ.setdefault("DISCO_TPU_COMPILE_CACHE", "off")
     from disco_tpu import obs
     from disco_tpu.obs.accounting import device_get_count

@@ -51,7 +51,7 @@ from disco_tpu.obs.metrics import REGISTRY as obs_registry
 from disco_tpu.runs import chaos as run_chaos
 from disco_tpu.runs import interrupt as run_interrupt
 from disco_tpu.runs.ledger import RunLedger, unit_rir
-from disco_tpu.utils import resilient_to_host
+from disco_tpu.utils import TRANSPORT_ERRORS, call_with_retries, device_get_tree
 
 
 def _record_degraded(fault_plan, streaming: bool = False, **attrs):
@@ -112,6 +112,7 @@ def dset_of_rir(rir: int) -> str:
 
 
 def results_root(scenario: str, dset: str, save_dir: str) -> Path:
+    """Results tree root for one (scenario, dset, save_dir) run."""
     return Path("results") / scenario / dset / save_dir
 
 
@@ -307,15 +308,34 @@ def _persist_and_score(
     not pay a per-clip ISTFT + readback again.  ``res`` then only needs its
     ``masks_z`` / ``mask_w`` / ``z_y`` leaves (host-resident)."""
     if time_domain is not None:
+        # disco-lint: disable=DL002 -- time_domain arrays are host-resident by contract (fetch_chunk_host already landed them); np.asarray here is a no-op guard
         sh_t, szh_t, sf_t, nf_t, szf_t, nzf_t = (np.asarray(a) for a in time_domain)
+        # host-resident per the contract above; slice on host
+        masks_z_h, mask_w_h, z_y_h = res.masks_z, res.mask_w, res.z_y
     else:
         with obs_events.stage("istft", rir=rir):
-            sh_t = np.asarray(istft(res.yf, length=L))
-            szh_t = np.asarray(istft(res.z_y, length=L))
-            sf_t = np.asarray(istft(res.sf, length=L))
-            nf_t = np.asarray(istft(res.nf, length=L))
-            szf_t = np.asarray(istft(res.z_s, length=L))
-            nzf_t = np.asarray(istft(res.z_n, length=L))
+            # All six ISTFTs queue ON DEVICE, then the whole scoring payload
+            # (time-domain stacks + masks + the complex z export) crosses the
+            # tunnel in ONE batched complex-safe readback under the same
+            # transport-retry budget the old per-leaf resilient_to_host had
+            # (the per-node slice loop below used to pay 2K extra fenced
+            # crossings per clip — the anti-pattern disco-lint DL002 pins).
+            host = call_with_retries(
+                device_get_tree,
+                {
+                    "td": tuple(
+                        istft(z, length=L)
+                        for z in (res.yf, res.z_y, res.sf, res.nf, res.z_s, res.z_n)
+                    ),
+                    "masks_z": res.masks_z,
+                    "mask_w": res.mask_w,
+                    "z_y": res.z_y,
+                },
+                retry_on=TRANSPORT_ERRORS,
+                label="persist_readback",
+            )
+        sh_t, szh_t, sf_t, nf_t, szf_t, nzf_t = host["td"]
+        masks_z_h, mask_w_h, z_y_h = host["masks_z"], host["mask_w"], host["z_y"]
     obs_sentinels.check_finite("istft_out", sh_t, stage="istft")
     # score_persist covers the whole tail of the function (node loop,
     # pickles, best-effort figure); ExitStack reuses the shared `stage`
@@ -360,12 +380,11 @@ def _persist_and_score(
         write_wav_atomic(out / "WAV" / str(rir) / f"out_noi-{tag}.wav", nf_t[k], fs)
         write_wav_atomic(out / "WAV" / str(rir) / f"in_tar-{tag}.wav", s0, fs)
         write_wav_atomic(out / "WAV" / str(rir) / f"out_tar-{tag}.wav", sf_t[k], fs)
-        save_npy_atomic(out / "MASK" / str(rir) / f"step1_{tag}", np.asarray(res.masks_z[k, :, :T_true]))
-        save_npy_atomic(out / "MASK" / str(rir) / f"step2_{tag}", np.asarray(res.mask_w[k, :, :T_true]))
-        # resilient: the z export is this function's one direct device
-        # readback (complex-split over the tunnel) — a dropped RPC retries
-        # in-process instead of aborting the clip (utils.resilience)
-        save_npy_atomic(zdir / f"{rir}_{tag}", resilient_to_host(res.z_y[k, :, :T_true]))
+        save_npy_atomic(out / "MASK" / str(rir) / f"step1_{tag}", masks_z_h[k, :, :T_true])
+        save_npy_atomic(out / "MASK" / str(rir) / f"step2_{tag}", mask_w_h[k, :, :T_true])
+        # z export: already on host via the single batched readback above —
+        # slicing here is numpy, not a per-node tunnel crossing
+        save_npy_atomic(zdir / f"{rir}_{tag}", z_y_h[k, :, :T_true])
 
     def stack_keys(dicts):
         return {k: np.array([d[k] for d in dicts]) for k in dicts[0]}

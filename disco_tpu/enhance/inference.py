@@ -252,7 +252,7 @@ def crnn_masks_batched(
         Ys_h, zs_h = device_get_tree((Ys, zs))
         return np.stack([
             crnn_mask(Ys_h[i], model, variables,
-                      z=None if zs_h is None else list(np.asarray(zs_h[i])),
+                      z=None if zs_h is None else list(zs_h[i]),
                       win_len=win_len, frame_to_pred=frame_to_pred,
                       norm_type=norm_type, three_d_tensor=three_d_tensor)
             for i in range(len(Ys_h))

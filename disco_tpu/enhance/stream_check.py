@@ -21,7 +21,11 @@ acceptance contract:
 
 Wired into ``make test`` alongside ``obs-check``/``fault-check``/
 ``chaos-check``/``perf-check``/``serve-check``.
+
+No reference counterpart: the reference has no streaming deployment to
+gate.
 """
+# disco-lint: file-disable=DL002 -- the per-block host loop IS this gate's oracle: per-item readbacks on hermetic CPU are the reference semantics the scan must match, not a tunnel cost
 from __future__ import annotations
 
 import json
@@ -215,6 +219,7 @@ def _check_readback_invariant(failures: list) -> dict:
 
 
 def main(argv=None) -> int:
+    """Run the super-tick gate (``make stream-check``); exit 1 on failure."""
     import os
 
     os.environ.setdefault("DISCO_TPU_COMPILE_CACHE", "off")

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from disco_tpu.core.dsp import stft
 from disco_tpu.enhance.tango import oracle_masks, tango_step1
 from disco_tpu.utils import device_get_tree
+from disco_tpu.io.atomic import save_npy_atomic
 from disco_tpu.io.layout import DatasetLayout, case_of_rir
 
 
@@ -136,7 +137,7 @@ def export_z(
     for k in range(n_nodes):
         for zsig, arr in (("zs_hat", zs[k]), ("zn_hat", zn[k])):
             raw = layout.stft_z(zfile, snr_range, zsig, rir, k + 1, noise, normed=False)
-            np.save(layout.ensure_dir(raw), arr)
+            save_npy_atomic(layout.ensure_dir(raw), arr)
             normed = layout.stft_z(zfile, snr_range, zsig, rir, k + 1, noise, normed=True)
-            np.save(layout.ensure_dir(normed), np.abs(arr))
+            save_npy_atomic(layout.ensure_dir(normed), np.abs(arr))
     return True

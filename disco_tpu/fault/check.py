@@ -14,6 +14,8 @@ degraded mode with obs recording on, and asserts the robustness contract:
 
 Runs on the CPU backend in a few seconds (no dataset, no TPU) — wired into
 ``make test`` alongside ``obs-check`` so fault-handling drift fails CI.
+
+No reference counterpart: the reference models no comms faults.
 """
 from __future__ import annotations
 
@@ -24,6 +26,7 @@ from pathlib import Path
 
 
 def main(argv=None) -> int:
+    """Run the fault-tolerance gate (``make fault-check``); exit 1 on failure."""
     import numpy as np
 
     from disco_tpu import obs

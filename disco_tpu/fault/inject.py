@@ -23,6 +23,9 @@ Determinism contract (tests/test_fault.py): all randomness comes from
 dropout ``(K,)``, link loss ``(K, B)``, stale ``(K, B)``, nan ``(K,)`` —
 drawn unconditionally so toggling one probability never reshuffles the
 others' streams.
+
+No reference counterpart: the reference assumes a perfect in-process
+z-exchange; fault injection exists only in this rebuild.
 """
 from __future__ import annotations
 

@@ -89,9 +89,12 @@ def _fsync_dir(path: Path) -> None:
 
 
 @contextlib.contextmanager
-def atomic_write(path, mode: str = "wb"):
+def atomic_write(path, mode: str = "wb", **open_kwargs):
     """Context manager yielding a file handle whose contents appear at
     ``path`` atomically on successful exit.
+
+    ``open_kwargs`` forward to :func:`open` (text-mode writers need e.g.
+    ``newline=""`` for the csv module).
 
     Writes go to ``<path>.tmp.<pid>`` in the same directory (same
     filesystem, so the final ``os.replace`` is atomic), are flushed and
@@ -107,7 +110,7 @@ def atomic_write(path, mode: str = "wb"):
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     tmp = path.parent / f"{path.name}{TMP_SUFFIX}.{os.getpid()}"
-    fh = open(tmp, mode)
+    fh = open(tmp, mode, **open_kwargs)
     try:
         yield fh
         fh.flush()

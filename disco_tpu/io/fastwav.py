@@ -73,6 +73,7 @@ def get_lib():
 
 
 def available() -> bool:
+    """True when the native fastwav library is built and loadable."""
     return get_lib() is not None
 
 

@@ -19,6 +19,9 @@ exercises all of them end-to-end on CPU in seconds.
 
 (The self-generated-corpus pipeline milestone lives in
 ``disco_tpu.milestones_corpus``.)
+
+No reference counterpart as code: the five configurations are benchmark
+harnesses sized from the SURVEY.md scenarios.
 """
 from __future__ import annotations
 

@@ -248,6 +248,7 @@ def meetit_corpus_milestone(
 
 
 def main(argv=None):
+    """``disco-milestones-corpus`` console entry point."""
     import argparse
     import json
     import tempfile

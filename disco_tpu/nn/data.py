@@ -29,6 +29,7 @@ from pathlib import Path
 
 import numpy as np
 
+from disco_tpu.io.atomic import atomic_write
 from disco_tpu.io.layout import DatasetLayout
 
 TRAIN_DUR = 11  # seconds (datasets.py:6)
@@ -255,7 +256,11 @@ def write_input_lists(lists, folder):
     ``--files-from`` staging format (reference lists_to_load.py:27-40)."""
     os.makedirs(folder, exist_ok=True)
     for i, row in enumerate(lists):
-        Path(folder, f"list_{i}.txt").write_text("\n".join(row) + "\n")
+        # atomic: a torn list file still parses (any line prefix is a valid
+        # list), so a crash here would silently starve the loader instead
+        # of erroring on resume
+        with atomic_write(Path(folder, f"list_{i}.txt"), "w") as fh:
+            fh.write("\n".join(row) + "\n")
 
 
 def load_input_lists(folder):

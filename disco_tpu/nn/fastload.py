@@ -70,6 +70,7 @@ def get_lib():
 
 
 def available() -> bool:
+    """True when the native fastloader library is built and loadable."""
     return get_lib() is not None
 
 

@@ -17,6 +17,9 @@ time ``bench.py`` measures.  This module is the counting seam:
 Counting stays on even when event recording is off (an int increment per
 ~80 ms RPC is free); events are only emitted through the no-op-when-disabled
 recorder.
+
+No reference counterpart: the reference has no dispatch/fence accounting
+of any kind (SURVEY.md §5.1).
 """
 from __future__ import annotations
 
@@ -43,6 +46,7 @@ def fence_tick(n: int = 1) -> None:
 
 
 def fence_count() -> int:
+    """Process-wide fence count (monotonic)."""
     return _FENCES.value
 
 
@@ -55,6 +59,7 @@ def fence_count_thread() -> int:
 
 
 def recompile_count() -> int:
+    """Process-wide ``counted_jit`` recompile count."""
     return _RECOMPILES.value
 
 
@@ -72,6 +77,7 @@ def device_get_tick() -> None:
 
 
 def device_get_count() -> int:
+    """Process-wide batched-readback count (``device_get_tree`` calls)."""
     return _DEVICE_GETS.value
 
 

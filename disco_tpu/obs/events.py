@@ -130,10 +130,12 @@ _RECORDER = Recorder()
 
 
 def recorder() -> Recorder:
+    """The process-global :class:`Recorder`."""
     return _RECORDER
 
 
 def enabled() -> bool:
+    """True while the process-global recorder is recording."""
     return _RECORDER.enabled
 
 
@@ -143,6 +145,7 @@ def enable(path) -> None:
 
 
 def disable() -> None:
+    """Stop recording on the process-global recorder and close the log."""
     _RECORDER.disable()
 
 

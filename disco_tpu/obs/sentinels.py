@@ -16,6 +16,8 @@ jitted pipeline's async dispatch is untouched.  When enabled, each checked
 tree leaf costs one host readback (counted as a fence — on the tunnel that
 is the ~80 ms unit of cost, which is why these live at clip-level stage
 boundaries and not inside kernels).
+
+No reference counterpart: the reference lets NaNs propagate silently.
 """
 from __future__ import annotations
 

@@ -45,11 +45,35 @@ which is what lets ``make chaos-check`` assert byte-identical recovery.
 
 Disabled cost: one module-level ``is None`` check per tick — the seams are
 free in production.
+
+No reference counterpart: the reference cannot resume, so it has nothing
+to chaos-test.
 """
 from __future__ import annotations
 
 import os
 import threading
+
+
+#: The closed set of production crash seams (each documented in the module
+#: docstring above).  ``disco-lint`` rule DL010 checks every
+#: ``tick("<seam>")`` string literal in the pipeline against this registry —
+#: a typo'd seam name would otherwise arm nothing and a chaos experiment
+#: would silently test nothing.  Runtime stays permissive (tests arm
+#: synthetic seams); registration is a lint-time contract.
+SEAMS = frozenset(
+    {
+        "mid_write",       # io.atomic, between payload bytes and rename
+        "between_clips",   # enhance/driver.py, after one RIR persisted
+        "mid_epoch",       # nn/training.py, post-train pre-checkpoint
+        "between_scenes",  # datagen/disco.py, after one scene saved
+        "pre_fence",       # milestones._fence_readback
+        "pre_dispatch",    # enhance/driver.py, chunk about to dispatch
+        "chunk_load",      # enhance/driver.py, on the prefetch thread
+        "between_blocks",  # enhance/streaming.py, streaming block loop
+        "serve_tick",      # serve/scheduler.py, top of a scheduler tick
+    }
+)
 
 
 class ChaosCrash(BaseException):
@@ -101,6 +125,7 @@ def disable() -> None:
 
 
 def active() -> bool:
+    """True when a chaos plan is armed."""
     return _PLAN is not None
 
 

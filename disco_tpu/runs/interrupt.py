@@ -27,6 +27,9 @@ Handlers install only in the main thread (Python's signal rule); from
 worker threads :class:`GracefulInterrupt` degrades to a pure poll flag that
 :func:`request_stop` can set programmatically (used by tests and the chaos
 harness).
+
+No reference counterpart: the reference's runs are short enough to simply
+restart from scratch.
 """
 from __future__ import annotations
 

@@ -26,6 +26,8 @@ clients are numpy-only threads) and asserts the serve acceptance contract:
 All crashes are simulated in-process; nothing is ever SIGKILLed
 (environment contract).  Wired into ``make test`` alongside ``obs-check``,
 ``fault-check``, ``chaos-check`` and ``perf-check``.
+
+No reference counterpart: the reference has no serving layer.
 """
 from __future__ import annotations
 
@@ -287,6 +289,7 @@ def _check_chaos(failures: list, state_dir: Path,
 
 
 def main(argv=None) -> int:
+    """Run the online-serving gate (``make serve-check``); exit 1 on failure."""
     import os
 
     # Hermetic gate: no persistent compile-cache writes from CI (an

@@ -188,4 +188,5 @@ def send_frame(sock: socket.socket, frame: dict) -> None:
 
 
 def frame_header_size() -> int:
+    """Byte length of the frame length-prefix header."""
     return _LEN.size

@@ -322,7 +322,7 @@ class Scheduler:
                 "(only the final block may be shorter)"
             )
         for name, m in (("mask_z", mask_z), ("mask_w", mask_w)):
-            m = np.asarray(m)
+            m = np.asarray(m)  # disco-lint: disable=DL002 -- wire-decoded host arrays on the I/O thread; no device array can reach push_block
             if not np.issubdtype(m.dtype, np.number):
                 raise ValueError(f"{name} dtype {m.dtype} is not numeric")
             if m.shape != (cfg.n_nodes, cfg.n_freq, Y.shape[-1]):

@@ -15,6 +15,9 @@ session can be checkpointed at any block boundary
 a later server process (:func:`load_session_state`) with bit-identical
 continuation — the crash-safety story of ``disco_tpu.runs`` extended to
 streams that never had a file to begin with.
+
+No reference counterpart: the reference has no serving layer; session
+state is the streaming carry plus admission bookkeeping invented here.
 """
 from __future__ import annotations
 

@@ -7,6 +7,9 @@ hardware class — the MXU DFT-matmul STFT (core/dsp.py), the Mosaic pallas
 kernels (beam/filters.py, ops/) — must key off the DEVICE, not the
 platform string, or it silently takes the non-TPU path on real TPU
 hardware.
+
+No reference counterpart: backend detection is tunnel-deployment
+machinery.
 """
 from __future__ import annotations
 

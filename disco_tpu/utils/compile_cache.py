@@ -40,6 +40,7 @@ _state = {"resolved": False, "path": None}
 
 
 def default_path() -> str:
+    """Default on-disk XLA cache location (``~/.cache/disco_tpu/xla_cache``)."""
     return os.path.join(os.path.expanduser("~"), ".cache", "disco_tpu", "xla_cache")
 
 

@@ -21,6 +21,9 @@ The concrete seams wrapped here are the fenced dispatch
 (:func:`resilient_fence` around ``disco_tpu.milestones._fence``) and the
 complex-safe transfers (:func:`resilient_to_host` /
 :func:`resilient_to_device` around ``disco_tpu.utils.transfer``).
+
+No reference counterpart: the reference never leaves one host process, so
+transport-layer retries do not exist there.
 """
 from __future__ import annotations
 

@@ -7,6 +7,8 @@ split complex arrays into two real transfers (the real/imag extraction and
 the recombination run on the side that supports them), and pass real arrays
 straight through.  On standard TPU/CPU backends they are equivalent to
 ``np.asarray`` / ``jnp.asarray``.
+
+No reference counterpart: the reference never crosses a device boundary.
 """
 from __future__ import annotations
 

@@ -1,0 +1,474 @@
+"""disco-lint (disco_tpu.analysis): per-rule true-positive + near-miss
+fixtures, the suppression machinery, the reporters/CLI, and the repo-wide
+self-run gate (the test twin of ``make lint-check``).
+
+The fixture snippets are linted IN MEMORY under synthetic repo-relative
+paths (rules scope by path), so each rule is pinned against at least one
+violation it must catch and one nearby shape it must NOT flag."""
+from __future__ import annotations
+
+import json
+import textwrap
+
+import pytest
+
+from disco_tpu import analysis
+from disco_tpu.analysis import registries, report
+from disco_tpu.analysis.registry import SUPPRESSION_RULE_ID
+
+
+def lint(src, rel, rules=None, suppress=True):
+    return analysis.lint_source(
+        textwrap.dedent(src), rel, rules=rules, use_suppressions=suppress
+    )
+
+
+def rule_ids(res):
+    return [f.rule for f in res.findings]
+
+
+# -- registry ----------------------------------------------------------------
+def test_rule_catalog_shape():
+    rules = analysis.get_rules()
+    assert len(rules) == 10
+    assert sorted(rules) == [f"DL{i:03d}" for i in range(1, 11)]
+    for rid, rule in rules.items():
+        assert rule.id == rid and rule.name and rule.summary
+
+
+# -- DL001 fence-discipline --------------------------------------------------
+def test_dl001_flags_bare_block_until_ready():
+    res = lint("import jax\njax.block_until_ready(x)\n",
+               "disco_tpu/enhance/foo.py", rules={"DL001"})
+    assert rule_ids(res) == ["DL001"]
+    # bare from-import form too
+    res = lint("from jax import block_until_ready\nblock_until_ready(x)\n",
+               "disco_tpu/serve/foo.py", rules={"DL001"})
+    assert rule_ids(res) == ["DL001"]
+
+
+def test_dl001_allows_obs_and_milestones():
+    for rel in ("disco_tpu/obs/foo.py", "disco_tpu/milestones.py"):
+        res = lint("import jax\njax.block_until_ready(x)\n", rel, rules={"DL001"})
+        assert rule_ids(res) == []
+
+
+# -- DL002 host-readback-in-loop ---------------------------------------------
+def test_dl002_flags_readback_in_loop():
+    src = """
+    from disco_tpu.utils import to_host
+    def f(xs):
+        return [to_host(x) for x in xs]
+    def g(xs):
+        out = []
+        for x in xs:
+            out.append(np.asarray(x))
+        return out
+    """
+    res = lint(src, "disco_tpu/enhance/foo.py", rules={"DL002"})
+    assert rule_ids(res) == ["DL002", "DL002"]
+
+
+def test_dl002_near_misses():
+    src = """
+    from disco_tpu.utils import to_host, device_get_tree
+    def f(xs):
+        host = device_get_tree(xs)     # sanctioned batched path, in no loop
+        one = to_host(xs[0])           # outside any loop
+        for x in host:
+            use(x)
+        return [device_get_tree_not_really for _ in host]
+    """
+    assert rule_ids(lint(src, "disco_tpu/enhance/foo.py", rules={"DL002"})) == []
+    # the rule only scopes enhance/serve/nn — core is exempt
+    loop = "def f(xs):\n    return [to_host(x) for x in xs]\n"
+    assert rule_ids(lint(loop, "disco_tpu/core/foo.py", rules={"DL002"})) == []
+
+
+def test_dl002_while_and_iter_expression_semantics():
+    # the for-iterable runs once (not flagged); a while test re-runs (flagged)
+    once = "def f(xs):\n    for x in to_host(xs):\n        use(x)\n"
+    assert rule_ids(lint(once, "disco_tpu/nn/foo.py", rules={"DL002"})) == []
+    per = "def f(xs):\n    while to_host(xs).any():\n        step()\n"
+    assert rule_ids(lint(per, "disco_tpu/nn/foo.py", rules={"DL002"})) == ["DL002"]
+    # a comprehension's FIRST generator iterable also runs exactly once —
+    # one batched readback feeding a comprehension is the sanctioned shape
+    comp = "def f(x):\n    return [g(v) for v in to_host(x)]\n"
+    assert rule_ids(lint(comp, "disco_tpu/nn/foo.py", rules={"DL002"})) == []
+    # ... but per-iteration positions (the element, inner generators) count
+    inner = "def f(xs):\n    return [v for x in xs for v in to_host(x)]\n"
+    assert rule_ids(lint(inner, "disco_tpu/nn/foo.py", rules={"DL002"})) == ["DL002"]
+
+
+# -- DL003 raw-tunnel-transfer -----------------------------------------------
+def test_dl003_flags_raw_device_get_put():
+    src = "import jax\na = jax.device_get(x)\nb = jax.device_put(y)\n"
+    res = lint(src, "disco_tpu/enhance/foo.py", rules={"DL003"})
+    assert rule_ids(res) == ["DL003", "DL003"]
+    src = "from jax import device_get\na = device_get(x)\n"
+    assert rule_ids(lint(src, "disco_tpu/serve/foo.py", rules={"DL003"})) == ["DL003"]
+
+
+def test_dl003_near_misses():
+    # device_get_tree is the sanctioned wrapper; a local device_get helper
+    # NOT imported from jax is someone else's function
+    src = """
+    from disco_tpu.utils import device_get_tree
+    from mylib import device_get
+    a = device_get_tree(x)
+    b = device_get(x)
+    """
+    assert rule_ids(lint(src, "disco_tpu/enhance/foo.py", rules={"DL003"})) == []
+    # utils/transfer.py is the one allowed home of the raw primitive
+    raw = "import jax\na = jax.device_get(x)\n"
+    assert rule_ids(lint(raw, "disco_tpu/utils/transfer.py", rules={"DL003"})) == []
+
+
+# -- DL004 atomic-write ------------------------------------------------------
+def test_dl004_flags_raw_writes():
+    src = """
+    import numpy as np, pickle, soundfile as sf
+    def persist(path, arr, obj, sig):
+        np.save(path, arr)
+        with open(path, "w") as fh:
+            fh.write("x")
+        with pickle_path.open(mode="wb") as fh:
+            pickle.dump(obj, fh)
+        sf.write(path, sig, 16000)
+        path.write_bytes(b"x")
+    """
+    res = lint(src, "disco_tpu/datagen/foo.py", rules={"DL004"})
+    # np.save, open("w"), Path.open(mode="wb"), pickle.dump, sf.write, write_bytes
+    assert rule_ids(res) == ["DL004"] * 6
+
+
+def test_dl004_module_qualified_open_variants():
+    # gzip/io/codecs-style X.open carries the BUILTIN signature: the mode
+    # sits at position 1, not 0 (which is where Path.open keeps it)
+    src = "import gzip, io\ngzip.open(p, 'wb')\nio.open(p, 'w')\n"
+    res = lint(src, "disco_tpu/runs/foo.py", rules={"DL004"})
+    assert rule_ids(res) == ["DL004", "DL004"]
+    ok = "import gzip\ngzip.open(p)\ngzip.open(p, 'rb')\n"
+    assert rule_ids(lint(ok, "disco_tpu/runs/foo.py", rules={"DL004"})) == []
+
+
+def test_dl004_near_misses():
+    src = """
+    import numpy as np
+    from disco_tpu.io.atomic import save_npy_atomic, atomic_write
+    def ok(path, arr):
+        save_npy_atomic(path, arr)          # the sanctioned writer
+        with open(path) as fh:              # read mode
+            fh.read()
+        with open(path, "a") as fh:         # append: the ledger protocol
+            fh.write("line")
+        with open(path, mode) as fh:        # non-literal mode: skipped
+            fh.write("x")
+        np.save_other(path, arr)            # not a numpy writer
+    """
+    assert rule_ids(lint(src, "disco_tpu/runs/foo.py", rules={"DL004"})) == []
+    # outside the run-critical packages the rule does not apply
+    raw = "import numpy as np\nnp.save(p, a)\n"
+    assert rule_ids(lint(raw, "disco_tpu/core/foo.py", rules={"DL004"})) == []
+
+
+# -- DL005 import-purity -----------------------------------------------------
+def test_dl005_client_bans_jax_anywhere():
+    src = "def f():\n    import jax\n    return jax\n"
+    res = lint(src, "disco_tpu/serve/client.py", rules={"DL005"})
+    assert rule_ids(res) == ["DL005"]
+    res = lint("import torch\n", "disco_tpu/serve/protocol.py", rules={"DL005"})
+    assert rule_ids(res) == ["DL005"]
+
+
+def test_dl005_cli_bans_module_level_only():
+    top = "import jax\n"
+    assert rule_ids(lint(top, "disco_tpu/cli/foo.py", rules={"DL005"})) == ["DL005"]
+    lazy = "def main():\n    import jax\n    return jax\n"
+    assert rule_ids(lint(lazy, "disco_tpu/cli/foo.py", rules={"DL005"})) == []
+    # outside client/cli scope, jax is the whole point of the package
+    assert rule_ids(lint(top, "disco_tpu/serve/server.py", rules={"DL005"})) == []
+    # near-miss: jaxtyping is not jax
+    assert rule_ids(lint("import jaxtyping\n", "disco_tpu/cli/foo.py",
+                         rules={"DL005"})) == []
+
+
+# -- DL006 reference-citation ------------------------------------------------
+def test_dl006_flags_missing_docstring_and_citation():
+    src = '''
+    """Module docstring with no citation."""
+    def undocumented():
+        return 1
+    def uncited():
+        """Does things."""
+        return 2
+    '''
+    res = lint(src, "disco_tpu/core/foo.py", rules={"DL006"})
+    assert rule_ids(res) == ["DL006", "DL006"]
+
+
+def test_dl006_near_misses():
+    src = '''
+    """Module docstring with no citation."""
+    def cited():
+        """Twin of the reference loop (tango.py:528-639)."""
+    def declared():
+        """No reference counterpart: invented here."""
+    def _private():
+        pass
+    '''
+    assert rule_ids(lint(src, "disco_tpu/core/foo.py", rules={"DL006"})) == []
+    # a module-level citation covers members that only describe themselves
+    src = '''
+    """Helpers for the reference main (tango.py:1-100)."""
+    def helper():
+        """Small helper."""
+    '''
+    assert rule_ids(lint(src, "disco_tpu/core/foo.py", rules={"DL006"})) == []
+    # "preference" must not read as "reference"
+    src = '''
+    """Module docstring with no citation."""
+    def f():
+        """Sorts by user preference."""
+    '''
+    assert rule_ids(lint(src, "disco_tpu/core/foo.py", rules={"DL006"})) == ["DL006"]
+
+
+# -- DL007 traced-float-literal ----------------------------------------------
+def test_dl007_flags_int_literals():
+    src = "streaming_tango(Y, m, m, mu=1)\ntango(Y, lambda_cor=0)\n"
+    res = lint(src, "disco_tpu/enhance/foo.py", rules={"DL007"})
+    assert rule_ids(res) == ["DL007", "DL007"]
+
+
+def test_dl007_near_misses():
+    src = "f(mu=1.0)\nf(lambda_cor=0.99)\nf(mu=mu)\nf(nu=1)\nf(1)\n"
+    assert rule_ids(lint(src, "disco_tpu/enhance/foo.py", rules={"DL007"})) == []
+
+
+# -- DL008 never-sigkill -----------------------------------------------------
+def test_dl008_flags_kill_apis():
+    src = """
+    import os, signal
+    os.kill(pid, signal.SIGTERM)
+    proc.kill()
+    proc.terminate()
+    sig = signal.SIGKILL
+    """
+    res = lint(src, "disco_tpu/runs/foo.py", rules={"DL008"})
+    assert rule_ids(res) == ["DL008"] * 4
+
+
+def test_dl008_near_misses():
+    src = """
+    def kill(session):      # a local function named kill is not os.kill
+        drop(session)
+    kill(s)
+    state = proc.terminated  # attribute access, not the call
+    msg = "never SIGKILL"    # strings/docstrings are not references
+    """
+    assert rule_ids(lint(src, "disco_tpu/runs/foo.py", rules={"DL008"})) == []
+
+
+# -- DL009 obs-event-kind ----------------------------------------------------
+def test_dl009_flags_unregistered_kind():
+    src = "from disco_tpu.obs import events as obs_events\nobs_events.record('clipz', rir=1)\n"
+    res = lint(src, "disco_tpu/enhance/foo.py", rules={"DL009"})
+    assert rule_ids(res) == ["DL009"]
+
+
+def test_dl009_near_misses():
+    src = """
+    from disco_tpu.obs import events as obs_events
+    obs_events.record("clip", rir=1)      # registered kind
+    obs_events.record(kind_var, rir=1)    # non-literal: skipped
+    ledger.record(unit, "done")           # a DIFFERENT record() API
+    plan.record(mode="offline")
+    """
+    assert rule_ids(lint(src, "disco_tpu/enhance/foo.py", rules={"DL009"})) == []
+
+
+# -- DL010 chaos-seam --------------------------------------------------------
+def test_dl010_flags_unregistered_seam():
+    src = "from disco_tpu.runs import chaos\nchaos.tick('mid_wrote')\n"
+    res = lint(src, "disco_tpu/enhance/foo.py", rules={"DL010"})
+    assert rule_ids(res) == ["DL010"]
+
+
+def test_dl010_near_misses():
+    src = """
+    from disco_tpu.runs import chaos
+    chaos.tick("mid_write")          # registered seam
+    clock.tick(5)                    # non-string first arg: skipped
+    accounting.fence_tick()          # different function
+    """
+    assert rule_ids(lint(src, "disco_tpu/enhance/foo.py", rules={"DL010"})) == []
+
+
+def test_registries_extracted_from_source():
+    root = analysis.repo_root()
+    kinds = registries.event_kinds(root)
+    assert {"manifest", "clip", "fault", "session"} <= kinds
+    seams = registries.chaos_seams(root)
+    assert {"mid_write", "serve_tick", "between_blocks"} <= seams
+
+
+# -- suppressions ------------------------------------------------------------
+_VIOLATION = "import jax\njax.block_until_ready(x)  # disco-lint: disable=DL001 -- pinned fixture\n"
+
+
+def test_suppression_same_line_and_next_line():
+    res = lint(_VIOLATION, "disco_tpu/enhance/foo.py", rules={"DL001"})
+    assert rule_ids(res) == []
+    assert [(f.rule, just) for f, just in res.suppressed] == [("DL001", "pinned fixture")]
+    above = ("import jax\n"
+             "# disco-lint: disable=DL001 -- fixture, comment-above form\n"
+             "jax.block_until_ready(x)\n")
+    res = lint(above, "disco_tpu/enhance/foo.py", rules={"DL001"})
+    assert rule_ids(res) == [] and len(res.suppressed) == 1
+
+
+def test_file_disable_suppresses_whole_file():
+    src = ("# disco-lint: file-disable=DL001 -- fixture-wide waiver\n"
+           "import jax\n"
+           "jax.block_until_ready(x)\n"
+           "jax.block_until_ready(y)\n")
+    res = lint(src, "disco_tpu/enhance/foo.py", rules={"DL001"})
+    assert rule_ids(res) == [] and len(res.suppressed) == 2
+
+
+def test_suppression_without_justification_is_a_finding():
+    src = "import jax\njax.block_until_ready(x)  # disco-lint: disable=DL001\n"
+    res = lint(src, "disco_tpu/enhance/foo.py", rules={"DL001"})
+    # the waiver is void (DL001 still fires) AND the bad comment is reported
+    assert sorted(rule_ids(res)) == [SUPPRESSION_RULE_ID, "DL001"]
+
+
+def test_unknown_rule_id_and_unsuppressable_dl000():
+    src = "x = 1  # disco-lint: disable=DL999 -- no such rule\n"
+    res = lint(src, "disco_tpu/enhance/foo.py")
+    assert rule_ids(res) == [SUPPRESSION_RULE_ID]
+    src = "x = 1  # disco-lint: disable=DL000 -- nice try\n"
+    res = lint(src, "disco_tpu/enhance/foo.py")
+    assert SUPPRESSION_RULE_ID in rule_ids(res)
+
+
+def test_unused_suppression_is_a_finding():
+    src = "x = 1  # disco-lint: disable=DL001 -- waives nothing\n"
+    res = lint(src, "disco_tpu/enhance/foo.py", rules={"DL001"})
+    assert rule_ids(res) == [SUPPRESSION_RULE_ID]
+    assert "unused suppression" in res.findings[0].message
+
+
+def test_no_suppressions_mode_reports_everything():
+    res = lint(_VIOLATION, "disco_tpu/enhance/foo.py", rules={"DL001"},
+               suppress=False)
+    assert rule_ids(res) == ["DL001"] and res.suppressed == []
+
+
+# -- reporters / CLI ---------------------------------------------------------
+def test_json_reporter_schema():
+    res = lint(_VIOLATION + "import jax.numpy\njax.device_get(q)\n",
+               "disco_tpu/enhance/foo.py", rules={"DL001", "DL003"})
+    doc = json.loads(report.format_json(res))
+    assert set(doc) == {"clean", "counts", "findings", "suppressed"}
+    assert doc["clean"] is (not doc["findings"])
+    assert doc["counts"]["by_rule"].get("DL003") == 1
+    assert doc["suppressed"][0]["justification"] == "pinned fixture"
+    f = doc["findings"][0]
+    assert {"path", "line", "col", "rule", "name", "message"} <= set(f)
+
+
+def test_text_reporter_line_format():
+    res = lint("import jax\njax.device_get(x)\n", "disco_tpu/enhance/foo.py",
+               rules={"DL003"})
+    text = report.format_text(res)
+    assert "disco_tpu/enhance/foo.py:2:0: DL003 [raw-tunnel-transfer]" in text
+    assert "1 finding(s)" in text
+
+
+def test_cli_end_to_end(tmp_path, capsys):
+    from disco_tpu.analysis import cli
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("f(mu=1)\n")
+    assert cli.main([str(bad), "--format", "json", "--rules", "DL007"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["counts"]["by_rule"] == {"DL007": 1}
+    assert cli.main([str(bad), "--rules", "DL001"]) == 0
+    assert cli.main(["--list-rules"]) == 0
+    assert "DL010" in capsys.readouterr().out
+    assert cli.main([str(bad), "--rules", "DLXXX"]) == 2
+    assert cli.main([str(tmp_path / "missing.py")]) == 2
+
+
+def test_rules_filter_does_not_flag_other_rules_suppressions():
+    """A focused --rules run must not report the shipped waivers of
+    NON-selected rules as unused DL000 (the repo stays clean under any
+    filter)."""
+    res = analysis.lint_paths(rules={"DL005"})
+    assert res.findings == [], "\n".join(f.render() for f in res.findings)
+    # the unused check still works when the suppressed rule IS selected
+    src = "x = 1  # disco-lint: disable=DL001 -- waives nothing\n"
+    res = lint(src, "disco_tpu/enhance/foo.py", rules={"DL001"})
+    assert rule_ids(res) == [SUPPRESSION_RULE_ID]
+    # ... and stays quiet when it is not
+    res = lint(src, "disco_tpu/enhance/foo.py", rules={"DL005"})
+    assert rule_ids(res) == []
+
+
+def test_outside_root_targets_are_reported(tmp_path, capsys):
+    from disco_tpu.analysis import cli
+
+    f = tmp_path / "loose.py"
+    f.write_text("x = 1\n")
+    res = analysis.lint_paths([str(f)], rules={"DL001"})
+    assert res.outside == ["loose.py"]
+    assert cli.main([str(f), "--rules", "DL001"]) == 0
+    assert "outside the repo root" in capsys.readouterr().err
+
+
+# -- the repo itself ---------------------------------------------------------
+def test_repo_lints_clean():
+    """The self-run gate: zero unsuppressed findings over the default
+    targets, and every suppression carries a non-empty justification."""
+    res = analysis.lint_paths()
+    assert res.findings == [], "\n".join(f.render() for f in res.findings)
+    assert res.n_files > 100  # the walk really covered the tree
+    for f, just in res.suppressed:
+        assert just.strip(), f"unjustified suppression for {f.render()}"
+
+
+def test_shipped_suppressions_are_load_bearing():
+    """Ignoring the suppression comments must re-surface real findings in
+    the files that carry them — i.e. removing any rule's suppression set
+    makes the gate fail (acceptance criterion)."""
+    res = analysis.lint_paths(use_suppressions=False)
+    got = {(f.rule, f.path) for f in res.findings}
+    expected = {
+        ("DL001", "__graft_entry__.py"),          # driver-contract fences
+        ("DL003", "__graft_entry__.py"),          # CPU-mesh device_put
+        ("DL002", "disco_tpu/enhance/stream_check.py"),  # per-block oracle
+        ("DL002", "disco_tpu/enhance/driver.py"), # host time_domain unpack
+        ("DL002", "disco_tpu/serve/scheduler.py"),# wire-decoded host arrays
+        ("DL004", "disco_tpu/runs/check.py"),     # deliberate bit rot
+    }
+    missing = expected - got
+    assert not missing, f"suppressed sites vanished (or rules stopped firing): {missing}"
+
+
+@pytest.mark.parametrize(
+    "src,rel,rule",
+    [
+        # reverting the zexport atomic-write fix would re-flag np.save
+        ("import numpy as np\nfor k in range(4):\n    np.save(p, arr[k])\n",
+         "disco_tpu/enhance/zexport.py", "DL004"),
+        # reverting the driver's batched readback would re-flag the loop
+        ("from disco_tpu.utils import resilient_to_host\n"
+         "for k in range(4):\n    z = resilient_to_host(res.z_y[k])\n",
+         "disco_tpu/enhance/driver.py", "DL002"),
+    ],
+)
+def test_satellite_fix_reverts_fail_the_gate(src, rel, rule):
+    res = lint(src, rel, rules={rule})
+    assert rule in rule_ids(res)

@@ -8,10 +8,8 @@ tests pin the pieces at unit size).
 """
 from __future__ import annotations
 
-import ast
 import socket
 import threading
-from pathlib import Path
 
 import numpy as np
 import pytest
@@ -115,21 +113,22 @@ def test_protocol_truncated_frame_is_an_error():
 
 def test_client_modules_never_import_jax():
     """The environment contract allows ONE chip-claiming process — serve
-    clients must be importable without jax.  Pinned structurally: no
-    module-level jax import in the client-side modules (the conftest has
-    already imported jax into this process, so sys.modules can't tell)."""
-    import disco_tpu.serve.client as client_mod
-    import disco_tpu.serve.protocol as protocol_mod
+    clients must be importable without jax.  Pinned structurally via the
+    disco-lint import-purity rule (DL005), so the client purity contract
+    has exactly ONE implementation (the bespoke AST walk that used to live
+    here moved into disco_tpu.analysis.rules.purity)."""
+    from disco_tpu import analysis
+    from disco_tpu.analysis.rules.purity import CLIENT_FILES
 
-    for mod in (client_mod, protocol_mod):
-        tree = ast.parse(Path(mod.__file__).read_text())
-        for node in ast.walk(tree):
-            if isinstance(node, (ast.Import, ast.ImportFrom)):
-                names = [a.name for a in node.names] if isinstance(node, ast.Import) \
-                    else [node.module or ""]
-                assert not any(n == "jax" or n.startswith("jax.") for n in names), (
-                    f"{mod.__name__} imports jax at line {node.lineno}"
-                )
+    root = analysis.repo_root()
+    res = analysis.lint_paths([str(root / f) for f in CLIENT_FILES],
+                              rules={"DL005"})
+    assert res.findings == [], "\n".join(f.render() for f in res.findings)
+    # ... and the rule has teeth: a lazy in-function jax import in a client
+    # module (which module-level-only checks would miss) IS caught
+    bad = analysis.lint_source("def f():\n    import jax.numpy\n",
+                               rel=CLIENT_FILES[0], rules={"DL005"})
+    assert [f.rule for f in bad.findings] == ["DL005"]
 
 
 # -- session config / state --------------------------------------------------
