@@ -3,9 +3,9 @@
 
 PYTHON ?= python
 
-.PHONY: test coverage doc install native clean bench milestone-corpus dryrun lint-check obs-check fault-check chaos-check perf-check serve-check stream-check
+.PHONY: test coverage doc install native clean bench milestone-corpus dryrun lint-check trace-check obs-check fault-check chaos-check perf-check serve-check stream-check
 
-test: lint-check obs-check fault-check chaos-check perf-check stream-check serve-check
+test: lint-check trace-check obs-check fault-check chaos-check perf-check stream-check serve-check
 	$(PYTHON) -m pytest tests/ -q
 
 # Static-analysis gate (runs FIRST: it needs no jax, no device and ~2 s):
@@ -20,6 +20,23 @@ test: lint-check obs-check fault-check chaos-check perf-check stream-check serve
 # the chip claim (doc/source/static_analysis.rst).
 lint-check:
 	$(PYTHON) -m disco_tpu.analysis.cli
+
+# Program-contract gate (the eighth gate, right after lint: both are cheap
+# and hermetic, so they fail fast before the heavy gates): disco-trace
+# traces the canonical hot-path programs on declared abstract inputs and
+# diffs their structural fingerprints (primitive multiset + sequence hash,
+# avals, scan unroll parameters, host-callback presence, dtype hygiene)
+# against the goldens committed under disco_tpu/analysis/golden/; runs the
+# retrace-budget workload (every counted_jit label held to an exact
+# per-label program count — the mu=1 trap, caught behaviorally); verifies
+# declared donation survives into the lowered modules' input-output
+# aliasing; and asserts the serve scheduler's CPU step IS the offline
+# jitted entry point.  CPU forced twice over (env here + ensure_cpu in the
+# checker): tracing must never claim the tunneled chip
+# (doc/source/static_analysis.rst, "Program-level contracts").
+trace-check:
+	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= DISCO_TPU_COMPILE_CACHE=off \
+	    $(PYTHON) -m disco_tpu.analysis.trace.cli
 
 # Telemetry gates (run before the suite so drift fails fast):
 # 1. the bench trajectory must not regress between the last two committed

@@ -10,12 +10,18 @@ CI via ``make lint-check`` (no jax import anywhere in the linter — the gate
 is hermetic and never touches the chip claim).
 
 * :mod:`.registry`     — Rule base class + ``DLnnn`` registry
-* :mod:`.rules`        — the ten rule implementations (catalog in its docstring)
+* :mod:`.rules`        — the eleven rule implementations (catalog in its docstring)
 * :mod:`.suppressions` — ``# disco-lint: disable=... -- justification`` parsing
 * :mod:`.registries`   — AST extraction of EVENT_KINDS / SEAMS (no imports)
 * :mod:`.runner`       — file collection + the lint engine (:func:`lint_paths`)
 * :mod:`.report`       — text / JSON reporters
 * :mod:`.cli`          — the ``disco-lint`` console entry
+
+The sibling :mod:`.trace` subpackage (``disco-trace``, ``make
+trace-check``) checks the contracts that live BELOW the AST — golden jaxpr
+fingerprints, retrace budgets, donation/dtype audits.  It does import jax
+(forced to the CPU backend), so nothing in the linter imports it: the
+lint gate stays stdlib-only.
 
 No reference counterpart: the reference repo has no static analysis of any
 kind (SURVEY.md documents no tooling beyond setup.py).

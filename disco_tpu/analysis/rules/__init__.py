@@ -13,6 +13,7 @@ is registration order):
 * DL008 ``never-sigkill``         — :mod:`.sigkill`
 * DL009 ``obs-event-kind``        — :mod:`.registered`
 * DL010 ``chaos-seam``            — :mod:`.registered`
+* DL011 ``scan-unroll``           — :mod:`.scanunroll`
 
 (DL000 ``lint-suppression`` is the engine's own hygiene rule — see
 :mod:`disco_tpu.analysis.suppressions`.)
@@ -26,6 +27,7 @@ from disco_tpu.analysis.rules import (  # noqa: F401  (import = register)
     purity,
     readback,
     registered,
+    scanunroll,
     sigkill,
     tracedfloat,
     transfer,

@@ -1,0 +1,75 @@
+"""DL011 — ``lax.scan`` in bit-exactness-gated modules must pass ``unroll=``.
+
+The PR-6 rolled-scan trap: a ROLLED ``lax.scan`` body compiles with
+different FMA/fusion choices than the standalone per-block program
+(measured ~2e-6 step-1 drift on CPU, amplified to ~3e-2 through the warm-up
+GEVD + ffill hold), while unrolled bodies compile exactly like the
+standalone program.  In the modules whose outputs are gated bit-exact
+against a per-block reference (``enhance/streaming.py`` — the
+``streaming_tango_scan`` super-tick driver and every scan inside the traced
+per-block body — and the serve scheduler that dispatches them), the unroll
+choice is therefore load-bearing and must be EXPLICIT: ``unroll=N`` where
+the scan must compile like the per-block program, ``unroll=1`` where the
+rolled form is the deliberate choice (intra-program recursions that exist
+in both the scanned and per-block paths and so cancel in the parity
+comparison).  An omitted ``unroll=`` is indistinguishable from "nobody
+thought about it" — exactly how the PR-6 divergence shipped.
+
+The jaxpr-level twin of this rule is the golden-fingerprint gate
+(``disco_tpu.analysis.trace``), which records every scan's ``unroll``
+parameter in the traced program and fails CI when it drifts; this AST rule
+catches the same trap at review time, before anything is traced.
+
+No reference counterpart: the reference has no jit, no scan and no
+bit-exactness gate.
+"""
+from __future__ import annotations
+
+import ast
+
+from disco_tpu.analysis.context import attr_chain
+from disco_tpu.analysis.registry import Rule, register
+
+#: the modules whose scans are bit-exactness-gated (make stream-check /
+#: make serve-check compare their outputs bit-for-bit against a per-block
+#: reference)
+_GATED_FILES = (
+    "disco_tpu/enhance/streaming.py",
+    "disco_tpu/serve/scheduler.py",
+)
+
+
+@register
+class ScanUnroll(Rule):
+    id = "DL011"
+    name = "scan-unroll"
+    summary = ("lax.scan without an explicit unroll= in a bit-exactness-"
+               "gated module — the PR-6 rolled-scan FMA-drift trap; state "
+               "the unroll choice")
+
+    def applies(self, ctx) -> bool:
+        return ctx.is_file(*_GATED_FILES)
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if not chain or chain[-1] != "scan":
+                continue
+            # jax.lax.scan / lax.scan / a bare `scan` from-import; leave
+            # other .scan callees (e.g. a dataframe API) alone
+            if len(chain) > 1 and chain[-2] != "lax":
+                continue
+            if any(kw.arg == "unroll" for kw in node.keywords):
+                continue
+            yield self.finding(
+                ctx, node,
+                "lax.scan without an explicit unroll= in a bit-exactness-"
+                "gated module: a rolled scan body compiles with different "
+                "FMA/fusion choices than the standalone per-block program "
+                "(the PR-6 ~2e-6 step-1 drift, amplified ~3e-2 through the "
+                "warm-up GEVD) — pass unroll=N to compile like the "
+                "per-block program, or unroll=1 to state that the rolled "
+                "form is deliberate",
+            )

@@ -1,0 +1,40 @@
+"""disco-trace — jaxpr-level program contracts (the eighth CI gate).
+
+The repo's worst bug class lives below the AST: "same value, different
+program" retraces (PR 5's traced-float convention, PR 6's msgpack
+``mu=1``), the rolled-scan FMA drift that broke bit-exactness, and
+donation that jax can silently drop.  ``disco-lint`` cannot see any of it
+— those are properties of the *traced jaxpr and lowered executable*, not
+the source text.  This package makes them mechanical:
+
+* **golden fingerprints** (:mod:`.fingerprint`, :mod:`.programs`): the
+  canonical hot-path programs traced on declared abstract inputs, reduced
+  to a stable structural fingerprint (primitive multiset + sequence hash,
+  avals, scan ``unroll`` parameters, host-callback presence, dtype
+  hygiene) and diffed against goldens committed under
+  ``disco_tpu/analysis/golden/`` — an unexplained diff fails CI with a
+  primitive-level report; ``disco-trace --update`` regenerates after an
+  intended change,
+* **retrace budgets** (:mod:`.budgets`): a miniature workload with cold
+  caches, every ``counted_jit`` label held to an exact per-label program
+  count — the next ``mu=1``-shaped trap fails here whatever its source
+  shape,
+* **donation + dtype audits** (:mod:`.audits`): declared
+  ``donate_argnums``/``donate_argnames`` verified to survive into the
+  lowered module's input-output aliasing, float64 leaks and weak-type
+  ``convert_element_type`` churn rejected inside jitted hot paths,
+* the gate itself (:mod:`.check`, ``make trace-check``) and the
+  ``disco-trace`` CLI (:mod:`.cli`, JSON reporter mirroring
+  ``disco-lint``'s contract).
+
+No reference counterpart: the reference repo has no traced programs.
+"""
+from disco_tpu.analysis.trace.check import (  # noqa: F401
+    TraceResult,
+    run_checks,
+)
+from disco_tpu.analysis.trace.fingerprint import (  # noqa: F401
+    diff_fingerprints,
+    fingerprint_fn,
+    fingerprint_jaxpr,
+)

@@ -1,0 +1,156 @@
+"""Donation and dtype audits over the lowered hot-path programs.
+
+**Donation**: ``donate_argnums``/``donate_argnames`` are metadata — jax can
+silently drop them (shape-mismatched outputs, backends without aliasing
+support) and the program still runs, just with double the HBM footprint the
+donation was supposed to save.  The audit lowers each program WITH its
+declared donation and counts what survived into the StableHLO module:
+``tf.aliasing_output`` (donated input aliased to an output buffer — the
+donation is real) vs ``jax.buffer_donor`` (donated, left for XLA to maybe
+use).  A spec with ``must_alias`` hard-fails when fewer than
+``min_aliased`` donated leaves alias; otherwise the result is report-only
+(the per-backend report the gate prints).
+
+**Dtype**: the pipeline is complex64/float32 end to end, pinned against
+float64 NumPy oracles host-side only (CLAUDE.md conventions).  A float64 or
+complex128 aval inside a jitted hot path means an accidental x64 promotion
+(2x memory, different numerics than validated); a dtype-preserving
+``convert_element_type`` is weak-type churn — each one marks a spot where
+a passed-vs-folded constant changes the traced program (the PR-5
+convention).  Both are extracted by the fingerprint walk; this module
+turns them into gate verdicts.
+
+No reference counterpart: the reference has no jit, no donation and a
+float64-everywhere numpy pipeline.
+"""
+from __future__ import annotations
+
+import re
+import warnings
+
+#: weak-type-churn ceiling per program: the count is recorded in the golden
+#: (so ANY drift fails the fingerprint diff); this absolute bound
+#: additionally fails a --update that tries to commit a churn explosion
+CONVERT_CHURN_MAX = 60
+
+
+def donated_lowering(spec):
+    """Lower ``spec``'s program with its declared donation; return
+    ``(stablehlo_text, args)``.  The jit is built here (not taken from the
+    production module) because the production call sites enable donation
+    off-CPU only — the audit checks the *declared* contract on the current
+    backend.  ``args`` are returned so the caller can count the declared
+    leaves without a second ``spec.build()``.
+
+    No reference counterpart (module docstring).
+    """
+    import jax
+
+    fn, args, kwargs = spec.build()
+    don = dict(spec.donate or {})
+    jit_kw = {}
+    if "argnums" in don:
+        jit_kw["donate_argnums"] = tuple(don["argnums"])
+    if "argnames" in don:
+        # donate_argnames needs named parameters: bind the args by position
+        # is fine — jax resolves names against the signature
+        jit_kw["donate_argnames"] = tuple(don["argnames"])
+    with warnings.catch_warnings():
+        # "Some donated buffers were not usable" is exactly what the audit
+        # quantifies — keep it out of the gate's stdout
+        warnings.simplefilter("ignore")
+        lowered = jax.jit(
+            lambda *a: fn(*a, **kwargs), **_positional(jit_kw, fn, args)
+        ).lower(*args)
+    return lowered.as_text(), args
+
+
+def _positional(jit_kw: dict, fn, args):
+    """``donate_argnames`` against a ``lambda *a`` wrapper cannot resolve —
+    rewrite it to the positional index of the named parameter in ``fn``'s
+    signature (the wrapper passes everything positionally).
+
+    No reference counterpart (module docstring).
+    """
+    if "donate_argnames" not in jit_kw:
+        return jit_kw
+    import inspect
+
+    params = list(inspect.signature(fn).parameters)
+    unresolved = [name for name in jit_kw["donate_argnames"]
+                  if name not in params or params.index(name) >= len(args)]
+    if unresolved:
+        # a declared name that does not resolve must FAIL the audit, not
+        # silently lower an undonated program and report it green
+        raise ValueError(
+            f"donate_argnames {unresolved} do not resolve against the "
+            f"program's positional signature {params[:len(args)]} — fix the "
+            "ProgramSpec donation declaration"
+        )
+    nums = tuple(params.index(name) for name in jit_kw["donate_argnames"])
+    out = dict(jit_kw)
+    del out["donate_argnames"]
+    out["donate_argnums"] = tuple(out.get("donate_argnums", ())) + nums
+    return out
+
+
+def audit_donation(spec) -> dict:
+    """One program's donation verdict: ``{declared, aliased, donor_only,
+    ok, note}``.
+
+    No reference counterpart (module docstring).
+    """
+    import jax
+
+    don = spec.donate or {}
+    text, args = donated_lowering(spec)
+    aliased = len(re.findall(r"tf\.aliasing_output", text))
+    donor_only = len(re.findall(r"jax\.buffer_donor", text))
+    declared = _declared_leaves(don, args)
+    ok = (not don.get("must_alias")) or aliased >= int(don.get("min_aliased", 1))
+    return {
+        "program": spec.name,
+        "backend": jax.default_backend(),
+        "declared_leaves": declared,
+        "aliased": aliased,
+        "donor_only": donor_only,
+        "ok": ok,
+        "must_alias": bool(don.get("must_alias")),
+        "min_aliased": int(don.get("min_aliased", 1)),
+        "note": don.get("note", ""),
+    }
+
+
+def _declared_leaves(don: dict, args) -> int:
+    import jax
+
+    n = 0
+    for i in don.get("argnums", ()):
+        n += len(jax.tree_util.tree_leaves(args[i]))
+    if don.get("argnames"):
+        # by construction the named args are the trailing entries of the
+        # spec's positional args (see ProgramSpec.build contracts)
+        n += len(jax.tree_util.tree_leaves(args[-len(don["argnames"]):]))
+    return n
+
+
+def audit_dtypes(fp: dict) -> list:
+    """Gate findings from one fingerprint's dtype fields (empty = clean).
+
+    No reference counterpart (module docstring).
+    """
+    out = []
+    if fp.get("f64"):
+        out.append(
+            "float64/complex128 leak inside a jitted hot path: "
+            + "; ".join(fp["f64"][:5])
+            + (" ..." if len(fp["f64"]) > 5 else "")
+        )
+    churn = int(fp.get("convert_churn", 0))
+    if churn > CONVERT_CHURN_MAX:
+        out.append(
+            f"{churn} dtype-preserving convert_element_type equations "
+            f"(> {CONVERT_CHURN_MAX}): weak-type churn exploded — check the "
+            "traced-float calling convention (streaming.DEFAULT_LAMBDA_COR)"
+        )
+    return out

@@ -1,0 +1,168 @@
+"""Retrace budgets: a declarative per-label bound on traced programs.
+
+The trap this gate exists for is PR 6's ``mu=1``: a wire-decoded *int* for
+a traced-float keyword traced a third, int-typed program per shape bucket —
+same value, different program, compile tax and a parity hazard at every new
+call site.  ``disco-lint`` DL007 catches the int *literal* at the call
+site; this gate catches the behavior, whatever the source shape: it runs a
+miniature representative workload through the jitted entry points with
+cold caches and fails when any ``counted_jit`` label traces more programs
+than its declared budget (the per-label ``jit_recompiles{label}`` counters,
+:func:`disco_tpu.obs.accounting.recompile_count`).
+
+Budgets are EXACT expectations, not loose ceilings: the workload is fixed,
+so the trace count per label is deterministic — one more program than
+declared means a new retrace seam leaked in (the gate's report names the
+label), one fewer means the workload stopped exercising the entry point
+and the budget is dead (also a failure: a gate that runs nothing gates
+nothing).
+
+Labels not listed here are covered elsewhere: the ``serve_step``/
+``serve_scan_step`` labels exist off-CPU only and dispatch the SAME
+programs as ``streaming_tango``/``streaming_tango_scan`` (scheduler
+``_resolve_step``); ``train_step``/``eval_step`` recompile drift is
+reported per epoch by ``nn.training.fit``'s epoch events.
+
+No reference counterpart: the reference repo has no jit and no retraces.
+"""
+from __future__ import annotations
+
+from disco_tpu.analysis.trace.programs import (
+    B,
+    BLOCKS_PER_DISPATCH,
+    C,
+    COV_IMPL,
+    F,
+    K,
+    SOLVER,
+    T,
+    UPDATE_EVERY,
+)
+
+#: label -> exact number of programs the miniature workload traces.
+#: streaming_tango: the warm-start program + the continuation-state program
+#: (a different carry pytree IS a different program); repeat calls and
+#: floats passed equal to the defaults must NOT add a third — that third
+#: program is precisely the mu=1 trap.  streaming_step1 is driven directly
+#: with the same two variants (inside streaming_tango it runs under the
+#: outer trace, where the inner jit compiles nothing and its cache-size
+#: counter legitimately stays flat).  The scan driver and the two corpus
+#: runners trace once each.
+BUDGETS: dict = {
+    "streaming_tango": 2,
+    "streaming_step1": 2,
+    "streaming_tango_scan": 1,
+    "run_batch": 1,
+    "run_batch_with_masks": 1,
+}
+
+
+def _inputs(rng, t):
+    import numpy as np
+
+    Y = (rng.standard_normal((K, C, F, t)) +
+         1j * rng.standard_normal((K, C, F, t))).astype(np.complex64)
+    mz = rng.uniform(0.1, 0.9, (K, F, t)).astype(np.float32)
+    mw = rng.uniform(0.1, 0.9, (K, F, t)).astype(np.float32)
+    return Y, mz, mw
+
+
+def run_workload(extra=None) -> None:
+    """The miniature representative workload (cold caches, CPU-sized).
+
+    ``extra``: optional callable run after the canonical calls — the
+    deliberate-retrace test fixtures push one more call through a fresh
+    call site (e.g. an int-typed ``mu``) and assert the gate fails.
+
+    No reference counterpart (module docstring).
+    """
+    import numpy as np
+
+    from disco_tpu.enhance import streaming
+    from disco_tpu.enhance.driver import make_batch_runners
+
+    for entry in (streaming.streaming_tango, streaming.streaming_step1,
+                  streaming.streaming_tango_scan):
+        if entry.clear_cache is None:
+            # counted_jit resolves clear_cache per jax version; without it
+            # a second same-process workload would count 0 fresh programs
+            # and misread as "workload no longer exercises the label" —
+            # fail self-diagnosing instead
+            raise RuntimeError(
+                "budget workload needs cold caches but this jax version "
+                "exposes no clear_cache on the jitted entry points "
+                "(obs.accounting.counted_jit) — update the cache-clearing "
+                "seam in budgets.run_workload"
+            )
+        entry.clear_cache()
+
+    rng = np.random.default_rng(0)
+    Y, mz, mw = _inputs(rng, T)
+
+    out = streaming.streaming_tango(Y, mz, mw, update_every=UPDATE_EVERY)
+    # cache hit: same shapes
+    streaming.streaming_tango(Y, mz, mw, update_every=UPDATE_EVERY)
+    # cache hit: floats passed EQUAL to the defaults are stripped by the
+    # canonical _float_kw convention — passing them must not retrace
+    streaming.streaming_tango(Y, mz, mw, update_every=UPDATE_EVERY,
+                              lambda_cor=0.99, mu=1.0)
+    # continuation program: the carry pytree is a new input structure
+    streaming.streaming_tango(Y, mz, mw, update_every=UPDATE_EVERY,
+                              state=out["state"])
+
+    # the per-node step-1 entry, warm start + continuation (direct calls:
+    # under streaming_tango's trace the inner jit compiles nothing)
+    s1 = streaming.streaming_step1(Y[0], mz[0], update_every=UPDATE_EVERY)
+    streaming.streaming_step1(Y[0], mz[0], update_every=UPDATE_EVERY)
+    streaming.streaming_step1(Y[0], mz[0], update_every=UPDATE_EVERY,
+                              state=(s1["Rss"], s1["Rnn"], s1["w"]))
+
+    n = BLOCKS_PER_DISPATCH
+    Y2, mz2, mw2 = _inputs(rng, n * T)
+    streaming.streaming_tango_scan(Y2, mz2, mw2, update_every=UPDATE_EVERY,
+                                   blocks_per_dispatch=n)
+
+    run_batch, run_batch_with_masks = make_batch_runners(
+        mask_type="irm1", mu=1.0, policy="local", solver=SOLVER,
+        cov_impl=COV_IMPL, n_nodes=K,
+    )
+    Yb = np.stack([_inputs(rng, T)[0] for _ in range(B)])
+    Sb = np.stack([_inputs(rng, T)[0] for _ in range(B)])
+    Nb = np.stack([_inputs(rng, T)[0] for _ in range(B)])
+    run_batch(Yb, Sb, Nb)
+    run_batch(Yb, Sb, Nb)  # cache hit
+    Mz = np.stack([_inputs(rng, T)[1] for _ in range(B)])
+    run_batch_with_masks(Yb, Sb, Nb, Mz, Mz)
+
+    if extra is not None:
+        extra(streaming, Y, mz, mw)
+
+
+def check_budgets(extra=None) -> tuple:
+    """Run the workload and diff the per-label counters against
+    :data:`BUDGETS`.  Returns ``(findings, counts)`` — findings empty when
+    every label traced exactly its budget.
+
+    No reference counterpart (module docstring).
+    """
+    from disco_tpu.obs.accounting import recompile_count
+
+    before = {label: recompile_count(label) for label in BUDGETS}
+    run_workload(extra=extra)
+    counts = {label: recompile_count(label) - before[label] for label in BUDGETS}
+    findings = []
+    for label, budget in BUDGETS.items():
+        n = counts[label]
+        if n > budget:
+            findings.append(
+                f"label {label!r} traced {n} programs, budget {budget}: a "
+                "new retrace seam (the mu=1 trap shape — check argument "
+                "dtypes and the _float_kw convention at new call sites)"
+            )
+        elif n < budget:
+            findings.append(
+                f"label {label!r} traced {n} programs, budget {budget}: the "
+                "workload no longer exercises this entry point — a budget "
+                "that runs nothing gates nothing (update budgets.py)"
+            )
+    return findings, counts

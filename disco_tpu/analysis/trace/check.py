@@ -1,0 +1,290 @@
+"""The trace-contract gate: fingerprints vs goldens + budgets + audits.
+
+``make trace-check`` runs :func:`main` (the eighth hermetic gate, right
+after ``lint-check``): every canonical hot-path program
+(:data:`~disco_tpu.analysis.trace.programs.PROGRAMS`) is traced on declared
+abstract inputs and its structural fingerprint diffed against the golden
+committed under ``disco_tpu/analysis/golden/``; the retrace-budget workload
+runs with cold caches and every ``counted_jit`` label is held to its
+declared budget; donation and dtype audits run over the same programs; and
+the serve scheduler's CPU step is asserted to BE the offline entry point
+(``_resolve_step`` identity — "the program I ship is the program I
+validated", made mechanical).
+
+Hermetic by construction: the checker forces the CPU backend before any
+device use (:func:`ensure_cpu` — the conftest trick), so it never touches
+the tunneled chip claim, needs no network, and runs in one JAX process
+like every other gate (environment contract).
+
+No reference counterpart: the reference repo has no traced programs and no
+CI gates.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+
+#: where the golden fingerprints live (committed, one JSON per program)
+GOLDEN_DIR = Path(__file__).resolve().parents[1] / "golden"
+
+
+def ensure_cpu() -> None:
+    """Force the CPU backend (the conftest path) or refuse to run.
+
+    Every python process claims the tunneled chip at first jax use and
+    blocks while another holds it (CLAUDE.md) — a contract checker must
+    never be the process that does that.
+
+    No reference counterpart (module docstring).
+    """
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:  # backend already initialised: verify, don't fight
+        pass
+    if jax.default_backend() != "cpu":
+        raise SystemExit(
+            f"disco-trace: refusing to run on backend "
+            f"{jax.default_backend()!r} — the gate is CPU-only by contract "
+            "(run via `make trace-check`, which forces JAX_PLATFORMS=cpu)"
+        )
+
+
+@dataclasses.dataclass
+class TraceResult:
+    """Everything one gate run produced (the JSON reporter's payload).
+
+    ``findings`` are gate-failing: dicts with ``program`` (or ``-`` for
+    process-wide checks), ``check`` (``fingerprint``/``budget``/
+    ``donation``/``dtype``/``identity``/``golden``) and ``message`` —
+    the same shape contract as ``disco-lint``'s findings list.
+
+    No reference counterpart (module docstring).
+    """
+
+    findings: list
+    fingerprints: dict
+    donation: list
+    budgets: dict
+    n_programs: int
+    updated: list
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+
+def _finding(program: str, check: str, message: str) -> dict:
+    return {"program": program, "check": check, "message": message}
+
+
+def golden_path(name: str) -> Path:
+    """The committed golden file of one program.
+
+    No reference counterpart (module docstring)."""
+    return GOLDEN_DIR / f"{name}.json"
+
+
+def load_golden(name: str) -> dict | None:
+    """Read one committed golden fingerprint (None when absent).
+
+    No reference counterpart (module docstring)."""
+    path = golden_path(name)
+    if not path.is_file():
+        return None
+    return json.loads(path.read_text())
+
+
+def run_checks(update: bool = False, programs=None, budgets: bool = True,
+               budget_extra=None) -> TraceResult:
+    """Run the gate.  ``update=True`` regenerates the goldens instead of
+    diffing (audits still run: a golden with a dtype leak or a dead
+    donation must not be committable).  ``programs`` optionally restricts
+    the fingerprint/audit passes; ``budgets=False`` skips the workload
+    (the fingerprint-only mode tests use).  ``budget_extra`` is threaded to
+    :func:`~disco_tpu.analysis.trace.budgets.run_workload` (test fixtures).
+
+    No reference counterpart (module docstring).
+    """
+    ensure_cpu()
+
+    from disco_tpu.analysis.trace import audits, fingerprint
+    from disco_tpu.analysis.trace.programs import PROGRAMS
+
+    findings: list = []
+    fps: dict = {}
+    donation: list = []
+    updated: list = []
+
+    selected = {
+        name: spec for name, spec in PROGRAMS.items()
+        if programs is None or name in programs
+    }
+    for name in (programs or ()):
+        if name not in PROGRAMS:
+            raise KeyError(f"unknown program {name!r}; known: {sorted(PROGRAMS)}")
+
+    for name, spec in selected.items():
+        fn, args, kwargs = spec.build()
+        fp = fingerprint.fingerprint_fn(fn, args, kwargs)
+        fps[name] = fp
+        dtype_msgs = audits.audit_dtypes(fp)
+        for msg in dtype_msgs:
+            findings.append(_finding(name, "dtype", msg))
+        if update:
+            if dtype_msgs:
+                # a golden with a dtype leak must not be committable: the
+                # finding fails the run AND the bad fingerprint never
+                # reaches disk, so `git add golden/` cannot smuggle it in
+                findings.append(_finding(
+                    name, "golden",
+                    "refusing to write a golden whose fingerprint fails "
+                    "the dtype audit (fix the program, then --update)",
+                ))
+            else:
+                GOLDEN_DIR.mkdir(parents=True, exist_ok=True)
+                golden_path(name).write_text(fingerprint.dumps(fp))
+                updated.append(name)
+        else:
+            golden = load_golden(name)
+            if golden is None:
+                findings.append(_finding(
+                    name, "golden",
+                    f"no committed golden at {golden_path(name)} — generate "
+                    "one with `disco-trace --update` and commit it",
+                ))
+            else:
+                for line in fingerprint.diff_fingerprints(golden, fp):
+                    findings.append(_finding(name, "fingerprint", line))
+        if spec.donate is not None:
+            rep = audits.audit_donation(spec)
+            donation.append(rep)
+            if not rep["ok"]:
+                findings.append(_finding(
+                    name, "donation",
+                    f"declared donation did not survive lowering: "
+                    f"{rep['aliased']} aliased < min {rep['min_aliased']} "
+                    f"(of {rep['declared_leaves']} donated leaves, "
+                    f"{rep['donor_only']} left as donor hints) on backend "
+                    f"{rep['backend']} — {rep['note']}",
+                ))
+
+    # ship-what-you-validate: on CPU the serve scheduler's step IS the
+    # offline jitted entry point (object identity, not equivalence)
+    if programs is None:
+        from disco_tpu.enhance import streaming
+        from disco_tpu.serve import scheduler
+
+        pairs = (
+            (scheduler._serve_step(), streaming.streaming_tango, "serve_step"),
+            (scheduler._serve_scan_step(), streaming.streaming_tango_scan,
+             "serve_scan_step"),
+        )
+        for got, want, label in pairs:
+            if got is not want:
+                findings.append(_finding(
+                    label, "identity",
+                    "scheduler._resolve_step no longer returns the offline "
+                    "jitted entry point on CPU — serve parity is only true "
+                    "by construction when the program object is shared "
+                    "(scheduler.py)",
+                ))
+
+    budget_counts: dict = {}
+    if budgets and not update:
+        from disco_tpu.analysis.trace import budgets as budgets_mod
+
+        lines, budget_counts = budgets_mod.check_budgets(extra=budget_extra)
+        for line in lines:
+            findings.append(_finding("-", "budget", line))
+
+    return TraceResult(
+        findings=findings, fingerprints=fps, donation=donation,
+        budgets=budget_counts, n_programs=len(selected), updated=updated,
+    )
+
+
+def format_text(result: TraceResult) -> str:
+    """Human-readable gate report (one line per program + findings).
+
+    No reference counterpart (module docstring)."""
+    lines = []
+    # DRIFT marks fingerprint/golden problems only — a donation or dtype
+    # finding on a program whose fingerprint matched must not steer the
+    # reader toward --update
+    bad = {f["program"] for f in result.findings
+           if f["check"] in ("fingerprint", "golden")}
+    for name, fp in result.fingerprints.items():
+        status = "DRIFT" if name in bad else "ok"
+        scans = ",".join(f"unroll={s['unroll']}" for s in fp["scans"]) or "-"
+        lines.append(
+            f"fingerprint {name:<24} {status:>5}  "
+            f"{fp['n_eqns']:>4} eqns  scans[{scans}]  "
+            f"churn={fp['convert_churn']}"
+        )
+    for rep in result.donation:
+        lines.append(
+            f"donation    {rep['program']:<24} "
+            f"{'ok' if rep['ok'] else 'FAIL':>5}  "
+            f"{rep['aliased']}/{rep['declared_leaves']} leaves aliased "
+            f"({rep['donor_only']} donor-only) on {rep['backend']}"
+        )
+    if result.budgets:
+        from disco_tpu.analysis.trace.budgets import BUDGETS
+
+        lines.append("budgets: " + "  ".join(
+            f"{label}={n}/{BUDGETS[label]}"
+            for label, n in sorted(result.budgets.items())
+        ))
+    if result.updated:
+        lines.append("updated goldens: " + ", ".join(result.updated))
+    for f in result.findings:
+        lines.append(f"FINDING [{f['check']}] {f['program']}: {f['message']}")
+    lines.append(
+        f"disco-trace: {len(result.findings)} finding(s), "
+        f"{result.n_programs} program(s) checked"
+    )
+    return "\n".join(lines)
+
+
+def format_json(result: TraceResult) -> str:
+    """Machine-readable report — the ``disco-lint --format json`` contract
+    shape (``clean``/``counts``/``findings`` top-level keys) extended with
+    the per-program payloads.
+
+    No reference counterpart (module docstring)."""
+    per_check: dict = {}
+    for f in result.findings:
+        per_check[f["check"]] = per_check.get(f["check"], 0) + 1
+    return json.dumps(
+        {
+            "clean": result.clean,
+            "counts": {
+                "findings": len(result.findings),
+                "programs": result.n_programs,
+                "by_check": per_check,
+            },
+            "findings": result.findings,
+            "fingerprints": result.fingerprints,
+            "donation": result.donation,
+            "budgets": result.budgets,
+            "updated": result.updated,
+        },
+        indent=2,
+    )
+
+
+def main(argv=None) -> int:
+    """``python -m disco_tpu.analysis.trace.check`` — the ``make
+    trace-check`` entry: full gate, text report, exit 1 on findings.
+
+    No reference counterpart (module docstring)."""
+    result = run_checks()
+    print(format_text(result))
+    return 0 if result.clean else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

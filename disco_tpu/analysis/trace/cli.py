@@ -1,0 +1,83 @@
+"""``disco-trace`` — the program-contract checker's command line.
+
+Exit codes mirror ``disco-lint``: 0 clean, 1 findings, 2 usage error.
+Unlike the linter this tool DOES import jax (it traces programs), but it
+forces the CPU backend before any device use
+(:func:`disco_tpu.analysis.trace.check.ensure_cpu`) so it never claims the
+tunneled chip.
+
+``--update`` regenerates the goldens under ``disco_tpu/analysis/golden/``
+after an *intended* program change; commit them with a message explaining
+WHAT changed in the program and why (doc/source/static_analysis.rst,
+"When to run --update").
+
+No reference counterpart: the reference repo has no static analysis.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    """The disco-trace argument parser (no reference counterpart)."""
+    p = argparse.ArgumentParser(
+        prog="disco-trace",
+        description=(
+            "jaxpr-level program-contract checker: golden fingerprints, "
+            "retrace budgets, donation/dtype audits over the canonical "
+            "hot-path programs (CPU-only by construction)."
+        ),
+    )
+    p.add_argument("--update", action="store_true",
+                   help="regenerate the golden fingerprints instead of "
+                        "diffing (audits still run); commit the result")
+    p.add_argument("--format", choices=("text", "json"), default="text",
+                   help="report format (json is the machine contract)")
+    p.add_argument("--programs", default=None,
+                   help="comma-separated program names to check "
+                        "(default: all; budgets run only on a full check)")
+    p.add_argument("--no-budgets", action="store_true",
+                   help="skip the retrace-budget workload (fingerprints and "
+                        "audits only)")
+    p.add_argument("--list-programs", action="store_true",
+                   help="print the program catalog and exit")
+    return p
+
+
+def main(argv=None) -> int:
+    """Entry point (console script ``disco-trace`` / ``python -m
+    disco_tpu.analysis.trace.cli``).  No reference counterpart."""
+    args = build_parser().parse_args(argv)
+    from disco_tpu.analysis.trace import check
+
+    if args.list_programs:
+        from disco_tpu.analysis.trace.programs import PROGRAMS
+
+        for name, spec in PROGRAMS.items():
+            donate = " [donated]" if spec.donate else ""
+            print(f"{name:<26} {spec.summary}{donate}")
+        return 0
+
+    programs = None
+    if args.programs:
+        programs = {s.strip() for s in args.programs.split(",") if s.strip()}
+    try:
+        result = check.run_checks(
+            update=args.update,
+            programs=programs,
+            budgets=not args.no_budgets and programs is None,
+        )
+    except KeyError as e:
+        print(f"disco-trace: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        print(check.format_json(result))
+    else:
+        print(check.format_text(result))
+    return 0 if result.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,219 @@
+"""Structural fingerprints of traced jaxprs — the identity of a program.
+
+A fingerprint is everything about a traced program that the repo's
+contracts care about and nothing that churns for free:
+
+* the **input/output avals** (shape/dtype signature of the traced unit),
+* the **primitive multiset** and a **sequence hash** over the depth-first
+  walk of every equation (nested ``pjit``/``scan``/``cond`` bodies
+  included) — "same value, different program" (the PR-5 traced-float
+  convention) shows up here as a different sequence,
+* every ``lax.scan``'s ``unroll``/``length`` parameters — the PR-6
+  rolled-scan FMA-drift trap is a one-line ``unroll`` diff,
+* the presence of ``pure_callback``/``io_callback``/``debug_callback``
+  primitives — a hidden host round-trip is a fenced ~80 ms RPC per call on
+  the tunnel (CLAUDE.md), so a callback appearing in a hot-path program is
+  a performance regression even when the numerics are untouched,
+* the **float64/complex128 leaks** (none allowed in the f32 pipeline) and
+  the count of no-op ``convert_element_type`` equations (weak-type churn —
+  each one is a program-identity hazard at a retrace seam).
+
+Variable names, equation source locations and anything else that differs
+between semantically identical traces is deliberately NOT part of the
+fingerprint, so goldens survive refactors that do not change the program.
+
+No reference counterpart: the reference repo has no jit and no traced
+programs to fingerprint.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+
+#: bump when the fingerprint schema changes incompatibly — a version
+#: mismatch against a golden is reported as "regenerate with --update",
+#: not as a program drift
+VERSION = 1
+
+#: callback primitives that smuggle a host round-trip into a traced program
+CALLBACK_PRIMITIVES = ("pure_callback", "io_callback", "debug_callback")
+
+#: dtypes that must never appear in the f32 pipeline's hot-path programs
+_BANNED_DTYPES = ("float64", "complex128")
+
+
+def _subjaxprs(params: dict):
+    """Yield the nested jaxprs of one equation's params (``pjit`` carries a
+    ClosedJaxpr under ``jaxpr``; ``scan``/``while``/``cond`` carry
+    ClosedJaxprs under ``jaxpr``/``cond_jaxpr``/``body_jaxpr``/
+    ``branches``; lists are walked)."""
+    for val in params.values():
+        vals = val if isinstance(val, (list, tuple)) else (val,)
+        for sub in vals:
+            if hasattr(sub, "jaxpr"):        # ClosedJaxpr
+                yield sub.jaxpr
+            elif hasattr(sub, "eqns"):       # raw Jaxpr
+                yield sub
+
+
+def _walk(jaxpr, depth, events):
+    """Depth-first equation walk: append ``(depth, primitive, params)``."""
+    for eqn in jaxpr.eqns:
+        events.append((depth, eqn))
+        for sub in _subjaxprs(eqn.params):
+            _walk(sub, depth + 1, events)
+
+
+def _aval_str(v) -> str:
+    """Stable text form of one variable's aval ('complex64[2,5,8]')."""
+    aval = v.aval
+    shape = ",".join(str(d) for d in getattr(aval, "shape", ()))
+    dtype = getattr(aval, "dtype", None)
+    name = str(dtype) if dtype is not None else type(aval).__name__
+    weak = "~" if getattr(aval, "weak_type", False) else ""
+    return f"{name}{weak}[{shape}]"
+
+
+def fingerprint_jaxpr(closed_jaxpr) -> dict:
+    """Extract the structural fingerprint of one ``ClosedJaxpr``.
+
+    Pure function of the jaxpr object — no tracing, no device, no jax
+    import (it only reads attributes), so it is reusable on any jaxpr a
+    test already has in hand.
+
+    No reference counterpart (module docstring).
+    """
+    jaxpr = closed_jaxpr.jaxpr if hasattr(closed_jaxpr, "jaxpr") else closed_jaxpr
+    events: list = []
+    _walk(jaxpr, 0, events)
+
+    primitives: dict[str, int] = {}
+    scans: list[dict] = []
+    callbacks: list[str] = []
+    convert_churn = 0
+    f64: list[str] = []
+    f64_seen: set = set()
+
+    def note_f64(entry: str) -> None:
+        if entry not in f64_seen:
+            f64_seen.add(entry)
+            f64.append(entry)
+
+    # program INPUTS leak too: an f64 invar consumed straight by a
+    # convert_element_type never shows in any equation's outputs
+    for v in jaxpr.invars:
+        if str(getattr(v.aval, "dtype", "")) in _BANNED_DTYPES:
+            note_f64(f"invar {_aval_str(v)}")
+    seq = hashlib.sha256()
+    for depth, eqn in events:
+        name = eqn.primitive.name
+        primitives[name] = primitives.get(name, 0) + 1
+        seq.update(f"{depth}:{name}\n".encode())
+        if name == "scan":
+            scans.append({
+                "depth": depth,
+                "unroll": int(eqn.params.get("unroll", 1)),
+                "length": int(eqn.params.get("length", 0)),
+            })
+        if name in CALLBACK_PRIMITIVES:
+            callbacks.append(name)
+        if name == "convert_element_type":
+            in_dt = [getattr(v.aval, "dtype", None) for v in eqn.invars
+                     if hasattr(v, "aval")]
+            out_dt = [getattr(v.aval, "dtype", None) for v in eqn.outvars]
+            if in_dt and out_dt and str(in_dt[0]) == str(out_dt[0]):
+                convert_churn += 1  # dtype-preserving: weak-type churn
+        for v in eqn.invars:
+            # closed-over consts and nested-jaxpr inputs surface here
+            if (hasattr(v, "aval")
+                    and str(getattr(v.aval, "dtype", "")) in _BANNED_DTYPES):
+                note_f64(f"{name} <- {_aval_str(v)}")
+        for v in eqn.outvars:
+            dt = str(getattr(v.aval, "dtype", ""))
+            if dt in _BANNED_DTYPES:
+                note_f64(f"{name} -> {_aval_str(v)}")
+
+    return {
+        "version": VERSION,
+        "in_avals": [_aval_str(v) for v in jaxpr.invars],
+        "out_avals": [_aval_str(v) for v in jaxpr.outvars],
+        "n_eqns": len(events),
+        "primitives": dict(sorted(primitives.items())),
+        "sequence_sha256": seq.hexdigest(),
+        "scans": scans,
+        "callbacks": callbacks,
+        "convert_churn": convert_churn,
+        "f64": f64,
+    }
+
+
+def fingerprint_fn(fn, args, kwargs=None) -> dict:
+    """Trace ``fn`` on abstract inputs (``jax.ShapeDtypeStruct`` pytrees —
+    no FLOP runs, no device buffer is touched) and fingerprint the jaxpr.
+
+    No reference counterpart (module docstring).
+    """
+    import jax
+
+    kwargs = kwargs or {}
+    closed = jax.make_jaxpr(lambda *a: fn(*a, **kwargs))(*args)
+    return fingerprint_jaxpr(closed)
+
+
+def diff_fingerprints(golden: dict, current: dict) -> list:
+    """Readable primitive-level differences, empty when identical.
+
+    The report names WHAT drifted (primitive counts, scan unrolls, avals,
+    callbacks, dtype hygiene), so a failing gate points at the change
+    instead of just two hashes.
+
+    No reference counterpart (module docstring).
+    """
+    out: list[str] = []
+    if golden.get("version") != current.get("version"):
+        return [
+            f"fingerprint schema version {golden.get('version')} != "
+            f"{current.get('version')}: regenerate goldens with "
+            "`disco-trace --update`"
+        ]
+    for key in ("in_avals", "out_avals"):
+        if golden.get(key) != current.get(key):
+            out.append(f"{key}: {golden.get(key)} -> {current.get(key)}")
+    gp, cp = golden.get("primitives", {}), current.get("primitives", {})
+    for prim in sorted(set(gp) | set(cp)):
+        a, b = gp.get(prim, 0), cp.get(prim, 0)
+        if a != b:
+            out.append(f"primitive {prim}: {a} -> {b} ({b - a:+d})")
+    if golden.get("scans") != current.get("scans"):
+        out.append(f"scans (depth/unroll/length): {golden.get('scans')} -> "
+                   f"{current.get('scans')}")
+    if golden.get("callbacks") != current.get("callbacks"):
+        out.append(
+            f"host callbacks: {golden.get('callbacks')} -> "
+            f"{current.get('callbacks')} (each is a hidden ~80 ms tunnel RPC)"
+        )
+    if golden.get("convert_churn") != current.get("convert_churn"):
+        out.append(f"dtype-preserving convert_element_type count: "
+                   f"{golden.get('convert_churn')} -> {current.get('convert_churn')}"
+                   " (weak-type churn)")
+    if golden.get("f64") != current.get("f64"):
+        out.append(f"float64/complex128 leaks: {golden.get('f64')} -> "
+                   f"{current.get('f64')}")
+    if (not out and golden.get("sequence_sha256") != current.get("sequence_sha256")):
+        out.append(
+            "primitive sequence reordered (same multiset, different order): "
+            f"{golden.get('sequence_sha256', '')[:12]} -> "
+            f"{current.get('sequence_sha256', '')[:12]}"
+        )
+    if (not out and golden.get("n_eqns") != current.get("n_eqns")):
+        out.append(f"n_eqns: {golden.get('n_eqns')} -> {current.get('n_eqns')}")
+    return out
+
+
+def dumps(fp: dict) -> str:
+    """Canonical JSON text of a fingerprint (sorted keys, indented — the
+    committed golden format, reviewable in a PR diff).
+
+    No reference counterpart (module docstring).
+    """
+    return json.dumps(fp, indent=2, sort_keys=True) + "\n"

@@ -1,0 +1,245 @@
+"""The canonical hot-path program catalog the trace gate fingerprints.
+
+One :class:`ProgramSpec` per program the repo actually ships: the offline
+two-step TANGO units (``tango_step1``/``tango_step2``), the streaming
+per-block body via its public ``streaming_tango`` entry (warm-start AND
+continuation-state variants — the continuation program is what the serve
+scheduler dispatches every tick), the scanned super-tick driver
+(``streaming_tango_scan``), and the corpus driver's per-chunk batch
+programs (``run_batch``/``run_batch_with_masks``, built through the SAME
+:func:`disco_tpu.enhance.driver.make_batch_runners` factory the driver
+uses).  Declared ``ShapeDtypeStruct`` inputs keep tracing abstract: no
+FLOP runs, no device buffer is allocated, no chip claim is needed beyond
+the jax import itself (the check forces the CPU backend first —
+:mod:`disco_tpu.analysis.trace.check`).
+
+The shapes are deliberately tiny (they only need to be *structurally*
+representative: K nodes exchanging z, refresh-aligned blocks, a batch
+axis); the fingerprint records primitives and parameters, not work sizes.
+Statics are pinned (``solver="power"``, ``cov_impl="xla"``) so the traced
+program is identical on every backend — ``cov_impl="auto"`` resolves per
+backend and would make the golden depend on where it was generated.
+
+No reference counterpart: the reference repo has no traced programs.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+# -- canonical abstract shapes (structural, not workload-sized) -------------
+K = 2          #: nodes
+C = 2          #: mics per node
+F = 5          #: frequency bins
+T = 8          #: frames per block (a multiple of UPDATE_EVERY)
+B = 2          #: clip batch of the corpus runners
+UPDATE_EVERY = 4
+BLOCKS_PER_DISPATCH = 2  #: super-tick width of the scanned program
+
+#: statics pinned backend-independent (module docstring)
+SOLVER = "power"
+COV_IMPL = "xla"
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramSpec:
+    """One fingerprinted program: a ``build()`` returning ``(fn, args,
+    kwargs)`` with ``args`` a tuple of ``ShapeDtypeStruct`` pytrees (traced
+    positionally) and ``kwargs`` the pinned statics, plus the declared
+    donation contract for the audit pass.
+
+    ``donate``: ``None`` or a dict with ``argnums``/``argnames`` (the
+    declaration the production call site uses off-CPU), ``min_aliased``
+    (how many donated leaves must survive to input-output aliasing in the
+    lowered module) and ``must_alias`` (hard-fail when aliasing is absent
+    vs. report-only on backends known to drop it).
+    """
+
+    name: str
+    summary: str
+    build: callable
+    donate: dict | None = None
+
+
+def _c64(*shape):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.ShapeDtypeStruct(shape, jnp.complex64)
+
+
+def _f32(*shape):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+
+def _state_structs():
+    """The streaming continuation carry as a ShapeDtypeStruct pytree —
+    exactly ``initial_stream_state``'s structure (the serve session carry).
+
+    No reference counterpart (module docstring)."""
+    import jax
+
+    from disco_tpu.enhance.streaming import initial_stream_state
+
+    state = initial_stream_state(K, C, F, update_every=UPDATE_EVERY)
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state
+    )
+
+
+def _build_tango_step1():
+    from disco_tpu.enhance.tango import tango_step1
+
+    args = (_c64(C, F, T), _c64(C, F, T), _c64(C, F, T), _f32(F, T))
+    return tango_step1, args, {"solver": SOLVER, "cov_impl": COV_IMPL}
+
+
+def _build_tango_step2():
+    import jax
+    import jax.numpy as jnp
+
+    from disco_tpu.enhance.tango import tango_step2
+
+    all_z = {key: _c64(K, F, T)
+             for key in ("z_y", "z_s", "z_n", "zn", "z_t1_s", "z_t1_n")}
+    args = (
+        _c64(C, F, T), _c64(C, F, T), _c64(C, F, T), _f32(F, T),
+        jax.ShapeDtypeStruct((), jnp.int32),          # traced node index k
+        all_z, _f32(K, F, T), _c64(K, F, T), _c64(K, F, T),
+    )
+    return tango_step2, args, {
+        "policy": "local", "solver": SOLVER, "cov_impl": COV_IMPL,
+    }
+
+
+def _streaming_args():
+    return (_c64(K, C, F, T), _f32(K, F, T), _f32(K, F, T))
+
+
+def _build_streaming_tango():
+    from disco_tpu.enhance import streaming
+
+    return (streaming.streaming_tango.__wrapped__, _streaming_args(),
+            {"update_every": UPDATE_EVERY, "solver": "eigh"})
+
+
+def _build_streaming_tango_state():
+    from disco_tpu.enhance import streaming
+
+    def with_state(Y, mz, mw, state):
+        return streaming.streaming_tango.__wrapped__(
+            Y, mz, mw, update_every=UPDATE_EVERY, solver="eigh", state=state
+        )
+
+    return with_state, (*_streaming_args(), _state_structs()), {}
+
+
+def _build_streaming_tango_scan():
+    from disco_tpu.enhance import streaming
+
+    n = BLOCKS_PER_DISPATCH
+    args = (_c64(K, C, F, n * T), _f32(K, F, n * T), _f32(K, F, n * T))
+    return (streaming.streaming_tango_scan.__wrapped__, args,
+            {"update_every": UPDATE_EVERY, "solver": "eigh",
+             "blocks_per_dispatch": n})
+
+
+def _batch_args(with_masks: bool):
+    stack = (_c64(B, K, C, F, T),) * 3
+    return stack + ((_f32(B, K, F, T), _f32(B, K, F, T)) if with_masks else ())
+
+
+def _build_run_batch():
+    from disco_tpu.enhance.driver import make_batch_runners
+
+    run_batch, _ = make_batch_runners(
+        mask_type="irm1", mu=1.0, policy="local", solver=SOLVER,
+        cov_impl=COV_IMPL, n_nodes=K,
+    )
+    return run_batch.__wrapped__, _batch_args(with_masks=False), {}
+
+
+def _build_run_batch_with_masks():
+    from disco_tpu.enhance.driver import make_batch_runners
+
+    _, run_batch_with_masks = make_batch_runners(
+        mask_type="irm1", mu=1.0, policy="local", solver=SOLVER,
+        cov_impl=COV_IMPL, n_nodes=K,
+    )
+    return run_batch_with_masks.__wrapped__, _batch_args(with_masks=True), {}
+
+
+#: name -> ProgramSpec, in documentation order (the golden catalog)
+PROGRAMS: dict = {
+    spec.name: spec
+    for spec in (
+        ProgramSpec(
+            "tango_step1",
+            "offline step-1 local MWF at one node (enhance/tango.py)",
+            _build_tango_step1,
+        ),
+        ProgramSpec(
+            "tango_step2",
+            "offline step-2 global MWF on [y_k ‖ z_j≠k] (enhance/tango.py)",
+            _build_tango_step2,
+        ),
+        ProgramSpec(
+            "streaming_tango",
+            "per-block streaming body, warm start (enhance/streaming.py)",
+            _build_streaming_tango,
+        ),
+        ProgramSpec(
+            "streaming_tango_state",
+            "per-block streaming body with continuation state — the program "
+            "the serve scheduler dispatches every tick",
+            _build_streaming_tango_state,
+            donate={
+                "argnames": ("state",),
+                # the 6 step1/step2 covariance+filter leaves alias in place;
+                # the 4 fault-hold leaves are dead without z_avail and
+                # legitimately cannot alias
+                "min_aliased": 6,
+                "must_alias": True,
+                "note": "serve _resolve_step donates the session carry "
+                        "off-CPU (scheduler.py); aliasing holds on CPU too",
+            },
+        ),
+        ProgramSpec(
+            "streaming_tango_scan",
+            f"scanned super-tick driver, N={BLOCKS_PER_DISPATCH} "
+            "(enhance/streaming.py) — the unroll=N contract",
+            _build_streaming_tango_scan,
+        ),
+        ProgramSpec(
+            "run_batch",
+            "corpus per-chunk batch program, oracle masks (enhance/driver.py "
+            "make_batch_runners)",
+            _build_run_batch,
+            donate={
+                "argnums": (0, 1, 2),
+                # the (Yb, Sb, Nb) stacks donate whole buffers; XLA aliases
+                # what it can and keeps the rest as donor hints — presence
+                # is report-only (CPU and some backends drop donation)
+                "min_aliased": 0,
+                "must_alias": False,
+                "note": "driver donates the STFT stacks off-CPU "
+                        "(make_batch_runners)",
+            },
+        ),
+        ProgramSpec(
+            "run_batch_with_masks",
+            "corpus per-chunk batch program, masks passed in "
+            "(enhance/driver.py make_batch_runners)",
+            _build_run_batch_with_masks,
+            donate={
+                "argnums": (0, 1, 2),
+                "min_aliased": 0,
+                "must_alias": False,
+                "note": "driver donates the STFT stacks off-CPU "
+                        "(make_batch_runners)",
+            },
+        ),
+    )
+}

@@ -107,11 +107,29 @@ def summarize(events: list[dict]) -> dict:
             "batch_occupancy": gvals.get("batch_occupancy"),
             "latency_ms": histograms.get("serve_block_latency_ms"),
         }
+    # -- per-label recompile table: the log's own jit_trace events are the
+    # run's truth (per-log scope); the jit_recompiles{label} counter series
+    # (obs.accounting.recompile_label) from the final snapshot only fills
+    # in labels with no events — the snapshot is PROCESS-cumulative, so for
+    # a log opened mid-process it over-counts labels the run retraced.
+    by_label: dict[str, int] = {}
+    for e in events:
+        if e["kind"] == "jit_trace":
+            by_label[e["stage"]] = by_label.get(
+                e["stage"], 0
+            ) + int(e["attrs"].get("n_new_programs", 1))
+    for name, v in (cvals or {}).items():
+        # zero-valued series carry no recompile to report (defensive: a
+        # stray created-but-never-incremented counter must not render)
+        if (name.startswith("jit_recompiles{") and name.endswith("}")
+                and int(v) > 0):
+            by_label.setdefault(name[len("jit_recompiles{"):-1], int(v))
     return {
         "manifest": manifest["attrs"] if manifest else None,
         "stages": dict(sorted(stages.items(), key=lambda kv: -kv[1]["total_s"])),
         "counters": counters,
         "recompiles": [e for e in events if e["kind"] == "jit_trace"],
+        "recompiles_by_label": dict(sorted(by_label.items())),
         "sentinels": [e for e in events if e["kind"] == "sentinel"],
         "epochs": [e for e in events if e["kind"] == "epoch"],
         "clips": sum(1 for e in events if e["kind"] == "clip"),
@@ -201,16 +219,15 @@ def render_report(summary: dict) -> str:
                 f"p95={fmtg(lat.get('p95'))}  p99={fmtg(lat.get('p99'))}  "
                 f"max={fmtg(lat.get('max'))} over {lat['count']} blocks"
             )
-    if summary["recompiles"]:
-        by_label: dict[str, int] = {}
-        for e in summary["recompiles"]:
-            by_label[e["stage"]] = by_label.get(e["stage"], 0) + int(
-                e["attrs"].get("n_new_programs", 1)
-            )
-        lines.append(
-            "recompiles: "
-            + "  ".join(f"{k}×{v}" for k, v in sorted(by_label.items()))
-        )
+    by_label = summary.get("recompiles_by_label") or {}
+    if by_label:
+        # per-label table (the jit_recompiles{label} counter series): which
+        # entry point traced how many programs — the first thing to read
+        # when `make trace-check`'s budget gate names a label
+        lines.append("")
+        lines.append(f"{'recompiled programs':<28}{'programs':>9}")
+        for label, n in sorted(by_label.items()):
+            lines.append(f"{label:<28}{n:>9}")
     def fmt6(v):
         # the schema admits any attrs dict; the reader must render partial
         # epoch events, not crash on a missing loss

@@ -655,6 +655,95 @@ def _batched_masks(Yb, Sb, Nb, models, mask_type, mu, n_nodes, z_sigs):
     return Mz, Mw
 
 
+def make_batch_runners(
+    *,
+    mask_type: str = "irm1",
+    mu: float = 1.0,
+    policy: str = "local",
+    solver: str = "power",
+    cov_impl: str = "auto",
+    z_mask_arr=None,
+    z_nan_arr=None,
+    n_nodes: int = 4,
+    mesh=None,
+):
+    """Build the per-chunk batch programs of :func:`enhance_rirs_batched`:
+    ``(run_batch, run_batch_with_masks)`` over (B, K, C, F, T) STFT stacks
+    (oracle masks computed in-program vs. masks passed in).
+
+    Hoisted out of :func:`enhance_rirs_batched` so the corpus driver and the
+    program-contract checker (``disco_tpu.analysis.trace``) construct the
+    SAME jitted entry points — the golden-fingerprint gate traces exactly
+    what the driver dispatches, not a re-implementation.
+
+    Single-device (``mesh=None``): one ``counted_jit`` per runner — each
+    length bucket (and each remainder-chunk padded size) traces a fresh
+    program, visible in `obs report` via the ``run_batch`` /
+    ``run_batch_with_masks`` labels.  The (Yb, Sb, Nb) STFT stacks are
+    donated off-CPU: they are rebuilt per chunk and never touched after
+    dispatch, so XLA can reuse their HBM for the outputs instead of
+    doubling the footprint (CPU ignores donation with a warning per
+    program — skip it there).  With a ``mesh``, the runners route through
+    ``disco_tpu.parallel.tango_batch_sharded`` instead.
+
+    No reference counterpart: the reference enhances one clip per process
+    (tango.py:460-641) and has no batched corpus driver.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if mesh is not None:
+        from disco_tpu.parallel import tango_batch_sharded
+
+        # jitted ONCE (not per chunk — a fresh lambda per call would defeat
+        # the jit cache and re-compile the mask program every chunk)
+        oracle_mask_fn = obs_accounting.counted_jit(
+            jax.vmap(partial(oracle_masks, mask_type=mask_type)), label="oracle_masks_batched"
+        )
+
+        def run_batch_with_masks(Yb, Sb, Nb, Mz, Mw):
+            zmb = znb = None
+            if z_mask_arr is not None:
+                B = Yb.shape[0]
+                zmb = jnp.broadcast_to(jnp.asarray(z_mask_arr), (B, n_nodes))
+                if z_nan_arr is not None:
+                    znb = jnp.broadcast_to(jnp.asarray(z_nan_arr), (B, n_nodes))
+            return tango_batch_sharded(
+                Yb, Sb, Nb, Mz, Mw, mesh, mu=mu, policy=policy,
+                mask_type=mask_type, solver=solver, cov_impl=cov_impl,
+                z_mask_b=zmb, z_nan_b=znb,
+            )
+
+        def run_batch(Yb, Sb, Nb):
+            Mb = oracle_mask_fn(Sb, Nb)
+            return run_batch_with_masks(Yb, Sb, Nb, Mb, Mb)
+
+        return run_batch, run_batch_with_masks
+
+    donate = (0, 1, 2) if jax.default_backend() != "cpu" else ()
+
+    @obs_accounting.counted_jit(label="run_batch", donate_argnums=donate)
+    def run_batch(Yb, Sb, Nb):
+        def one(Y, S, N):
+            m = oracle_masks(S, N, mask_type)
+            return tango(Y, S, N, m, m, mu=mu, policy=policy, mask_type=mask_type,
+                         solver=solver, cov_impl=cov_impl,
+                         z_mask=z_mask_arr, z_nan=z_nan_arr)
+
+        return jax.vmap(one)(Yb, Sb, Nb)
+
+    @obs_accounting.counted_jit(label="run_batch_with_masks", donate_argnums=donate)
+    def run_batch_with_masks(Yb, Sb, Nb, Mz, Mw):
+        def one(Y, S, N, mz, mw):
+            return tango(Y, S, N, mz, mw, mu=mu, policy=policy, mask_type=mask_type,
+                         solver=solver, cov_impl=cov_impl,
+                         z_mask=z_mask_arr, z_nan=z_nan_arr)
+
+        return jax.vmap(one)(Yb, Sb, Nb, Mz, Mw)
+
+    return run_batch, run_batch_with_masks
+
+
 def enhance_rirs_batched(
     root: str,
     scenario: str,
@@ -851,59 +940,11 @@ def enhance_rirs_batched(
         Lp = bucket_length(L, bucket) if bucket else L
         groups.setdefault(Lp, []).append((rir, out, layout))
 
-    if mesh is not None:
-        from disco_tpu.parallel import tango_batch_sharded
-
-        # jitted ONCE (not per chunk — a fresh lambda per call would defeat
-        # the jit cache and re-compile the mask program every chunk)
-        oracle_mask_fn = obs_accounting.counted_jit(
-            jax.vmap(partial(oracle_masks, mask_type=mask_type)), label="oracle_masks_batched"
-        )
-
-        def run_batch_with_masks(Yb, Sb, Nb, Mz, Mw):
-            zmb = znb = None
-            if z_mask_arr is not None:
-                B = Yb.shape[0]
-                zmb = jnp.broadcast_to(jnp.asarray(z_mask_arr), (B, n_nodes))
-                if z_nan_arr is not None:
-                    znb = jnp.broadcast_to(jnp.asarray(z_nan_arr), (B, n_nodes))
-            return tango_batch_sharded(
-                Yb, Sb, Nb, Mz, Mw, mesh, mu=mu, policy=policy,
-                mask_type=mask_type, solver=solver, cov_impl=cov_impl,
-                z_mask_b=zmb, z_nan_b=znb,
-            )
-
-        def run_batch(Yb, Sb, Nb):
-            Mb = oracle_mask_fn(Sb, Nb)
-            return run_batch_with_masks(Yb, Sb, Nb, Mb, Mb)
-    else:
-        # counted_jit: each length bucket (and each remainder-chunk padded
-        # size) traces a fresh program — the recompile counter makes that
-        # compile tax visible in `obs report` instead of folded into chunk 1's
-        # wall time.  The (Yb, Sb, Nb) STFT stacks are donated off-CPU: they
-        # are rebuilt per chunk and never touched after dispatch, so XLA can
-        # reuse their HBM for the outputs instead of doubling the footprint
-        # (CPU ignores donation with a warning per program — skip it there).
-        donate = (0, 1, 2) if jax.default_backend() != "cpu" else ()
-
-        @obs_accounting.counted_jit(label="run_batch", donate_argnums=donate)
-        def run_batch(Yb, Sb, Nb):
-            def one(Y, S, N):
-                m = oracle_masks(S, N, mask_type)
-                return tango(Y, S, N, m, m, mu=mu, policy=policy, mask_type=mask_type,
-                             solver=solver, cov_impl=cov_impl,
-                             z_mask=z_mask_arr, z_nan=z_nan_arr)
-
-            return jax.vmap(one)(Yb, Sb, Nb)
-
-        @obs_accounting.counted_jit(label="run_batch_with_masks", donate_argnums=donate)
-        def run_batch_with_masks(Yb, Sb, Nb, Mz, Mw):
-            def one(Y, S, N, mz, mw):
-                return tango(Y, S, N, mz, mw, mu=mu, policy=policy, mask_type=mask_type,
-                             solver=solver, cov_impl=cov_impl,
-                             z_mask=z_mask_arr, z_nan=z_nan_arr)
-
-            return jax.vmap(one)(Yb, Sb, Nb, Mz, Mw)
+    run_batch, run_batch_with_masks = make_batch_runners(
+        mask_type=mask_type, mu=mu, policy=policy, solver=solver,
+        cov_impl=cov_impl, z_mask_arr=z_mask_arr, z_nan_arr=z_nan_arr,
+        n_nodes=n_nodes, mesh=mesh,
+    )
 
     from collections import deque
     from concurrent.futures import ThreadPoolExecutor

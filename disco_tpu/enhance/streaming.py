@@ -158,7 +158,11 @@ def _block_covariances(XSb, XNb, lam, Rss0=None, Rnn0=None):
             Rss_e, Rnn_e = Rss_r, Rnn_r
         return (Rss_e, Rnn_e), (Rss_r, Rnn_r)
 
-    return jax.lax.scan(body, (Rss0, Rnn0), (XSb, XNb))
+    # unroll=1 (explicit, DL011): this recursion runs identically inside the
+    # per-block program and the scanned super-tick body, so its rolled form
+    # cancels in the bit-exactness comparison — rolled is the deliberate
+    # choice (smaller program, no parity exposure).
+    return jax.lax.scan(body, (Rss0, Rnn0), (XSb, XNb), unroll=1)
 
 
 def _stream_filter(X, XS, XN, lam, u, mu, ref: int = 0, extras=None, init_state=None,
@@ -219,7 +223,9 @@ def _stream_filter(X, XS, XN, lam, u, mu, ref: int = 0, extras=None, init_state=
         wb = jnp.where(ok, wb, prev)
         return wb, wb
 
-    _, w = jax.lax.scan(ffill, e_ref, w)
+    # unroll=1 (explicit, DL011): same in both gated paths — see
+    # _block_covariances.
+    _, w = jax.lax.scan(ffill, e_ref, w, unroll=1)
     out = jnp.einsum("bfd,bufd->buf", jnp.conj(w), Xb).reshape(B * u, F)[:T]
     if extras is not None:
         # Apply the SAME per-block filters to auxiliary streams (clean
@@ -346,7 +352,9 @@ def hold_last_good(z, avail, update_every: int, fallback=None, carry=None,
         return (out, seen | a), out
 
     init = (jnp.zeros_like(zb[0]), jnp.zeros(K, bool)) if carry is None else carry
-    carry_out, held = jax.lax.scan(step, init, (zb, fb, ok))
+    # unroll=1 (explicit, DL011): pure jnp.where selects — no FMA to
+    # reassociate — and identical in both gated paths.
+    carry_out, held = jax.lax.scan(step, init, (zb, fb, ok), unroll=1)
     out = jnp.moveaxis(held, 0, 2).reshape(K, F, B * u)[..., :T]
     return (out, carry_out) if return_carry else out
 

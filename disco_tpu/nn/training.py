@@ -29,7 +29,7 @@ from flax.training import train_state
 
 from disco_tpu.nn.losses import reconstruction_loss
 from disco_tpu.obs import events as obs_events
-from disco_tpu.obs.accounting import counted_jit
+from disco_tpu.obs.accounting import counted_jit, recompile_count
 from disco_tpu.obs.metrics import REGISTRY as obs_registry
 from disco_tpu.utils.transfer import prefetch_to_device
 
@@ -277,7 +277,11 @@ def fit(
         run_name = run_name or get_model_name()
 
     gate = SaveAndStop(patience=patience if patience is not None else n_epochs, mode="min")
-    recompiles0 = obs_registry.counter("jit_recompiles").value
+    # Per-label counts, not the process-wide total: an unrelated retrace
+    # elsewhere (e.g. an enhancement pass sharing the process) must not be
+    # charged to an epoch's `recompiles` attribute.
+    _fit_recompiles = lambda: recompile_count("train_step") + recompile_count("eval_step")
+    recompiles0 = _fit_recompiles()
     interrupted = False
     for epoch in range(first_epoch, first_epoch + n_epochs):
         if run_interrupt.stop_requested():
@@ -311,7 +315,7 @@ def fit(
         obs_registry.gauge("train_loss").set(train_losses[epoch])
         obs_registry.gauge("val_loss").set(val_losses[epoch])
         if obs_events.enabled():
-            recompiles = obs_registry.counter("jit_recompiles").value
+            recompiles = _fit_recompiles()
             obs_events.record(
                 "epoch", stage="train", epoch=int(epoch),
                 train_loss=train_losses[epoch], val_loss=val_losses[epoch],

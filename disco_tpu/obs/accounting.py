@@ -58,9 +58,29 @@ def fence_count_thread() -> int:
     return getattr(_TLS, "count", 0)
 
 
-def recompile_count() -> int:
-    """Process-wide ``counted_jit`` recompile count."""
-    return _RECOMPILES.value
+def recompile_label(label: str) -> str:
+    """Counter name of one label's recompile count
+    (``jit_recompiles{label}`` — the Prometheus labeled-series convention,
+    flattened into the flat registry namespace)."""
+    return f"jit_recompiles{{{label}}}"
+
+
+def recompile_count(label: str | None = None) -> int:
+    """``counted_jit`` recompile count — process-wide, or one label's.
+
+    The per-label series (``jit_recompiles{label}``) is what the trace-
+    budget auditor (``disco_tpu.analysis.trace.budgets``) diffs: a budget is
+    declared per entry-point label, so the process-wide total — which mixes
+    every entry point — cannot arbitrate which label blew its budget.
+    ``nn.training.fit`` diffs its own labels for the same reason: an
+    unrelated retrace elsewhere in the process must not show up in an epoch
+    event as a training-step recompile.
+    """
+    if label is None:
+        return _RECOMPILES.value
+    # peek, don't create: a label that never recompiled must not grow a
+    # zero-valued counter into every later counters snapshot
+    return _metrics.REGISTRY.peek_counter(recompile_label(label))
 
 
 _DEVICE_GETS = _metrics.REGISTRY.counter("device_get_batches")
@@ -126,6 +146,9 @@ def counted_jit(fun=None, *, label: str | None = None, **jit_kwargs):
         after = _cache_size(jitted)
         if before is not None and after is not None and after > before:
             _RECOMPILES.inc(after - before)
+            # per-label series alongside the process-wide total: budgets and
+            # the report table are per entry point (see recompile_count)
+            _metrics.REGISTRY.counter(recompile_label(name)).inc(after - before)
             _events.record("jit_trace", stage=name, n_new_programs=after - before,
                            cache_size=after)
         return out

@@ -155,6 +155,15 @@ class Registry:
                 self._counters[name] = Counter(name)
             return self._counters[name]
 
+    def peek_counter(self, name: str) -> int:
+        """A counter's value WITHOUT creating it (0 when absent).  Readers
+        (``accounting.recompile_count``) must not materialize zero-valued
+        instruments as a side effect — every counter created here appears
+        in ``snapshot()`` and therefore in every later report."""
+        with self._lock:
+            c = self._counters.get(name)
+            return c.value if c is not None else 0
+
     def gauge(self, name: str) -> Gauge:
         with self._lock:
             if name not in self._gauges:

@@ -30,8 +30,8 @@ def rule_ids(res):
 # -- registry ----------------------------------------------------------------
 def test_rule_catalog_shape():
     rules = analysis.get_rules()
-    assert len(rules) == 10
-    assert sorted(rules) == [f"DL{i:03d}" for i in range(1, 11)]
+    assert len(rules) == 11
+    assert sorted(rules) == [f"DL{i:03d}" for i in range(1, 12)]
     for rid, rule in rules.items():
         assert rule.id == rid and rule.name and rule.summary
 
@@ -303,6 +303,39 @@ def test_dl010_near_misses():
     accounting.fence_tick()          # different function
     """
     assert rule_ids(lint(src, "disco_tpu/enhance/foo.py", rules={"DL010"})) == []
+
+
+# -- DL011 scan-unroll -------------------------------------------------------
+def test_dl011_flags_scan_without_unroll_in_gated_modules():
+    src = """
+    import jax
+    def f(xs):
+        return jax.lax.scan(body, init, xs)
+    """
+    for rel in ("disco_tpu/enhance/streaming.py", "disco_tpu/serve/scheduler.py"):
+        res = lint(src, rel, rules={"DL011"})
+        assert rule_ids(res) == ["DL011"], rel
+    # bare from-import form too
+    src2 = "from jax.lax import scan\nscan(body, init, xs)\n"
+    assert rule_ids(lint(src2, "disco_tpu/enhance/streaming.py",
+                         rules={"DL011"})) == ["DL011"]
+
+
+def test_dl011_near_misses():
+    # explicit unroll (either choice) is the point of the rule
+    src = """
+    import jax
+    jax.lax.scan(body, init, xs, unroll=4)
+    jax.lax.scan(body, init, xs, unroll=1)
+    sched.scan(job)                      # a different .scan API
+    """
+    assert rule_ids(lint(src, "disco_tpu/enhance/streaming.py",
+                         rules={"DL011"})) == []
+    # non-gated modules may scan however they like (their outputs are not
+    # bit-exactness-gated against a per-block reference)
+    src2 = "import jax\njax.lax.scan(body, init, xs)\n"
+    assert rule_ids(lint(src2, "disco_tpu/enhance/tango.py",
+                         rules={"DL011"})) == []
 
 
 def test_registries_extracted_from_source():

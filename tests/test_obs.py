@@ -357,9 +357,14 @@ def test_obs_report_renders_stage_table_and_fences(tmp_path, capsys):
     assert summary["n_fences"] >= 6
     assert summary["est_rpc_s"] == pytest.approx(summary["n_fences"] * 0.08)
     assert summary["clips"] == 1
+    # the per-label recompile table may carry OTHER labels too (the
+    # counters snapshot is the live process registry — earlier counted_jit
+    # tests legitimately appear), so pin the run_batch row, not the table
     for token in ("stft", "masks", "mwf", "istft", "fences:", "SENTINEL",
-                  "recompiles: run_batch×1"):
+                  "recompiled programs"):
         assert token in out, token
+    (row,) = [ln for ln in out.splitlines() if ln.startswith("run_batch ")]
+    assert row.split()[-1] == "1"
 
 
 def test_obs_report_serve_section(tmp_path, capsys):
