@@ -14,8 +14,11 @@ test: lint-check trace-check obs-check fault-check chaos-check perf-check stream
 # readbacks (DL002), complex-safe transfers (DL003), atomic-only artifact
 # writes (DL004), jax-free serve client / lazy-jax CLIs (DL005), reference
 # citations (DL006), traced-float literals (DL007), never-SIGKILL (DL008),
-# registered obs kinds / chaos seams (DL009/DL010).  Zero unsuppressed
-# findings, and every suppression must carry a justification (DL000).
+# registered obs kinds / chaos seams (DL009/DL010), explicit scan unroll
+# in the bit-exactness-gated modules (DL011), and fused-magnitude /
+# precision-seam discipline (DL012: no abs(stft(...)), no bfloat16
+# literals outside ops/).  Zero unsuppressed findings, and every
+# suppression must carry a justification (DL000).
 # Hermetic by construction: the linter is stdlib-only and never touches
 # the chip claim (doc/source/static_analysis.rst).
 lint-check:

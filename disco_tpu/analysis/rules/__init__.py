@@ -14,6 +14,7 @@ is registration order):
 * DL009 ``obs-event-kind``        — :mod:`.registered`
 * DL010 ``chaos-seam``            — :mod:`.registered`
 * DL011 ``scan-unroll``           — :mod:`.scanunroll`
+* DL012 ``fused-magnitude-precision`` — :mod:`.magnitude`
 
 (DL000 ``lint-suppression`` is the engine's own hygiene rule — see
 :mod:`disco_tpu.analysis.suppressions`.)
@@ -24,6 +25,7 @@ from disco_tpu.analysis.rules import (  # noqa: F401  (import = register)
     atomicio,
     citations,
     fence,
+    magnitude,
     purity,
     readback,
     registered,

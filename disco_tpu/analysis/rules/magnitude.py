@@ -1,0 +1,101 @@
+"""DL012 — magnitude recomputation and precision-cast literals stay in ops/.
+
+The hot-path fusion round gave the analysis stage a fused spec+magnitude
+STFT (``ops.stft_ops.stft_with_mag``: the magnitude is computed in VMEM
+while the re/im tiles are resident) and a ``precision=`` compute-lane seam
+(``ops.resolve``: 'f32'/'bf16', canonicalized once, threaded as a static
+argument through tango/streaming/driver).  Two call-site shapes silently
+undo those seams:
+
+* ``jnp.abs(stft(...))`` — recomputing the magnitude from a fresh STFT is
+  exactly the separate abs-pass-over-HBM the fused kernel deletes, and it
+  bypasses the ``stft_impl`` resolution (the caller gets whatever ``stft``
+  alone resolves to, with a second read of the spec).
+* dtype-cast literals (``x.astype("bfloat16")``, ``dtype=jnp.bfloat16``) —
+  a hand-rolled precision change outside ops/ creates a lane the
+  ``precision=`` seam doesn't know about: it escapes the oracle-tolerance
+  gates, and as a non-canonical static value it is the string-typed twin
+  of the PR-6 ``mu=1`` retrace trap.
+
+Inside ``disco_tpu/ops/`` both shapes are the implementation itself (the
+'xla' lane of ``stft_with_mag`` IS ``abs(stft(...))``; the bf16 casts live
+in the kernels) — the rule exempts it.
+
+No reference counterpart: the reference has one STFT path and one dtype
+(float64 numpy).
+"""
+from __future__ import annotations
+
+import ast
+
+from disco_tpu.analysis.context import attr_chain
+from disco_tpu.analysis.registry import Rule, register
+
+#: callables whose result is a complex spectrogram the fused kernel already
+#: pairs with a magnitude
+_STFT_NAMES = ("stft", "_stft_rfft", "stft_matmul", "stft_pallas",
+               "stft_fused", "stft_with_mag")
+
+#: the magnitude callables the recomputation shape goes through
+_ABS_NAMES = ("abs", "absolute")
+
+
+def _is_bf16_literal(node) -> bool:
+    """True for the literal spellings of a bfloat16 dtype: the string
+    ``"bfloat16"``/``"bf16"`` or an attribute chain ending in ``bfloat16``
+    (``jnp.bfloat16``, ``np.bfloat16``, ...).
+
+    No reference counterpart (module docstring)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value.strip().lower() in ("bfloat16", "bf16")
+    chain = attr_chain(node)
+    return bool(chain) and chain[-1] == "bfloat16"
+
+
+@register
+class MagnitudePrecisionSeam(Rule):
+    id = "DL012"
+    name = "fused-magnitude-precision"
+    summary = ("jnp.abs(stft(...)) magnitude recomputation or a bfloat16 "
+               "cast literal outside ops/ — use the fused spec+mag STFT "
+               "and the precision= seam")
+
+    def applies(self, ctx) -> bool:
+        return not ctx.in_dir("disco_tpu/ops")
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if chain and chain[-1] in _ABS_NAMES and node.args:
+                inner = node.args[0]
+                if isinstance(inner, ast.Call):
+                    ichain = attr_chain(inner.func)
+                    if ichain and ichain[-1] in _STFT_NAMES:
+                        yield self.finding(
+                            ctx, node,
+                            "magnitude recomputed as abs(stft(...)): the "
+                            "fused spec+magnitude kernel "
+                            "(ops.stft_ops.stft_with_mag) already emits it "
+                            "in the same pass — a separate abs is one more "
+                            "HBM read of the full spec and bypasses the "
+                            "stft_impl seam",
+                        )
+            if chain and chain[-1] == "astype" and node.args \
+                    and _is_bf16_literal(node.args[0]):
+                yield self.finding(
+                    ctx, node,
+                    "bfloat16 cast literal outside ops/: precision changes "
+                    "go through the precision= seam (ops.resolve) so the "
+                    "lane stays oracle-gated and canonical — a hand-rolled "
+                    "cast is the string-typed mu=1 retrace trap",
+                )
+            for kw in node.keywords:
+                if kw.arg == "dtype" and _is_bf16_literal(kw.value):
+                    yield self.finding(
+                        ctx, node,
+                        "bfloat16 dtype literal outside ops/: request the "
+                        "lane through the precision= seam (ops.resolve) "
+                        "instead of constructing bf16 values directly",
+                    )

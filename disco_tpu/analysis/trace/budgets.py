@@ -41,15 +41,17 @@ from disco_tpu.analysis.trace.programs import (
 
 #: label -> exact number of programs the miniature workload traces.
 #: streaming_tango: the warm-start program + the continuation-state program
-#: (a different carry pytree IS a different program); repeat calls and
-#: floats passed equal to the defaults must NOT add a third — that third
-#: program is precisely the mu=1 trap.  streaming_step1 is driven directly
-#: with the same two variants (inside streaming_tango it runs under the
-#: outer trace, where the inner jit compiles nothing and its cache-size
-#: counter legitimately stays flat).  The scan driver and the two corpus
-#: runners trace once each.
+#: (a different carry pytree IS a different program) + exactly ONE bf16-lane
+#: program; repeat calls, floats passed equal to the defaults, and the
+#: precision token passed equal to (or as a non-canonical spelling of) the
+#: 'f32' default must NOT add a fourth — that fourth program is precisely
+#: the mu=1 trap, in its float and string forms.  streaming_step1 is driven
+#: directly with the warm/continuation variants (inside streaming_tango it
+#: runs under the outer trace, where the inner jit compiles nothing and its
+#: cache-size counter legitimately stays flat).  The scan driver and the
+#: two corpus runners trace once each.
 BUDGETS: dict = {
-    "streaming_tango": 2,
+    "streaming_tango": 3,
     "streaming_step1": 2,
     "streaming_tango_scan": 1,
     "run_batch": 1,
@@ -109,6 +111,18 @@ def run_workload(extra=None) -> None:
     # continuation program: the carry pytree is a new input structure
     streaming.streaming_tango(Y, mz, mw, update_every=UPDATE_EVERY,
                               state=out["state"])
+    # cache hits: the precision token passed EQUAL to the canonical default
+    # — and as a non-canonical spelling of it — must not trace (the host
+    # wrapper canonicalizes via ops.resolve.resolve_precision BEFORE the
+    # static seam; a spelling variant reaching jit would be the string-typed
+    # mu=1 trap)
+    streaming.streaming_tango(Y, mz, mw, update_every=UPDATE_EVERY,
+                              precision="f32")
+    streaming.streaming_tango(Y, mz, mw, update_every=UPDATE_EVERY,
+                              precision=" F32 ")
+    # the bf16 lane is a REAL second kernel family: exactly one program
+    streaming.streaming_tango(Y, mz, mw, update_every=UPDATE_EVERY,
+                              precision="bf16")
 
     # the per-node step-1 entry, warm start + continuation (direct calls:
     # under streaming_tango's trace the inner jit compiles nothing)

@@ -398,17 +398,27 @@ def compare_records(old: dict, new: dict, threshold: float = 0.05) -> dict:
             verdict = "OK"
         detail = f"headline rtf {o:g} → {n:g} ({r:+.1%}, threshold ±{threshold:.0%})"
 
-    # Secondary throughput lanes — the corpus engine's clips/s and the
-    # online service's blocks/s — judged alongside the RTF, each only when
-    # the BASELINE carries the lane: pre-engine/pre-serve records don't,
-    # and their absence must not flag — but a candidate that LOST a
-    # measured lane is a regression, not a skip.
-    for key, label, unit in (
-        ("streaming_rtf_scan", "streaming-scan", "x realtime"),
-        ("corpus_clips_per_s", "corpus", "clips/s"),
-        ("serve_blocks_per_s", "serve", "blocks/s"),
+    # Secondary lanes — the corpus engine's clips/s, the online service's
+    # blocks/s, and (since the hot-path fusion round) the roofline lanes:
+    # mfu plus the two dominant stage times the fusion targets
+    # (stage_ms.stft_x3 / stage_ms.step2_exchange_mwf, lower is better).
+    # Each is judged alongside the RTF, and only when the BASELINE carries
+    # the lane: older records don't, and their absence must not flag — but
+    # a candidate that LOST a measured lane is a regression, not a skip.
+    def lane(rec, key):
+        if key.startswith("stage_ms."):
+            return (rec.get("stage_ms") or {}).get(key[len("stage_ms."):])
+        return rec.get(key)
+
+    for key, label, unit, higher_is_better in (
+        ("streaming_rtf_scan", "streaming-scan", "x realtime", True),
+        ("corpus_clips_per_s", "corpus", "clips/s", True),
+        ("serve_blocks_per_s", "serve", "blocks/s", True),
+        ("mfu", "mfu", "", True),
+        ("stage_ms.stft_x3", "stft stage", "ms", False),
+        ("stage_ms.step2_exchange_mwf", "step2 stage", "ms", False),
     ):
-        o_lane, n_lane = old.get(key), new.get(key)
+        o_lane, n_lane = lane(old, key), lane(new, key)
         if o_lane is None:
             continue
         if n_lane is None:
@@ -416,9 +426,10 @@ def compare_records(old: dict, new: dict, threshold: float = 0.05) -> dict:
             lane_detail = f"{key} lost (null in candidate)"
         else:
             rl = (n_lane - o_lane) / o_lane
-            lane_verdict = ("REGRESSION" if rl < -threshold
-                            else "IMPROVED" if rl > threshold else "OK")
-            lane_detail = f"{label} {o_lane:g} → {n_lane:g} {unit} ({rl:+.1%})"
+            good = rl if higher_is_better else -rl
+            lane_verdict = ("REGRESSION" if good < -threshold
+                            else "IMPROVED" if good > threshold else "OK")
+            lane_detail = f"{label} {o_lane:g} → {n_lane:g} {unit} ({rl:+.1%})".rstrip()
         detail = f"{detail}; {lane_detail}"
         if lane_verdict == "REGRESSION":
             verdict = "REGRESSION"

@@ -71,9 +71,19 @@ def build_parser():
                         "solver_ab)")
     p.add_argument("--cov_impl", choices=["auto", "xla", "pallas"], default="auto",
                    help="masked-covariance stage: 'auto' (fused pallas kernel "
-                        "on TPU, einsum elsewhere — DISCO_TPU_COV_IMPL env "
-                        "overrides), 'xla' (einsum) or 'pallas' (fused "
-                        "single-read kernel, ops/cov_ops.py)")
+                        "on TPU, folded einsum elsewhere — DISCO_TPU_COV_IMPL "
+                        "env overrides), 'xla' (folded einsum) or 'pallas' "
+                        "(fused single-read kernel, ops/cov_ops.py)")
+    p.add_argument("--stft_impl", choices=["auto", "xla", "pallas"], default="auto",
+                   help="fused spec+magnitude STFT stage: 'auto' (fused pallas "
+                        "kernel on TPU, XLA elsewhere — DISCO_TPU_STFT_IMPL "
+                        "env overrides), 'xla' or 'pallas' "
+                        "(ops/stft_ops.stft_with_mag)")
+    p.add_argument("--precision", choices=["f32", "bf16"], default="f32",
+                   help="compute lane of the fused STFT/covariance kernels: "
+                        "'f32' (default) or 'bf16' (bf16 multiplies with f32 "
+                        "accumulators — faster on MXU, gated by looser oracle "
+                        "tolerances; see doc/source/performance.rst)")
     p.add_argument("--mesh", nargs=2, type=int, default=None, metavar=("BATCH", "NODE"),
                    help="--rirs mode only: run each chunk on a (BATCH, NODE) device "
                         "mesh (clips sharded over 'batch', nodes over 'node', "
@@ -290,7 +300,8 @@ def _run(args, policy):
                 bucket=8192 if args.bucket is None else args.bucket,
                 max_batch=args.batch_size, models=models,
                 z_sigs=args.zsigs[0] if len(args.zsigs) == 1 else "zs&zn",
-                solver=args.solver, cov_impl=args.cov_impl, mesh=mesh,
+                solver=args.solver, cov_impl=args.cov_impl,
+                stft_impl=args.stft_impl, precision=args.precision, mesh=mesh,
                 fault_spec=args.fault_spec,
                 ledger=args.ledger, resume=args.resume,
                 pipeline=not args.no_pipeline,
@@ -311,6 +322,7 @@ def _run(args, policy):
             out_root=args.out_root, streaming=args.streaming, bucket=args.bucket or 0,
             z_sigs=args.zsigs[0] if len(args.zsigs) == 1 else "zs&zn",
             solver=args.solver, cov_impl=args.cov_impl,
+            stft_impl=args.stft_impl, precision=args.precision,
             fault_spec=args.fault_spec, ledger=args.ledger,
         )
     if results is None:

@@ -38,6 +38,30 @@ def tf_mask(s: jnp.ndarray, n: jnp.ndarray, mask_type: str = "irm1", bin_thr: fl
     raise ValueError('Unknown mask type. Should be "irmX", "ibmX" or "iamX"')
 
 
+@partial(jax.jit, static_argnames=("mask_type",))
+def tf_mask_mag(mag_s: jnp.ndarray, mag_n: jnp.ndarray, mask_type: str = "irm1",
+                bin_thr: float = 0.0):
+    """:func:`tf_mask` from MAGNITUDE spectrograms — the consumer of the
+    fused STFT's magnitude output (``ops.stft_ops.stft_with_mag``), so the
+    irm/ibm mask families never recompute ``abs`` over the complex spectra
+    (same formulas as sigproc_utils.py:58-86; identical bits when
+    ``mag == abs(spec)``).  The iam family needs ``|s + n|`` — not
+    derivable from the two magnitudes — and keeps the complex entry point.
+    """
+    power = int(mask_type[-1])
+    family = mask_type[:-1]
+    if family == "irm":
+        xi = (mag_s / jnp.maximum(mag_n, _EPS)) ** power
+        return xi / (1.0 + xi)
+    if family == "ibm":
+        xi = (mag_s / jnp.maximum(mag_n, _EPS)) ** power
+        return (xi >= db2lin(bin_thr)).astype(mag_s.dtype)
+    raise ValueError(
+        'tf_mask_mag supports "irmX" and "ibmX" (iam needs the complex sum '
+        "— use tf_mask)"
+    )
+
+
 @partial(jax.jit, static_argnames=("win_len", "win_hop", "rat"))
 def vad_oracle_batch(
     x: jnp.ndarray,

@@ -16,8 +16,16 @@ prefetch/dispatch/readback engine (``disco_tpu.enhance.pipeline``):
    K×n_real per-clip readbacks are gone — and the overlap gauges
    (``prefetch_stall_ms`` et al.) are recorded.
 4. **Bench contract**: ``bench.py`` still prints exactly ONE JSON line on
-   stdout, now carrying the ``corpus_clips_per_s`` corpus-mode metric (the
-   field ``disco-obs compare`` gates on).
+   stdout, carrying the ``corpus_clips_per_s`` corpus-mode metric plus —
+   since the hot-path fusion round — the ``stft_impl``/``precision``
+   active-kernel fields and the bf16 error-reporting lane (the fields
+   ``disco-obs compare`` gates on).
+5. **Fused-path parity**: the DEFAULT hot-path kernels (the folded
+   covariance einsum, the fused spec+magnitude STFT, and their pallas
+   twins in interpret mode) are asserted against the UNFUSED reference
+   formulations (``beam.covariance.masked_covariances``, ``dsp.stft`` +
+   ``abs``) at the committed tolerances on every CI run — the default
+   path can never silently drift from the materializing math it replaced.
 
 Runs on the CPU backend; wired into ``make test`` alongside ``obs-check``,
 ``fault-check`` and ``chaos-check``.
@@ -107,7 +115,69 @@ def _check_bench_one_line(failures: list) -> dict | None:
                 f"bench: {key} missing/null in the record "
                 f"(streaming_scan_error={rec.get('streaming_scan_error')!r})"
             )
+    for key, allowed in (("stft_impl", ("xla", "pallas")),
+                         ("precision", ("f32", "bf16"))):
+        if rec.get(key) not in allowed:
+            failures.append(f"bench: {key} missing/invalid in the record: "
+                            f"{rec.get(key)!r} (expected one of {allowed})")
+    if not isinstance(rec.get("bf16_max_rel_err"), (int, float)):
+        failures.append(
+            f"bench: bf16_max_rel_err missing/null in the record "
+            f"(bf16_error={rec.get('bf16_error')!r})"
+        )
     return rec
+
+
+def _check_fused_parity(failures: list) -> None:
+    """Fused-vs-unfused parity at the kernel seams (acceptance item 5):
+    the DEFAULT path's folded/fused kernels against the materializing
+    reference formulations they replaced, on a fixed random case."""
+    import numpy as np
+
+    from disco_tpu.beam.covariance import masked_covariances
+    from disco_tpu.core.dsp import stft
+    from disco_tpu.ops.cov_ops import masked_cov_pallas, masked_covariances_folded
+    from disco_tpu.ops.stft_ops import stft_with_mag
+
+    rng = np.random.default_rng(42)
+    x = rng.standard_normal((2, 3, 12000)).astype("float32")
+    spec_ref = np.asarray(stft(x))
+    mag_ref = np.abs(spec_ref)
+    scale = np.max(mag_ref)
+    for impl in ("xla", "pallas"):
+        spec, mag = stft_with_mag(x, impl=impl, interpret=True)
+        # disco-lint: disable=DL002 -- hermetic CPU gate: interpret-mode/CPU arrays, no tunnel crossing to batch
+        spec, mag = np.asarray(spec), np.asarray(mag)
+        err_s = np.max(np.abs(spec - spec_ref)) / scale
+        err_m = np.max(np.abs(mag - mag_ref)) / scale
+        if err_s > 1e-5 or err_m > 1e-5:
+            failures.append(
+                f"fused parity: stft_with_mag[{impl}] drifted from "
+                f"dsp.stft+abs (spec {err_s:.2e}, mag {err_m:.2e} > 1e-5)"
+            )
+
+    C, F, T = 4, 33, 50
+    y = (rng.standard_normal((C, F, T)) + 1j * rng.standard_normal((C, F, T))
+         ).astype(np.complex64)
+    m = rng.random((F, T)).astype(np.float32)
+    Rss_ref_d, Rnn_ref_d = masked_covariances(y, m)
+    Rss_ref, Rnn_ref = np.asarray(Rss_ref_d), np.asarray(Rnn_ref_d)
+    scale_r = max(np.max(np.abs(Rss_ref)), np.max(np.abs(Rnn_ref)))
+    for name, fn in (
+        ("folded-xla", lambda: masked_covariances_folded(y, m)),
+        ("pallas", lambda: masked_cov_pallas(y, m, t_tile=16, f_tile=8,
+                                             interpret=True)),
+    ):
+        Rss, Rnn = fn()
+        # disco-lint: disable=DL002 -- hermetic CPU gate: interpret-mode/CPU arrays, no tunnel crossing to batch
+        Rss, Rnn = np.asarray(Rss), np.asarray(Rnn)
+        err = max(np.max(np.abs(Rss - Rss_ref)),
+                  np.max(np.abs(Rnn - Rnn_ref))) / scale_r
+        if err > 1e-4:
+            failures.append(
+                f"fused parity: masked covariance [{name}] drifted from the "
+                f"materializing einsum ({err:.2e} > 1e-4 max rel)"
+            )
 
 
 def main(argv=None) -> int:
@@ -170,6 +240,7 @@ def main(argv=None) -> int:
                    for e in events):
             failures.append("event log missing the chunk_pipeline stage event")
 
+    _check_fused_parity(failures)
     bench_rec = _check_bench_one_line(failures)
 
     if failures:

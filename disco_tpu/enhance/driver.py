@@ -236,17 +236,30 @@ def _node_metrics_pair(y0, s0, n0, sh_t, szh_t, s_dry, n_dry, sf_t, nf_t,
     return one_output(sh_t, sf_t, nf_t), one_output(szh_t, szf_t, nzf_t)
 
 
-def estimate_masks(Y, S, N, models, mask_type: str, n_nodes: int, mu: float = 1.0, z_sigs: str = "zs_hat"):
+def estimate_masks(Y, S, N, models, mask_type: str, n_nodes: int, mu: float = 1.0,
+                   z_sigs: str = "zs_hat", mags=None):
     """Step-1 and step-2 masks, oracle or CRNN (reference tango.py:189-225,
     387-394).  ``models`` is a 2-list; each entry is None (oracle) or a
     ``(flax_module, variables)`` pair.  The step-2 CRNN consumes the local
     reference channel plus the exchanged z streams, so step 1 runs first to
     produce them (the staged flow of reference main:497-503).  All node
     forwards run as ONE batched device call per step
-    (:func:`disco_tpu.enhance.inference.crnn_masks_batched`)."""
+    (:func:`disco_tpu.enhance.inference.crnn_masks_batched`).
+
+    ``mags``: optional ``(mag_S, mag_N)`` (K, C, F, T) magnitude
+    spectrograms from the fused STFT (``ops.stft_ops.stft_with_mag``) —
+    the irm/ibm oracle masks then consume them directly instead of
+    recomputing ``abs`` over the complex spectra (the magnitude the fused
+    kernel already emitted); the iam family needs the complex sum and
+    falls back to the spectra."""
     import jax.numpy as jnp
 
-    oracle = oracle_masks(S, N, mask_type)
+    if mags is not None and mask_type[:-1] in ("irm", "ibm"):
+        from disco_tpu.core.masks import tf_mask_mag
+
+        oracle = tf_mask_mag(mags[0][:, 0], mags[1][:, 0], mask_type)
+    else:
+        oracle = oracle_masks(S, N, mask_type)
     Y = jnp.asarray(Y)
     if models[0] is None:
         masks_z = oracle
@@ -442,6 +455,8 @@ def enhance_rir(
     z_sigs: str = "zs_hat",
     solver: str | None = None,
     cov_impl: str = "auto",
+    stft_impl: str = "auto",
+    precision: str = "f32",
     fault_spec=None,
     ledger=None,
 ):
@@ -473,13 +488,21 @@ def enhance_rir(
     eigengaps that the 12-iteration power default cannot resolve
     (tests/test_streaming.py pins ~power:96 for eigh-level quality there).
 
+    ``stft_impl`` / ``precision``: the fused-hot-path seams
+    (``ops.stft_ops.resolve_stft_impl`` / ``ops.resolve``).  The y/s/n
+    analysis STFTs run as ONE fused spec+magnitude program over the
+    stacked streams (three fenced dispatches collapse to one on the
+    tunneled attachment, and the irm/ibm oracle masks consume the emitted
+    magnitudes); ``precision='bf16'`` opts the STFT matmuls and both
+    pipelines' covariance accumulations into the bf16 compute lane.
+
     Returns the tango results dict, or None when the RIR was already
     processed (idempotency)."""
     if solver is None:
         solver = "eigh" if streaming else "power"
     import jax.numpy as jnp
 
-    from disco_tpu.core.dsp import stft
+    from disco_tpu.ops.stft_ops import stft_with_mag
 
     out = Path(out_root) if out_root is not None else results_root(scenario, dset_of_rir(rir), save_dir)
     if not force and _clip_done(out, rir, noise):
@@ -508,10 +531,18 @@ def enhance_rir(
 
     T_true = n_stft_frames(L)  # saved masks/z trimmed to the true frames
     with obs_events.stage("stft", rir=rir):
-        Y, S, N = stft(jnp.asarray(y_in)), stft(jnp.asarray(s_in)), stft(jnp.asarray(n_in))
+        # ONE fused spec+magnitude program over the stacked y/s/n streams
+        # (was three separate stft dispatches + an abs pass in the mask
+        # program — on the tunneled attachment each dispatch is a fenced
+        # ~80 ms RPC)
+        spec, mag = stft_with_mag(jnp.asarray(np.stack([y_in, s_in, n_in])),
+                                  impl=stft_impl, precision=precision)
+        Y, S, N = spec[0], spec[1], spec[2]
     obs_sentinels.check_finite("stft_Y", Y, stage="stft")
     with obs_events.stage("masks", rir=rir):
-        masks_z, mask_w = estimate_masks(Y, S, N, models, mask_type, n_nodes, mu=mu, z_sigs=z_sigs)
+        masks_z, mask_w = estimate_masks(Y, S, N, models, mask_type, n_nodes,
+                                         mu=mu, z_sigs=z_sigs,
+                                         mags=(mag[1], mag[2]))
     obs_sentinels.check_finite("masks", (masks_z, mask_w), stage="masks")
 
     fault_plan = None
@@ -552,6 +583,7 @@ def enhance_rir(
         with obs_events.stage("mwf", rir=rir, mode="streaming", solver=solver):
             st = streaming_tango(Y, masks_z, mask_w, mu=mu, S=S, N=N,
                                  with_diagnostics=True, policy=policy, solver=solver,
+                                 precision=precision,
                                  z_avail=None if fault_plan is None
                                  else fault_plan.avail_streaming)
         # ONE filter everywhere: every saved wav, mask, z and metric below
@@ -566,10 +598,12 @@ def enhance_rir(
         with obs_events.stage("mwf", rir=rir, mode="offline", solver=solver):
             if fault_plan is None:
                 res = tango(Y, S, N, masks_z, mask_w, mu=mu, policy=policy,
-                            mask_type=mask_type, solver=solver, cov_impl=cov_impl)
+                            mask_type=mask_type, solver=solver, cov_impl=cov_impl,
+                            precision=precision)
             else:
                 res = tango(Y, S, N, masks_z, mask_w, mu=mu, policy=policy,
                             mask_type=mask_type, solver=solver, cov_impl=cov_impl,
+                            precision=precision,
                             z_mask=fault_plan.avail_offline,
                             z_nan=fault_plan.z_nan if fault_plan.z_nan.any() else None)
     obs_sentinels.check_finite("mwf_yf", res.yf, stage="mwf")
@@ -662,6 +696,7 @@ def make_batch_runners(
     policy: str = "local",
     solver: str = "power",
     cov_impl: str = "auto",
+    precision: str = "f32",
     z_mask_arr=None,
     z_nan_arr=None,
     n_nodes: int = 4,
@@ -692,7 +727,17 @@ def make_batch_runners(
     import jax
     import jax.numpy as jnp
 
+    from disco_tpu.ops.resolve import resolve_precision
+
+    precision = resolve_precision(precision)
     if mesh is not None:
+        if precision != "f32":
+            # the sharded runners have no precision plumbing yet — reject
+            # loudly instead of silently running the f32 kernels under a
+            # bf16 request
+            raise ValueError(
+                "precision='bf16' is a single-device lane; mesh runs are f32"
+            )
         from disco_tpu.parallel import tango_batch_sharded
 
         # jitted ONCE (not per chunk — a fresh lambda per call would defeat
@@ -727,7 +772,7 @@ def make_batch_runners(
         def one(Y, S, N):
             m = oracle_masks(S, N, mask_type)
             return tango(Y, S, N, m, m, mu=mu, policy=policy, mask_type=mask_type,
-                         solver=solver, cov_impl=cov_impl,
+                         solver=solver, cov_impl=cov_impl, precision=precision,
                          z_mask=z_mask_arr, z_nan=z_nan_arr)
 
         return jax.vmap(one)(Yb, Sb, Nb)
@@ -736,7 +781,7 @@ def make_batch_runners(
     def run_batch_with_masks(Yb, Sb, Nb, Mz, Mw):
         def one(Y, S, N, mz, mw):
             return tango(Y, S, N, mz, mw, mu=mu, policy=policy, mask_type=mask_type,
-                         solver=solver, cov_impl=cov_impl,
+                         solver=solver, cov_impl=cov_impl, precision=precision,
                          z_mask=z_mask_arr, z_nan=z_nan_arr)
 
         return jax.vmap(one)(Yb, Sb, Nb, Mz, Mw)
@@ -765,6 +810,8 @@ def enhance_rirs_batched(
     z_sigs: str = "zs_hat",
     solver: str | None = None,
     cov_impl: str = "auto",
+    stft_impl: str = "auto",
+    precision: str = "f32",
     score_workers: int = 4,
     mesh=None,
     fault_spec=None,
@@ -845,7 +892,8 @@ def enhance_rirs_batched(
     import jax
     import jax.numpy as jnp
 
-    from disco_tpu.core.dsp import bucket_length, n_stft_frames, stft
+    from disco_tpu.core.dsp import bucket_length, n_stft_frames
+    from disco_tpu.ops.stft_ops import stft_fused
     from disco_tpu.utils import compile_cache as _compile_cache
 
     _compile_cache.ensure_enabled(compile_cache)
@@ -942,7 +990,8 @@ def enhance_rirs_batched(
 
     run_batch, run_batch_with_masks = make_batch_runners(
         mask_type=mask_type, mu=mu, policy=policy, solver=solver,
-        cov_impl=cov_impl, z_mask_arr=z_mask_arr, z_nan_arr=z_nan_arr,
+        cov_impl=cov_impl, precision=precision,
+        z_mask_arr=z_mask_arr, z_nan_arr=z_nan_arr,
         n_nodes=n_nodes, mesh=mesh,
     )
 
@@ -1033,9 +1082,14 @@ def enhance_rirs_batched(
         run_chaos.tick("pre_dispatch", bucket=lc.bucket, n_clips=lc.n_real)
         with obs_events.stage("chunk_enhance", n_clips=lc.n_real,
                               bucket=lc.bucket, batch=len(lc.ys)):
-            Yb = stft(jnp.asarray(lc.ys))
-            Sb = stft(jnp.asarray(lc.ss))
-            Nb = stft(jnp.asarray(lc.ns))
+            # one fused STFT program over the stacked y/s/n chunk (was
+            # three separate stft dispatches); the batch runners compute
+            # masks in-program, so the spec-only fused entry applies
+            spec = stft_fused(
+                jnp.asarray(np.stack([lc.ys, lc.ss, lc.ns])),
+                impl=stft_impl, precision=precision,
+            )
+            Yb, Sb, Nb = spec[0], spec[1], spec[2]
             if models == (None, None):
                 return run_batch(Yb, Sb, Nb)
             Mz, Mw = _batched_masks(Yb, Sb, Nb, models, mask_type, mu, n_nodes, z_sigs)

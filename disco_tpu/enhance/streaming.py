@@ -40,6 +40,7 @@ import jax.numpy as jnp
 from disco_tpu.beam.filters import rank1_gevd
 from disco_tpu.enhance.tango import others_index
 from disco_tpu.obs.accounting import counted_jit
+from disco_tpu.ops.resolve import resolve_precision
 
 #: Default filter-refresh block length (frames).  Shared with the driver's
 #: fault wiring: a streaming availability mask is per-block, so the block
@@ -111,7 +112,7 @@ def initial_stream_state(n_nodes: int, n_mics: int, n_freq: int,
     }
 
 
-def _block_covariances(XSb, XNb, lam, Rss0=None, Rnn0=None):
+def _block_covariances(XSb, XNb, lam, Rss0=None, Rnn0=None, precision: str = "f32"):
     """Scan over frame blocks, emitting the refresh-point covariances.
     ``Rss0``/``Rnn0`` seed the recursion (continuation state from a previous
     chunk); default is the documented warm start.
@@ -129,6 +130,11 @@ def _block_covariances(XSb, XNb, lam, Rss0=None, Rnn0=None):
         policy-shaped — see ``_stream_stats``).
       XNb: (B, u, F, D) noise-statistic frame blocks.
       lam: smoothing factor.
+      precision: ops.resolve compute lane of the intra-block accumulation
+        einsum — 'f32' (default: the pre-existing program, bit-identical)
+        or 'bf16' (planar re/im contraction with bf16 operands and f32
+        accumulators; the rank-1 refresh outer product stays f32 — it is
+        one frame, and the GEVD warm-up conditions on it).
 
     Returns:
       ((Rss_end, Rnn_end), (Rss_ref, Rnn_ref)) with ref shapes (B, F, D, D).
@@ -141,6 +147,17 @@ def _block_covariances(XSb, XNb, lam, Rss0=None, Rnn0=None):
         Rnn0 = jnp.broadcast_to(eps * jnp.eye(D, dtype=XSb.dtype), (F, D, D))
     # weights lam^(u-1-i) for intra-block frames i = 1..u-1
     tail_w = lam ** jnp.arange(u - 2, -1, -1, dtype=jnp.float32) if u > 1 else None
+    bf16 = resolve_precision(precision) == "bf16"
+
+    def acc_tail(x):  # (u-1, F, D) -> sum_t w_t x_t x_t^H, (F, D, D)
+        if not bf16:
+            return jnp.einsum("t,tfc,tfd->fcd", tail_w, x, jnp.conj(x),
+                              precision=jax.lax.Precision.HIGHEST)
+        # the bf16 planar accumulator lives in ops/ — precision casts are
+        # an ops concern (DL012), this module only routes the lane
+        from disco_tpu.ops.cov_ops import outer_acc_bf16
+
+        return outer_acc_bf16(tail_w, x)
 
     def body(carry, inp):
         Rss, Rnn = carry
@@ -148,10 +165,8 @@ def _block_covariances(XSb, XNb, lam, Rss0=None, Rnn0=None):
         Rss_r = lam * Rss + (1.0 - lam) * _outer(xs[0])
         Rnn_r = lam * Rnn + (1.0 - lam) * _outer(xn[0])
         if u > 1:
-            acc_s = jnp.einsum("t,tfc,tfd->fcd", tail_w, xs[1:], jnp.conj(xs[1:]),
-                               precision=jax.lax.Precision.HIGHEST)
-            acc_n = jnp.einsum("t,tfc,tfd->fcd", tail_w, xn[1:], jnp.conj(xn[1:]),
-                               precision=jax.lax.Precision.HIGHEST)
+            acc_s = acc_tail(xs[1:])
+            acc_n = acc_tail(xn[1:])
             Rss_e = lam ** (u - 1) * Rss_r + (1.0 - lam) * acc_s
             Rnn_e = lam ** (u - 1) * Rnn_r + (1.0 - lam) * acc_n
         else:
@@ -166,7 +181,7 @@ def _block_covariances(XSb, XNb, lam, Rss0=None, Rnn0=None):
 
 
 def _stream_filter(X, XS, XN, lam, u, mu, ref: int = 0, extras=None, init_state=None,
-                   solver: str = "eigh"):
+                   solver: str = "eigh", precision: str = "f32"):
     """One node's streaming filter over a (T, F, D) frame stream.
 
     ``X`` is the stream the filter is APPLIED to; ``XS``/``XN`` are the
@@ -193,7 +208,8 @@ def _stream_filter(X, XS, XN, lam, u, mu, ref: int = 0, extras=None, init_state=
 
     Rss0, Rnn0, w_seed = (None, None, None) if init_state is None else init_state
     (Rss_e, Rnn_e), (Rss_ref, Rnn_ref) = _block_covariances(
-        XS.reshape(B, u, F, D), XN.reshape(B, u, F, D), lam, Rss0, Rnn0
+        XS.reshape(B, u, F, D), XN.reshape(B, u, F, D), lam, Rss0, Rnn0,
+        precision=precision,
     )
     if pad:
         # Padded zero frames only decay the carry (R <- lam R); undo so the
@@ -245,7 +261,8 @@ def _stream_filter(X, XS, XN, lam, u, mu, ref: int = 0, extras=None, init_state=
 # `obs report` — per-chunk deployment with drifting chunk lengths is exactly
 # the recompile trap this counter exists to catch.
 @partial(counted_jit, label="streaming_step1",
-         static_argnames=("update_every", "ref_mic", "with_diagnostics", "solver"))
+         static_argnames=("update_every", "ref_mic", "with_diagnostics", "solver",
+                          "precision"))
 def streaming_step1(
     Y,
     mask_z,
@@ -258,6 +275,7 @@ def streaming_step1(
     with_diagnostics: bool = False,
     state=None,
     solver: str = "eigh",
+    precision: str = "f32",
 ):
     """Streaming local MWF at one node: recursive covariance smoothing with a
     filter refresh every ``update_every`` frames.
@@ -287,7 +305,7 @@ def streaming_step1(
     M = mask_z.T[..., None]  # (T, F, 1) broadcast over channels
     z, w, Rss, Rnn, extra_out = _stream_filter(
         X, M * X, (1.0 - M) * X, lambda_cor, update_every, mu, ref=ref_mic, extras=extras,
-        init_state=state, solver=solver,
+        init_state=state, solver=solver, precision=precision,
     )
     z_y = z.T
     out = {"z_y": z_y, "zn": Y[ref_mic] - z_y, "Rss": Rss, "Rnn": Rnn, "w": w}
@@ -410,18 +428,24 @@ def _streaming_tango_body(
     state=None,
     solver: str = "eigh",
     z_avail=None,
+    precision: str = "f32",
 ):
     """The one-block state transition of :func:`streaming_tango` — the
     traced computation, shared verbatim with the :func:`streaming_tango_scan`
     scan body so the scanned path is the per-block program by construction
     (the serve scheduler already proved a *restructured* program — the
-    vmapped megabatch — diverges through the warm-up GEVD + ffill hold)."""
+    vmapped megabatch — diverges through the warm-up GEVD + ffill hold).
+    ``precision`` routes BOTH steps' covariance accumulations through the
+    ops.resolve compute lane here, in the one shared body, so the scanned
+    super-tick, the per-block path and the serve scheduler can never run
+    different kernels for the same lane."""
     K, C, F, T = Y.shape
     st1_in, st2_in = (None, None) if state is None else (state["step1"], state["step2"])
     step1 = jax.vmap(
         lambda y, m, s, n, st: streaming_step1(
             y, m, lambda_cor=lambda_cor, update_every=update_every, mu=mu, ref_mic=ref_mic,
             S=s, N=n, with_diagnostics=with_diagnostics, state=st, solver=solver,
+            precision=precision,
         ),
         in_axes=(0, 0, 0, 0, 0 if st1_in is not None else None),
     )
@@ -471,7 +495,7 @@ def _streaming_tango_body(
         stream2 = jax.vmap(
             lambda x, xs_st, xn_st, xs, xn, st: _stream_filter(
                 x, xs_st, xn_st, lambda_cor, update_every, mu, ref=ref_mic, extras=[xs, xn],
-                init_state=st, solver=solver,
+                init_state=st, solver=solver, precision=precision,
             ),
             in_axes=(0, 0, 0, 0, 0, 0 if st2_in is not None else None),
         )
@@ -493,7 +517,7 @@ def _streaming_tango_body(
     stream2 = jax.vmap(
         lambda x, xs_st, xn_st, st: _stream_filter(
             x, xs_st, xn_st, lambda_cor, update_every, mu, ref=ref_mic, init_state=st,
-            solver=solver,
+            solver=solver, precision=precision,
         )[:4],
         in_axes=(0, 0, 0, 0 if st2_in is not None else None),
     )
@@ -537,7 +561,8 @@ def _chaos_between_blocks(state):
 
 
 @partial(counted_jit, label="streaming_tango",
-         static_argnames=("update_every", "ref_mic", "with_diagnostics", "policy", "solver"))
+         static_argnames=("update_every", "ref_mic", "with_diagnostics", "policy",
+                          "solver", "precision"))
 def _streaming_tango_jit(
     Y,
     masks_z,
@@ -553,6 +578,7 @@ def _streaming_tango_jit(
     state=None,
     solver: str = "eigh",
     z_avail=None,
+    precision: str = "f32",
 ):
     """The jitted :func:`_streaming_tango_body` (the public
     :func:`streaming_tango` wrapper adds the host-side chaos seam)."""
@@ -560,6 +586,7 @@ def _streaming_tango_jit(
         Y, masks_z, mask_w, lambda_cor=lambda_cor, update_every=update_every,
         mu=mu, ref_mic=ref_mic, S=S, N=N, with_diagnostics=with_diagnostics,
         policy=policy, state=state, solver=solver, z_avail=z_avail,
+        precision=precision,
     )
 
 
@@ -578,6 +605,7 @@ def streaming_tango(
     state=None,
     solver: str = "eigh",
     z_avail=None,
+    precision: str = "f32",
 ):
     """Full two-step streaming TANGO over all nodes (mixture-only by
     default: the deployment path needs no oracle S/N).
@@ -609,6 +637,12 @@ def streaming_tango(
         previous chunk's last good block, matching the unchunked run
         across refresh-block-aligned boundaries.  None (default) is the
         fault-free path, byte-identical to before.
+      precision: ops.resolve compute lane of the covariance accumulations
+        ('f32' default — the pre-existing program, bit-identical — or
+        'bf16' opt-in).  Canonicalized here (``resolve_precision``) before
+        it reaches the static-argument seam, so spelling variants of the
+        same lane can never trace duplicate programs (the string-typed
+        mu=1 trap; retrace budgets stay exact).
 
     Returns:
       dict with yf (K, F, T) enhanced outputs, z_y/zn (K, F, T) streams,
@@ -629,6 +663,7 @@ def streaming_tango(
         Y, masks_z, mask_w, update_every=update_every, ref_mic=ref_mic,
         S=S, N=N, with_diagnostics=with_diagnostics, policy=policy,
         state=state, solver=solver, z_avail=z_avail,
+        precision=resolve_precision(precision),
         **_float_kw(lambda_cor, mu),
     )
 
@@ -644,7 +679,7 @@ streaming_tango.__wrapped__ = _streaming_tango_jit.__wrapped__
 
 @partial(counted_jit, label="streaming_tango_scan",
          static_argnames=("blocks_per_dispatch", "update_every", "ref_mic",
-                          "with_diagnostics", "policy", "solver"))
+                          "with_diagnostics", "policy", "solver", "precision"))
 def _streaming_tango_scan_jit(
     Y,
     masks_z,
@@ -661,6 +696,7 @@ def _streaming_tango_scan_jit(
     solver: str = "eigh",
     z_avail=None,
     blocks_per_dispatch: int = 1,
+    precision: str = "f32",
 ):
     """Device-resident super-tick: ``blocks_per_dispatch`` refresh-aligned
     streaming blocks per dispatch, via ``lax.scan`` over the per-block state
@@ -781,7 +817,7 @@ def _streaming_tango_scan_jit(
             x["Y"], x["mz"], x["mw"], lambda_cor=lambda_cor, update_every=u,
             mu=mu, ref_mic=ref_mic, S=x.get("S"), N=x.get("N"),
             with_diagnostics=with_diagnostics, policy=policy, state=st,
-            solver=solver, z_avail=x.get("za"),
+            solver=solver, z_avail=x.get("za"), precision=precision,
         )
         st_out = out.pop("state")
         c_out = {"step1": st_out["step1"], "step2": st_out["step2"]}
@@ -818,6 +854,7 @@ def streaming_tango_scan(
     solver: str = "eigh",
     z_avail=None,
     blocks_per_dispatch: int = 1,
+    precision: str = "f32",
 ):
     """Host entry of the scanned super-tick driver — fires the
     ``between_blocks`` chaos seam on every chunk-continuation call (outside
@@ -836,6 +873,7 @@ def streaming_tango_scan(
         S=S, N=N, with_diagnostics=with_diagnostics, policy=policy,
         state=state, solver=solver, z_avail=z_avail,
         blocks_per_dispatch=blocks_per_dispatch,
+        precision=resolve_precision(precision),
         **_float_kw(lambda_cor, mu),
     )
 

@@ -52,6 +52,7 @@ import numpy as np
 from disco_tpu.beam.covariance import frame_mean_covariance
 from disco_tpu.beam.filters import rank1_gevd
 from disco_tpu.core.masks import tf_mask
+from disco_tpu.ops.resolve import check_canonical_precision
 
 Policy = str | None
 _POLICIES = ("local", "none", "distant", "compressed", "use_oracle_refs", "use_oracle_zs")
@@ -94,40 +95,51 @@ def oracle_masks(S: jnp.ndarray, N: jnp.ndarray, mask_type: str = "irm1", ref_mi
     return tf_mask(S[:, ref_mic], N[:, ref_mic], mask_type)
 
 
-def _masked_cov_pair(X, mask, cov_impl: str, frame_axis):
+def _masked_cov_pair(X, mask, cov_impl: str, frame_axis, precision: str = "f32"):
     """(Rss, Rnn) of ``mask * X`` / ``(1-mask) * X`` — the shared
     mask->covariance stage of both steps, routed by ``cov_impl``:
 
     * 'auto' (the default since the round-6 promotion): the fused pallas
-      kernel on real TPU backends, the einsum path elsewhere —
+      kernel on real TPU backends, the folded einsum elsewhere —
       ``ops.cov_ops.resolve_cov_impl``, ``DISCO_TPU_COV_IMPL`` env escape
       hatch.  Parity stays gated by the float64 oracles in
       tests/reference_impls.py and tests/test_ops.py.
-    * 'xla': materialized masked copies + einsum (beam.covariance).
+    * 'xla': the FOLDED einsum (``ops.cov_ops.masked_covariances_folded``,
+      since the hot-path fusion round): mask weights contracted inside the
+      covariance accumulation, so the masked spectrogram copies are never
+      program values even off-TPU.
     * 'pallas': the fused single-read kernel (ops.cov_ops) — the masked
-      copies never touch HBM (round-2 verdict #3).  Falls back to 'xla'
-      under sequence parallelism (the psum over ``frame_axis`` needs the
-      einsum path's axis_name plumbing).
+      copies never touch HBM (round-2 verdict #3).
+
+    ``mask`` is (F, T) shared or (C, F, T) per-channel (the step-2 stacked
+    layout under the 'distant'/'none' policies); ``precision`` is the
+    ops.resolve compute lane ('f32' default, 'bf16' opt-in).  Sequence
+    parallelism (``frame_axis``) falls back to the materializing einsum —
+    the psum needs ``frame_mean_covariance``'s axis_name plumbing — and
+    supports shared masks only (the one caller shape that existed before
+    the fold).
     """
     if cov_impl == "auto":
         from disco_tpu.ops.cov_ops import resolve_cov_impl
 
         cov_impl = resolve_cov_impl(cov_impl)
-    if cov_impl == "pallas" and frame_axis is None:
+    if frame_axis is None:
         from disco_tpu.ops.cov_ops import masked_covariances_fused
 
-        return masked_covariances_fused(X, mask, impl="pallas")
-    m = mask[None]
+        return masked_covariances_fused(X, mask, impl=cov_impl, precision=precision)
+    m = mask[None] if mask.ndim == X.ndim - 1 else mask
     Rss = frame_mean_covariance(m * X, axis_name=frame_axis)
     Rnn = frame_mean_covariance((1.0 - m) * X, axis_name=frame_axis)
     return Rss, Rnn
 
 
 # ------------------------------------------------------------------ step 1
-@partial(jax.jit, static_argnames=("oracle_stats", "ref_mic", "frame_axis", "solver", "cov_impl"))
+@partial(jax.jit, static_argnames=("oracle_stats", "ref_mic", "frame_axis", "solver",
+                                   "cov_impl", "precision"))
 def tango_step1(
     Y, S, N, mask_z, mu: float = 1.0, oracle_stats: bool = False, ref_mic: int = 0,
     frame_axis: str | None = None, solver: str = "power", cov_impl: str = "auto",
+    precision: str = "f32",
 ):
     """Step 1 at ONE node: local rank-1 GEVD-MWF -> compressed signals.
 
@@ -139,16 +151,21 @@ def tango_step1(
       mask_z: (F, T) step-1 mask at the reference mic.
       oracle_stats: the 'use_oracle_' step-1 branch (tango.py:345-349) —
         covariances from the true S/N instead of masked Y.
+      precision: the ops.resolve compute lane of the masked-covariance
+        accumulation — 'f32' (default, the pre-existing program) or 'bf16'
+        (bf16 multiplies, f32 accumulators; gated by the documented looser
+        oracle tolerances in tests/test_tango.py).
 
     Returns:
       dict with z_y/z_s/z_n/zn (F, T) and t1-projected references
       z_t1_s/z_t1_n (F, T) (the ``z_gevd_*`` diagnostics of tango.py:372-374).
     """
+    precision = check_canonical_precision(precision)
     if oracle_stats:
         Rss = frame_mean_covariance(S, axis_name=frame_axis)  # (F, C, C)
         Rnn = frame_mean_covariance(N, axis_name=frame_axis)
     else:
-        Rss, Rnn = _masked_cov_pair(Y, mask_z, cov_impl, frame_axis)
+        Rss, Rnn = _masked_cov_pair(Y, mask_z, cov_impl, frame_axis, precision)
     w, t1 = rank1_gevd(Rss, Rnn, mu=mu, solver=solver)  # (F, C) each
     z_y = jnp.einsum("fc,cft->ft", jnp.conj(w), Y)
     z_s = jnp.einsum("fc,cft->ft", jnp.conj(w), S)
@@ -228,7 +245,8 @@ def _z_stats(policy: Policy, mask_w_k, all_z, all_masks_w, all_S_ref, all_N_ref,
     raise ValueError(f"unknown mask_for_z policy {policy!r}; expected one of {_POLICIES}")
 
 
-@partial(jax.jit, static_argnames=("policy", "ref_mic", "mask_type", "frame_axis", "solver", "cov_impl"))
+@partial(jax.jit, static_argnames=("policy", "ref_mic", "mask_type", "frame_axis",
+                                   "solver", "cov_impl", "precision"))
 def tango_step2(
     Y,
     S,
@@ -246,6 +264,7 @@ def tango_step2(
     frame_axis: str | None = None,
     solver: str = "power",
     cov_impl: str = "auto",
+    precision: str = "f32",
     z_avail=None,
 ):
     """Step 2 at ONE node k: global rank-1 GEVD-MWF on ``[y_k ‖ z_{j≠k}]``
@@ -260,14 +279,29 @@ def tango_step2(
       all_masks_w: (K, F, T) gathered step-2 masks (for the 'distant' policy).
       all_S_ref / all_N_ref: (K, F, T) gathered ref-mic clean components
         (for the 'use_oracle_refs' policy).
+      precision: ops.resolve compute lane of the covariance accumulation
+        ('f32' default / 'bf16' opt-in — see :func:`tango_step1`).
       z_avail: optional (K,) availability of the exchanged streams as seen
         by THIS consumer (1 = arrived intact).  Unavailable channels are
         excluded from the MWF (module docstring); None (default) is the
         fault-free fast path, byte-identical to the original pipeline.
 
+    Covariance fusion (the hot-path fusion round): the 'local', 'distant'
+    and 'none' policies all express their statistic stacks as per-channel
+    masks over the SAME stacked streams, so their covariances run as
+    masked rank-1 updates (``_masked_cov_pair`` / ``weighted_cov_folded``)
+    and the masked spectrograms are never materialized — 'local' shares
+    one mask across the stack, 'distant' carries producer masks on the z
+    channels, 'none' is ``[m ‖ 1]`` over ``[Y ‖ z]`` for speech and
+    ``[(1-m) ‖ 1]`` over ``[Y ‖ zn]`` for noise (two single-cov folds:
+    the two stacks differ, so the pair kernel does not apply).  The
+    remaining policies ('compressed', the oracle ones) substitute genuinely
+    different signals and keep the materializing path.
+
     Returns:
       (yf, sf, nf): (F, T) filtered mixture / speech / noise at node k.
     """
+    precision = check_canonical_precision(precision)
     K = all_z["z_y"].shape[0]
     C = Y.shape[0]
     # Ascending j != k (dynamic k — shard_map passes a traced axis_index).
@@ -278,13 +312,36 @@ def tango_step2(
         a_oth = z_avail[oth]  # (K-1,) availability of this node's others
         sel = lambda v: _masked_select(v[oth], a_oth)
 
+    in_y = jnp.concatenate([Y, sel(all_z["z_y"])], axis=0)  # (C+K-1, F, T)
+    fold_ok = frame_axis is None  # sequence parallelism keeps the psum path
     if policy == "local":
         # 'local' masks every stacked channel — own mics AND incoming z's —
         # with node k's own mask (tango.py:418-420), i.e. the whole stat
         # stack is one masked covariance of [Y ‖ z_{j≠k}]: the fused
         # single-read kernel applies to the full C+K-1 stack.
-        stacked = jnp.concatenate([Y, sel(all_z["z_y"])], axis=0)  # (C+K-1, F, T)
-        Rss, Rnn = _masked_cov_pair(stacked, mask_w_k, cov_impl, frame_axis)
+        Rss, Rnn = _masked_cov_pair(in_y, mask_w_k, cov_impl, frame_axis, precision)
+    elif policy == "distant" and fold_ok:
+        # Producer-side masks per z channel, consumer mask on the local
+        # mics (tango.py:398-400): one per-channel mask stack over in_y —
+        # the zeroing select on unavailable z commutes with the real mask
+        # multiply, so folding is exact under faults too.
+        chan_mask = jnp.concatenate(
+            [jnp.broadcast_to(mask_w_k[None], (C,) + mask_w_k.shape),
+             all_masks_w[oth]], axis=0,
+        )
+        Rss, Rnn = _masked_cov_pair(in_y, chan_mask, cov_impl, frame_axis, precision)
+    elif policy in (None, "none") and fold_ok:
+        from disco_tpu.ops.cov_ops import weighted_cov_folded
+
+        ones = jnp.ones((K - 1,) + mask_w_k.shape, mask_w_k.dtype)
+        m_c = jnp.broadcast_to(mask_w_k[None], (C,) + mask_w_k.shape)
+        Rss = weighted_cov_folded(
+            in_y, jnp.concatenate([m_c, ones], axis=0), precision
+        )
+        in_zn = jnp.concatenate([Y, sel(all_z["zn"])], axis=0)
+        Rnn = weighted_cov_folded(
+            in_zn, jnp.concatenate([1.0 - m_c, ones], axis=0), precision
+        )
     else:
         zs_stat_all, zn_stat_all = _z_stats(
             policy, mask_w_k, all_z, all_masks_w, all_S_ref, all_N_ref, mask_type
@@ -298,7 +355,6 @@ def tango_step2(
         Rnn = _regularize_excluded(Rnn, C, a_oth)
     w, _ = rank1_gevd(Rss, Rnn, mu=mu, solver=solver)  # (F, C+K-1)
 
-    in_y = jnp.concatenate([Y, sel(all_z["z_y"])], axis=0)
     in_s = jnp.concatenate([S, sel(all_z["z_s"])], axis=0)
     in_n = jnp.concatenate([N, sel(all_z["z_n"])], axis=0)
     yf = jnp.einsum("fc,cft->ft", jnp.conj(w), in_y)
@@ -308,7 +364,9 @@ def tango_step2(
 
 
 # ------------------------------------------------------------- full pipeline
-@partial(jax.jit, static_argnames=("policy", "ref_mic", "mask_type", "oracle_step1_stats", "solver", "cov_impl"))
+@partial(jax.jit, static_argnames=("policy", "ref_mic", "mask_type",
+                                   "oracle_step1_stats", "solver", "cov_impl",
+                                   "precision"))
 def tango(
     Y,
     S,
@@ -322,6 +380,7 @@ def tango(
     oracle_step1_stats: bool = False,
     solver: str = "power",
     cov_impl: str = "auto",
+    precision: str = "f32",
     z_mask=None,
     z_nan=None,
 ) -> TangoResult:
@@ -346,11 +405,21 @@ def tango(
 
     Batched use: ``jax.vmap(tango, in_axes=(0, 0, 0, 0, 0))`` over a rooms
     axis — rooms, nodes, freq and frames are all array axes.
+
+    ``precision``: ops.resolve compute lane of both steps' covariance
+    accumulations ('f32' default — the pre-existing program — or 'bf16'
+    with f32 accumulators, gated by the documented looser oracle
+    tolerances; tests/test_tango.py).  Must be the CANONICAL token: this
+    entry point is jitted directly, so a spelling variant normalized here
+    would already have keyed a duplicate program — it raises instead
+    (``ops.resolve.check_canonical_precision``; callers holding user input
+    canonicalize with ``resolve_precision`` first, as the CLI/driver do).
     """
+    precision = check_canonical_precision(precision)
     step1 = jax.vmap(
         lambda y, s, n, m: tango_step1(
             y, s, n, m, mu=mu, oracle_stats=oracle_step1_stats, ref_mic=ref_mic,
-            solver=solver, cov_impl=cov_impl,
+            solver=solver, cov_impl=cov_impl, precision=precision,
         )
     )
     all_z = step1(Y, S, N, masks_z)
@@ -368,7 +437,7 @@ def tango(
             lambda y, s, n, mw, k: tango_step2(
                 y, s, n, mw, k, all_z, mask_w, S[:, ref_mic], N[:, ref_mic],
                 mu=mu, policy=policy, ref_mic=ref_mic, mask_type=mask_type,
-                solver=solver, cov_impl=cov_impl,
+                solver=solver, cov_impl=cov_impl, precision=precision,
             ),
             in_axes=(0, 0, 0, 0, 0),
         )
@@ -385,7 +454,7 @@ def tango(
             lambda y, s, n, mw, k, za: tango_step2(
                 y, s, n, mw, k, all_z, mask_w, S[:, ref_mic], N[:, ref_mic],
                 mu=mu, policy=policy, ref_mic=ref_mic, mask_type=mask_type,
-                solver=solver, cov_impl=cov_impl, z_avail=za,
+                solver=solver, cov_impl=cov_impl, precision=precision, z_avail=za,
             ),
             in_axes=(0, 0, 0, 0, 0, 0),
         )

@@ -1,5 +1,15 @@
 from disco_tpu.ops.eigh_ops import eigh_jacobi, eigh_jacobi_pallas
-from disco_tpu.ops.stft_ops import dft_matrices, idft_matrices, istft_matmul, stft_matmul, stft_pallas
+from disco_tpu.ops.resolve import resolve_precision
+from disco_tpu.ops.stft_ops import (
+    dft_matrices,
+    idft_matrices,
+    istft_matmul,
+    resolve_stft_impl,
+    stft_fused,
+    stft_matmul,
+    stft_pallas,
+    stft_with_mag,
+)
 
 __all__ = [
     "dft_matrices",
@@ -7,6 +17,10 @@ __all__ = [
     "eigh_jacobi_pallas",
     "idft_matrices",
     "istft_matmul",
+    "resolve_precision",
+    "resolve_stft_impl",
+    "stft_fused",
     "stft_matmul",
     "stft_pallas",
+    "stft_with_mag",
 ]

@@ -24,9 +24,18 @@ is born as an (Fb,) lane vector, so output layout inside the kernel is
 (C, C, Fb) and every store is a contiguous lane store; the host
 transposes the tiny result to the (..., F, C, C) convention.
 
-:func:`masked_covariances_fused` dispatches 'xla' (the einsum path) /
-'pallas' so callers can pick per backend; parity is pinned in
-tests/test_ops.py against ``beam.covariance.masked_covariances``.
+:func:`masked_covariances_fused` dispatches 'xla' / 'pallas' so callers can
+pick per backend; parity is pinned in tests/test_ops.py against
+``beam.covariance.masked_covariances`` and the float64 oracle.  Since the
+hot-path fusion round the 'xla' lane is the FOLDED einsum
+(:func:`masked_covariances_folded`): the mask weights are contracted inside
+the covariance einsum (masked rank-1 updates), so even off-TPU the masked
+spectrogram copies never exist as program values.  Both lanes additionally
+support PER-CHANNEL masks ((..., C, F, T) — the step-2 stacked
+``[local mics ‖ z]`` layout where each channel carries its own mask, e.g.
+the 'distant' policy) and the ``precision='bf16'`` compute lane
+(:mod:`disco_tpu.ops.resolve`): bf16 multiply inner loops, f32
+accumulators, gated by the documented looser oracle tolerances.
 """
 from __future__ import annotations
 
@@ -37,6 +46,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from disco_tpu.beam.covariance import masked_covariances
+from disco_tpu.ops.resolve import compute_dtype, resolve_impl, resolve_precision
 
 
 def _cov_kernel(yr_ref, yi_ref, m_ref, ssr_ref, ssi_ref, nnr_ref, nni_ref, *, C, inv_t):
@@ -90,9 +100,52 @@ def _cov_kernel(yr_ref, yi_ref, m_ref, ssr_ref, ssi_ref, nnr_ref, nni_ref, *, C,
                 nni_ref[0, d, c, :] += -nn_im
 
 
-@partial(jax.jit, static_argnames=("f_tile", "t_tile", "interpret"))
+def _cov_kernel_chan(yr_ref, yi_ref, m_ref, ssr_ref, ssi_ref, nnr_ref, nni_ref, *, C, inv_t):
+    """Per-CHANNEL-mask variant of :func:`_cov_kernel` — the step-2 stacked
+    ``[local mics ‖ z]`` layout where every channel carries its own mask
+    (the 'distant' mask-for-z policy: producer masks on the z channels,
+    the consumer mask on the local mics).  Same layout/accumulation scheme;
+    the pair weight is ``m_c * m_d`` (speech) / ``(1-m_c)(1-m_d)`` (noise)
+    instead of the shared ``m^2`` / ``(1-m)^2``."""
+    t_idx = pl.program_id(2)
+
+    @pl.when(t_idx == 0)
+    def _init():
+        ssr_ref[...] = jnp.zeros_like(ssr_ref)
+        ssi_ref[...] = jnp.zeros_like(ssi_ref)
+        nnr_ref[...] = jnp.zeros_like(nnr_ref)
+        nni_ref[...] = jnp.zeros_like(nni_ref)
+
+    for c in range(C):
+        xr_c, xi_c = yr_ref[0, c], yi_ref[0, c]  # (Tb, Fb)
+        m_c = m_ref[0, c]
+        for d in range(c, C):
+            xr_d, xi_d = yr_ref[0, d], yi_ref[0, d]
+            m_d = m_ref[0, d]
+            ws = (m_c * m_d) * inv_t
+            wn = ((1.0 - m_c) * (1.0 - m_d)) * inv_t
+            # Y_c conj(Y_d): re = rc rd + ic id, im = ic rd - rc id
+            prr = xr_c * xr_d + xi_c * xi_d
+            pii = xi_c * xr_d - xr_c * xi_d
+            ss_re = jnp.sum(ws * prr, axis=0)  # (Fb,) lane vector
+            ss_im = jnp.sum(ws * pii, axis=0)
+            nn_re = jnp.sum(wn * prr, axis=0)
+            nn_im = jnp.sum(wn * pii, axis=0)
+            ssr_ref[0, c, d, :] += ss_re
+            ssi_ref[0, c, d, :] += ss_im
+            nnr_ref[0, c, d, :] += nn_re
+            nni_ref[0, c, d, :] += nn_im
+            if d != c:  # hermitian mirror
+                ssr_ref[0, d, c, :] += ss_re
+                ssi_ref[0, d, c, :] += -ss_im
+                nnr_ref[0, d, c, :] += nn_re
+                nni_ref[0, d, c, :] += -nn_im
+
+
+@partial(jax.jit, static_argnames=("f_tile", "t_tile", "interpret", "precision"))
 def masked_cov_pallas(
-    y: jnp.ndarray, mask: jnp.ndarray, f_tile: int = 128, t_tile: int = 256, interpret: bool = False
+    y: jnp.ndarray, mask: jnp.ndarray, f_tile: int = 128, t_tile: int = 256,
+    interpret: bool = False, precision: str = "f32",
 ):
     """Speech/noise covariances from a mixture and TF mask, fused.
 
@@ -102,7 +155,10 @@ def masked_cov_pallas(
 
     Args:
       y: (..., C, F, T) complex64 mixture STFT.
-      mask: (..., F, T) float mask, broadcast over channels.
+      mask: (..., F, T) float mask, broadcast over channels — or
+        (..., C, F, T) PER-CHANNEL masks (the step-2 stacked layout under
+        the 'distant' policy), routed to :func:`_cov_kernel_chan` with pair
+        weights ``m_c m_d`` / ``(1-m_c)(1-m_d)``.
       f_tile: frequency bins per grid step (F is zero-padded to a multiple).
         Mosaic requires the covariance blocks' trailing dim to be a multiple
         of 128 (measured on TPU v5e: f_tile=8 is rejected at lowering), so
@@ -114,25 +170,40 @@ def masked_cov_pallas(
         blew the ~16 MB VMEM budget at 10 s clips, which is where the
         round-3/4 on-device compile crashes came from.
       interpret: pallas interpreter mode (CPU correctness tests).
+      precision: 'f32' (default, the pre-existing program) or 'bf16' — the
+        Y planes are fed to the kernel in bf16, so the elementwise products
+        of the inner loop run at bf16 while the mask weights and the
+        sublane reductions accumulate in f32 (``ops.resolve`` lane; gated
+        by the documented looser oracle tolerances).
 
     Returns:
       (Rss, Rnn), each (..., F, C, C) complex64.
     """
     y = jnp.asarray(y)
+    mask = jnp.asarray(mask, jnp.float32)
     *lead, C, F, T = y.shape
+    chan = mask.ndim == y.ndim  # per-channel masks carry the C axis
     B = 1
     for n in lead:
         B *= n
+    dt = compute_dtype(precision)
     # frames-major planes: the kernel reduces over sublanes (see
     # _cov_kernel's layout note) — transpose costs one HBM pass of Y, still
     # far below the three masked-copy round trips the einsum path pays
-    yr = jnp.real(y).astype(jnp.float32).reshape(B, C, F, T).transpose(0, 1, 3, 2)
-    yi = jnp.imag(y).astype(jnp.float32).reshape(B, C, F, T).transpose(0, 1, 3, 2)
-    m = (
-        jnp.broadcast_to(jnp.asarray(mask, jnp.float32), tuple(lead) + (F, T))
-        .reshape(B, F, T)
-        .transpose(0, 2, 1)
-    )
+    yr = jnp.real(y).astype(dt).reshape(B, C, F, T).transpose(0, 1, 3, 2)
+    yi = jnp.imag(y).astype(dt).reshape(B, C, F, T).transpose(0, 1, 3, 2)
+    if chan:
+        m = (
+            jnp.broadcast_to(mask, tuple(lead) + (C, F, T))
+            .reshape(B, C, F, T)
+            .transpose(0, 1, 3, 2)
+        )
+    else:
+        m = (
+            jnp.broadcast_to(mask, tuple(lead) + (F, T))
+            .reshape(B, F, T)
+            .transpose(0, 2, 1)
+        )
 
     n_ft = -(-F // f_tile)
     Fp = n_ft * f_tile
@@ -141,7 +212,8 @@ def masked_cov_pallas(
     if Fp != F or Tp != T:
         pad = ((0, 0), (0, 0), (0, Tp - T), (0, Fp - F))
         yr, yi = jnp.pad(yr, pad), jnp.pad(yi, pad)
-        m = jnp.pad(m, ((0, 0), (0, Tp - T), (0, Fp - F)))
+        mpad = pad if chan else ((0, 0), (0, Tp - T), (0, Fp - F))
+        m = jnp.pad(m, mpad)
 
     # NOTE on shard_map: pallas_call's vma handling is incomplete in this
     # jax version (its interpreter rejects even correctly-annotated
@@ -150,15 +222,21 @@ def masked_cov_pallas(
     # check_vma for the pallas cov variant instead of annotating here.
     out_struct = jax.ShapeDtypeStruct((B, C, C, Fp), jnp.float32)
 
+    kernel = _cov_kernel_chan if chan else _cov_kernel
+    m_spec = (
+        pl.BlockSpec((1, C, t_tile, f_tile), lambda b, f, t: (b, 0, t, f))
+        if chan
+        else pl.BlockSpec((1, t_tile, f_tile), lambda b, f, t: (b, t, f))
+    )
     # frame tiles innermost: the output block's index map ignores t, so the
     # (1, C, C, f_tile) accumulator stays VMEM-resident across the sweep
     out = pl.pallas_call(
-        partial(_cov_kernel, C=C, inv_t=1.0 / T),
+        partial(kernel, C=C, inv_t=1.0 / T),
         grid=(B, n_ft, n_tt),
         in_specs=[
             pl.BlockSpec((1, C, t_tile, f_tile), lambda b, f, t: (b, 0, t, f)),
             pl.BlockSpec((1, C, t_tile, f_tile), lambda b, f, t: (b, 0, t, f)),
-            pl.BlockSpec((1, t_tile, f_tile), lambda b, f, t: (b, t, f)),
+            m_spec,
         ],
         out_specs=[
             pl.BlockSpec((1, C, C, f_tile), lambda b, f, t: (b, 0, 0, f)),
@@ -174,6 +252,116 @@ def masked_cov_pallas(
     Rnn = jax.lax.complex(nnr, nni).transpose(0, 3, 1, 2)
     shape = tuple(lead) + (F, C, C)
     return Rss.reshape(shape), Rnn.reshape(shape)
+
+
+# ------------------------------------------------------- folded XLA einsums
+def _weighted_cov_shared(y, w, precision: str):
+    """``R[..., f, c, d] = (1/T) sum_t w[..., f, t] y_c conj(y_d)`` with the
+    frame weights contracted IN the einsum — the masked copies of the
+    materializing path (``beam.covariance``) never exist as program values.
+
+    No reference counterpart: the reference materializes the masked copies
+    (tango.py:347-348); folding is the TPU HBM-traffic optimization.
+    """
+    T = y.shape[-1]
+    if resolve_precision(precision) == "bf16":
+        dt = compute_dtype(precision)
+        yr, yi = jnp.real(y).astype(dt), jnp.imag(y).astype(dt)
+        w16 = w.astype(dt)
+        pe = dict(preferred_element_type=jnp.float32)
+        re = (jnp.einsum("...ft,...cft,...dft->...fcd", w16, yr, yr, **pe)
+              + jnp.einsum("...ft,...cft,...dft->...fcd", w16, yi, yi, **pe))
+        im = (jnp.einsum("...ft,...cft,...dft->...fcd", w16, yi, yr, **pe)
+              - jnp.einsum("...ft,...cft,...dft->...fcd", w16, yr, yi, **pe))
+        return jax.lax.complex(re, im) / T
+    cov = jnp.einsum("...ft,...cft,...dft->...fcd", w, y, jnp.conj(y),
+                     precision=jax.lax.Precision.HIGHEST)
+    return cov / T
+
+
+def _weighted_cov_chan(y, m, precision: str):
+    """Per-channel-mask fold: ``R[..., f, c, d] = (1/T) sum_t m_c m_d
+    y_c conj(y_d)`` with ``m`` shaped (..., C, F, T).
+
+    No reference counterpart (see :func:`_weighted_cov_shared`).
+    """
+    T = y.shape[-1]
+    if resolve_precision(precision) == "bf16":
+        dt = compute_dtype(precision)
+        yr, yi = jnp.real(y).astype(dt), jnp.imag(y).astype(dt)
+        m16 = m.astype(dt)
+        pe = dict(preferred_element_type=jnp.float32)
+        sub = "...cft,...dft,...cft,...dft->...fcd"
+        re = (jnp.einsum(sub, m16, m16, yr, yr, **pe)
+              + jnp.einsum(sub, m16, m16, yi, yi, **pe))
+        im = (jnp.einsum(sub, m16, m16, yi, yr, **pe)
+              - jnp.einsum(sub, m16, m16, yr, yi, **pe))
+        return jax.lax.complex(re, im) / T
+    cov = jnp.einsum("...cft,...dft,...cft,...dft->...fcd", m, m, y, jnp.conj(y),
+                     precision=jax.lax.Precision.HIGHEST)
+    return cov / T
+
+
+def outer_acc_bf16(w, x):
+    """``sum_t w_t x_t x_t^H`` over a (u, F, D) complex stream with bf16
+    multiplies and f32 accumulators (planar re/im) — the streaming
+    covariance tail accumulation of ``enhance/streaming._block_covariances``
+    under the bf16 lane.  Lives here because precision casts are an ops/
+    concern (disco-lint DL012): callers request a lane through the
+    ``precision=`` seam and never spell dtype literals themselves.
+
+    The exponential-smoothing estimator this accelerates is reference
+    se_utils/internal_formulas.py:84-103; the bf16 lane itself has no
+    reference counterpart.
+    """
+    xr = jnp.real(x).astype(jnp.bfloat16)
+    xi = jnp.imag(x).astype(jnp.bfloat16)
+    w16 = w.astype(jnp.bfloat16)
+    pe = dict(preferred_element_type=jnp.float32)
+    re = (jnp.einsum("t,tfc,tfd->fcd", w16, xr, xr, **pe)
+          + jnp.einsum("t,tfc,tfd->fcd", w16, xi, xi, **pe))
+    im = (jnp.einsum("t,tfc,tfd->fcd", w16, xi, xr, **pe)
+          - jnp.einsum("t,tfc,tfd->fcd", w16, xr, xi, **pe))
+    return jax.lax.complex(re, im)
+
+
+def weighted_cov_folded(y, mask, precision: str = "f32"):
+    """ONE covariance of the mask-applied stack without materializing it:
+    the generalized masked-rank-1-update accumulator behind
+    :func:`masked_covariances_folded`.
+
+    ``mask`` is (..., F, T) (shared over channels) or (..., C, F, T)
+    (per-channel — the step-2 stacked ``[local mics ‖ z]`` layouts where
+    each channel carries its own mask, e.g. the 'none' policy's
+    ``[(1-m) · Y ‖ zn]`` noise stack expressed as masks ``[(1-m) ‖ 1]``
+    over ``[Y ‖ zn]``).  ``precision='bf16'`` runs the contraction with
+    bf16 operands in planar re/im form with f32 accumulators.
+
+    The mask->covariance stage of reference tango.py:347-364, re-associated
+    so the masked spectrogram copies never exist.
+    """
+    y = jnp.asarray(y)
+    mask = jnp.asarray(mask, jnp.float32)
+    if mask.ndim == y.ndim:
+        return _weighted_cov_chan(y, mask, precision)
+    return _weighted_cov_shared(y, mask * mask, precision)
+
+
+def masked_covariances_folded(y, mask, precision: str = "f32"):
+    """Speech/noise covariance pair with the TF mask folded into the
+    accumulation — the XLA twin of :func:`masked_cov_pallas` (same
+    semantics as ``beam.covariance.masked_covariances``, reference
+    tango.py:347-364, to f32 re-association roundoff): ``Rss`` weights by
+    the mask, ``Rnn`` by its complement, and neither ``m*Y`` nor
+    ``(1-m)*Y`` is ever a program value.  Accepts shared (..., F, T) or
+    per-channel (..., C, F, T) masks like the pallas kernel.
+    """
+    y = jnp.asarray(y)
+    mask = jnp.asarray(mask, jnp.float32)
+    return (
+        weighted_cov_folded(y, mask, precision),
+        weighted_cov_folded(y, 1.0 - mask, precision),
+    )
 
 
 #: Environment escape hatch for the default covariance kernel selection:
@@ -197,39 +385,35 @@ def resolve_cov_impl(impl: str = "auto") -> str:
 
     No reference counterpart: kernel selection is a TPU-port concern — the
     reference computes its covariances one way only (numpy einsum,
-    tango.py:347-364, the stage both kernels implement).
+    tango.py:347-364, the stage both kernels implement).  Backed by the
+    shared resolution policy (:func:`disco_tpu.ops.resolve.resolve_impl`)
+    since the STFT seam landed, so ``cov_impl='auto'`` and
+    ``stft_impl='auto'`` can never resolve differently on one backend.
     """
-    if impl != "auto":
-        if impl not in ("xla", "pallas"):
-            raise ValueError(f"unknown cov impl {impl!r}; expected 'auto', 'xla' or 'pallas'")
-        return impl
-    import os
-
-    env = os.environ.get(COV_IMPL_ENV, "").strip().lower()
-    if env:
-        if env not in ("xla", "pallas"):
-            raise ValueError(f"{COV_IMPL_ENV}={env!r}: expected 'xla' or 'pallas'")
-        return env
-    from disco_tpu.utils.backend import is_tpu
-
-    return "pallas" if is_tpu() else "xla"
+    return resolve_impl(impl, COV_IMPL_ENV)
 
 
-def masked_covariances_fused(y, mask, impl: str = "xla", interpret: bool | None = None):
+def masked_covariances_fused(y, mask, impl: str = "xla", interpret: bool | None = None,
+                             precision: str = "f32"):
     """Masked speech/noise covariance pair with implementation dispatch —
     the mask->covariance stage of reference tango.py:347-364.
 
-    'xla': einsum via materialized masked copies (``beam.covariance``);
-    'pallas': single fused read of Y (:func:`masked_cov_pallas`).
+    'xla': the FOLDED einsum (:func:`masked_covariances_folded`) — since
+    the hot-path fusion round this lane no longer materializes the masked
+    copies either (the materializing reference formulation survives as
+    ``beam.covariance.masked_covariances``, which the perf-check parity
+    gate pins this path against); 'pallas': single fused read of Y
+    (:func:`masked_cov_pallas`).  Both accept shared (..., F, T) or
+    per-channel (..., C, F, T) masks and the ``precision`` lane.
     ``interpret=None`` resolves to the pallas interpreter off-TPU (the
     Mosaic lowering is TPU-only) — the one place this decision lives.
     """
     if impl == "xla":
-        return masked_covariances(y, mask)
+        return masked_covariances_folded(y, mask, precision=precision)
     if impl == "pallas":
         if interpret is None:
             from disco_tpu.utils.backend import is_tpu
 
             interpret = not is_tpu()
-        return masked_cov_pallas(y, mask, interpret=interpret)
+        return masked_cov_pallas(y, mask, interpret=interpret, precision=precision)
     raise ValueError(f"unknown cov impl {impl!r}; expected 'xla' or 'pallas'")

@@ -1,0 +1,119 @@
+"""Shared kernel-implementation and precision resolution seams.
+
+Every fused kernel family in :mod:`disco_tpu.ops` exposes the same knob
+shape: an ``impl`` argument taking ``'auto' | 'xla' | 'pallas'`` with a
+``DISCO_TPU_*_IMPL`` environment escape hatch, where ``'auto'`` resolves to
+the fused pallas kernel on real TPU backends and to the XLA formulation
+everywhere else (off-TPU the pallas interpreter is a correctness tool, not
+a fast path).  Before this module each family hand-rolled that resolution
+(``ops.cov_ops.resolve_cov_impl`` was the template); now the policy lives
+ONCE, so ``cov_impl="auto"`` and ``stft_impl="auto"`` can never resolve
+differently on the same backend — pinned by tests/test_ops.py.
+
+The ``precision`` seam (``'f32'`` default, ``'bf16'`` opt-in) is resolved
+here too: :func:`resolve_precision` is the one place the token is
+validated/normalized, so every kernel family and every jit static argument
+sees the SAME canonical string — a non-canonical spelling reaching a
+``static_argnames`` seam would trace a duplicate program per call site
+(the PR-6 ``mu=1`` retrace trap, this time with strings), which the
+retrace-budget gate (``disco_tpu.analysis.trace.budgets``) holds exact.
+
+No reference counterpart: kernel selection and mixed-precision lanes are
+TPU-port concerns — the reference computes everything one way only
+(float64 numpy).
+"""
+from __future__ import annotations
+
+import os
+
+#: the concrete kernel choices every ``impl`` seam resolves to
+IMPL_CHOICES = ("xla", "pallas")
+
+#: the compute-precision lanes of the fused kernels: ``'f32'`` (default,
+#: full float32) or ``'bf16'`` (bf16 multiply inner loops with float32
+#: accumulators — gated by the documented looser oracle tolerances)
+PRECISIONS = ("f32", "bf16")
+
+
+def resolve_impl(impl: str, env_var: str) -> str:
+    """Resolve an ``impl`` knob (``'auto'``/``'xla'``/``'pallas'``) to a
+    concrete kernel choice with the shared auto policy.
+
+    ``'auto'`` resolves to ``'pallas'`` on real TPU backends and ``'xla'``
+    elsewhere, with ``env_var`` (e.g. ``DISCO_TPU_COV_IMPL``) as the
+    operator escape hatch.  Explicit choices pass through after validation.
+    Resolution happens when a program is *traced* (``impl`` knobs are
+    static jit arguments), so flipping the env var mid-process does not
+    retrace already-compiled buckets.
+
+    No reference counterpart (module docstring).
+    """
+    if impl != "auto":
+        if impl not in IMPL_CHOICES:
+            raise ValueError(
+                f"unknown impl {impl!r}; expected 'auto' or one of {IMPL_CHOICES}"
+            )
+        return impl
+    env = os.environ.get(env_var, "").strip().lower()
+    if env:
+        if env not in IMPL_CHOICES:
+            raise ValueError(f"{env_var}={env!r}: expected one of {IMPL_CHOICES}")
+        return env
+    from disco_tpu.utils.backend import is_tpu
+
+    return "pallas" if is_tpu() else "xla"
+
+
+def resolve_precision(precision: str) -> str:
+    """Validate/normalize a ``precision`` token to its canonical form
+    (``'f32'`` or ``'bf16'``).
+
+    Callers holding a jit ``static_argnames`` precision seam MUST pass the
+    canonical string (this function's output): two spellings of the same
+    lane would be two static values and therefore two traced programs —
+    the string-typed twin of the ``mu=1`` retrace trap, held exact by the
+    retrace-budget gate.
+
+    No reference counterpart (module docstring).
+    """
+    p = str(precision).strip().lower()
+    if p not in PRECISIONS:
+        raise ValueError(
+            f"unknown precision {precision!r}; expected one of {PRECISIONS}"
+        )
+    return p
+
+
+def check_canonical_precision(precision: str) -> str:
+    """Require an ALREADY-canonical precision token — the guard for
+    directly-jitted entry points whose ``precision`` is a static argument
+    (``enhance.tango.tango``/``tango_step1``/``tango_step2``).
+
+    Unlike :func:`resolve_precision` this does not normalize: a
+    normalization *inside* the traced body runs after the jit cache key is
+    formed, so every spelling variant would silently trace (and compile) a
+    duplicate program — the string-typed ``mu=1`` retrace trap.  Raising at
+    trace time turns the trap into a loud error; host-side wrappers that
+    accept user input (the CLI, the driver, ``streaming_tango``)
+    canonicalize with :func:`resolve_precision` BEFORE the static seam.
+
+    No reference counterpart (module docstring).
+    """
+    if precision not in PRECISIONS:
+        raise ValueError(
+            f"precision {precision!r} is not canonical; static jit seams must "
+            f"see exactly one spelling per lane — pass one of {PRECISIONS} "
+            "(canonicalize user input with resolve_precision first)"
+        )
+    return precision
+
+
+def compute_dtype(precision: str):
+    """The matmul/accumulation *input* dtype of a precision lane (the
+    accumulator stays float32 in both lanes — ``preferred_element_type``).
+
+    No reference counterpart (module docstring).
+    """
+    import jax.numpy as jnp
+
+    return jnp.bfloat16 if resolve_precision(precision) == "bf16" else jnp.float32

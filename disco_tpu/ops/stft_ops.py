@@ -12,10 +12,21 @@ transform — the MXU is.  Two implementations:
   at 3e-7 relative error (exact integer-mod angles).
 * :func:`stft_pallas` — the same computation as one fused pallas kernel:
   signal chunks are DMA'd HBM->VMEM per frame tile, frames/window/DFT all
-  happen in VMEM, and the framed intermediate never exists in HBM.
+  happen in VMEM, and the framed intermediate never exists in HBM.  With
+  ``with_mag=True`` the kernel ALSO emits the magnitude spectrogram,
+  computed from the re/im planes while they are still VMEM-resident — the
+  separate ``jnp.abs`` pass (one more HBM read of the full spec) that the
+  mask stage otherwise pays never happens.
 
 ``disco_tpu.core.dsp.stft`` dispatches to the matmul path on TPU backends
-automatically; the pallas kernel is opt-in (``impl='pallas'``).
+automatically; the pallas kernel is opt-in (``impl='pallas'``).  The fused
+spec+magnitude entry point :func:`stft_with_mag` has its own
+``resolve_stft_impl`` auto/xla/pallas seam (mirroring
+``ops.cov_ops.resolve_cov_impl``; ``DISCO_TPU_STFT_IMPL`` env escape
+hatch) plus the ``precision`` lane of :mod:`disco_tpu.ops.resolve`: under
+``'bf16'`` the DFT matmuls run with bf16 operands and float32 accumulators
+(``preferred_element_type``) — opt-in, gated by the documented looser
+oracle tolerances in tests/test_ops.py.
 """
 from __future__ import annotations
 
@@ -26,7 +37,27 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from disco_tpu.ops.resolve import compute_dtype, resolve_impl, resolve_precision
+
 N_FFT, N_HOP = 512, 256
+
+#: Environment escape hatch for the fused STFT kernel selection:
+#: ``DISCO_TPU_STFT_IMPL=xla`` (or ``pallas``) overrides the ``'auto'``
+#: resolution everywhere callers left ``stft_impl`` at its default.
+STFT_IMPL_ENV = "DISCO_TPU_STFT_IMPL"
+
+
+def resolve_stft_impl(impl: str = "auto") -> str:
+    """Resolve a ``stft_impl`` knob to a concrete kernel choice — the STFT
+    twin of ``ops.cov_ops.resolve_cov_impl``, backed by the SAME shared
+    policy (:func:`disco_tpu.ops.resolve.resolve_impl`): ``'auto'`` is the
+    fused pallas kernel on real TPU backends and the XLA formulation
+    elsewhere, with :data:`STFT_IMPL_ENV` as the operator escape hatch.
+
+    No reference counterpart: kernel selection is a TPU-port concern — the
+    reference computes every STFT one way only (librosa, tango.py:335).
+    """
+    return resolve_impl(impl, STFT_IMPL_ENV)
 
 
 @functools.lru_cache(maxsize=8)
@@ -60,46 +91,76 @@ def _chunked(x, n_fft, hop):
     return A, n_frames, bs
 
 
-@partial(jax.jit, static_argnames=("n_fft", "hop"))
-def stft_matmul(x: jnp.ndarray, n_fft: int = N_FFT, hop: int = N_HOP) -> jnp.ndarray:
+@partial(jax.jit, static_argnames=("n_fft", "hop", "precision"))
+def stft_matmul(
+    x: jnp.ndarray, n_fft: int = N_FFT, hop: int = N_HOP, precision: str = "f32"
+) -> jnp.ndarray:
     """Centered STFT as two MXU matmuls (see module docstring).  Identical
-    conventions and output layout to ``disco_tpu.core.dsp.stft``."""
+    conventions and output layout to ``disco_tpu.core.dsp.stft``.
+    ``precision='bf16'`` runs the DFT matmuls with bf16 operands and f32
+    accumulators (the opt-in compute lane; default unchanged)."""
     A, n_frames, bs = _chunked(x, n_fft, hop)
     frames = jnp.concatenate([A[:, :-1], A[:, 1:]], axis=-1)  # (B, T, n_fft)
     wf = frames * _hann(n_fft, frames.dtype)
     Dre, Dim = (jnp.asarray(d) for d in dft_matrices(n_fft))
-    spec = jax.lax.complex(
-        jnp.matmul(wf, Dre, precision="float32"),
-        jnp.matmul(wf, Dim, precision="float32"),
-    )
+    if resolve_precision(precision) == "bf16":
+        dt = compute_dtype(precision)
+        spec = jax.lax.complex(
+            jnp.matmul(wf.astype(dt), Dre.astype(dt), preferred_element_type=jnp.float32),
+            jnp.matmul(wf.astype(dt), Dim.astype(dt), preferred_element_type=jnp.float32),
+        )
+    else:
+        spec = jax.lax.complex(
+            jnp.matmul(wf, Dre, precision="float32"),
+            jnp.matmul(wf, Dim, precision="float32"),
+        )
     return jnp.swapaxes(spec, -1, -2).reshape(bs + (n_fft // 2 + 1, n_frames))
 
 
 # --------------------------------------------------------------- pallas path
-def _stft_kernel(a0_ref, a1_ref, dre_ref, dim_ref, win_ref, re_ref, im_ref):
+def _stft_kernel(a0_ref, a1_ref, dre_ref, dim_ref, win_ref, re_ref, im_ref, *rest):
     """One (batch, frame-tile) program: frames assembled from the two
-    shifted chunk views in VMEM, windowed, DFT'd on the MXU."""
+    shifted chunk views in VMEM, windowed, DFT'd on the MXU.  The chunk
+    views and DFT matrices arrive pre-cast to the precision lane's compute
+    dtype (bf16 under ``precision='bf16'``); the dots accumulate in f32
+    either way.  With a trailing ``mag_ref`` the magnitude is computed from
+    the re/im tiles while they are still VMEM-resident and stored as a
+    third output — the downstream ``jnp.abs`` HBM pass never happens."""
     frames = jnp.concatenate([a0_ref[0], a1_ref[0]], axis=-1)  # (TILE_T, n_fft)
-    wf = frames * win_ref[:]
-    re_ref[0] = jnp.dot(wf, dre_ref[:], precision="float32", preferred_element_type=jnp.float32)
-    im_ref[0] = jnp.dot(wf, dim_ref[:], precision="float32", preferred_element_type=jnp.float32)
+    wf = frames * win_ref[:].astype(frames.dtype)
+    # f32 lane: pinned float32 MXU passes (the pre-fusion program, bit-
+    # compatible); bf16 lane: operand dtype IS the precision request, the
+    # preferred_element_type keeps the accumulator f32
+    kw = (dict(precision="float32") if frames.dtype == jnp.float32 else {})
+    re = jnp.dot(wf, dre_ref[:], preferred_element_type=jnp.float32, **kw)
+    im = jnp.dot(wf, dim_ref[:], preferred_element_type=jnp.float32, **kw)
+    re_ref[0] = re
+    im_ref[0] = im
+    if rest:
+        rest[0][0] = jnp.sqrt(re * re + im * im)
 
 
-@partial(jax.jit, static_argnames=("n_fft", "hop", "tile_t", "interpret"))
+@partial(jax.jit, static_argnames=("n_fft", "hop", "tile_t", "interpret",
+                                   "precision", "with_mag"))
 def stft_pallas(
     x: jnp.ndarray,
     n_fft: int = N_FFT,
     hop: int = N_HOP,
     tile_t: int = 128,
     interpret: bool = False,
-) -> jnp.ndarray:
+    precision: str = "f32",
+    with_mag: bool = False,
+):
     """Fused pallas STFT (frame + window + DFT in VMEM, grid over
     (batch, frame tiles)).  Same output as :func:`stft_matmul`.
 
     The framed (B, T, 512) intermediate never touches HBM: each grid step
     reads a (tile_t + 1, hop) chunk strip and writes (tile_t, 257) re/im.
     ``interpret=True`` runs the kernel in the pallas interpreter (CPU
-    correctness tests).
+    correctness tests).  ``with_mag=True`` additionally emits the magnitude
+    spectrogram (computed in VMEM — see :func:`_stft_kernel`) and returns
+    ``(spec, mag)``; ``precision='bf16'`` feeds the DFT dots bf16 operands
+    with f32 accumulation.
     """
     from jax.experimental import pallas as pl
 
@@ -112,12 +173,14 @@ def stft_pallas(
     n_tiles = -(-n_frames // tile_t)
     rows_needed = n_tiles * tile_t + 1
     A = jnp.pad(A, ((0, 0), (0, rows_needed - A.shape[1]), (0, 0)))
-    A0 = A[:, :-1]
-    A1 = A[:, 1:]
-    Dre, Dim = (jnp.asarray(d) for d in dft_matrices(n_fft))
+    dt = compute_dtype(precision)
+    A0 = A[:, :-1].astype(dt)
+    A1 = A[:, 1:].astype(dt)
+    Dre, Dim = (jnp.asarray(d).astype(dt) for d in dft_matrices(n_fft))
     win = _hann(n_fft)
 
-    re, im = pl.pallas_call(
+    n_out = 3 if with_mag else 2
+    out = pl.pallas_call(
         _stft_kernel,
         grid=(B, n_tiles),
         in_specs=[
@@ -129,16 +192,97 @@ def stft_pallas(
         ],
         out_specs=[
             pl.BlockSpec((1, tile_t, n_freq), lambda b, t: (b, t, 0)),
-            pl.BlockSpec((1, tile_t, n_freq), lambda b, t: (b, t, 0)),
-        ],
+        ] * n_out,
         out_shape=[
             jax.ShapeDtypeStruct((B, n_tiles * tile_t, n_freq), jnp.float32),
-            jax.ShapeDtypeStruct((B, n_tiles * tile_t, n_freq), jnp.float32),
-        ],
+        ] * n_out,
         interpret=interpret,
     )(A0, A1, Dre, Dim, win)
+    re, im = out[0], out[1]
     spec = jax.lax.complex(re, im)[:, :n_frames]
-    return jnp.swapaxes(spec, -1, -2).reshape(bs + (n_freq, n_frames))
+    spec = jnp.swapaxes(spec, -1, -2).reshape(bs + (n_freq, n_frames))
+    if not with_mag:
+        return spec
+    mag = jnp.swapaxes(out[2][:, :n_frames], -1, -2).reshape(bs + (n_freq, n_frames))
+    return spec, mag
+
+
+def stft_with_mag(
+    x: jnp.ndarray,
+    n_fft: int = N_FFT,
+    hop: int = N_HOP,
+    impl: str = "auto",
+    precision: str = "f32",
+    interpret: bool | None = None,
+):
+    """Fused STFT returning ``(spec, mag)`` for ALL leading-axis channels in
+    one pass — the analysis stage of the enhancement hot path (the three
+    y/s/n streams stack on a leading axis and transform together), emitting
+    both the complex spec and the magnitude the mask stage consumes so the
+    separate ``stft`` + ``jnp.abs`` round-trips disappear.
+
+    Implementation seam (``resolve_stft_impl``, mirroring
+    ``ops.cov_ops.resolve_cov_impl``; ``DISCO_TPU_STFT_IMPL`` env escape
+    hatch):
+
+    * 'xla': ``disco_tpu.core.dsp.stft``'s backend-auto path (rFFT off-TPU,
+      MXU matmul on TPU — bit-identical to the pre-fusion pipeline at the
+      default precision) + ``jnp.abs``; XLA fuses the abs when traced
+      inside a larger program.
+    * 'pallas': :func:`stft_pallas` ``with_mag=True`` — framing, window,
+      DFT and magnitude all in VMEM; the framed intermediate and the
+      spec re-read for ``abs`` never touch HBM.
+
+    ``precision='bf16'`` (ops.resolve lane) runs the DFT matmuls with bf16
+    operands and f32 accumulators; on the 'xla' lane this selects the
+    matmul formulation (rFFT has no bf16 form).
+
+    No reference counterpart: the reference computes STFTs and magnitudes
+    in separate per-channel librosa calls (tango.py:335-337) — fusing them
+    is a TPU-port concern.
+    """
+    impl = resolve_stft_impl(impl)
+    precision = resolve_precision(precision)
+    if impl == "pallas":
+        if interpret is None:
+            from disco_tpu.utils.backend import is_tpu
+
+            interpret = not is_tpu()
+        return stft_pallas(x, n_fft, hop, interpret=interpret,
+                           precision=precision, with_mag=True)
+    spec = stft_fused(x, n_fft, hop, impl=impl, precision=precision)
+    return spec, jnp.abs(spec)
+
+
+def stft_fused(
+    x: jnp.ndarray,
+    n_fft: int = N_FFT,
+    hop: int = N_HOP,
+    impl: str = "auto",
+    precision: str = "f32",
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Spec-only twin of :func:`stft_with_mag` — the same
+    ``resolve_stft_impl``/``precision`` seams for callers whose masks are
+    computed in-program (the corpus batch runners compute oracle masks
+    inside the jitted chunk program, so emitting a magnitude here would be
+    a dead output).
+
+    No reference counterpart (see :func:`stft_with_mag`).
+    """
+    impl = resolve_stft_impl(impl)
+    precision = resolve_precision(precision)
+    if impl == "pallas":
+        if interpret is None:
+            from disco_tpu.utils.backend import is_tpu
+
+            interpret = not is_tpu()
+        return stft_pallas(x, n_fft, hop, interpret=interpret, precision=precision)
+    if precision == "bf16":
+        return stft_matmul(x, n_fft, hop, precision=precision)
+    from disco_tpu.core.dsp import stft
+
+    return stft(x, n_fft, hop)
 
 
 @functools.lru_cache(maxsize=8)

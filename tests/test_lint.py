@@ -30,8 +30,8 @@ def rule_ids(res):
 # -- registry ----------------------------------------------------------------
 def test_rule_catalog_shape():
     rules = analysis.get_rules()
-    assert len(rules) == 11
-    assert sorted(rules) == [f"DL{i:03d}" for i in range(1, 12)]
+    assert len(rules) == 12
+    assert sorted(rules) == [f"DL{i:03d}" for i in range(1, 13)]
     for rid, rule in rules.items():
         assert rule.id == rid and rule.name and rule.summary
 
@@ -505,3 +505,65 @@ def test_shipped_suppressions_are_load_bearing():
 def test_satellite_fix_reverts_fail_the_gate(src, rel, rule):
     res = lint(src, rel, rules={rule})
     assert rule in rule_ids(res)
+
+
+# -- DL012 fused-magnitude-precision -----------------------------------------
+def test_dl012_flags_abs_of_stft():
+    src = """
+    import jax.numpy as jnp
+    from disco_tpu.core.dsp import stft
+    def features(y):
+        return jnp.abs(stft(y))
+    """
+    res = lint(src, "disco_tpu/enhance/foo.py", rules={"DL012"})
+    assert rule_ids(res) == ["DL012"]
+    # np.abs over the matmul/pallas entry points counts too
+    src2 = """
+    import numpy as np
+    from disco_tpu.ops.stft_ops import stft_matmul
+    mag = np.abs(stft_matmul(y))
+    """
+    assert rule_ids(lint(src2, "disco_tpu/nn/feats.py",
+                         rules={"DL012"})) == ["DL012"]
+
+
+def test_dl012_flags_bf16_cast_literals():
+    src = """
+    import jax.numpy as jnp
+    def f(x):
+        return x.astype("bfloat16")
+    """
+    assert rule_ids(lint(src, "disco_tpu/enhance/foo.py",
+                         rules={"DL012"})) == ["DL012"]
+    src2 = """
+    import jax.numpy as jnp
+    def f(x):
+        y = x.astype(jnp.bfloat16)
+        z = jnp.zeros((3,), dtype=jnp.bfloat16)
+        return y, z
+    """
+    assert rule_ids(lint(src2, "disco_tpu/serve/foo.py",
+                         rules={"DL012"})) == ["DL012", "DL012"]
+
+
+def test_dl012_near_misses():
+    # abs of a VARIABLE holding a spec (not a nested stft call), f32 casts,
+    # and the precision= seam itself are all fine
+    src = """
+    import jax.numpy as jnp
+    from disco_tpu.core.dsp import stft
+    def f(y):
+        spec = stft(y)
+        mag = jnp.abs(spec)
+        g = mag.astype("float32")
+        return tango(spec, precision="bf16")   # requesting the lane is the point
+    """
+    assert rule_ids(lint(src, "disco_tpu/enhance/foo.py", rules={"DL012"})) == []
+    # inside ops/ both shapes ARE the implementation — exempt
+    src2 = """
+    import jax.numpy as jnp
+    from disco_tpu.core.dsp import stft
+    def stft_with_mag(y):
+        return jnp.abs(stft(y)), y.astype(jnp.bfloat16)
+    """
+    assert rule_ids(lint(src2, "disco_tpu/ops/stft_ops.py", rules={"DL012"})) == []

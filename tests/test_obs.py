@@ -680,3 +680,69 @@ def test_fit_records_epoch_events(tmp_path):
     assert obs.REGISTRY.gauge("val_loss").value == pytest.approx(
         epochs[2]["attrs"]["val_loss"]
     )
+
+
+def test_obs_compare_mfu_and_stage_lanes(tmp_path):
+    """The hot-path-fusion lanes: mfu (higher better) and the two dominant
+    stage_ms entries (lower better) are judged like the corpus/serve lanes —
+    baseline-gated, lost-measured-lane = REGRESSION, inverted sign for the
+    stage times."""
+    def rec(path, rtf, mfu=None, stft=None, step2=None):
+        d = _bench_record(rtf)
+        if mfu is not None:
+            d["mfu"] = mfu
+        if stft is not None:
+            d["stage_ms"]["stft_x3"] = stft
+            d["stage_ms"]["step2_exchange_mwf"] = step2
+        p = tmp_path / path
+        p.write_text(json.dumps(d))
+        return p
+
+    base = rec("base.json", 6700.0, mfu=0.03, stft=57.6, step2=115.9)
+    # a 2x stage-time REDUCTION with mfu up = IMPROVED (not a regression —
+    # lower stage_ms is better)
+    good = rec("good.json", 6710.0, mfu=0.11, stft=25.0, step2=50.0)
+    assert obs_cli.main(["compare", str(base), str(good)])["verdict"] == "IMPROVED"
+    # stage time BLOWING UP regresses even with the headline flat
+    slow = rec("slow.json", 6710.0, mfu=0.03, stft=80.0, step2=115.9)
+    with pytest.raises(SystemExit):
+        obs_cli.main(["compare", str(base), str(slow)])
+    # losing a measured mfu lane = REGRESSION
+    lost = rec("lost.json", 6710.0, stft=57.6, step2=115.9)
+    with pytest.raises(SystemExit):
+        obs_cli.main(["compare", str(base), str(lost)])
+    # a baseline without the lanes never judges them (pre-fusion records)
+    old_base = rec("old_base.json", 6700.0)
+    assert obs_cli.main(
+        ["compare", str(old_base), str(lost)]
+    )["verdict"] == "OK"
+
+
+def test_bench_record_carries_fused_kernel_fields(monkeypatch, capsys):
+    """The ONE-JSON-line record documents the active fused kernels: the
+    stft_impl/precision fields plus the bf16 error-reporting lane ride the
+    line exactly like cov_impl does."""
+    import bench
+
+    canned = dict(_canned_bench_jax())
+    canned.update({
+        "cov_impl": "xla", "stft_impl": "xla", "precision": "f32",
+        "rtf_bf16": 7200.0, "bf16_max_rel_err": 0.0021, "bf16_error": None,
+    })
+    monkeypatch.setattr(bench, "bench_jax", lambda **_: canned)
+    monkeypatch.setattr(bench, "bench_streaming", lambda **_: (0.85, 16.0, 18.9))
+    monkeypatch.setattr(bench, "bench_streaming_scan",
+                        lambda **_: (95.0, 2.7, 0.125,
+                                     {"blocks_per_dispatch": 8}))
+    monkeypatch.setattr(bench, "bench_corpus", _canned_bench_corpus)
+    monkeypatch.setattr(bench, "bench_serve", _canned_bench_serve)
+    monkeypatch.setattr(bench, "bench_numpy", lambda **_: 3.0)
+    bench.main([])
+    out_lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(out_lines) == 1
+    record = json.loads(out_lines[0])
+    assert record["stft_impl"] == "xla"
+    assert record["precision"] == "f32"
+    assert record["rtf_bf16"] == 7200.0
+    assert record["bf16_max_rel_err"] == 0.0021
+    assert record["bf16_error"] is None

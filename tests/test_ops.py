@@ -179,3 +179,204 @@ def test_masked_cov_pallas_under_vmap():
     got = jax.vmap(lambda yy, mm: masked_cov_pallas(yy, mm, interpret=True))(y, m)
     np.testing.assert_allclose(np.asarray(got[0]), np.asarray(ref[0]), rtol=2e-4, atol=1e-6)
     np.testing.assert_allclose(np.asarray(got[1]), np.asarray(ref[1]), rtol=2e-4, atol=1e-6)
+
+
+# ----------------------------------------------- impl / precision resolution
+def test_resolve_seams_identical_per_backend(monkeypatch):
+    """cov_impl='auto' and stft_impl='auto' must resolve to the SAME kernel
+    class on any one backend — both are backed by ops.resolve.resolve_impl."""
+    import disco_tpu.utils.backend as backend
+
+    from disco_tpu.ops.cov_ops import resolve_cov_impl
+    from disco_tpu.ops.stft_ops import resolve_stft_impl
+
+    monkeypatch.delenv("DISCO_TPU_COV_IMPL", raising=False)
+    monkeypatch.delenv("DISCO_TPU_STFT_IMPL", raising=False)
+    # this suite runs on CPU: auto -> xla for both
+    assert resolve_cov_impl("auto") == "xla"
+    assert resolve_stft_impl("auto") == "xla"
+    # forced TPU (memoized backend probe): auto -> pallas for both
+    monkeypatch.setattr(backend, "_cached", True)
+    assert resolve_cov_impl("auto") == "pallas"
+    assert resolve_stft_impl("auto") == "pallas"
+    # explicit choices pass through regardless of backend
+    assert resolve_cov_impl("xla") == resolve_stft_impl("xla") == "xla"
+
+
+def test_resolve_env_escape_hatches(monkeypatch):
+    from disco_tpu.ops.cov_ops import resolve_cov_impl
+    from disco_tpu.ops.stft_ops import resolve_stft_impl
+
+    monkeypatch.setenv("DISCO_TPU_COV_IMPL", "pallas")
+    monkeypatch.setenv("DISCO_TPU_STFT_IMPL", "pallas")
+    assert resolve_cov_impl("auto") == "pallas"
+    assert resolve_stft_impl("auto") == "pallas"
+    # an explicit impl wins over the env var
+    assert resolve_cov_impl("xla") == resolve_stft_impl("xla") == "xla"
+    monkeypatch.setenv("DISCO_TPU_STFT_IMPL", "bogus")
+    with pytest.raises(ValueError, match="DISCO_TPU_STFT_IMPL"):
+        resolve_stft_impl("auto")
+    with pytest.raises(ValueError, match="unknown impl"):
+        resolve_cov_impl("mosaic")
+
+
+def test_resolve_precision_canonicalizes_and_rejects():
+    from disco_tpu.ops.resolve import compute_dtype, resolve_precision
+
+    assert resolve_precision("f32") == "f32"
+    assert resolve_precision(" BF16 ") == "bf16"  # canonical form, one spelling
+    with pytest.raises(ValueError, match="unknown precision"):
+        resolve_precision("fp8")
+    import jax.numpy as jnp
+
+    assert compute_dtype("f32") == jnp.float32
+    assert compute_dtype("bf16") == jnp.bfloat16
+
+
+# --------------------------------------------------- fused spec+mag STFT
+def test_stft_with_mag_xla_bit_identical_to_stft_abs(sig):
+    """The 'xla' lane is the pre-fusion program: spec bit-identical to
+    dsp.stft's backend-auto path, mag bit-identical to jnp.abs of it."""
+    from disco_tpu.ops.stft_ops import stft_with_mag
+
+    spec, mag = stft_with_mag(sig, impl="xla")
+    ref = np.asarray(stft(sig))
+    np.testing.assert_array_equal(np.asarray(spec), ref)
+    np.testing.assert_array_equal(np.asarray(mag), np.abs(ref))
+
+
+def test_stft_with_mag_pallas_parity(sig):
+    from disco_tpu.ops.stft_ops import stft_with_mag
+
+    ref = np.asarray(_stft_rfft(sig))
+    spec, mag = stft_with_mag(sig, impl="pallas", interpret=True)
+    scale = np.max(np.abs(ref))
+    assert np.max(np.abs(np.asarray(spec) - ref)) / scale < 1e-5
+    assert np.max(np.abs(np.asarray(mag) - np.abs(ref))) / scale < 1e-5
+
+
+def test_stft_with_mag_bf16_lane_tolerance(sig):
+    """Documented bf16-lane tolerance for the STFT stage: 1e-2 max relative
+    deviation vs the f32 rFFT reference (measured ~2e-3 — bf16 operands,
+    f32 accumulators), on BOTH impls."""
+    from disco_tpu.ops.stft_ops import stft_with_mag
+
+    ref = np.asarray(_stft_rfft(sig))
+    scale = np.max(np.abs(ref))
+    for impl in ("xla", "pallas"):
+        spec, mag = stft_with_mag(sig, impl=impl, precision="bf16", interpret=True)
+        assert np.max(np.abs(np.asarray(spec) - ref)) / scale < 1e-2, impl
+        assert np.max(np.abs(np.asarray(mag) - np.abs(ref))) / scale < 1e-2, impl
+
+
+def test_stft_fused_spec_only_matches_with_mag(sig):
+    from disco_tpu.ops.stft_ops import stft_fused, stft_with_mag
+
+    for impl in ("xla", "pallas"):
+        spec = stft_fused(sig, impl=impl, interpret=True)
+        spec2, _ = stft_with_mag(sig, impl=impl, interpret=True)
+        np.testing.assert_array_equal(np.asarray(spec), np.asarray(spec2))
+
+
+def test_stft_with_mag_unknown_impl():
+    from disco_tpu.ops.stft_ops import stft_with_mag
+
+    with pytest.raises(ValueError, match="unknown impl"):
+        stft_with_mag(np.zeros((1, 4096), "float32"), impl="bogus")
+
+
+# --------------------------------------------------- folded masked covs
+def test_masked_cov_folded_matches_float64_oracle():
+    """The folded einsum (the post-fusion 'xla' default of the tango steps)
+    against the float64 oracle AND the materializing einsum it replaced."""
+    from tests.reference_impls import covariances_np
+
+    from disco_tpu.beam.covariance import masked_covariances
+    from disco_tpu.ops.cov_ops import masked_covariances_folded
+
+    rng = np.random.default_rng(15)
+    y, m = _cov_case(rng, lead=())
+    y64, m64 = np.asarray(y, np.complex128), np.asarray(m, np.float64)
+    Rss_or = covariances_np(m64[None] * y64)
+    Rnn_or = covariances_np((1.0 - m64)[None] * y64)
+    Rss, Rnn = masked_covariances_folded(y, m)
+    np.testing.assert_allclose(np.asarray(Rss), Rss_or, rtol=5e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(Rnn), Rnn_or, rtol=5e-4, atol=1e-6)
+    Rss_ref, Rnn_ref = masked_covariances(y, m)
+    np.testing.assert_allclose(np.asarray(Rss), np.asarray(Rss_ref), rtol=2e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(Rnn), np.asarray(Rnn_ref), rtol=2e-4, atol=1e-6)
+
+
+def test_masked_cov_folded_per_channel_masks():
+    """(C, F, T) per-channel masks — the step-2 stacked [mics ‖ z] layout of
+    the 'distant' policy — vs materializing each channel's masked stream."""
+    from disco_tpu.beam.covariance import frame_mean_covariance
+    from disco_tpu.ops.cov_ops import masked_covariances_folded, weighted_cov_folded
+
+    rng = np.random.default_rng(16)
+    y, _ = _cov_case(rng, lead=(), C=5, F=17, T=40)
+    mc = rng.random((5, 17, 40)).astype(np.float32)
+    Rss, Rnn = masked_covariances_folded(y, mc)
+    Rss_ref = np.asarray(frame_mean_covariance(mc * y))
+    Rnn_ref = np.asarray(frame_mean_covariance((1.0 - mc) * y))
+    np.testing.assert_allclose(np.asarray(Rss), Rss_ref, rtol=2e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(Rnn), Rnn_ref, rtol=2e-4, atol=1e-6)
+    # the single-cov fold (the 'none' policy's building block)
+    R1 = weighted_cov_folded(y, mc)
+    np.testing.assert_allclose(np.asarray(R1), Rss_ref, rtol=2e-4, atol=1e-6)
+
+
+def test_masked_cov_pallas_per_channel_masks():
+    """The extended pallas kernel under per-channel masks, interpret mode."""
+    from disco_tpu.beam.covariance import frame_mean_covariance
+    from disco_tpu.ops.cov_ops import masked_cov_pallas
+
+    rng = np.random.default_rng(17)
+    y, _ = _cov_case(rng, lead=(), C=4, F=17, T=53)
+    mc = rng.random((4, 17, 53)).astype(np.float32)
+    Rss, Rnn = masked_cov_pallas(y, mc, t_tile=16, f_tile=8, interpret=True)
+    Rss_ref = np.asarray(frame_mean_covariance(mc * y))
+    Rnn_ref = np.asarray(frame_mean_covariance((1.0 - mc) * y))
+    np.testing.assert_allclose(np.asarray(Rss), Rss_ref, rtol=5e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(Rnn), Rnn_ref, rtol=5e-4, atol=1e-6)
+
+
+def test_cov_bf16_lane_tolerance():
+    """Documented bf16-lane tolerance for the covariance stage: 3e-2 max
+    relative deviation vs the float64 oracle (measured ~2e-3 folded /
+    ~2.5e-3 pallas on this case — bf16 products, f32 accumulation)."""
+    from tests.reference_impls import covariances_np
+
+    from disco_tpu.ops.cov_ops import masked_cov_pallas, masked_covariances_folded
+
+    rng = np.random.default_rng(18)
+    y, m = _cov_case(rng, lead=())
+    y64, m64 = np.asarray(y, np.complex128), np.asarray(m, np.float64)
+    Rss_or = covariances_np(m64[None] * y64)
+    scale = np.max(np.abs(Rss_or))
+    for impl_fn in (
+        lambda: masked_covariances_folded(y, m, precision="bf16")[0],
+        lambda: masked_cov_pallas(y, m, interpret=True, precision="bf16")[0],
+    ):
+        got = np.asarray(impl_fn())
+        assert np.max(np.abs(got - Rss_or)) / scale < 3e-2
+
+
+def test_outer_acc_bf16_matches_f32_at_tolerance():
+    """The streaming tail accumulator's bf16 form vs its f32 einsum."""
+    import jax
+    import jax.numpy as jnp
+
+    from disco_tpu.ops.cov_ops import outer_acc_bf16
+
+    rng = np.random.default_rng(19)
+    x = (rng.standard_normal((3, 9, 4)) + 1j * rng.standard_normal((3, 9, 4))
+         ).astype(np.complex64)
+    w = rng.random(3).astype(np.float32)
+    ref = np.asarray(jnp.einsum("t,tfc,tfd->fcd", w, x, np.conj(x),
+                                precision=jax.lax.Precision.HIGHEST))
+    got = np.asarray(outer_acc_bf16(jnp.asarray(w), jnp.asarray(x)))
+    assert np.max(np.abs(got - ref)) / np.max(np.abs(ref)) < 3e-2
+    # hermitian by construction
+    np.testing.assert_allclose(got, np.conj(np.swapaxes(got, -1, -2)),
+                               rtol=1e-5, atol=1e-6)

@@ -6,7 +6,11 @@ import pytest
 from disco_tpu.core.dsp import istft, stft
 from disco_tpu.core.metrics import si_sdr
 from disco_tpu.enhance import oracle_masks
-from disco_tpu.enhance.streaming import streaming_step1, streaming_tango
+from disco_tpu.enhance.streaming import (
+    streaming_step1,
+    streaming_tango,
+    streaming_tango_scan,
+)
 
 FS = 16000
 
@@ -380,3 +384,47 @@ def test_streaming_jacobi_solver_matches_eigh(scene):
         sdr_e = float(si_sdr(s[k, 0, FS:], np.asarray(istft(np.asarray(out_e["yf"])[k], length=L))[FS:]))
         sdr_j = float(si_sdr(s[k, 0, FS:], np.asarray(istft(np.asarray(out_j["yf"])[k], length=L))[FS:]))
         assert abs(sdr_e - sdr_j) < 0.2, (k, sdr_e, sdr_j)
+
+
+def test_bf16_lane_scan_vs_per_block_bit_exact():
+    """The bit-exactness contract holds PER LANE: under precision='bf16' the
+    scanned super-tick still shares _streaming_tango_body with the per-block
+    path, so chunked per-block continuation must reproduce the scan output
+    bit-for-bit (same construction as the f32 gate — the lane changes the
+    kernels, never the program-sharing)."""
+    rng = np.random.default_rng(23)
+    K_, C_, L_ = 3, 2, 12288
+    y = rng.standard_normal((K_, C_, L_)).astype("float32")
+    Y = stft(y)
+    F, T = Y.shape[-2:]
+    u, n_disp = 4, 2
+    Tc = (T // (n_disp * u)) * u * n_disp
+    Yw = Y[..., :Tc]
+    m = rng.uniform(0.1, 0.9, (K_, F, Tc)).astype("float32")
+    scan = streaming_tango_scan(Yw, m, m, update_every=u,
+                                blocks_per_dispatch=n_disp, precision="bf16")
+    half = Tc // n_disp
+    o1 = streaming_tango(Yw[..., :half], m[..., :half], m[..., :half],
+                         update_every=u, precision="bf16")
+    o2 = streaming_tango(Yw[..., half:], m[..., half:], m[..., half:],
+                         update_every=u, state=o1["state"], precision="bf16")
+    per_block = np.concatenate([np.asarray(o1["yf"]), np.asarray(o2["yf"])], axis=-1)
+    np.testing.assert_array_equal(per_block, np.asarray(scan["yf"]))
+
+
+def test_streaming_f32_default_ignores_precision_spelling():
+    """Canonicalization guard: passing precision='F32 ' (non-canonical
+    spelling) reaches the static seam as the one canonical token — same
+    program, bit-identical output, no duplicate trace (the string-typed
+    mu=1 trap)."""
+    from disco_tpu.obs.accounting import recompile_count
+
+    rng = np.random.default_rng(24)
+    y = rng.standard_normal((2, 2, 8192)).astype("float32")
+    Y = stft(y)
+    m = rng.uniform(0.1, 0.9, (2,) + Y.shape[-2:]).astype("float32")
+    a = streaming_tango(Y, m, m)
+    before = recompile_count("streaming_tango")
+    b = streaming_tango(Y, m, m, precision=" F32 ")
+    assert recompile_count("streaming_tango") == before  # no fresh program
+    np.testing.assert_array_equal(np.asarray(a["yf"]), np.asarray(b["yf"]))
