@@ -3,9 +3,9 @@
 
 PYTHON ?= python
 
-.PHONY: test coverage doc install native clean bench milestone-corpus dryrun lint-check trace-check obs-check fault-check chaos-check perf-check serve-check stream-check
+.PHONY: test coverage doc install native clean bench milestone-corpus dryrun lint-check trace-check obs-check fault-check chaos-check perf-check serve-check stream-check flywheel-check
 
-test: lint-check trace-check obs-check fault-check chaos-check perf-check stream-check serve-check
+test: lint-check trace-check obs-check fault-check chaos-check perf-check stream-check serve-check flywheel-check
 	$(PYTHON) -m pytest tests/ -q
 
 # Static-analysis gate (runs FIRST: it needs no jax, no device and ~2 s):
@@ -100,6 +100,24 @@ stream-check:
 serve-check:
 	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= DISCO_TPU_COMPILE_CACHE=off \
 	    $(PYTHON) -m disco_tpu.serve.check
+
+# Flywheel gate (the tenth gate): close the serve→train loop end to end —
+# loopback serve traffic with the corpus tap on (zero drops, the
+# one-batched-readback-per-tick invariant intact), clean shard digests
+# verified through the manifest ledger, an injected mid_write chaos crash
+# that must leave NO torn shard at a final path (and a planted truncated
+# shard the dataset must skip loudly), deterministic + ledger-resumable
+# dataset replay, then data-parallel CRNN training on the 8-virtual-device
+# mesh with loss parity vs the single-device oracle (bit-exact on the
+# 1-device mesh; documented MESH_LOSS_RTOL across shards) and the
+# ChunkPrefetcher batch-feed overlap gauges + explicit epochs_done
+# checkpoint field pinned (disco_tpu/flywheel/check.py).  Hermetic: CPU
+# forced, 8 virtual devices, compile cache off, loopback only, one JAX
+# process, zero SIGKILLs.
+flywheel-check:
+	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= DISCO_TPU_COMPILE_CACHE=off \
+	    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	    $(PYTHON) -m disco_tpu.flywheel.check
 
 coverage:
 	$(PYTHON) -m coverage run --branch --source=disco_tpu -m pytest tests/ -q

@@ -498,6 +498,94 @@ def bench_serve(n_sessions=4, dur_s=4.0):
     return total_blocks / dt, p95_ms, stats
 
 
+def bench_train(n_steps=8, batch=8):
+    """Flywheel training lane: ``train_steps_per_s`` — jitted CRNN
+    train-step throughput (``nn.training.make_step_fns``) on synthetic
+    windowed batches.  The steps form a sequential state chain, so queuing
+    them async and fencing ONCE on the last loss drains the whole chain —
+    the same single-fence discipline as every other lane (a per-step fence
+    would measure the ~80 ms tunnel RPC n_steps times).  A reduced-width
+    CRNN (conv 8/16/16, GRU 64 — pinned in the stats) keeps the trend lane
+    cheap on CPU smoke runs; the canonical model rides ``disco-train``.
+
+    Returns (train_steps_per_s, stats).
+    """
+    from disco_tpu.nn.crnn import build_crnn
+    from disco_tpu.nn.training import create_train_state, make_step_fns
+
+    win, n_freq = 21, 257
+    model, tx = build_crnn(
+        n_ch=1, win_len=win, n_freq=n_freq,
+        cnn_filters=(8, 16, 16), rnn_units=(64,), ff_units=(n_freq,),
+    )
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((batch, win, n_freq)).astype(np.float32)
+    y = rng.uniform(0.1, 0.9, (batch, win, n_freq)).astype(np.float32)
+    train_step, _ = make_step_fns(model, "all")
+    state = create_train_state(model, tx, x[:1], seed=5)
+    state, loss = train_step(state, x, y)  # compile + warm
+    _fence(loss)
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, loss = train_step(state, x, y)
+    _fence(loss)
+    dt = time.perf_counter() - t0
+    stats = {
+        "n_steps": n_steps,
+        "batch": batch,
+        "win_len": win,
+        "n_freq": n_freq,
+        "model": "crnn(8,16,16)/gru64",
+        "step_ms": round(dt / n_steps * 1e3, 3),
+    }
+    return n_steps / dt, stats
+
+
+def bench_tap(n_blocks=64):
+    """Flywheel tap lane: ``tap_blocks_per_s`` — host-side spool
+    throughput of the corpus tap (offer → background shard rotation →
+    atomic write + manifest record), measured to a temp dir with
+    serve-shaped synthetic blocks.  Pure host work (msgpack + sha256 +
+    fsync) — the number that says whether the tap can keep up with the
+    serve scheduler's delivery rate without dropping.
+
+    Returns (tap_blocks_per_s, stats).
+    """
+    import tempfile
+    from pathlib import Path
+
+    from disco_tpu.flywheel import CorpusTap
+
+    Ks, Cs, F, Tb = 4, 2, 257, 16
+    rng = np.random.default_rng(9)
+    Y = (rng.standard_normal((Ks, Cs, F, Tb))
+         + 1j * rng.standard_normal((Ks, Cs, F, Tb))).astype(np.complex64)
+    yf = (rng.standard_normal((Ks, F, Tb))
+          + 1j * rng.standard_normal((Ks, F, Tb))).astype(np.complex64)
+    m = rng.uniform(0.05, 0.95, (Ks, F, Tb)).astype(np.float32)
+    with tempfile.TemporaryDirectory() as tmp:
+        tap = CorpusTap(Path(tmp) / "tap", max_queue_blocks=max(n_blocks, 8),
+                        records_per_shard=16)
+        t0 = time.perf_counter()
+        for i in range(n_blocks):
+            tap.offer("bench", i, Y, m, m, yf)
+        stats_tap = tap.close()
+        dt = time.perf_counter() - t0
+    if stats_tap["blocks_dropped"]:
+        raise RuntimeError(
+            f"tap lane dropped {stats_tap['blocks_dropped']} blocks with an "
+            "n_blocks-deep queue — the spool path is broken"
+        )
+    stats = {
+        "n_blocks": n_blocks,
+        "block_mb": round(
+            (Y.nbytes + yf.nbytes + 2 * m.nbytes) / 1e6, 3
+        ),
+        "shards_written": stats_tap["shards_written"],
+    }
+    return n_blocks / dt, stats
+
+
 def bench_numpy(dur_s=2.0):
     from tests.reference_impls import tango_np
 
@@ -654,6 +742,29 @@ def main(argv=None):
                 )
         except Exception as e:
             serve_error = f"{type(e).__name__}: {e}"[:200]
+    # flywheel training lane: train_steps_per_s of the jitted CRNN step
+    # (BENCH_TRAIN_STEPS steps; 0 disables the lane)
+    train_sps = train_stats = train_error = None
+    n_train = int(os.environ.get("BENCH_TRAIN_STEPS", 8))
+    if n_train > 0:
+        try:
+            with obs_events.stage("bench_train", n_steps=n_train):
+                train_sps, train_stats = bench_train(
+                    n_steps=n_train,
+                    batch=int(os.environ.get("BENCH_TRAIN_BATCH", 8)),
+                )
+        except Exception as e:
+            train_error = f"{type(e).__name__}: {e}"[:200]
+    # flywheel tap lane: host-side corpus-tap spool throughput
+    # (BENCH_TAP_BLOCKS blocks; 0 disables the lane)
+    tap_bps = tap_stats = tap_error = None
+    n_tap = int(os.environ.get("BENCH_TAP_BLOCKS", 64))
+    if n_tap > 0:
+        try:
+            with obs_events.stage("bench_tap", n_blocks=n_tap):
+                tap_bps, tap_stats = bench_tap(n_blocks=n_tap)
+        except Exception as e:
+            tap_error = f"{type(e).__name__}: {e}"[:200]
     if done is not None:
         done.set()
     # BENCH_NP_DUR_S=0 skips the float64 NumPy baseline (CPU smoke runs —
@@ -702,10 +813,16 @@ def main(argv=None):
         "serve_p95_ms": round(serve_p95, 3) if serve_p95 is not None else None,
         "serve_sessions": serve_stats,
         "serve_error": serve_error,
+        "train_steps_per_s": round(train_sps, 3) if train_sps else None,
+        "train_stats": train_stats,
+        "train_error": train_error,
+        "tap_blocks_per_s": round(tap_bps, 2) if tap_bps else None,
+        "tap_stats": tap_stats,
+        "tap_error": tap_error,
         "mfu": round(r["mfu"], 6) if r["mfu"] else None,
         "flops_per_clip": round(r["flops_per_clip"]) if r["flops_per_clip"] else None,
         "stage_ms": r["stage_ms"],
-        "notes": "value = DEFAULT pipeline (solver=power since round 4; rtf_eigh_solver is the reference-bit-matching lane; cov_impl/stft_impl fields name the ACTIVE kernels behind the 'auto' defaults — fused pallas on TPU, DISCO_TPU_COV_IMPL/DISCO_TPU_STFT_IMPL override; the hot path is fused: one spec+magnitude STFT over the stacked y/s/n streams, irm masks from the emitted magnitudes, mask-folded covariance accumulation; precision names the default lane, rtf_bf16/bf16_max_rel_err the opt-in bf16 compute lane measured against it), on-device RTF via k-queued slope timing (tunnel adds ~80ms/dispatch, reported separately; value_single_dispatch includes it); stages timed as separate fenced programs (full pipeline fuses tighter); streaming_rtf_scan / streaming_rtf_block = tunnel-included realtime factors of the scanned super-tick (blocks_per_dispatch blocks per fenced dispatch, streaming_tango_scan) vs per-block block-recursive deployment, dispatches_per_block from the obs fence accounting; corpus_clips_per_s = end-to-end miniature-corpus throughput through the pipelined prefetch/dispatch/readback engine (load+scoring included); serve_blocks_per_s / serve_p95_ms = online-service continuous-batching throughput and request-latency p95 over loopback (BENCH_SERVE_SESSIONS concurrent streaming sessions, compile warm-up excluded; serve_queue_wait/dispatch p95s split admission wait from device time); numpy baseline at 2s clips; MFU vs dense-f32 peak (pipeline is FFT/small-eig bound by design)",
+        "notes": "value = DEFAULT pipeline (solver=power since round 4; rtf_eigh_solver is the reference-bit-matching lane; cov_impl/stft_impl fields name the ACTIVE kernels behind the 'auto' defaults — fused pallas on TPU, DISCO_TPU_COV_IMPL/DISCO_TPU_STFT_IMPL override; the hot path is fused: one spec+magnitude STFT over the stacked y/s/n streams, irm masks from the emitted magnitudes, mask-folded covariance accumulation; precision names the default lane, rtf_bf16/bf16_max_rel_err the opt-in bf16 compute lane measured against it), on-device RTF via k-queued slope timing (tunnel adds ~80ms/dispatch, reported separately; value_single_dispatch includes it); stages timed as separate fenced programs (full pipeline fuses tighter); streaming_rtf_scan / streaming_rtf_block = tunnel-included realtime factors of the scanned super-tick (blocks_per_dispatch blocks per fenced dispatch, streaming_tango_scan) vs per-block block-recursive deployment, dispatches_per_block from the obs fence accounting; corpus_clips_per_s = end-to-end miniature-corpus throughput through the pipelined prefetch/dispatch/readback engine (load+scoring included); serve_blocks_per_s / serve_p95_ms = online-service continuous-batching throughput and request-latency p95 over loopback (BENCH_SERVE_SESSIONS concurrent streaming sessions, compile warm-up excluded; serve_queue_wait/dispatch p95s split admission wait from device time); train_steps_per_s = flywheel CRNN train-step throughput (reduced-width model pinned in train_stats, one fence over the async step chain); tap_blocks_per_s = host-side corpus-tap spool throughput (offer -> shard rotation -> atomic write); numpy baseline at 2s clips; MFU vs dense-f32 peak (pipeline is FFT/small-eig bound by design)",
     }
     # sideband first (mirror of the stdout record + final counter snapshot),
     # THEN the one stdout line — events go to the file, never stdout.

@@ -22,7 +22,7 @@ from disco_tpu.analysis.registry import Rule, register
 
 _SCOPE = (
     "disco_tpu/enhance", "disco_tpu/datagen", "disco_tpu/nn",
-    "disco_tpu/runs", "disco_tpu/serve",
+    "disco_tpu/runs", "disco_tpu/serve", "disco_tpu/flywheel",
 )
 _NP_WRITERS = {"save", "savez", "savez_compressed"}
 _NP_BASES = {"np", "numpy"}

@@ -1,12 +1,18 @@
-"""DL005 — import purity: serve clients and CLI wiring stay jax-free.
+"""DL005 — import purity: serve clients, the flywheel host side and CLI
+wiring stay jax-free.
 
 The environment contract allows ONE chip-claiming process, so the
 numpy+stdlib serve client (``serve/client.py`` + ``serve/protocol.py``)
 must be importable with no jax anywhere — not even lazily, since any call
 path that reaches jax would claim (or block on) the chip from the client
-process.  The CLI modules may use jax, but only INSIDE ``main``-path
-functions: a module-level import would claim the chip at ``--help`` time
-and break the jax-free gates that shell out to argparse.
+process.  The flywheel's host side (``flywheel/tap.py`` writer thread,
+``flywheel/shards.py`` codec, ``flywheel/dataset.py`` reader) carries the
+same contract for a different reason: its tap thread runs INSIDE the one
+chip-claiming server process, where a second thread entering jax would
+contend for the single dispatch thread's claim.  The CLI modules may use
+jax, but only INSIDE ``main``-path functions: a module-level import would
+claim the chip at ``--help`` time and break the jax-free gates that shell
+out to argparse.
 
 Generalizes the bespoke AST walk formerly in ``tests/test_serve.py`` (the
 client purity contract now has exactly one implementation — this rule).
@@ -19,8 +25,17 @@ from disco_tpu.analysis.context import imports_module
 from disco_tpu.analysis.registry import Rule, register
 
 _BANNED = ("jax", "jaxlib", "torch")
-#: no jax/torch ANYWHERE (module or function level)
-CLIENT_FILES = ("disco_tpu/serve/client.py", "disco_tpu/serve/protocol.py")
+#: no jax/torch ANYWHERE (module or function level): the numpy-only serve
+#: client plus the flywheel host side (the tap's writer thread must never
+#: import jax — it shares a process with the one chip claim)
+CLIENT_FILES = (
+    "disco_tpu/serve/client.py",
+    "disco_tpu/serve/protocol.py",
+    "disco_tpu/flywheel/__init__.py",
+    "disco_tpu/flywheel/tap.py",
+    "disco_tpu/flywheel/shards.py",
+    "disco_tpu/flywheel/dataset.py",
+)
 #: no jax/torch at MODULE level (lazy in-function imports are the idiom)
 _CLI_DIR = "disco_tpu/cli"
 
@@ -45,9 +60,11 @@ class ImportPurity(Rule):
                 ):
                     yield self.finding(
                         ctx, node,
-                        "jax/torch import in a numpy-only serve-client module: "
-                        "the client must be importable and runnable without "
-                        "ever touching the chip claim (one-process contract)",
+                        "jax/torch import in a numpy-only module (serve "
+                        "client / flywheel host side): it must be importable "
+                        "and runnable without ever touching the chip claim "
+                        "(one-process contract; the tap's writer thread "
+                        "shares the server process)",
                     )
         else:
             for node in ctx.module_level_imports():

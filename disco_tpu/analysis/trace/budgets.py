@@ -50,12 +50,23 @@ from disco_tpu.analysis.trace.programs import (
 #: runs under the outer trace, where the inner jit compiles nothing and its
 #: cache-size counter legitimately stays flat).  The scan driver and the
 #: two corpus runners trace once each.
+#: train_step / eval_step: one program per LANE — f32 single-device, f32 on
+#: the 1-device mesh (sharding constraints are a different program), and
+#: the bf16 mixed-precision lane (exactly ONE extra program: the budget is
+#: what pins "one lane, one program").  Repeat steps on an evolving
+#: TrainState, fresh factory calls with the same key, and precision passed
+#: as a non-canonical spelling (' F32 ') must all add NOTHING — the step
+#: factory memoizes on the canonicalized key (nn.training.make_step_fns),
+#: so a spelling variant reaching jit as a distinct static is impossible
+#: by construction.
 BUDGETS: dict = {
     "streaming_tango": 3,
     "streaming_step1": 2,
     "streaming_tango_scan": 1,
     "run_batch": 1,
     "run_batch_with_masks": 1,
+    "train_step": 3,
+    "eval_step": 3,
 }
 
 
@@ -148,8 +159,71 @@ def run_workload(extra=None) -> None:
     Mz = np.stack([_inputs(rng, T)[1] for _ in range(B)])
     run_batch_with_masks(Yb, Sb, Nb, Mz, Mz)
 
+    _train_workload(rng)
+
     if extra is not None:
         extra(streaming, Y, mz, mw)
+
+
+def _train_workload(rng) -> None:
+    """The flywheel training lanes' share of the budget workload: exactly
+    one program per lane (f32 / 1-device mesh / bf16) for train_step AND
+    eval_step, with repeat steps, equal-key factory calls and spelling
+    variants pinned non-retracing.
+
+    No reference counterpart (module docstring).
+    """
+    import numpy as np
+
+    from disco_tpu.analysis.trace.programs import (
+        TRAIN_BATCH,
+        TRAIN_FREQ,
+        TRAIN_WIN,
+        _train_mesh,
+        _train_model,
+    )
+    from disco_tpu.nn import training
+
+    # the step-fn factory memoizes across workload runs; clear the compiled
+    # caches so a warm process still counts one fresh trace per lane (the
+    # budget twin of the streaming entries' clear_cache above)
+    training.clear_step_fn_caches()
+
+    model, tx = _train_model()
+    x = rng.standard_normal((TRAIN_BATCH, TRAIN_WIN, TRAIN_FREQ)).astype(np.float32)
+    y = rng.uniform(0.1, 0.9, x.shape).astype(np.float32)
+    state0 = training.create_train_state(model, tx, x[:1], seed=0)
+
+    # lane 1: f32 single-device — repeat steps and an equal-key second
+    # factory call trace nothing new
+    train_step, eval_step = training.make_step_fns(model, "all")
+    s, _ = train_step(state0, x, y)
+    s, _ = train_step(s, x, y)
+    eval_step(s, x, y)
+    eval_step(s, x, y)
+    again_t, again_e = training.make_step_fns(model, "all", precision=" F32 ")
+    assert again_t is train_step and again_e is eval_step  # memoized key
+    again_t(s, x, y)
+    again_e(s, x, y)
+
+    # lane 2: the 1-device data-parallel mesh (sharding constraints +
+    # donated carry = a different program, once)
+    mesh = _train_mesh()
+    mt, me = training.make_step_fns(model, "all", mesh=mesh)
+    ms = training.replicate_to_mesh(
+        training.create_train_state(model, tx, x[:1], seed=0), mesh
+    )
+    ms, _ = mt(ms, x, y)
+    ms, _ = mt(ms, x, y)
+    me(ms, x, y)
+
+    # lane 3: bf16 mixed precision — exactly ONE extra program (a bf16
+    # batch-stats pytree leaking out of step 1 would make step 2 a second
+    # program; the budget pins the f32-accumulator contract behaviorally)
+    bt, be = training.make_step_fns(model, "all", precision="bf16")
+    bs, _ = bt(state0, x, y)
+    bs, _ = bt(bs, x, y)
+    be(bs, x, y)
 
 
 def check_budgets(extra=None) -> tuple:

@@ -151,6 +151,57 @@ def _batch_args(with_masks: bool):
     return stack + ((_f32(B, K, F, T), _f32(B, K, F, T)) if with_masks else ())
 
 
+# -- the flywheel training step (sharded data-parallel lane) -----------------
+#: tiny CRNN the train_step golden is traced on (structural, not workload
+#: sized: one conv layer, one GRU, sigmoid FF — the full step shape of
+#: value_and_grad + optax apply + batch-stats mutation + dropout split)
+TRAIN_WIN = 5
+TRAIN_FREQ = 8
+TRAIN_BATCH = 4
+
+
+def _train_model():
+    from disco_tpu.nn.crnn import build_crnn
+
+    return build_crnn(
+        n_ch=1, win_len=TRAIN_WIN, n_freq=TRAIN_FREQ,
+        cnn_filters=(2,), pool_kernels=((1, 2),), conv_padding=((0, 1),),
+        rnn_units=(4,), ff_units=(TRAIN_FREQ,), rnn_dropouts=0.0,
+    )
+
+
+def _train_mesh():
+    """A 1-device ('batch', 'node') mesh: the golden must fingerprint the
+    SAME program under the trace CLI (1 CPU device) and the 8-virtual-
+    device test conftest, so the spec always takes exactly one device.
+
+    No reference counterpart (module docstring)."""
+    import jax
+    import numpy as np
+
+    from disco_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(n_node=1, n_batch=1, devices=np.array(jax.devices()[:1]))
+
+
+def _build_train_step():
+    import jax
+
+    from disco_tpu.nn.training import create_train_state, make_step_fns
+
+    model, tx = _train_model()
+    train_step, _eval_step = make_step_fns(model, "all", mesh=_train_mesh())
+    # abstract TrainState: eval_shape runs init/opt-init without a single
+    # real FLOP, keeping the gate's no-device-work property
+    import numpy as np
+
+    sample = np.zeros((1, TRAIN_WIN, TRAIN_FREQ), np.float32)
+    state = jax.eval_shape(lambda: create_train_state(model, tx, sample, seed=0))
+    args = (state, _f32(TRAIN_BATCH, TRAIN_WIN, TRAIN_FREQ),
+            _f32(TRAIN_BATCH, TRAIN_WIN, TRAIN_FREQ))
+    return train_step.__wrapped__, args, {}
+
+
 def _build_run_batch():
     from disco_tpu.enhance.driver import make_batch_runners
 
@@ -211,6 +262,23 @@ PROGRAMS: dict = {
             f"scanned super-tick driver, N={BLOCKS_PER_DISPATCH} "
             "(enhance/streaming.py) — the unroll=N contract",
             _build_streaming_tango_scan,
+        ),
+        ProgramSpec(
+            "train_step",
+            "flywheel data-parallel CRNN train step on a 1-device mesh "
+            "(nn/training.make_step_fns: batch sharded P('batch'), "
+            "replicated params, donated TrainState)",
+            _build_train_step,
+            donate={
+                "argnames": ("state",),
+                # the sharded lane donates the whole TrainState carry; on
+                # CPU XLA aliases the optimizer/params buffers it can —
+                # require at least the bulk of the float leaves to alias
+                "min_aliased": 4,
+                "must_alias": True,
+                "note": "make_step_fns donates the TrainState on the mesh "
+                        "lane (fit always rebinds)",
+            },
         ),
         ProgramSpec(
             "run_batch",

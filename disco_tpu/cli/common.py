@@ -135,6 +135,44 @@ def add_fault_args(parser) -> None:
     )
 
 
+def add_tap_args(parser) -> None:
+    """Attach the shared flywheel corpus-tap arguments (``disco-serve``)."""
+    parser.add_argument(
+        "--tap-dir", default=None,
+        help="opt-in flywheel corpus tap (disco_tpu.flywheel): spool every "
+             "delivered block's (noisy, enhanced, mask) tuple into rotating "
+             "training shards under this directory on a host-only "
+             "background thread; overflow drops-and-counts (tap_dropped) — "
+             "serving never backpressures on the tap.  Train on the shards "
+             "with `disco-train --shards DIR`",
+    )
+    parser.add_argument(
+        "--tap-records-per-shard", type=int, default=64,
+        help="blocks per rotated shard file (atomic write + sha256 "
+             "manifest record each rotation)",
+    )
+    parser.add_argument(
+        "--tap-queue-blocks", type=int, default=256,
+        help="bound on spooled-but-unwritten tap blocks; offers past it "
+             "are dropped and counted, never queued unboundedly",
+    )
+
+
+def resolve_tap(args):
+    """Build the :class:`~disco_tpu.flywheel.CorpusTap` described by the
+    ``--tap-*`` arguments (None without ``--tap-dir``).  The caller owns the
+    tap's lifecycle and must ``close()`` it after the server drains."""
+    if getattr(args, "tap_dir", None) is None:
+        return None
+    from disco_tpu.flywheel import CorpusTap
+
+    return CorpusTap(
+        args.tap_dir,
+        max_queue_blocks=args.tap_queue_blocks,
+        records_per_shard=args.tap_records_per_shard,
+    )
+
+
 def resolve_fault_spec(args):
     """Load ``--fault-spec`` (with the optional ``--fault-seed`` override)
     into a FaultSpec, converting file/format errors into clean CLI errors."""

@@ -18,8 +18,10 @@ Two subcommands:
   and — when the baseline carries the lane — on ``corpus_clips_per_s``
   (the pipelined corpus engine's end-to-end throughput),
   ``serve_blocks_per_s`` (the online service's continuous-batching
-  throughput) and ``streaming_rtf_scan`` (the amortized super-tick
-  streaming deployment).  Exits nonzero on a regression beyond ``--threshold``,
+  throughput), ``streaming_rtf_scan`` (the amortized super-tick
+  streaming deployment) and ``train_steps_per_s`` / ``tap_blocks_per_s``
+  (the flywheel's training-step and corpus-tap spool lanes — losing a
+  measured lane is a REGRESSION, not a skip).  Exits nonzero on a regression beyond ``--threshold``,
   which is what lets ``make obs-check`` gate CI on the bench trajectory.
 
 No reference counterpart (the reference has no observability, SURVEY.md
@@ -107,6 +109,20 @@ def summarize(events: list[dict]) -> dict:
             "batch_occupancy": gvals.get("batch_occupancy"),
             "latency_ms": histograms.get("serve_block_latency_ms"),
         }
+    # -- flywheel section: corpus-tap spool + shard-training telemetry
+    tap_events = [e for e in events if e["kind"] == "tap"]
+    flywheel = None
+    if tap_events or any(k.startswith(("tap_", "shards_")) for k in cvals):
+        flywheel = {
+            "tap_blocks": int(cvals.get("tap_blocks", 0)),
+            "tap_dropped": int(cvals.get("tap_dropped", 0)),
+            "tap_shards_written": int(cvals.get("tap_shards_written", 0)),
+            "tap_errors": int(cvals.get("tap_errors", 0)),
+            "shards_skipped": int(cvals.get("shards_skipped", 0)),
+            "train_steps": int(cvals.get("train_steps", 0)),
+            "rotations": sum(1 for e in tap_events
+                             if e["attrs"].get("action") == "shard"),
+        }
     # -- per-label recompile table: the log's own jit_trace events are the
     # run's truth (per-log scope); the jit_recompiles{label} counter series
     # (obs.accounting.recompile_label) from the final snapshot only fills
@@ -142,6 +158,7 @@ def summarize(events: list[dict]) -> dict:
         "warnings": [e for e in events if e["kind"] == "warning"],
         "histograms": histograms,
         "serve": serve,
+        "flywheel": flywheel,
         "n_events": len(events),
         "n_fences": n_fences,
         "est_rpc_s": n_fences * RPC_MS_ESTIMATE / 1e3,
@@ -219,6 +236,18 @@ def render_report(summary: dict) -> str:
                 f"p95={fmtg(lat.get('p95'))}  p99={fmtg(lat.get('p99'))}  "
                 f"max={fmtg(lat.get('max'))} over {lat['count']} blocks"
             )
+    fw = summary.get("flywheel")
+    if fw:
+        lines.append("")
+        lines.append(
+            f"flywheel tap: {fw['tap_blocks']} blocks spooled  "
+            f"dropped={fw['tap_dropped']}  shards={fw['tap_shards_written']}"
+            + (f"  errors={fw['tap_errors']}" if fw["tap_errors"] else "")
+        )
+        lines.append(
+            f"flywheel train: {fw['train_steps']} steps  "
+            f"corrupt shards skipped={fw['shards_skipped']}"
+        )
     by_label = summary.get("recompiles_by_label") or {}
     if by_label:
         # per-label table (the jit_recompiles{label} counter series): which
@@ -368,6 +397,8 @@ def compare_records(old: dict, new: dict, threshold: float = 0.05) -> dict:
         ("corpus_clips_per_s", True),
         ("serve_blocks_per_s", True),
         ("serve_p95_ms", False),
+        ("train_steps_per_s", True),
+        ("tap_blocks_per_s", True),
         ("latency_ms_frame", False),
         ("dispatch_overhead_ms", False),
         ("mfu", True),
@@ -414,6 +445,8 @@ def compare_records(old: dict, new: dict, threshold: float = 0.05) -> dict:
         ("streaming_rtf_scan", "streaming-scan", "x realtime", True),
         ("corpus_clips_per_s", "corpus", "clips/s", True),
         ("serve_blocks_per_s", "serve", "blocks/s", True),
+        ("train_steps_per_s", "train", "steps/s", True),
+        ("tap_blocks_per_s", "tap", "blocks/s", True),
         ("mfu", "mfu", "", True),
         ("stage_ms.stft_x3", "stft stage", "ms", False),
         ("stage_ms.step2_exchange_mwf", "step2 stage", "ms", False),

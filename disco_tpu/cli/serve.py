@@ -19,7 +19,12 @@ The production seams are the shared ones from :mod:`disco_tpu.cli.common`:
   ``disco-obs report``;
 * ``--fault-spec`` expands a per-session seeded fault plan at admission
   (``disco_tpu.fault``) — degraded-mode beamforming flows through the
-  service unchanged.
+  service unchanged;
+* ``--tap-dir`` arms the flywheel corpus tap (``disco_tpu.flywheel``):
+  every delivered block's (noisy, enhanced, mask) tuple is spooled into
+  rotating training shards on a host-only background thread — overflow
+  drops-and-counts, serving never backpressures; train on the shards
+  with ``disco-train --shards``.
 
 No reference counterpart: the reference pipeline is strictly offline
 (SURVEY.md §2); this is the ROADMAP's "serves heavy traffic" entry point.
@@ -32,8 +37,10 @@ from disco_tpu.cli.common import (
     add_fault_args,
     add_obs_log_arg,
     add_preflight_arg,
+    add_tap_args,
     obs_session,
     resolve_fault_spec,
+    resolve_tap,
     run_preflight,
 )
 
@@ -91,6 +98,7 @@ def build_parser():
                         "drain saves every open session here (atomic msgpack "
                         "+ sha256 digest) and a later server resumes them "
                         "(client opens with resume=<session id>)")
+    add_tap_args(p)
     add_fault_args(p)
     add_preflight_arg(p, what="the server")
     add_obs_log_arg(p, what="serving")
@@ -103,6 +111,7 @@ def main(argv=None):
     args.fault_spec = resolve_fault_spec(args)
     with obs_session(args, tool="disco-serve"):
         preflight = run_preflight(args)
+        tap = resolve_tap(args)
         from disco_tpu.runs import GracefulInterrupt
         from disco_tpu.serve import EnhanceServer
 
@@ -117,12 +126,22 @@ def main(argv=None):
             tick_interval_s=args.tick_interval,
             state_dir=args.state_dir,
             fault_spec=args.fault_spec,
+            tap=tap,
             run_info={"preflight": preflight, "state_dir": args.state_dir,
                       "max_sessions": args.max_sessions,
-                      "blocks_per_super_tick": args.blocks_per_super_tick},
+                      "blocks_per_super_tick": args.blocks_per_super_tick,
+                      "tap_dir": args.tap_dir},
         )
-        with GracefulInterrupt() as stopped:
-            srv.serve_forever()
+        try:
+            with GracefulInterrupt() as stopped:
+                srv.serve_forever()
+        finally:
+            if tap is not None:
+                stats = tap.close()
+                print(f"flywheel tap: {stats['shards_written']} shard(s), "
+                      f"{stats['blocks_accepted']} block(s) spooled, "
+                      f"{stats['blocks_dropped']} dropped under "
+                      f"{args.tap_dir}")
         if stopped():
             n = len(srv.checkpoints)
             where = f" under {args.state_dir}" if n else ""

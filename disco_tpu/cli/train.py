@@ -49,6 +49,29 @@ def build_parser():
     p.add_argument("--single_channel", "-sc", action="store_true",
                    help="train the step-1 single-channel model (no z inputs)")
     p.add_argument("--seed", type=int, default=26, help="train.py:20 seed")
+    p.add_argument("--shards", default=None, metavar="DIR",
+                   help="train on a flywheel shard directory (the serve "
+                        "tap's --tap-dir output, disco_tpu.flywheel) "
+                        "instead of the pre-generated corpus: streaming "
+                        "reader with deterministic seeded shuffle, ledger "
+                        "resume (--ledger) and corrupt-shard "
+                        "skip-with-warning; the model is sized from the "
+                        "shards' geometry")
+    p.add_argument("--shard-win-len", type=int, default=None,
+                   help="frames per training window on the --shards path "
+                        "(default: the tapped block length; must fit "
+                        "inside one block)")
+    p.add_argument("--data-parallel", type=int, default=0, metavar="N",
+                   help="shard the batch axis over an N-device mesh "
+                        "(NamedSharding(mesh, P('batch')) through "
+                        "parallel/mesh; params replicated, TrainState "
+                        "donated; 0 = single device).  Degrades cleanly "
+                        "to a 1-device mesh")
+    p.add_argument("--precision", default="f32", choices=["f32", "bf16"],
+                   help="training compute lane (ops.resolve): 'bf16' arms "
+                        "mixed precision — bf16 apply-time params and "
+                        "activations, float32 master params, optimizer "
+                        "accumulators and loss")
     add_ledger_arg(p, "epoch")
     add_preflight_arg(p, what="the multi-hour run")
     add_obs_log_arg(p, what="training")
@@ -82,7 +105,71 @@ def main(argv=None):
             raise SystemExit(f"--weights: {e}")
 
 
+def _mesh(args):
+    """The --data-parallel training mesh (None at the 0 default) — a
+    (batch, node=1) mesh through the parallel/mesh compat seams
+    (reference: none; SURVEY.md §2.9 runs data parallelism as a process
+    array)."""
+    if not args.data_parallel:
+        return None
+    from disco_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(n_node=1, n_batch=args.data_parallel)
+
+
+def _run_shards(args):
+    """The flywheel path: train the single-channel mask CRNN on tapped
+    serve traffic (disco_tpu.flywheel.ShardDataset).  No reference
+    counterpart: the reference has no serving layer to learn from."""
+    cfg = TrainConfig()
+    from disco_tpu.flywheel import ShardDataset
+    from disco_tpu.flywheel.dataset import peek_geometry
+
+    geom = peek_geometry(args.shards)
+    if geom is None:
+        raise SystemExit(f"--shards {args.shards}: no intact shard files")
+    win_len = args.shard_win_len or geom["block_frames"]
+    if win_len > geom["block_frames"]:
+        raise SystemExit(
+            f"--shard-win-len {win_len} exceeds the tapped block length "
+            f"{geom['block_frames']} (windows never cross block boundaries)"
+        )
+    ds = ShardDataset(args.shards, win_len=win_len, seed=args.seed)
+    batch = args.batch_size or cfg.batch_size
+    model, tx = build_crnn(n_ch=1, win_len=win_len, n_freq=geom["n_freq"],
+                           learning_rate=cfg.lr, ff_units=(geom["n_freq"],))
+    if model.conv_output_hw()[0] < 1:
+        raise SystemExit(
+            f"--shard-win-len {win_len} is too short for the canonical CRNN "
+            "conv stack (three VALID 3-kernels eat 6 frames): use >= 7, or "
+            "tap longer blocks — an empty loss slice trains on NaNs"
+        )
+    first = next(ds.batches(1, epoch=0), None)
+    if first is None:
+        raise SystemExit(f"--shards {args.shards}: shards hold no windows "
+                         f"of {win_len} frames")
+    state = create_train_state(model, tx, first[0], seed=args.seed)
+
+    state, train_losses, val_losses, run_name = fit(
+        model, state,
+        ds.batch_fn(batch, shuffle=True, ledger=args.ledger),
+        ds.batch_fn(batch, shuffle=False),
+        n_epochs=args.n_epochs,
+        save_path=args.save_path,
+        output_frames=cfg.output_frames,
+        resume_from=none_str(args.weights),
+        patience=cfg.early_stop_patience,
+        ledger=args.ledger,
+        mesh=_mesh(args),
+        precision=args.precision,
+    )
+    print(f"run {run_name}: best val loss {np.nanmin(val_losses):.6f}")
+    return run_name
+
+
 def _run(args):
+    if args.shards is not None:
+        return _run_shards(args)
     cfg = TrainConfig()
     rng = np.random.default_rng(args.seed)
 

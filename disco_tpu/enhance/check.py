@@ -73,6 +73,11 @@ def _check_bench_one_line(failures: list) -> dict | None:
         "BENCH_BLOCKS_PER_DISPATCH": "4",
         "BENCH_SERVE_SESSIONS": "2",
         "BENCH_SERVE_DUR_S": "1.0",
+        # flywheel lanes at smoke size: the gate asserts presence, the
+        # numbers only need to be measured, not representative
+        "BENCH_TRAIN_STEPS": "2",
+        "BENCH_TRAIN_BATCH": "2",
+        "BENCH_TAP_BLOCKS": "8",
         "BENCH_NP_DUR_S": "0",  # skip the minutes-long float64 baseline
         "BENCH_WATCHDOG_S": "900",
     }
@@ -114,6 +119,13 @@ def _check_bench_one_line(failures: list) -> dict | None:
             failures.append(
                 f"bench: {key} missing/null in the record "
                 f"(streaming_scan_error={rec.get('streaming_scan_error')!r})"
+            )
+    for key, err_key in (("train_steps_per_s", "train_error"),
+                         ("tap_blocks_per_s", "tap_error")):
+        if not isinstance(rec.get(key), (int, float)):
+            failures.append(
+                f"bench: {key} missing/null in the record "
+                f"({err_key}={rec.get(err_key)!r})"
             )
     for key, allowed in (("stft_impl", ("xla", "pallas")),
                          ("precision", ("f32", "bf16"))):

@@ -1,0 +1,63 @@
+"""disco_tpu.flywheel — the serve→train learning loop (ROADMAP item 5).
+
+The serve subsystem checkpoints session state but used to discard the one
+signal the CRNN mask estimator is starved for: real (noisy, enhanced,
+mask) traffic.  This package closes the loop end to end:
+
+* :mod:`disco_tpu.flywheel.tap`     — :class:`CorpusTap`, an opt-in,
+  never-blocking spool on the serve scheduler's post-readback seam that
+  rotates delivered blocks into shard files on a host-only background
+  thread (overflow drops-and-counts; serving never backpressures).
+* :mod:`disco_tpu.flywheel.shards`  — the self-describing atomic shard
+  format (complex-split wire codec, embedded sha256, ``probe_shard``)
+  plus the manifest-ledger unit ids.
+* :mod:`disco_tpu.flywheel.dataset` — :class:`ShardDataset`, the
+  streaming reader: deterministic seeded shuffle, ``RunLedger`` verified
+  resume, corrupt-shard skip-with-warning, ``fit``-ready batch callables.
+* :mod:`disco_tpu.flywheel.check`   — ``make flywheel-check``, the tenth
+  hermetic gate: loopback serve traffic with the tap on → clean shard
+  digests → a ``mid_write`` chaos crash that must leave no torn shard →
+  dataset resume → data-parallel training with loss parity against the
+  single-device oracle.
+
+The training side (mesh-sharded ``NamedSharding(mesh, P("batch"))`` data
+parallelism and the opt-in bf16 lane) lives in
+:mod:`disco_tpu.nn.training` — this package only produces its input.
+
+All three non-check modules are importable jax-free (disco-lint DL005):
+the tap's writer thread runs next to the one chip-claiming process and
+must never enter jax.
+
+No reference counterpart: the reference has neither a serving layer nor
+any path from deployment traffic back into training (SURVEY.md §2).
+"""
+from disco_tpu.flywheel.dataset import ShardDataset, peek_geometry, unit_shard_epoch
+from disco_tpu.flywheel.shards import (
+    RECORD_ARRAYS,
+    SHARD_SUFFIX,
+    SHARD_VERSION,
+    ShardError,
+    list_shards,
+    probe_shard,
+    read_shard,
+    unit_shard,
+    write_shard,
+)
+from disco_tpu.flywheel.tap import MANIFEST_NAME, CorpusTap
+
+__all__ = [
+    "CorpusTap",
+    "MANIFEST_NAME",
+    "RECORD_ARRAYS",
+    "SHARD_SUFFIX",
+    "SHARD_VERSION",
+    "ShardDataset",
+    "ShardError",
+    "list_shards",
+    "peek_geometry",
+    "probe_shard",
+    "read_shard",
+    "unit_shard",
+    "unit_shard_epoch",
+    "write_shard",
+]

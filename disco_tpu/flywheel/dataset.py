@@ -1,0 +1,244 @@
+"""Streaming shard dataset: tapped serve traffic → CRNN training batches.
+
+Reads the shard files a :class:`~disco_tpu.flywheel.tap.CorpusTap` wrote
+and windows them into exactly the (x, y) batch convention the training
+stack already consumes (``nn/data.DiscoDataset`` item shape, reference
+dnn/data/datasets.py:102-162): ``x`` is the reference-mic magnitude STFT
+window ``(win_len, F)`` float32 of one node, ``y`` the matching step-1
+mask window — the tap's ``mask_z`` is the mask the serve client actually
+used, so training on it closes the loop on real traffic.
+
+Three production properties, each pinned by ``tests/test_flywheel.py``:
+
+* **Deterministic seeded shuffle** — shard order is a permutation drawn
+  from ``(seed, epoch)`` and the window order inside a shard from
+  ``(seed, epoch, shard name)``, so two runs with one seed see identical
+  batch streams (what makes the flywheel gate's mesh-vs-single-device
+  loss parity meaningful), and a resumed run sees the SAME per-shard
+  order regardless of which shards were already consumed.
+* **Ledger resume** — with a :class:`~disco_tpu.runs.RunLedger`, every
+  shard's consumption is an ``in_flight``→``done`` record (unit
+  ``shard:<name>:epoch:<e>``, artifacts = the shard digest), and
+  :meth:`ShardDataset.batches` skips shards whose record verifies — the
+  verified-resume story of the corpus driver, applied to training input.
+* **Corrupt-shard skip** — a shard failing :func:`~disco_tpu.flywheel.
+  shards.read_shard` validation is skipped with a ``warning`` obs event
+  and the ``shards_skipped`` counter, never silently truncating an epoch
+  into wrong-but-plausible gradients.
+
+Host-only module (numpy + stdlib): batches feed the jitted train step
+through ``utils.transfer.prefetch_to_device`` on the training side; the
+reader itself must stay importable jax-free (disco-lint DL005).
+
+No reference counterpart: the reference trains from pre-generated .npy
+lists (dnn/utils.py:74-140); a served-traffic dataset is flywheel-only.
+"""
+from __future__ import annotations
+
+import zlib
+from pathlib import Path
+
+import numpy as np
+
+from disco_tpu.flywheel.shards import ShardError, list_shards, read_shard
+from disco_tpu.obs import events as obs_events
+from disco_tpu.obs.metrics import REGISTRY as obs_registry
+
+
+def unit_shard_epoch(name: str, epoch: int) -> str:
+    """Ledger work-unit id of one shard's consumption in one epoch.
+
+    No reference counterpart (module docstring)."""
+    return f"shard:{name}:epoch:{int(epoch)}"
+
+
+class ShardDataset:
+    """Windowed training batches over a directory of flywheel shards.
+
+    Args:
+      shard_dir: the tap directory (shards + manifest).
+      win_len: frames per training window; must fit inside one tapped
+        block (serve blocks are short — the windows never cross block
+        boundaries, matching the reference's per-segment windowing).
+      win_hop: window hop (default ``win_len`` — non-overlapping).
+      ref_mic: the node channel whose magnitude becomes the input.
+      seed: base seed of every deterministic draw.
+
+    No reference counterpart (module docstring).
+    """
+
+    def __init__(self, shard_dir, *, win_len: int = 8, win_hop: int | None = None,
+                 ref_mic: int = 0, seed: int = 0):
+        if win_len < 1:
+            raise ValueError(f"win_len must be >= 1, got {win_len}")
+        self.shard_dir = Path(shard_dir)
+        self.win_len = int(win_len)
+        self.win_hop = int(win_hop) if win_hop else self.win_len
+        self.ref_mic = int(ref_mic)
+        self.seed = int(seed)
+
+    def shard_paths(self) -> list[Path]:
+        """Sorted shard files currently on disk (discovery only; integrity
+        is checked as each shard is read).
+
+        No reference counterpart (module docstring)."""
+        return list_shards(self.shard_dir)
+
+    # -- windowing -----------------------------------------------------------
+    def _shard_windows(self, path: Path, epoch: int, shuffle: bool = True):
+        """(xs, ys) window stacks of one shard — in the shard's
+        deterministic per-epoch order when ``shuffle``, in natural
+        (record, node, frame) order otherwise (the validation stream must
+        be identical every epoch); None when the shard is corrupt
+        (skipped loudly)."""
+        try:
+            _meta, records = read_shard(path)
+        except ShardError as e:
+            obs_registry.counter("shards_skipped").inc()
+            obs_events.record("warning", stage="flywheel", path=str(path),
+                              reason=f"corrupt shard skipped: {e}")
+            return None
+        xs, ys = [], []
+        for rec in records:
+            Y, mz = rec["Y"], rec["mask_z"]
+            mag = np.abs(np.asarray(Y)[:, self.ref_mic]).astype(np.float32)
+            K, _F, T = mag.shape
+            for k in range(K):
+                for t0 in range(0, T - self.win_len + 1, self.win_hop):
+                    # (F, win) -> (win, F): the DiscoDataset item convention
+                    xs.append(mag[k, :, t0:t0 + self.win_len].T)
+                    ys.append(np.asarray(mz, np.float32)[k, :, t0:t0 + self.win_len].T)
+        if not xs:
+            return None
+        if not shuffle:
+            return np.stack(xs), np.stack(ys)
+        order = self._shard_rng(path.name, epoch).permutation(len(xs))
+        return (np.stack([xs[i] for i in order]),
+                np.stack([ys[i] for i in order]))
+
+    def _shard_rng(self, name: str, epoch: int) -> np.random.Generator:
+        """Per-(shard, epoch) rng keyed by NAME, not position: resuming a
+        partially-consumed epoch must reproduce each remaining shard's
+        window order exactly, whatever was already consumed."""
+        return np.random.default_rng(
+            [self.seed, int(epoch), zlib.crc32(name.encode())]
+        )
+
+    # -- the batch stream ----------------------------------------------------
+    def batches(self, batch_size: int, *, epoch: int = 0, shuffle: bool = True,
+                ledger=None, drop_last: bool = True):
+        """Yield ``(x, y)`` numpy batches for one epoch.
+
+        Batches never cross shard boundaries (the streaming property: one
+        shard resident at a time), shard order is the ``(seed, epoch)``
+        permutation when ``shuffle`` and the sorted order otherwise, and
+        ``drop_last`` drops each shard's ragged tail batch so the jitted
+        step sees ONE batch shape per run (the compile-bucket discipline).
+
+        ``ledger``: a :class:`~disco_tpu.runs.RunLedger` (or path) arms
+        verified resume — consumed shards are recorded per epoch and
+        skipped when their digest still matches on replay.
+
+        No reference counterpart (module docstring).
+        """
+        from disco_tpu.runs.ledger import RunLedger
+
+        if batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+        own_ledger = ledger is not None and not isinstance(ledger, RunLedger)
+        if own_ledger:
+            ledger = RunLedger(ledger)
+        try:
+            done: set = set()
+            if ledger is not None:
+                done, _requeued = ledger.verified_done()
+            paths = self.shard_paths()
+            if shuffle:
+                order = np.random.default_rng([self.seed, int(epoch)]).permutation(len(paths))
+                paths = [paths[i] for i in order]
+            for path in paths:
+                unit = unit_shard_epoch(path.name, epoch)
+                if unit in done:
+                    continue
+                windows = self._shard_windows(path, epoch, shuffle=shuffle)
+                if windows is None:
+                    continue
+                if ledger is not None:
+                    ledger.mark_in_flight(unit)
+                xs, ys = windows
+                n = len(xs)
+                for start in range(0, n, batch_size):
+                    if drop_last and start + batch_size > n:
+                        break
+                    yield xs[start:start + batch_size], ys[start:start + batch_size]
+                if ledger is not None:
+                    ledger.mark_done(unit, artifact_paths=[path], n_windows=n)
+        finally:
+            if own_ledger:
+                # a path-opened ledger is this generator's to close — one
+                # leaked handle per epoch would EMFILE a long training run
+                ledger.close()
+
+    def batch_fn(self, batch_size: int, *, shuffle: bool = True,
+                 ledger=None, drop_last: bool = True):
+        """A ``fit``-compatible zero-arg callable: each call is one epoch's
+        fresh batch iterator, with the epoch counter advancing per call
+        (so every epoch reshuffles deterministically — the
+        ``train_batches`` contract of :func:`disco_tpu.nn.training.fit`).
+
+        The callable exposes ``set_start_epoch(n)`` — the resume protocol
+        ``fit`` drives: on a ``resume_from`` run the dataset epoch counter
+        must restart at the TRAINING epoch being resumed, or (a) the
+        shuffle order replays the wrong epochs and (b) with a reused
+        ``ledger`` the already-consumed ``shard:*:epoch:<e>`` units of the
+        pre-crash epochs would make the first resumed epochs yield ZERO
+        batches — silently training on nothing.
+
+        No reference counterpart (module docstring).
+        """
+        from disco_tpu.runs.ledger import RunLedger
+
+        if ledger is not None and not isinstance(ledger, RunLedger):
+            # one ledger handle for the whole run, not one per epoch
+            ledger = RunLedger(ledger)
+        state = {"epoch": 0}
+
+        def make():
+            epoch = state["epoch"]
+            state["epoch"] += 1
+            return self.batches(batch_size, epoch=epoch, shuffle=shuffle,
+                                ledger=ledger, drop_last=drop_last)
+
+        def set_start_epoch(epoch: int) -> None:
+            state["epoch"] = int(epoch)
+
+        make.set_start_epoch = set_start_epoch
+        return make
+
+    def peek_geometry(self) -> dict | None:
+        """(n_nodes, n_freq, block_frames) of the first readable shard —
+        what ``disco-train --shards`` sizes the model from; None when no
+        intact shard exists.
+
+        No reference counterpart (module docstring)."""
+        return peek_geometry(self.shard_dir)
+
+
+def peek_geometry(shard_dir) -> dict | None:
+    """Module-level twin of :meth:`ShardDataset.peek_geometry` — callers
+    sizing a model BEFORE choosing window parameters (``disco-train
+    --shards``) need the geometry without constructing a dataset first.
+
+    No reference counterpart (module docstring)."""
+    for path in list_shards(shard_dir):
+        try:
+            _meta, records = read_shard(path)
+        except ShardError:
+            continue
+        if records:
+            Y = np.asarray(records[0]["Y"])
+            return {"n_nodes": int(Y.shape[0]),
+                    "mics_per_node": int(Y.shape[1]),
+                    "n_freq": int(Y.shape[2]),
+                    "block_frames": int(Y.shape[3])}
+    return None

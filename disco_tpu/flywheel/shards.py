@@ -1,0 +1,176 @@
+"""Training-shard files: the on-disk format of the serve→train flywheel.
+
+A shard is one self-describing msgpack file holding a bounded list of
+served-traffic records — each record is one delivered streaming block's
+``(noisy Y, enhanced yf, mask_z, mask_w)`` arrays plus its session/seq
+metadata.  Arrays travel in the wire codec of
+:mod:`disco_tpu.serve.protocol` (``encode_array``: complex dtypes split
+into two real byte strings — msgpack has no complex type), so a shard is
+readable by any numpy+stdlib process; nothing in this module may import
+jax (the tap's writer thread runs it — disco-lint DL005 pins the import
+graph).
+
+Integrity is layered the same way as the serve session checkpoints
+(``serve/session.py``): the record payload carries an embedded sha256 of
+its own bytes, the file is placed with the tmp+fsync+``os.replace``
+protocol of :mod:`disco_tpu.io.atomic` (a crash mid-write — the
+``mid_write`` chaos seam fires inside — can never leave a torn shard at
+the final path), and :func:`probe_shard` is the validate-before-trust
+read the dataset and the manifest ledger use: a truncated or tampered
+shard reads as *not a shard*, never as silently-wrong training data.
+
+No reference counterpart: the reference trains from a pre-generated
+corpus and has no serving layer to tap (SURVEY.md §2).
+"""
+from __future__ import annotations
+
+import hashlib
+from pathlib import Path
+
+import msgpack
+import numpy as np
+
+from disco_tpu.serve.protocol import decode_array, encode_array
+
+#: bump on incompatible shard-format changes; readers reject unknown
+#: versions loudly instead of misparsing
+SHARD_VERSION = 1
+
+#: final-path suffix of every flywheel shard (``tap-000001.shard.msgpack``)
+SHARD_SUFFIX = ".shard.msgpack"
+
+#: the array fields every record carries (the tap's post-readback payload)
+RECORD_ARRAYS = ("Y", "yf", "mask_z", "mask_w")
+
+
+class ShardError(ValueError):
+    """A shard file is truncated, tampered with, or not a shard at all."""
+
+
+def unit_shard(name: str) -> str:
+    """Ledger work-unit id of one shard (manifest + dataset-resume records).
+
+    No reference counterpart (module docstring)."""
+    return f"shard:{name}"
+
+
+def _pack(obj):
+    """Msgpack-ready structure: numpy arrays via the complex-safe wire
+    codec, numpy scalars to python, containers walked."""
+    if isinstance(obj, np.ndarray):
+        return encode_array(obj)
+    if isinstance(obj, np.generic):
+        return obj.item()
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_pack(v) for v in obj]
+    return obj
+
+
+def _unpack(obj):
+    if isinstance(obj, dict):
+        if obj.get("__nd__") == 1:
+            return decode_array(obj)
+        return {k: _unpack(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_unpack(v) for v in obj]
+    return obj
+
+
+def write_shard(path, records, meta: dict | None = None) -> Path:
+    """Write one shard atomically; returns the final path.
+
+    ``records``: list of dicts, each carrying the :data:`RECORD_ARRAYS`
+    numpy arrays (complex ``Y``/``yf`` included — the codec splits them)
+    plus arbitrary scalar metadata (``session``, ``seq``, ``t``).  The
+    record bytes are digested (sha256) into the envelope, and the whole
+    payload goes through :func:`disco_tpu.io.atomic.atomic_write` — the
+    ``mid_write`` chaos seam fires between payload and rename, which is
+    exactly what lets ``make flywheel-check`` prove no torn shard can
+    survive a crash.
+
+    No reference counterpart (module docstring).
+    """
+    from disco_tpu.io.atomic import atomic_write
+
+    records_bytes = msgpack.packb([_pack(r) for r in records], use_bin_type=True)
+    payload = msgpack.packb(
+        {
+            "version": SHARD_VERSION,
+            "meta": _pack(dict(meta or {})),
+            "n_records": len(records),
+            "records": records_bytes,
+            "records_sha256": hashlib.sha256(records_bytes).hexdigest(),
+        },
+        use_bin_type=True,
+    )
+    path = Path(path)
+    with atomic_write(path) as fh:
+        fh.write(payload)
+    return path
+
+
+def read_shard(path) -> tuple[dict, list]:
+    """Load one shard as ``(meta, records)`` with full validation.
+
+    Raises :class:`ShardError` on unreadable/truncated msgpack, an unknown
+    version, a record-digest mismatch (torn or tampered payload) or a
+    record-count mismatch — the dataset's corrupt-shard skip and the
+    :func:`probe_shard` integrity probe both stand on this being strict.
+
+    No reference counterpart (module docstring).
+    """
+    path = Path(path)
+    try:
+        d = msgpack.unpackb(path.read_bytes(), raw=False, strict_map_key=False)
+    except Exception as e:
+        raise ShardError(f"{path}: not a readable shard: {e}") from None
+    if not isinstance(d, dict) or d.get("version") != SHARD_VERSION:
+        raise ShardError(
+            f"{path}: unknown shard version "
+            f"{d.get('version') if isinstance(d, dict) else d!r}"
+        )
+    records_bytes = d.get("records")
+    digest = d.get("records_sha256")
+    if not isinstance(records_bytes, bytes) or not digest:
+        raise ShardError(f"{path}: shard missing records payload/digest")
+    if hashlib.sha256(records_bytes).hexdigest() != digest:
+        raise ShardError(
+            f"{path}: records digest mismatch — shard corrupt, refusing to "
+            "feed it to training"
+        )
+    try:
+        records = _unpack(msgpack.unpackb(records_bytes, raw=False,
+                                          strict_map_key=False))
+    except Exception as e:
+        raise ShardError(f"{path}: bad shard records: {e}") from None
+    if not isinstance(records, list) or len(records) != int(d.get("n_records", -1)):
+        raise ShardError(
+            f"{path}: record count mismatch "
+            f"({len(records) if isinstance(records, list) else '?'} vs "
+            f"declared {d.get('n_records')})"
+        )
+    return _unpack(d.get("meta") or {}), records
+
+
+def probe_shard(path) -> bool:
+    """True iff ``path`` holds a complete, digest-consistent shard — the
+    validate-before-trust probe (``io.atomic`` probe family shape).
+
+    No reference counterpart (module docstring)."""
+    try:
+        read_shard(path)
+        return True
+    except Exception:
+        return False
+
+
+def list_shards(shard_dir) -> list[Path]:
+    """Sorted final-path shard files under ``shard_dir`` (non-recursive —
+    a tap dir holds its shards flat next to the manifest ledger).  Pure
+    discovery: no integrity check (the dataset probes as it reads, so a
+    corrupt entry is skipped loudly there, not hidden here).
+
+    No reference counterpart (module docstring)."""
+    return sorted(Path(shard_dir).glob(f"*{SHARD_SUFFIX}"))

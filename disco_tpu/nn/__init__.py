@@ -22,6 +22,7 @@ from disco_tpu.nn.training import (
     load_checkpoint,
     load_params_for_inference,
     make_step_fns,
+    replicate_to_mesh,
     save_checkpoint,
 )
 
@@ -33,6 +34,7 @@ __all__ = [
     "nanmean", "reconstruction_loss",
     "CheckpointError", "SaveAndStop", "TrainState", "create_train_state",
     "fit", "get_model_name",
-    "load_checkpoint", "load_params_for_inference", "make_step_fns", "save_checkpoint",
+    "load_checkpoint", "load_params_for_inference", "make_step_fns",
+    "replicate_to_mesh", "save_checkpoint",
 ]
 from disco_tpu.nn import fastload

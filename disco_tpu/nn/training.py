@@ -16,6 +16,7 @@ val_loss})`` (train.py:147-156); resume splices the loss history exactly as
 from __future__ import annotations
 
 import string
+import threading
 import time
 from pathlib import Path
 from typing import Any
@@ -42,16 +43,25 @@ class TrainState(train_state.TrainState):
 
 
 def create_train_state(model, tx, sample_input, seed=0):
-    """Initialise parameters/batch stats from a sample batch."""
+    """Initialise parameters/batch stats from a sample batch.
+
+    The ``step`` counter is materialized as a concrete int32 array up
+    front: flax's ``TrainState.create`` leaves it a python int, which is a
+    weak-typed leaf that differs from the int32 array every
+    ``apply_gradients`` returns — so the FIRST train step of every run
+    traced its own one-shot program (the weak-type twin of the mu=1
+    retrace trap).  One dtype pin here keeps every lane at exactly one
+    program, which the retrace-budget gate now holds exact."""
     init_rng, dropout_rng = jax.random.split(jax.random.PRNGKey(seed))
     variables = model.init({"params": init_rng, "dropout": dropout_rng}, jnp.asarray(sample_input))
-    return TrainState.create(
+    state = TrainState.create(
         apply_fn=model.apply,
         params=variables["params"],
         tx=tx,
         batch_stats=variables.get("batch_stats", {}),
         dropout_rng=dropout_rng,
     )
+    return state.replace(step=jnp.asarray(state.step, jnp.int32))
 
 
 def _x_for_loss(x, bounds, n_freq=257):
@@ -65,20 +75,138 @@ def _x_for_loss(x, bounds, n_freq=257):
     return x[:, 0, :] if lf - ff == 1 else x
 
 
-def make_step_fns(model, output_frames="all", n_freq=None):
+#: memoized (train_step, eval_step) pairs keyed on
+#: (model, output_frames, n_freq, mesh, canonical precision).  The memo is
+#: what makes precision-spelling variants non-retracing — ' F32 ' and
+#: 'f32' resolve to ONE key and therefore ONE pair of compiled programs
+#: (the string-typed mu=1 retrace trap, closed at the factory) — and what
+#: lets repeated ``fit`` calls share programs.  LRU-bounded: a
+#: hyperparameter sweep building hundreds of distinct configs must not
+#: pin every model + compiled executable forever (an evicted key simply
+#: retraces, which the recompile counters make visible as always).
+_STEP_FNS_MAX = 64
+_STEP_FNS: dict = {}
+_STEP_FNS_LOCK = threading.Lock()
+
+
+def clear_step_fn_caches() -> None:
+    """Clear the compiled-program caches of every memoized step-fn pair —
+    the cold-cache seam the retrace-budget gate
+    (``disco_tpu.analysis.trace.budgets``) needs to count fresh traces in
+    an already-warm process.  The memo itself is kept: the budget asserts
+    programs per LANE, not per factory call.
+
+    No reference counterpart: the reference has no jit (SURVEY.md §5)."""
+    with _STEP_FNS_LOCK:
+        pairs = list(_STEP_FNS.values())
+    for pair in pairs:
+        for fn in pair:
+            if getattr(fn, "clear_cache", None):
+                fn.clear_cache()
+
+
+def replicate_to_mesh(state: TrainState, mesh):
+    """Replicate every TrainState leaf across ``mesh`` (params, optimizer
+    accumulators and batch stats fully replicated — the data-parallel
+    layout where only the batch axis of the data is sharded; the
+    ``shard_params`` pattern of SNIPPETS [2] with ``P()`` specs).  The
+    sharded ``train_step`` then keeps the replication invariant: XLA
+    all-reduces the per-shard gradients and every device applies the same
+    update.
+
+    No reference counterpart: the reference trains on one process with
+    torch (SURVEY.md §2.9)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.device_put(state, NamedSharding(mesh, P()))  # disco-lint: disable=DL003 -- TrainState leaves are real-dtyped (f32 params/stats, int32 step, uint32 rng); no complex array can reach this placement call
+
+
+def make_step_fns(model, output_frames="all", n_freq=None, mesh=None,
+                  precision="f32"):
     """(train_step, eval_step) jitted over TrainState + (x, y) batches
-    (reference dnn/utils.py:249-294)."""
+    (reference dnn/utils.py:249-294), memoized per
+    ``(model, output_frames, n_freq, mesh, precision)``.
+
+    ``mesh``: opt-in data-parallel lane — batches are constrained to
+    ``NamedSharding(mesh, P("batch"))`` (the SNIPPETS [2] pattern through
+    the same GSPMD formulation as ``parallel.mesh.tango_batch_sharded``),
+    params stay replicated (:func:`replicate_to_mesh`), and the input
+    ``TrainState`` is donated (``donate_argnames=("state",)`` — the
+    corpus-engine donation rule applied to the training carry; ``fit``
+    always rebinds, so the donated buffers are dead by construction).
+    Degrades cleanly to a 1-device mesh, where the program is bit-exact
+    with the meshless path (``make flywheel-check`` pins this).
+
+    ``precision``: ``'f32'`` (default, the untouched original program) or
+    ``'bf16'`` — mixed precision with bf16 apply-time params/activations
+    and float32 master params, optimizer accumulators, batch stats and
+    loss (the PR-9 enhancement-lane recipe on the training side).  The
+    token is canonicalized through :func:`disco_tpu.ops.resolve.
+    resolve_precision` BEFORE the memo key is formed, so spelling
+    variants cannot trace duplicate programs; the retrace-budget gate
+    holds the bf16 lane to exactly ONE extra program per step fn.
+    """
+    from disco_tpu.ops.resolve import compute_dtype, resolve_precision
+
+    precision = resolve_precision(precision)
+    key = (model, output_frames, n_freq, mesh, precision)
+    with _STEP_FNS_LOCK:
+        cached = _STEP_FNS.pop(key, None)
+        if cached is not None:
+            _STEP_FNS[key] = cached  # refresh recency (true LRU eviction)
+    if cached is not None:
+        return cached
+
     in_bounds, out_bounds = model.loss_frames(output_frames)
     n_freq = n_freq or model.input_shape[-1]
+    cdtype = compute_dtype(precision)
+
+    if mesh is not None:
+        if "batch" not in mesh.axis_names:
+            raise ValueError(
+                f"data-parallel training needs a mesh with a 'batch' axis; "
+                f"got axes {mesh.axis_names}"
+            )
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        batch_sharding = NamedSharding(mesh, P("batch"))
+
+        def constrain(t):
+            return jax.lax.with_sharding_constraint(t, batch_sharding)
+    else:
+        def constrain(t):
+            return t
+
+    def _cast_floats(tree, dtype):
+        return jax.tree_util.tree_map(
+            lambda a: a.astype(dtype)
+            if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a,
+            tree,
+        )
 
     def compute_loss(params, batch_stats, dropout_rng, x, y, train):
-        variables = {"params": params, "batch_stats": batch_stats}
+        x, y = constrain(x), constrain(y)
+        if precision == "bf16":
+            # bf16 apply-time copies; the f32 masters stay the grad target
+            # (the cast is differentiable, so grads come back f32)
+            apply_params = _cast_floats(params, cdtype)
+            x_in = x.astype(cdtype)
+        else:
+            apply_params, x_in = params, x
+        variables = {"params": apply_params, "batch_stats": batch_stats}
         if train:
             est, mutated = model.apply(
-                variables, x, train=True, mutable=["batch_stats"], rngs={"dropout": dropout_rng}
+                variables, x_in, train=True, mutable=["batch_stats"], rngs={"dropout": dropout_rng}
             )
         else:
-            est, mutated = model.apply(variables, x, train=False), None
+            est, mutated = model.apply(variables, x_in, train=False), None
+        if precision == "bf16":
+            # f32 accumulators: the loss and the carried batch stats must
+            # not drift to bf16 (a bf16 stats pytree on step 2 would also
+            # be a NEW program — the budget gate holds the lane to one)
+            est = est.astype(jnp.float32)
+            if mutated is not None:
+                mutated = _cast_floats(mutated, jnp.float32)
         loss = reconstruction_loss(
             _x_for_loss(y, in_bounds, n_freq),
             _x_for_loss(est, out_bounds, n_freq),
@@ -86,7 +214,12 @@ def make_step_fns(model, output_frames="all", n_freq=None):
         )
         return loss, mutated
 
-    @counted_jit(label="train_step")
+    # donate the carry on the sharded lane only: every mesh caller rebinds
+    # (fit's loop), while the meshless entry points keep their historical
+    # no-donation contract (tests step the same state freely)
+    jit_kw = {"donate_argnames": ("state",)} if mesh is not None else {}
+
+    @counted_jit(label="train_step", **jit_kw)
     def train_step(state: TrainState, x, y):
         dropout_rng, next_rng = jax.random.split(state.dropout_rng)
         (loss, mutated), grads = jax.value_and_grad(compute_loss, has_aux=True)(
@@ -102,7 +235,11 @@ def make_step_fns(model, output_frames="all", n_freq=None):
         loss, _ = compute_loss(state.params, state.batch_stats, state.dropout_rng, x, y, False)
         return loss
 
-    return train_step, eval_step
+    with _STEP_FNS_LOCK:
+        pair = _STEP_FNS.setdefault(key, (train_step, eval_step))
+        if len(_STEP_FNS) > _STEP_FNS_MAX:  # evict least-recently-used
+            _STEP_FNS.pop(next(iter(_STEP_FNS)))
+        return pair
 
 
 class SaveAndStop:
@@ -154,15 +291,27 @@ class CheckpointError(RuntimeError):
     traceback."""
 
 
-def save_checkpoint(path, state: TrainState, train_losses, val_losses):
+def save_checkpoint(path, state: TrainState, train_losses, val_losses,
+                    epochs_done: int | None = None):
     """Serialize model+optimizer state and loss history to one msgpack file
     (the torch.save dict of reference train.py:147-156).  Written
     atomically (``disco_tpu.io.atomic``): a crash mid-save leaves the
     previous best checkpoint intact, never a truncated msgpack — the
     artifact a multi-hour training run resumes from must survive the crash
-    that interrupts it."""
+    that interrupts it.
+
+    ``epochs_done`` is the number of completed epochs the (preallocated,
+    zero-padded) loss histories cover, stored EXPLICITLY in the payload:
+    the resume point used to be re-derived by trimming trailing zeros from
+    the history (reference dnn/utils.py:155-175 ``np.trim_zeros``), which
+    silently truncated it whenever a final epoch's loss was legitimately
+    0.0.  The ``None`` default keeps direct callers working by recording
+    the trimmed length — exactly the old inference, now frozen at save
+    time; ``fit`` always passes the true count."""
     from disco_tpu.io.atomic import write_bytes_atomic
 
+    if epochs_done is None:
+        epochs_done = int(np.trim_zeros(np.asarray(train_losses), "b").size)
     payload = {
         "params": state.params,
         "batch_stats": state.batch_stats,
@@ -170,14 +319,23 @@ def save_checkpoint(path, state: TrainState, train_losses, val_losses):
         "step": state.step,
         "train_loss": np.asarray(train_losses),
         "val_loss": np.asarray(val_losses),
+        "epochs_done": np.asarray(int(epochs_done), np.int32),
     }
     write_bytes_atomic(path, serialization.to_bytes(payload))
 
 
 def load_checkpoint(path, state: TrainState):
     """Restore a checkpoint into a compatible TrainState; returns
-    (state, train_losses, val_losses) with trailing zero-padding trimmed
+    (state, train_losses, val_losses) cut to the completed-epoch count
     (reference dnn/utils.py:155-175).
+
+    The completed-epoch count is read from the payload's explicit
+    ``epochs_done`` field when present (every checkpoint written since the
+    flywheel PR); pre-flywheel checkpoints fall back to the historical
+    ``np.trim_zeros`` inference — which is exactly the bug the explicit
+    field fixes: a trailing epoch whose loss was legitimately 0.0 was
+    indistinguishable from preallocated zero padding and silently moved
+    the resume point backwards.
 
     Raises :class:`CheckpointError` naming ``path`` when the file is
     missing, truncated or not a compatible payload — a corrupt resume
@@ -195,25 +353,42 @@ def load_checkpoint(path, state: TrainState):
         "train_loss": np.zeros(0, np.float64),
         "val_loss": np.zeros(0, np.float64),
     }
+    # flax's from_bytes template-matches strictly, so the new-format read
+    # (with the explicit epochs_done field) is attempted first and a
+    # pre-flywheel checkpoint falls back to the old template — one full
+    # parse for every current file, two only for legacy ones (never a
+    # whole msgpack_restore just to peek at the keys)
+    has_count = True
     try:
-        payload = serialization.from_bytes(template, raw)
-    except Exception as e:
-        raise CheckpointError(
-            f"checkpoint {path}: corrupt or incompatible msgpack payload "
-            f"({type(e).__name__}: {e}) — the file may be truncated by a "
-            f"crashed writer; delete it or point --weights at an intact "
-            f"checkpoint"
-        ) from e
+        payload = serialization.from_bytes(
+            {**template, "epochs_done": np.zeros((), np.int32)}, raw
+        )
+    except Exception:
+        has_count = False
+        try:
+            payload = serialization.from_bytes(template, raw)
+        except Exception as e:
+            raise CheckpointError(
+                f"checkpoint {path}: corrupt or incompatible msgpack payload "
+                f"({type(e).__name__}: {e}) — the file may be truncated by a "
+                f"crashed writer; delete it or point --weights at an intact "
+                f"checkpoint"
+            ) from e
     state = state.replace(
         params=payload["params"],
         batch_stats=payload["batch_stats"],
         opt_state=payload["opt_state"],
         step=payload["step"],
     )
+    train_hist = np.asarray(payload["train_loss"])
+    val_hist = np.asarray(payload["val_loss"])
+    if has_count:
+        n = max(0, min(int(payload["epochs_done"]), train_hist.size))
+        return state, train_hist[:n], val_hist[: min(n, val_hist.size)]
     return (
         state,
-        np.trim_zeros(np.asarray(payload["train_loss"]), "b"),
-        np.trim_zeros(np.asarray(payload["val_loss"]), "b"),
+        np.trim_zeros(train_hist, "b"),
+        np.trim_zeros(val_hist, "b"),
     )
 
 
@@ -225,6 +400,34 @@ def load_params_for_inference(path, state: TrainState) -> TrainState:
 
 
 # -- the epoch loop ---------------------------------------------------------
+def _prefetch_host_batches(make_batches):
+    """Double-buffered host batch feed: batch N+1's numpy prep (shard
+    reads, windowing, stacking) runs on a
+    :class:`~disco_tpu.enhance.pipeline.ChunkPrefetcher` loader thread
+    while step N's device compute runs, and the stall/overlap economics
+    land in the SAME obs gauges the corpus engine records
+    (``prefetch_stall_ms`` / ``overlap_efficiency`` via
+    :func:`~disco_tpu.enhance.pipeline.note_chunk_overlap`) so the
+    training-side overlap is observable and testable.  The loader is
+    host-only (it never enters jax) and is always closed on unwind — an
+    early stop mid-epoch must not leave it blocked on a full queue.
+
+    Reference: train.py:104-105 reaches for torch DataLoader workers for
+    exactly this host/device overlap."""
+    from disco_tpu.enhance.pipeline import ChunkPrefetcher, note_chunk_overlap
+
+    pf = ChunkPrefetcher(((b,) for b in make_batches()), lambda b: b, depth=2)
+    try:
+        last = time.perf_counter()
+        for batch, stall_s in pf:
+            busy_s = max(time.perf_counter() - last - stall_s, 0.0)
+            note_chunk_overlap(stall_s, busy_s)
+            yield batch
+            last = time.perf_counter()
+    finally:
+        pf.close()
+
+
 def fit(
     model,
     state: TrainState,
@@ -238,14 +441,26 @@ def fit(
     patience: float | None = None,
     verbose: bool = True,
     ledger=None,
+    mesh=None,
+    precision: str = "f32",
 ):
     """Full training loop (reference train.py:110-158): per-epoch train +
     no-grad validation, loss history saved every epoch, best-model
     checkpoint gated by ``SaveAndStop``, optional early stop and resume.
 
     ``train_batches`` / ``val_batches`` are callables returning an iterator
-    of (x, y) numpy batches (fresh shuffle each epoch).
+    of (x, y) numpy batches (fresh shuffle each epoch).  Each epoch's
+    batches ride a double-buffered host prefetch
+    (:func:`_prefetch_host_batches` — the corpus engine's ChunkPrefetcher)
+    into :func:`~disco_tpu.utils.transfer.prefetch_to_device`, so numpy
+    batch prep, host→device transfer and device compute overlap.
     Returns (state, train_losses, val_losses, run_name).
+
+    ``mesh`` / ``precision`` (the flywheel training lanes, see
+    :func:`make_step_fns`): a mesh with a 'batch' axis arms data-parallel
+    steps — the state is replicated (:func:`replicate_to_mesh`), batches
+    shard over the mesh's batch axis, the carry is donated.  ``precision=
+    'bf16'`` arms the mixed-precision lane (f32 masters/accumulators).
 
     Crash safety (``disco_tpu.runs``): checkpoints and loss histories are
     written atomically; an optional ``ledger``
@@ -261,7 +476,8 @@ def fit(
 
     if ledger is not None and not isinstance(ledger, RunLedger):
         ledger = RunLedger(ledger)
-    train_step, eval_step = make_step_fns(model, output_frames)
+    train_step, eval_step = make_step_fns(model, output_frames, mesh=mesh,
+                                          precision=precision)
     save_dir = Path(save_path)
     save_dir.mkdir(parents=True, exist_ok=True)
 
@@ -275,6 +491,21 @@ def fit(
         first_epoch = 0
         train_losses, val_losses = np.zeros(n_epochs), np.zeros(n_epochs)
         run_name = run_name or get_model_name()
+
+    if mesh is not None:
+        # data-parallel invariant: replicated state, sharded batches
+        state = replicate_to_mesh(state, mesh)
+
+    # epoch-aware batch sources (flywheel ShardDataset.batch_fn): tell them
+    # where training actually starts, so a resumed run's dataset epochs —
+    # shuffle draws AND ledger shard:*:epoch:<e> consumption units — line
+    # up with the training epochs instead of replaying from 0 (which, with
+    # a reused dataset ledger, would yield zero batches for every
+    # already-consumed epoch and silently train on nothing)
+    for cb in (train_batches, val_batches):
+        hook = getattr(cb, "set_start_epoch", None)
+        if hook is not None:
+            hook(first_epoch)
 
     gate = SaveAndStop(patience=patience if patience is not None else n_epochs, mode="min")
     # Per-label counts, not the process-wide total: an unrelated retrace
@@ -298,7 +529,7 @@ def fit(
         # dispatch + the prefetch feed, step N+1's data is ready while
         # step N runs; one readback per epoch.
         tr, nb = jnp.zeros(()), 0
-        for x, y in prefetch_to_device(train_batches()):
+        for x, y in prefetch_to_device(_prefetch_host_batches(train_batches)):
             state, loss = train_step(state, x, y)
             tr = tr + loss
             nb += 1
@@ -306,9 +537,25 @@ def fit(
         # persisted — the whole epoch must be redone on resume, never half
         run_chaos.tick("mid_epoch", epoch=int(epoch))
         va, nv = jnp.zeros(()), 0
-        for x, y in prefetch_to_device(val_batches()):
+        for x, y in prefetch_to_device(_prefetch_host_batches(val_batches)):
             va = va + eval_step(state, x, y)
             nv += 1
+        if nb == 0:
+            # an epoch that saw NO training batches is almost always an
+            # operator error (e.g. a reused dataset ledger whose shard
+            # units are all consumed — rerun with a fresh --ledger or
+            # resume with --weights): it must be loud, or the run records
+            # 0.0 losses and checkpoints an untrained model as 'best'
+            obs_registry.counter("train_empty_epochs").inc()
+            obs_events.record(
+                "warning", stage="train", epoch=int(epoch),
+                reason="epoch yielded ZERO training batches — empty "
+                       "dataset, or a reused dataset ledger already marks "
+                       "every shard consumed for this epoch",
+            )
+            if verbose:
+                print(f"epoch {epoch}\tWARNING: zero training batches "
+                      "(empty dataset or fully-consumed dataset ledger)")
         train_losses[epoch] = float(tr) / nb if nb else 0.0
         val_losses[epoch] = float(va) / nv if nv else 0.0
         obs_registry.counter("train_steps").inc(nb)
@@ -335,7 +582,8 @@ def fit(
         ckpt_path = save_dir / f"{run_name}_model.msgpack"
         improved = gate.save_model_query(val_losses[epoch])
         if improved:
-            save_checkpoint(ckpt_path, state, train_losses, val_losses)
+            save_checkpoint(ckpt_path, state, train_losses, val_losses,
+                            epochs_done=int(epoch) + 1)
         if ledger is not None:
             # Epoch records are state-only (artifacts=None): the losses npz
             # and best checkpoint are SHARED mutable files that later epochs

@@ -46,6 +46,7 @@ EVENT_KINDS = frozenset(
         "run_start",    # a crash-safe run began (attrs: preflight, ledger, ...)
         "run_resume",   # a run resumed from its ledger (attrs: done/requeued counts)
         "session",      # serve session lifecycle (attrs: action=open/close/evict/drain)
+        "tap",          # flywheel corpus-tap lifecycle (attrs: action=shard/close)
         "interrupted",  # graceful stop requested (SIGTERM/SIGINT; runs.interrupt)
         "warning",      # degraded input / requeued unit — visible, non-fatal
         "note",         # freeform annotation

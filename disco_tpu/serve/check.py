@@ -23,6 +23,13 @@ clients are numpy-only threads) and asserts the serve acceptance contract:
    leaves **no truncated checkpoint at a final path** (the atomic-write
    invariant), after which a clean drain still checkpoints and resumes.
 
+4. **Tap transparency**: the concurrent-parity experiment runs with the
+   flywheel corpus tap enabled (``disco_tpu.flywheel.CorpusTap``) — every
+   serve invariant above must hold unchanged (bit-parity, ONE batched
+   readback per tick), the tap must spool every delivered block with zero
+   drops at this load, every rotated shard must pass its integrity probe,
+   and no session may be evicted or backpressured because of the tap.
+
 All crashes are simulated in-process; nothing is ever SIGKILLed
 (environment contract).  Wired into ``make test`` alongside ``obs-check``,
 ``fault-check``, ``chaos-check`` and ``perf-check``.
@@ -128,7 +135,8 @@ def _check_parity(failures: list, server_kw: dict | None = None,
             f"{label}: {gets} batched readbacks for {ticks} scheduler ticks — "
             "the one-device_get_tree-per-tick contract is broken"
         )
-    return {"sessions": len(scenes), "ticks": ticks, "batched_readbacks": gets}
+    return {"sessions": len(scenes), "ticks": ticks, "batched_readbacks": gets,
+            "blocks_total": sum(-(-ref.shape[-1] // BLOCK) for ref in refs)}
 
 
 def _check_drain_resume(failures: list, state_dir: Path,
@@ -305,7 +313,30 @@ def main(argv=None) -> int:
         obs_log = tmp / "serve_check.jsonl"
         with obs.recording(obs_log):
             obs.write_manifest(tool="serve-check")
-            parity = _check_parity(failures)
+            # the base parity cycle runs WITH the corpus tap on: the serve
+            # contract must be tap-transparent (experiment 4 above)
+            from disco_tpu.flywheel import CorpusTap, list_shards, probe_shard
+
+            tap = CorpusTap(tmp / "tap", records_per_shard=8)
+            parity = _check_parity(failures, server_kw={"tap": tap})
+            tap_stats = tap.close()
+            expected_blocks = parity["blocks_total"]
+            if tap_stats["blocks_dropped"]:
+                failures.append(
+                    f"tap: {tap_stats['blocks_dropped']} blocks dropped at "
+                    "parity load — the spool bound is undersized for the gate"
+                )
+            if tap_stats["blocks_accepted"] != expected_blocks:
+                failures.append(
+                    f"tap: spooled {tap_stats['blocks_accepted']} blocks, "
+                    f"expected {expected_blocks} (one per delivered block)"
+                )
+            shard_files = list_shards(tmp / "tap")
+            if not shard_files:
+                failures.append("tap: no shard files written")
+            for sp in shard_files:
+                if not probe_shard(sp):
+                    failures.append(f"tap: shard fails its integrity probe: {sp}")
             drain = _check_drain_resume(failures, tmp / "state")
             chaos_stats = _check_chaos(failures, tmp / "chaos_state")
             # super-tick cycle: the same concurrent-parity, drain/resume and
@@ -352,6 +383,8 @@ def main(argv=None) -> int:
         "batched_readbacks": parity["batched_readbacks"],
         "supertick_ticks": st_parity["ticks"],
         "supertick_readbacks": st_parity["batched_readbacks"],
+        "tap_blocks": tap_stats["blocks_accepted"],
+        "tap_shards": tap_stats["shards_written"],
         "drain_blocks": drain["blocks_before_drain"],
         "crashes_injected": chaos_stats["crashes_injected"],
         "jax_processes": 1,   # by construction: clients are numpy threads

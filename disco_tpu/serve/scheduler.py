@@ -150,7 +150,7 @@ class Scheduler:
                  max_blocks_per_tick: int = DEFAULT_MAX_BLOCKS_PER_TICK,
                  blocks_per_super_tick: int = 1,
                  overlap_readback: bool | None = None,
-                 fault_spec=None):
+                 fault_spec=None, tap=None):
         if max_sessions < 1 or max_queue_blocks < 1 or max_blocks_per_tick < 1:
             raise ValueError("scheduler bounds must be >= 1")
         if blocks_per_super_tick < 1:
@@ -184,6 +184,12 @@ class Scheduler:
         self.overlap_readback = (blocks_per_super_tick > 1
                                  if overlap_readback is None else overlap_readback)
         self.fault_spec = fault_spec
+        #: opt-in flywheel corpus tap (disco_tpu.flywheel.CorpusTap), fed at
+        #: the post-readback seam with every delivered block's host arrays
+        #: (noisy Y, masks, enhanced yf).  The tap's offer() never blocks
+        #: and never raises — overflow drops-and-counts inside the tap —
+        #: so serving cannot backpressure or crash on its own telemetry.
+        self.tap = tap
         self.draining = False
         self._lock = threading.Lock()
         self._sessions: dict[str, Session] = {}
@@ -191,7 +197,10 @@ class Scheduler:
         self._rotate = 0
         self.ticks_with_work = 0
         #: dispatched-but-not-read-back units from the previous tick
-        #: (overlap_readback): [(session, [seq, ...], yf_device, t_dispatch)]
+        #: (overlap_readback):
+        #: [(session, [seq, ...], yf_device, t_dispatch, raw_blocks)] where
+        #: raw_blocks keeps the input (seq, Y, mz, mw) host tuples for the
+        #: corpus tap (None when no tap — no point pinning the memory)
         self._inflight: list = []
 
     # -- registry (I/O thread) ----------------------------------------------
@@ -386,7 +395,8 @@ class Scheduler:
             k = self._rotate % len(sessions)
             self._rotate += 1
             sessions = sessions[k:] + sessions[:k]
-        units: list = []       # (session, [seq, ...], yf_device, t_dispatch)
+        units: list = []  # (session, [seq, ...], yf_device, t_dispatch, raw)
+        keep_raw = self.tap is not None
         budget = self.max_blocks_per_tick
         n_super = self.blocks_per_super_tick
         n_busy = 0
@@ -428,13 +438,17 @@ class Scheduler:
                             and all(b[1].shape[-1] == bf for b in group)):
                         yf = self._dispatch_scan(session, group)
                         units.append(
-                            (session, [b[0] for b in group], yf, time.time())
+                            (session, [b[0] for b in group], yf, time.time(),
+                             group if keep_raw else None)
                         )
                         session.inflight += len(group)
                     else:
                         for seq, Y, mz, mw in group:
                             yf = self._dispatch(session, seq, Y, mz, mw)
-                            units.append((session, [seq], yf, time.time()))
+                            units.append(
+                                (session, [seq], yf, time.time(),
+                                 [(seq, Y, mz, mw)] if keep_raw else None)
+                            )
                             session.inflight += 1
             except Exception as e:
                 # per-session isolation: one block the device rejects
@@ -485,17 +499,17 @@ class Scheduler:
         """
         from disco_tpu.utils.transfer import device_get_tree
 
-        n_blocks = sum(len(seqs) for (_, seqs, _, _) in units)
-        n_sessions = len({s.id for (s, _, _, _) in units})
+        n_blocks = sum(len(seqs) for (_, seqs, _, _, _) in units)
+        n_sessions = len({s.id for (s, _, _, _, _) in units})
         with obs_events.stage("serve_tick", n_blocks=n_blocks,
                               n_sessions=n_sessions):
-            host = device_get_tree([yf for (_, _, yf, _) in units])
+            host = device_get_tree([yf for (_, _, yf, _, _) in units])
         now = time.time()
         lat_hist = obs_registry.histogram("serve_block_latency_ms")
         wait_hist = obs_registry.histogram("serve_queue_wait_ms")
         disp_hist = obs_registry.histogram("serve_dispatch_ms")
         deliveries = []
-        for (session, seqs, _, t_disp), yf in zip(units, host):
+        for (session, seqs, _, t_disp, raw), yf in zip(units, host):
             bf = session.config.block_frames
             for j, seq in enumerate(seqs):
                 blk = yf if len(seqs) == 1 else yf[..., j * bf:(j + 1) * bf]
@@ -508,10 +522,22 @@ class Scheduler:
                 session.blocks_done = max(session.blocks_done, seq + 1)
                 session.inflight = max(session.inflight - 1, 0)
                 deliveries.append((session, seq, blk, lat_s))
+            if self.tap is not None and raw:
+                # THE corpus-tap seam: every delivered block's full training
+                # tuple is host-resident right here (inputs were retained at
+                # dispatch, yf just crossed in the one batched readback).
+                # offer() is non-blocking and exception-free by contract.
+                # Super-tick slices are COPIED before spooling: a queued
+                # view would pin the whole N-block readback buffer and
+                # void the tap queue's memory bound under backlog.
+                for j, (seq, Y, mz, mw) in enumerate(raw):
+                    blk = (yf if len(seqs) == 1
+                           else np.ascontiguousarray(yf[..., j * bf:(j + 1) * bf]))
+                    self.tap.offer(session.id, seq, Y, mz, mw, blk)
         self.ticks_with_work += 1
         obs_registry.counter("serve_ticks").inc()
         obs_registry.counter("serve_blocks").inc(n_blocks)
-        if any(len(seqs) > 1 for (_, seqs, _, _) in units):
+        if any(len(seqs) > 1 for (_, seqs, _, _, _) in units):
             obs_registry.counter("serve_super_ticks").inc()
         return deliveries
 

@@ -78,13 +78,15 @@ class EnhanceServer:
                  overlap_readback: bool | None = None,
                  max_backlog: int = DEFAULT_MAX_BACKLOG,
                  tick_interval_s: float = 0.002,
-                 state_dir=None, fault_spec=None, run_info: dict | None = None):
+                 state_dir=None, fault_spec=None, tap=None,
+                 run_info: dict | None = None):
         self.host, self.port, self.unix_path = host, port, unix_path
         self.scheduler = scheduler or Scheduler(
             max_sessions=max_sessions, max_queue_blocks=max_queue_blocks,
             max_blocks_per_tick=max_blocks_per_tick,
             blocks_per_super_tick=blocks_per_super_tick,
             overlap_readback=overlap_readback, fault_spec=fault_spec,
+            tap=tap,
         )
         self.max_backlog = max_backlog
         self.tick_interval_s = tick_interval_s

@@ -1,0 +1,457 @@
+"""Flywheel subsystem tests: shard format, corpus tap, shard dataset and
+the sharded/bf16 training lanes (disco_tpu/flywheel, nn/training mesh+
+precision paths).  The end-to-end serve→tap→shard→train loop is gated by
+``make flywheel-check``; these tests pin the pieces in isolation."""
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from disco_tpu.flywheel import (
+    CorpusTap,
+    ShardDataset,
+    ShardError,
+    list_shards,
+    probe_shard,
+    read_shard,
+    write_shard,
+)
+from disco_tpu.obs.metrics import REGISTRY as obs_registry
+
+K, C, F, T = 4, 2, 9, 8
+
+
+def _block(rng, seq=0, session="s"):
+    Y = (rng.standard_normal((K, C, F, T))
+         + 1j * rng.standard_normal((K, C, F, T))).astype(np.complex64)
+    yf = (rng.standard_normal((K, F, T))
+          + 1j * rng.standard_normal((K, F, T))).astype(np.complex64)
+    mz = rng.uniform(0.05, 0.95, (K, F, T)).astype(np.float32)
+    mw = rng.uniform(0.05, 0.95, (K, F, T)).astype(np.float32)
+    return {"session": session, "seq": seq, "Y": Y, "yf": yf,
+            "mask_z": mz, "mask_w": mw}
+
+
+def _fill_tap_dir(tmp_path, rng, n_blocks=6, records_per_shard=3):
+    tap = CorpusTap(tmp_path / "tap", records_per_shard=records_per_shard)
+    for i in range(n_blocks):
+        b = _block(rng, seq=i)
+        assert tap.offer("s1", i, b["Y"], b["mask_z"], b["mask_w"], b["yf"])
+    tap.close()
+    return tmp_path / "tap"
+
+
+# ---------------------------------------------------------------- shard files
+def test_shard_roundtrip_preserves_complex_splits(tmp_path, rng):
+    rec = _block(rng)
+    p = write_shard(tmp_path / "a.shard.msgpack", [rec], meta={"k": 1})
+    meta, records = read_shard(p)
+    assert meta == {"k": 1} and len(records) == 1
+    got = records[0]
+    assert got["session"] == "s" and got["seq"] == 0
+    for key in ("Y", "yf"):
+        assert got[key].dtype == np.complex64
+        np.testing.assert_array_equal(got[key], rec[key])
+    for key in ("mask_z", "mask_w"):
+        assert got[key].dtype == np.float32
+        np.testing.assert_array_equal(got[key], rec[key])
+    assert probe_shard(p)
+
+
+def test_torn_and_tampered_shards_fail_probe(tmp_path, rng):
+    p = write_shard(tmp_path / "a.shard.msgpack", [_block(rng)])
+    raw = bytearray(p.read_bytes())
+    # truncation: a torn write that somehow reached a final path
+    torn = tmp_path / "torn.shard.msgpack"
+    torn.write_bytes(bytes(raw[: len(raw) // 2]))
+    assert not probe_shard(torn)
+    with pytest.raises(ShardError):
+        read_shard(torn)
+    # tamper: flip one payload byte — the embedded digest must catch it
+    flipped = bytearray(raw)
+    flipped[len(flipped) // 2] ^= 0xFF
+    bad = tmp_path / "bad.shard.msgpack"
+    bad.write_bytes(bytes(flipped))
+    assert not probe_shard(bad)
+    # not-a-shard
+    junk = tmp_path / "junk.shard.msgpack"
+    junk.write_bytes(b"\x00\x01\x02")
+    assert not probe_shard(junk)
+
+
+def test_write_shard_is_atomic_under_mid_write_chaos(tmp_path, rng):
+    from disco_tpu.io.atomic import TMP_SUFFIX
+    from disco_tpu.runs import chaos
+
+    victim = tmp_path / "v.shard.msgpack"
+    chaos.configure("mid_write", after=1)
+    try:
+        with pytest.raises(chaos.ChaosCrash):
+            write_shard(victim, [_block(rng)])
+    finally:
+        chaos.disable()
+    assert not victim.exists()
+    assert not list(tmp_path.rglob(f"*{TMP_SUFFIX}.*"))
+    # clean retry lands
+    write_shard(victim, [_block(rng)])
+    assert probe_shard(victim)
+
+
+# ------------------------------------------------------------------- the tap
+def test_tap_overflow_drops_and_counts_without_blocking(tmp_path, rng):
+    tap = CorpusTap(tmp_path / "tap", max_queue_blocks=4,
+                    records_per_shard=3, start=False)
+    c0 = obs_registry.counter("tap_dropped").value
+    for i in range(7):
+        b = _block(rng, seq=i)
+        ok = tap.offer("s1", i, b["Y"], b["mask_z"], b["mask_w"], b["yf"])
+        assert ok == (i < 4)  # queue bound 4: the rest drop, never block
+    assert tap.dropped == 3
+    assert obs_registry.counter("tap_dropped").value - c0 == 3
+    stats = tap.close()  # flushes the 4 accepted blocks via a late start
+    assert stats["blocks_accepted"] == 4 and stats["blocks_dropped"] == 3
+    shards = list_shards(tmp_path / "tap")
+    assert sum(len(read_shard(s)[1]) for s in shards) == 4
+    # offers after close drop-and-count instead of raising
+    b = _block(rng, seq=99)
+    assert not tap.offer("s1", 99, b["Y"], b["mask_z"], b["mask_w"], b["yf"])
+
+
+def test_tap_rotation_and_manifest_verify(tmp_path, rng):
+    from disco_tpu.runs.ledger import RunLedger
+
+    tap_dir = _fill_tap_dir(tmp_path, rng, n_blocks=7, records_per_shard=3)
+    shards = list_shards(tap_dir)
+    assert len(shards) == 3  # 3 + 3 + the close()-flushed remainder of 1
+    assert [len(read_shard(s)[1]) for s in shards] == [3, 3, 1]
+    done, requeued = RunLedger(tap_dir / "manifest.jsonl").verified_done(requeue=False)
+    assert len(done) == 3 and not requeued
+
+
+def test_tap_writer_is_jax_free_by_lint_contract():
+    """The tap thread's import graph is pinned by disco-lint DL005 — this
+    asserts the flywheel host-side files are actually enrolled in the
+    no-jax-anywhere list (deleting them from the rule must fail a test,
+    not just silently weaken the gate)."""
+    from disco_tpu.analysis.rules.purity import CLIENT_FILES
+
+    for f in ("disco_tpu/flywheel/tap.py", "disco_tpu/flywheel/shards.py",
+              "disco_tpu/flywheel/dataset.py", "disco_tpu/flywheel/__init__.py"):
+        assert f in CLIENT_FILES
+
+
+# -------------------------------------------------------------- shard dataset
+def test_dataset_deterministic_shuffle_and_epoch_variation(tmp_path, rng):
+    tap_dir = _fill_tap_dir(tmp_path, rng)
+    ds = ShardDataset(tap_dir, win_len=4, seed=7)
+    a = list(ds.batches(4, epoch=0))
+    b = list(ds.batches(4, epoch=0))
+    assert len(a) > 1
+    assert all(np.array_equal(xa, xb) and np.array_equal(ya, yb)
+               for (xa, ya), (xb, yb) in zip(a, b))
+    c = list(ds.batches(4, epoch=1))
+    assert not all(np.array_equal(xa, xc) for (xa, _), (xc, _) in zip(a, c))
+    # windows follow the DiscoDataset item convention: (win, F) pairs
+    x0, y0 = a[0]
+    assert x0.shape == (4, 4, F) and y0.shape == (4, 4, F)
+    assert x0.dtype == np.float32 and y0.dtype == np.float32
+
+
+def test_dataset_ledger_resume_skips_consumed_shards(tmp_path, rng):
+    tap_dir = _fill_tap_dir(tmp_path, rng)
+    ds = ShardDataset(tap_dir, win_len=4, seed=7)
+    led = tmp_path / "led.jsonl"
+    full = list(ds.batches(4, epoch=0, ledger=led))
+    assert full
+    # a completed epoch fully resumes to nothing
+    assert list(ds.batches(4, epoch=0, ledger=led)) == []
+    # another epoch is untouched by epoch-0 records
+    assert len(list(ds.batches(4, epoch=1, ledger=led))) == len(
+        list(ds.batches(4, epoch=1))
+    )
+
+
+def test_dataset_skips_corrupt_shard_with_warning(tmp_path, rng):
+    from disco_tpu import obs
+
+    tap_dir = _fill_tap_dir(tmp_path, rng)
+    intact = len(list(ShardDataset(tap_dir, win_len=4).batches(4, epoch=0)))
+    good = list_shards(tap_dir)[0]
+    raw = good.read_bytes()
+    (tap_dir / "zz-torn.shard.msgpack").write_bytes(raw[: len(raw) // 2])
+    c0 = obs_registry.peek_counter("shards_skipped")
+    log = tmp_path / "ev.jsonl"
+    with obs.recording(log):
+        after = len(list(ShardDataset(tap_dir, win_len=4).batches(4, epoch=0)))
+    assert after == intact  # the torn shard contributed nothing
+    assert obs_registry.peek_counter("shards_skipped") - c0 == 1
+    events = obs.read_events(log)
+    assert any(e["kind"] == "warning" and "corrupt shard" in e["attrs"]["reason"]
+               for e in events)
+
+
+# ----------------------------------------------- scheduler post-readback seam
+def test_scheduler_feeds_tap_at_the_post_readback_seam(tmp_path, rng):
+    """A minimal in-process scheduler run: pushed blocks come back delivered
+    AND spooled, with the tap's record bit-identical to the wire arrays."""
+    from disco_tpu.serve.scheduler import Scheduler
+    from disco_tpu.serve.session import SessionConfig
+
+    Fs = 5
+    cfg = SessionConfig(n_nodes=K, mics_per_node=C, n_freq=Fs,
+                        block_frames=8, update_every=4)
+    tap = CorpusTap(tmp_path / "tap", records_per_shard=2)
+    sched = Scheduler(max_sessions=2, tap=tap)
+    session = sched.open_session(cfg)
+    Y = (rng.standard_normal((K, C, Fs, 8))
+         + 1j * rng.standard_normal((K, C, Fs, 8))).astype(np.complex64)
+    m = rng.uniform(0.05, 0.95, (K, Fs, 8)).astype(np.float32)
+    sched.push_block(session, 0, Y, m, m)
+    sched.push_block(session, 1, Y, m, m)
+    deliveries = sched.tick()
+    assert len(deliveries) == 2
+    sched.request_close(session)
+    sched.tick()
+    tap.close()
+    shards = list_shards(tmp_path / "tap")
+    records = [r for s in shards for r in read_shard(s)[1]]
+    assert sorted(r["seq"] for r in records) == [0, 1]
+    for r in records:
+        np.testing.assert_array_equal(r["Y"], Y)
+        np.testing.assert_array_equal(r["mask_z"], m)
+        _, seq, yf, _ = deliveries[r["seq"]]
+        np.testing.assert_array_equal(r["yf"], np.asarray(yf))
+
+
+# ------------------------------------------------------------- training lanes
+def _tiny_model():
+    from disco_tpu.nn.crnn import build_crnn
+
+    return build_crnn(
+        n_ch=1, win_len=9, n_freq=33, cnn_filters=(4, 4), conv_kernels=3,
+        conv_strides=1, pool_kernels=[(1, 2)] * 2, pool_strides=None,
+        conv_padding=[(0, 1)] * 2, rnn_units=(8,), ff_units=(33,),
+    )
+
+
+def _xy(rng, batch=8):
+    x = rng.random((batch, 9, 33)).astype("float32")
+    y = (rng.random((batch, 9, 33)) > 0.5).astype("float32")
+    return x, y
+
+
+def test_step_fn_factory_memoizes_and_canonicalizes_precision(rng):
+    from disco_tpu.nn.training import make_step_fns
+
+    model, _tx = _tiny_model()
+    a = make_step_fns(model, "all", n_freq=33)
+    b = make_step_fns(model, "all", n_freq=33, precision=" F32 ")
+    assert a[0] is b[0] and a[1] is b[1]
+    c = make_step_fns(model, "all", n_freq=33, precision="bf16")
+    assert c[0] is not a[0]
+    with pytest.raises(ValueError):
+        make_step_fns(model, "all", n_freq=33, precision="fp8")
+
+
+def test_bf16_lane_keeps_f32_masters_and_traces_one_program(rng):
+    from disco_tpu.nn.training import create_train_state, make_step_fns
+    from disco_tpu.obs.accounting import recompile_count
+
+    model, tx = _tiny_model()
+    x, y = _xy(rng)
+    t32, _ = make_step_fns(model, "all", n_freq=33)
+    tb, eb = make_step_fns(model, "all", n_freq=33, precision="bf16")
+    s0 = create_train_state(model, tx, x[:1], seed=3)
+    s32, l32 = t32(s0, x, y)
+    n0 = recompile_count("train_step")
+    sb, lb = tb(create_train_state(model, tx, x[:1], seed=3), x, y)
+    sb2, _ = tb(sb, x, y)
+    eb(sb2, x, y)
+    # one program for the whole lane: the carried pytree keeps f32 dtypes
+    assert recompile_count("train_step") - n0 <= 1
+    for leaf in jax.tree_util.tree_leaves((sb.params, sb.batch_stats, sb.opt_state)):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == jnp.float32
+    # the lane tracks the f32 oracle within bf16 resolution
+    rel = abs(float(lb) - float(l32)) / max(abs(float(l32)), 1e-12)
+    assert rel < 2e-2
+
+
+def test_mesh_one_device_training_is_bit_exact(rng):
+    from disco_tpu.nn.training import (
+        create_train_state,
+        make_step_fns,
+        replicate_to_mesh,
+    )
+    from disco_tpu.parallel.mesh import make_mesh
+
+    model, tx = _tiny_model()
+    x, y = _xy(rng)
+    t_ref, _ = make_step_fns(model, "all", n_freq=33)
+    mesh = make_mesh(n_node=1, n_batch=1, devices=np.array(jax.devices()[:1]))
+    t_mesh, _ = make_step_fns(model, "all", n_freq=33, mesh=mesh)
+
+    s_ref = create_train_state(model, tx, x[:1], seed=5)
+    s_mesh = replicate_to_mesh(create_train_state(model, tx, x[:1], seed=5), mesh)
+    for _ in range(3):
+        s_ref, l_ref = t_ref(s_ref, x, y)
+        s_mesh, l_mesh = t_mesh(s_mesh, x, y)
+        assert np.asarray(l_mesh).tobytes() == np.asarray(l_ref).tobytes()
+    pa = np.asarray(jax.tree_util.tree_leaves(s_ref.params)[0])
+    pb = np.asarray(jax.tree_util.tree_leaves(s_mesh.params)[0])
+    np.testing.assert_array_equal(pa, pb)
+
+
+@pytest.mark.slow
+def test_mesh_eight_device_loss_parity(rng):
+    from disco_tpu.flywheel.check import MESH_LOSS_RTOL
+    from disco_tpu.nn.training import (
+        create_train_state,
+        make_step_fns,
+        replicate_to_mesh,
+    )
+    from disco_tpu.parallel.mesh import make_mesh
+
+    n_dev = len(jax.devices())
+    assert n_dev == 8, "conftest forces 8 virtual CPU devices"
+    model, tx = _tiny_model()
+    x, y = _xy(rng, batch=8)
+    t_ref, _ = make_step_fns(model, "all", n_freq=33)
+    mesh = make_mesh(n_node=1, n_batch=n_dev)
+    t_mesh, _ = make_step_fns(model, "all", n_freq=33, mesh=mesh)
+    s_ref = create_train_state(model, tx, x[:1], seed=5)
+    s_mesh = replicate_to_mesh(create_train_state(model, tx, x[:1], seed=5), mesh)
+    for _ in range(4):
+        s_ref, l_ref = t_ref(s_ref, x, y)
+        s_mesh, l_mesh = t_mesh(s_mesh, x, y)
+        rel = abs(float(l_mesh) - float(l_ref)) / max(abs(float(l_ref)), 1e-12)
+        assert rel <= MESH_LOSS_RTOL
+
+
+@pytest.mark.slow
+def test_fit_on_shards_with_prefetch_and_mesh(tmp_path, rng):
+    """fit over a ShardDataset batch feed: the ChunkPrefetcher host
+    prefetch records its overlap gauges, the mesh lane trains, and the
+    checkpoint restores the explicit epochs_done count."""
+    from disco_tpu.nn.crnn import build_crnn
+    from disco_tpu.nn.training import create_train_state, fit, load_checkpoint
+    from disco_tpu.parallel.mesh import make_mesh
+
+    tap_dir = _fill_tap_dir(tmp_path, rng, n_blocks=8, records_per_shard=4)
+    ds = ShardDataset(tap_dir, win_len=4, seed=1)
+    model, tx = build_crnn(n_ch=1, win_len=4, n_freq=F, cnn_filters=(2,),
+                           pool_kernels=((1, 2),), conv_padding=((0, 1),),
+                           rnn_units=(4,), ff_units=(F,), rnn_dropouts=0.0)
+    first = next(ds.batches(2, epoch=0))
+    state = create_train_state(model, tx, first[0][:1], seed=2)
+    obs_registry.gauge("prefetch_stall_ms").value = None
+    mesh = make_mesh(n_node=1, n_batch=len(jax.devices()))
+    state, tr, va, name = fit(
+        model, state, ds.batch_fn(8), ds.batch_fn(8, shuffle=False),
+        n_epochs=2, save_path=tmp_path / "m", verbose=False, mesh=mesh,
+    )
+    assert np.count_nonzero(tr) == 2
+    assert obs_registry.gauge("prefetch_stall_ms").value is not None
+    assert obs_registry.gauge("overlap_efficiency").value is not None
+    fresh = create_train_state(model, tx, first[0][:1], seed=2)
+    _, tr_hist, va_hist = load_checkpoint(tmp_path / "m" / f"{name}_model.msgpack", fresh)
+    assert 1 <= len(tr_hist) <= 2 and len(tr_hist) == len(va_hist)
+
+
+@pytest.mark.slow
+def test_resumed_fit_aligns_dataset_epochs_with_training_epochs(tmp_path, rng):
+    """The resume protocol (batch_fn.set_start_epoch): a --weights resume
+    with a reused dataset ledger must NOT replay dataset epoch 0 — whose
+    shard units are already consumed — and silently train on zero batches."""
+    from disco_tpu.nn.crnn import build_crnn
+    from disco_tpu.nn.training import create_train_state, fit
+
+    tap_dir = _fill_tap_dir(tmp_path, rng, n_blocks=8, records_per_shard=4)
+    ds = ShardDataset(tap_dir, win_len=4, seed=1)
+    model, tx = build_crnn(n_ch=1, win_len=4, n_freq=F, cnn_filters=(2,),
+                           pool_kernels=((1, 2),), conv_padding=((0, 1),),
+                           rnn_units=(4,), ff_units=(F,), rnn_dropouts=0.0)
+    first = next(ds.batches(2, epoch=0))
+    led = tmp_path / "shards_led.jsonl"
+    state = create_train_state(model, tx, first[0][:1], seed=2)
+    state, tr, _va, name = fit(
+        model, state, ds.batch_fn(8, ledger=led), ds.batch_fn(8, shuffle=False),
+        n_epochs=2, save_path=tmp_path / "m", verbose=False,
+    )
+    assert np.count_nonzero(tr) == 2
+    # resume for one more epoch with the SAME dataset ledger: the dataset
+    # must serve epoch 2 (fresh units), not replay the consumed epoch 0
+    state2 = create_train_state(model, tx, first[0][:1], seed=2)
+    _, tr2, _va2, _ = fit(
+        model, state2, ds.batch_fn(8, ledger=led), ds.batch_fn(8, shuffle=False),
+        n_epochs=1, save_path=tmp_path / "m", verbose=False,
+        resume_from=tmp_path / "m" / f"{name}_model.msgpack",
+    )
+    assert len(tr2) == 3 and tr2[2] > 0.0  # the resumed epoch actually trained
+
+
+# ------------------------------------------------- checkpoint epoch-count fix
+def test_checkpoint_stores_explicit_epoch_count_zero_loss_safe(tmp_path, rng):
+    """The load_checkpoint resume bug (ISSUE 11 satellite): an epoch whose
+    loss is legitimately 0.0 must not truncate the resume point."""
+    from disco_tpu.nn.training import (
+        create_train_state,
+        load_checkpoint,
+        save_checkpoint,
+    )
+
+    model, tx = _tiny_model()
+    x, _ = _xy(rng, batch=2)
+    state = create_train_state(model, tx, x[:1])
+    # 3 completed epochs out of 5 preallocated; epoch 2's loss is EXACTLY 0.0
+    train = np.array([0.5, 0.4, 0.0, 0.0, 0.0])
+    val = np.array([0.6, 0.5, 0.0, 0.0, 0.0])
+    save_checkpoint(tmp_path / "ck.msgpack", state, train, val, epochs_done=3)
+    _, tr, va = load_checkpoint(tmp_path / "ck.msgpack", state)
+    assert len(tr) == 3 and len(va) == 3  # trim_zeros would have said 2
+    assert tr[2] == 0.0
+
+    # back-compat: a pre-flywheel checkpoint (no epochs_done key) still
+    # loads via the historical trim inference
+    from flax import serialization
+
+    from disco_tpu.io.atomic import write_bytes_atomic
+
+    legacy = {
+        "params": state.params,
+        "batch_stats": state.batch_stats,
+        "opt_state": state.opt_state,
+        "step": state.step,
+        "train_loss": train,
+        "val_loss": val,
+    }
+    write_bytes_atomic(tmp_path / "old.msgpack", serialization.to_bytes(legacy))
+    _, tr_old, _ = load_checkpoint(tmp_path / "old.msgpack", state)
+    assert len(tr_old) == 2  # the old (buggy) inference, preserved for old files
+
+
+# ------------------------------------------------------- lazy ChunkPrefetcher
+def test_chunk_prefetcher_accepts_lazy_generators():
+    """The training batch feed hands ChunkPrefetcher a GENERATOR whose
+    next() does the numpy prep — it must be drained lazily on the loader
+    thread, not list()-ed up front on the caller's."""
+    from disco_tpu.enhance.pipeline import ChunkPrefetcher
+
+    drained_on: list = []
+
+    def gen():
+        for i in range(4):
+            drained_on.append(threading.current_thread().name)
+            yield (i,)
+
+    g = gen()
+    pf = ChunkPrefetcher(g, lambda i: i * 10, depth=2)
+    try:
+        got = [item for item, _stall in pf]
+    finally:
+        pf.close()
+    assert got == [0, 10, 20, 30]
+    assert all(name == "disco-chunk-prefetch" for name in drained_on)
