@@ -3,9 +3,9 @@
 
 PYTHON ?= python
 
-.PHONY: test coverage doc install native clean bench milestone-corpus dryrun lint-check trace-check obs-check fault-check chaos-check perf-check serve-check stream-check flywheel-check
+.PHONY: test coverage doc install native clean bench milestone-corpus dryrun lint-check trace-check obs-check fault-check chaos-check perf-check serve-check stream-check flywheel-check soak-check
 
-test: lint-check trace-check obs-check fault-check chaos-check perf-check stream-check serve-check flywheel-check
+test: lint-check trace-check obs-check fault-check chaos-check perf-check stream-check serve-check flywheel-check soak-check
 	$(PYTHON) -m pytest tests/ -q
 
 # Static-analysis gate (runs FIRST: it needs no jax, no device and ~2 s):
@@ -118,6 +118,24 @@ flywheel-check:
 	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= DISCO_TPU_COMPILE_CACHE=off \
 	    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	    $(PYTHON) -m disco_tpu.flywheel.check
+
+# Chaos-soak gate (the eleventh gate): disco-soak composes the existing
+# fault primitives — chaos seams, protocol truncation, hard connection
+# drops, slow clients, injected TRANSPORT_ERRORS through the scheduler's
+# fakeable dispatch hook — into >= 5 seeded randomized multi-fault
+# campaigns against a loopback server on CPU and asserts the survival
+# invariants after every run: no torn session checkpoint or tap shard,
+# no delivered frame lost or duplicated, every parked session reattached
+# bit-exact vs offline streaming_tango, recovery within the declared tick
+# bound, and a byte-stable per-seed event summary (the first seed literally
+# runs twice and the summaries must match byte for byte).  The final seed
+# adds the crash leg: a parked session's checkpoint survives a ChaosCrash
+# server death and resumes bit-exact on a fresh server via its resume
+# token.  Hermetic: CPU, loopback only, compile cache off, one JAX
+# process, zero SIGKILLs (disco_tpu/runs/soak.py).
+soak-check:
+	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= DISCO_TPU_COMPILE_CACHE=off \
+	    $(PYTHON) -m disco_tpu.runs.soak
 
 coverage:
 	$(PYTHON) -m coverage run --branch --source=disco_tpu -m pytest tests/ -q

@@ -477,6 +477,32 @@ def bench_serve(n_sessions=4, dur_s=4.0):
             t.join()
         dt = time.perf_counter() - t0
         ticks = srv.scheduler.ticks_with_work - ticks0
+        # survival probe (off the measured window): one park -> reattach ->
+        # resume cycle, wall ms from the socket kill to the next delivered
+        # block of the bit-exact stitched stream — the latency a client
+        # actually pays for a dropped connection under the survival layer
+        reattach_ms = None
+        try:
+            import socket as socket_mod
+
+            cl = ServeClient(addr, retry_seed=11)
+            cl.open(cfg, session_id="bench-reattach")
+            marks: dict = {}
+
+            def on_block(seq, _yf):
+                if seq == 1 and "t0" not in marks:
+                    marks["t0"] = time.perf_counter()
+                    cl._sock.shutdown(socket_mod.SHUT_RDWR)
+                elif "t0" in marks and "t1" not in marks:
+                    marks["t1"] = time.perf_counter()
+
+            cl.enhance_clip(Y, m, m, on_block=on_block)
+            cl.close()
+            cl.shutdown()
+            if "t1" in marks:
+                reattach_ms = round((marks["t1"] - marks["t0"]) * 1e3, 3)
+        except Exception:
+            pass   # the probe must never fail the lane
     finally:
         srv.stop()
     if errors:
@@ -494,6 +520,7 @@ def bench_serve(n_sessions=4, dur_s=4.0):
         "queue_wait_p95_ms": wait_hist.percentile(95.0),
         "dispatch_p95_ms": disp_hist.percentile(95.0),
         "mean_blocks_per_tick": total_blocks / ticks if ticks else None,
+        "reattach_ms": reattach_ms,
     }
     return total_blocks / dt, p95_ms, stats
 

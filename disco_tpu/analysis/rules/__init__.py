@@ -15,6 +15,7 @@ is registration order):
 * DL010 ``chaos-seam``            — :mod:`.registered`
 * DL011 ``scan-unroll``           — :mod:`.scanunroll`
 * DL012 ``fused-magnitude-precision`` — :mod:`.magnitude`
+* DL013 ``adhoc-transport-retry`` — :mod:`.retryloop`
 
 (DL000 ``lint-suppression`` is the engine's own hygiene rule — see
 :mod:`disco_tpu.analysis.suppressions`.)
@@ -29,6 +30,7 @@ from disco_tpu.analysis.rules import (  # noqa: F401  (import = register)
     purity,
     readback,
     registered,
+    retryloop,
     scanunroll,
     sigkill,
     tracedfloat,

@@ -97,7 +97,38 @@ def build_parser():
                    help="directory for live-session checkpoints: a graceful "
                         "drain saves every open session here (atomic msgpack "
                         "+ sha256 digest) and a later server resumes them "
-                        "(client opens with resume=<session id>)")
+                        "(client opens with resume=<session id>); parked "
+                        "sessions checkpoint here too, so a reattach "
+                        "survives even a server death in between")
+    p.add_argument("--park-ttl", type=float, default=60.0, metavar="SECONDS",
+                   help="how long a session parked by a dropped connection "
+                        "waits for its client to reattach (resume token + "
+                        "bit-exact replay) before the slot is reclaimed; "
+                        "parked sessions count toward --max-sessions")
+    p.add_argument("--no-park", dest="park_on_disconnect",
+                   action="store_false", default=True,
+                   help="evict on connection drop instead of parking "
+                        "(pre-survival-layer behavior)")
+    p.add_argument("--replay-blocks", type=int, default=64,
+                   help="per-session replay-buffer depth: how many delivered "
+                        "blocks a reattaching client can have missed and "
+                        "still stitch bit-exact")
+    p.add_argument("--dispatch-retries", type=int, default=2,
+                   help="transport-error retry budget per dispatch/readback "
+                        "(seeded-jitter backoff; an exhausted budget "
+                        "quarantines the session instead of evicting)")
+    p.add_argument("--tick-deadline", type=float, default=None,
+                   metavar="SECONDS",
+                   help="per-tick wall deadline: a tick that overruns is "
+                        "marked suspect, the device is fenced via the "
+                        "preflight probe, and the hit feeds the degradation "
+                        "ladder (never kills anything — environment "
+                        "contract); default: no watchdog")
+    p.add_argument("--no-ladder", dest="ladder", action="store_false",
+                   default=True,
+                   help="disable the degradation ladder (overload control: "
+                        "super-tick shrink -> tap off -> shed-to-park, "
+                        "driven by queue-wait p95 and deadline hits)")
     add_tap_args(p)
     add_fault_args(p)
     add_preflight_arg(p, what="the server")
@@ -127,9 +158,18 @@ def main(argv=None):
             state_dir=args.state_dir,
             fault_spec=args.fault_spec,
             tap=tap,
+            park_on_disconnect=args.park_on_disconnect,
+            park_ttl_s=args.park_ttl,
+            replay_blocks=args.replay_blocks,
+            dispatch_retries=args.dispatch_retries,
+            tick_deadline_s=args.tick_deadline,
+            ladder=args.ladder,
             run_info={"preflight": preflight, "state_dir": args.state_dir,
                       "max_sessions": args.max_sessions,
                       "blocks_per_super_tick": args.blocks_per_super_tick,
+                      "park_ttl_s": args.park_ttl,
+                      "tick_deadline_s": args.tick_deadline,
+                      "ladder": bool(args.ladder),
                       "tap_dir": args.tap_dir},
         )
         try:

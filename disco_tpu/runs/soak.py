@@ -1,0 +1,538 @@
+"""``make soak-check`` — the chaos-soak gate of the serving survival layer.
+
+The serve subsystem's other gates each prove ONE failure mode in isolation
+(serve-check: crash/drain; fault-check: z-exchange faults; chaos-check:
+artifact atomicity).  A production outage is never that polite: a client
+disconnects while another truncates a frame mid-block while the tunnel
+throws a transport error burst.  ``disco-soak`` composes the EXISTING
+fault primitives — the chaos seams (:mod:`disco_tpu.runs.chaos`), protocol
+truncation, hard connection drops, slow clients, and injected
+``TRANSPORT_ERRORS`` through the scheduler's fakeable dispatch hook
+(:func:`disco_tpu.serve.scheduler.set_dispatch_fault_injector`) — into K
+seeded randomized multi-fault campaigns against a loopback server on CPU,
+and asserts the survival invariants after every run:
+
+1. **no torn artifact or shard** — every session checkpoint in the state
+   dir passes ``probe_session_state``; every flywheel tap shard passes
+   ``probe_shard``.
+2. **no delivered frame lost or duplicated** — each client's log of
+   received ``enhanced`` seqs is exactly ``0..n_blocks-1``, once each,
+   across every drop/park/reattach.
+3. **bit-exact reattach** — every session's stitched output equals the
+   offline ``streaming_tango`` run of the same clip, byte for byte.
+4. **bounded recovery** — after the last injected fault the server drains
+   the remaining work within :data:`RECOVERY_TICK_BOUND` scheduler ticks.
+5. **byte-stable ledger** — the per-seed event summary (planned faults +
+   deterministic survival counts distilled from the obs JSONL ledger) is
+   byte-identical across runs of the same seed (asserted by literally
+   running the first seed twice).
+
+The final schedule adds the crash leg: a parked session's park-checkpoint
+must survive a :class:`~disco_tpu.runs.chaos.ChaosCrash` server death and
+resume bit-exact on a FRESH server via its resume token — parking is what
+turns "the server died" into "the client reattaches somewhere else".
+
+Hermetic like the other gates: CPU backend, loopback sockets only, compile
+cache off, ONE jax process (clients are numpy threads), zero SIGKILLs.
+
+No reference counterpart: the reference has no serving layer to soak.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+#: the seeded campaign roster (>= 5 schedules; acceptance criterion)
+SEEDS = (201, 202, 203, 204, 205)
+
+#: declared recovery bound: scheduler ticks between the last injected fault
+#: and full drain of the remaining work (2 ms idle ticks — generous, but a
+#: wedged server blows it by orders of magnitude, which is the point)
+RECOVERY_TICK_BOUND = 3000
+
+K, C, U = 4, 2, 4
+BLOCK = 2 * U
+
+
+def _scene(seed, L=16000):
+    import numpy as np
+
+    from disco_tpu.core.dsp import stft
+
+    rng = np.random.default_rng(seed)
+    Y = np.asarray(stft(rng.standard_normal((K, C, L)).astype(np.float32)))
+    F, T = Y.shape[-2:]
+    m = rng.uniform(0.05, 0.95, size=(K, F, T)).astype(np.float32)
+    # whole blocks only: a ragged final block would compile a third program
+    # shape mid-campaign, and XLA compile time mid-soak reads as a fault
+    T -= T % BLOCK
+    return Y[..., :T], m[..., :T]
+
+
+def _warm(F: int, n_super: int) -> None:
+    """Pre-compile the serve-shaped programs through the production
+    scheduler path (one scan group + one per-block dispatch on a throwaway
+    scheduler).  Serving fleets warm before taking traffic for the same
+    reason this gate does: the first dispatch of a cold program pays
+    seconds of XLA compile, which is start-up cost, not a fault — unwarmed
+    it would dominate the campaign's queue waits and the first run of a
+    seed would not match the second (byte-stability).
+
+    No reference counterpart (module docstring)."""
+    import numpy as np
+
+    from disco_tpu.serve import Scheduler
+
+    cap = max(2 * n_super, 2)
+    sched = Scheduler(max_sessions=1, max_queue_blocks=cap,
+                      max_blocks_per_tick=cap,
+                      blocks_per_super_tick=n_super)
+    s = sched.open_session(_config(F), session_id="warm")
+    Y = np.zeros((K, C, F, BLOCK), np.complex64)
+    m = np.ones((K, F, BLOCK), np.float32)
+    for i in range(n_super):
+        sched.push_block(s, i, Y, m, m)
+    sched.tick()                      # the (scan or per-block) program
+    if n_super > 1:
+        sched.push_block(s, n_super, Y, m, m)
+        sched.tick()                  # the per-block tail program
+    sched.tick()                      # flush the overlap buffer
+
+
+def _offline(Y, m):
+    import numpy as np
+
+    from disco_tpu.enhance.streaming import streaming_tango
+
+    return np.asarray(
+        streaming_tango(Y, m, m, update_every=U, policy="local")["yf"])
+
+
+def _config(F):
+    from disco_tpu.serve import SessionConfig
+
+    return SessionConfig(n_nodes=K, mics_per_node=C, n_freq=F,
+                         block_frames=BLOCK, update_every=U)
+
+
+def plan_campaign(seed: int) -> dict:
+    """Expand one seed into a deterministic multi-fault schedule.
+
+    Per session: one connection fault (``drop`` — hard socket kill after a
+    drawn delivery, ``truncate`` — a partial frame then EOF mid-stream, or
+    ``none``) plus an optional slow-reader delay; per run: a seeded set of
+    dispatch-attempt indices that raise an injected transport error (single
+    indices retry in place; a consecutive triple exhausts the retry budget
+    and exercises quarantine).  Same seed, same plan, same summary —
+    ``plan_faults``'s determinism contract applied to the serving layer.
+
+    No reference counterpart (module docstring)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    n_sessions = int(2 + rng.integers(0, 2))          # 2-3 clients
+    kinds = ["drop", "truncate", "none"]
+    faults = [kinds[int(rng.integers(0, len(kinds)))] for _ in range(n_sessions)]
+    if all(f == "none" for f in faults):
+        faults[0] = "drop"                            # every run multi-faults
+    plan = {
+        "seed": seed,
+        "super_tick": 2 if seed % 2 == 0 else 1,
+        "sessions": [
+            {
+                "sid": f"soak{seed}-{i}",
+                "scene_seed": seed * 100 + i,
+                "fault": faults[i],
+                "drop_after": int(rng.integers(1, 4)),  # deliveries before it
+                "slow_ms": int(rng.integers(0, 2)) * 5,  # 0 or 5 ms per block
+            }
+            for i in range(n_sessions)
+        ],
+        "crash_leg": seed == SEEDS[-1],
+    }
+    # transport bursts only on per-block schedules (attempt indices map 1:1
+    # to blocks there, so consumption is deterministic); one lone transient
+    # plus one exhausting triple
+    if plan["super_tick"] == 1 and not plan["crash_leg"]:
+        lone = int(rng.integers(2, 6))
+        burst = int(rng.integers(8, 11))
+        plan["transport_attempts"] = sorted({lone, burst, burst + 1, burst + 2})
+    else:
+        plan["transport_attempts"] = []
+    return plan
+
+
+class _LoggingClient:
+    """A ServeClient + a log of every received ``enhanced`` seq (duplicate
+    and loss detection across reattaches).  Built lazily so the module
+    imports without the serve package loaded."""
+
+    def __new__(cls, *args, **kwargs):
+        from disco_tpu.serve import ServeClient
+
+        class LoggingClient(ServeClient):
+            def __init__(self, *a, **k):
+                self.seq_log: list[int] = []
+                super().__init__(*a, **k)
+
+            def _fold(self, frame):
+                if frame.get("type") == "enhanced":
+                    self.seq_log.append(int(frame["seq"]))
+                super()._fold(frame)
+
+        return LoggingClient(*args, **kwargs)
+
+
+def _make_injector(attempt_indices):
+    """The transport-fault injector: raises ``TimeoutError`` (a
+    ``TRANSPORT_ERRORS`` member with no jax dependency) on the planned
+    dispatch-attempt indices.  Counts every attempt, including retries —
+    which is what makes a consecutive index triple hit one block's whole
+    retry chain and exhaust it.
+
+    No reference counterpart (module docstring)."""
+    planned = set(attempt_indices)
+    state = {"n": 0, "injected": 0, "last_wall": 0.0}
+
+    def injector(_sid, _seqs):
+        state["n"] += 1
+        if state["n"] - 1 in planned:
+            state["injected"] += 1
+            state["last_wall"] = time.monotonic()
+            raise TimeoutError(
+                f"soak: injected transport fault at dispatch attempt "
+                f"{state['n'] - 1}")
+
+    return injector, state
+
+
+def _client_worker(plan_s, addr, Y, m, results, errors, i):
+    """One streaming client thread executing its session's fault script."""
+    import numpy as np
+
+    cl = _LoggingClient(addr, timeout_s=120.0, reattach_timeout_s=10.0,
+                        retry_seed=plan_s["scene_seed"])
+    try:
+        F = Y.shape[-2]
+        cl.open(_config(F), session_id=plan_s["sid"])
+        fired = [False]
+
+        def on_block(seq, _yf):
+            if plan_s["slow_ms"]:
+                time.sleep(plan_s["slow_ms"] / 1e3)
+            if fired[0] or seq + 1 != plan_s["drop_after"]:
+                return
+            fired[0] = True
+            if plan_s["fault"] == "drop":
+                # a hard network drop: both directions die mid-stream
+                cl._sock.shutdown(socket.SHUT_RDWR)
+            elif plan_s["fault"] == "truncate":
+                # a partial frame then EOF: the server must park the
+                # session (nothing reached push_block), never corrupt it
+                from disco_tpu.serve import protocol
+
+                frame = protocol.pack_frame({"type": "close"})
+                cl._sock.sendall(frame[: max(1, len(frame) // 2)])
+                cl._sock.shutdown(socket.SHUT_WR)
+
+        yf = cl.enhance_clip(Y, m, m, on_block=on_block)
+        cl.close()
+        results[i] = (yf, list(cl.seq_log), cl.reattaches)
+    except Exception as e:
+        errors.append(f"client {plan_s['sid']}: {type(e).__name__}: {e}")
+    finally:
+        cl.shutdown()
+
+
+def run_soak(seed: int, tmp: Path, failures: list) -> dict:
+    """One seeded soak campaign; returns the canonical per-seed summary
+    dict (deterministic — the byte-stability invariant hashes its JSON).
+
+    No reference counterpart (module docstring)."""
+    import numpy as np
+
+    from disco_tpu import obs
+    from disco_tpu.flywheel import CorpusTap, list_shards, probe_shard
+    from disco_tpu.serve import EnhanceServer, set_dispatch_fault_injector
+    from disco_tpu.serve.session import probe_session_state
+
+    plan = plan_campaign(seed)
+    scenes = [_scene(s["scene_seed"]) for s in plan["sessions"]]
+    refs = [_offline(Y, m) for (Y, m) in scenes]
+    n_blocks = [-(-Y.shape[-1] // BLOCK) for (Y, _m) in scenes]
+    _warm(scenes[0][0].shape[-2], plan["super_tick"])
+
+    run_dir = tmp / f"seed{seed}"
+    run_dir.mkdir(parents=True, exist_ok=True)
+    obs_log = run_dir / "events.jsonl"
+    tap = CorpusTap(run_dir / "tap", records_per_shard=8)
+    injector, inj_state = _make_injector(plan["transport_attempts"])
+
+    summary: dict = {"seed": seed, "plan": plan}
+    with obs.recording(obs_log):
+        srv = EnhanceServer(
+            max_sessions=8, state_dir=run_dir / "state", tap=tap,
+            blocks_per_super_tick=plan["super_tick"],
+            park_ttl_s=60.0, quarantine_ticks=5, tick_deadline_s=10.0,
+            dispatch_retries=2, retry_seed=seed, ladder=True,
+        )
+        srv.scheduler.dispatch_retry_base_s = 0.002
+        set_dispatch_fault_injector(injector)
+        try:
+            addr = srv.start()
+            results: list = [None] * len(scenes)
+            errors: list = []
+            threads = [
+                threading.Thread(
+                    target=_client_worker,
+                    args=(plan["sessions"][i], addr, scenes[i][0], scenes[i][1],
+                          results, errors, i))
+                for i in range(len(scenes))
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+            recovery_start_tick = srv.scheduler.tick_no
+            failures.extend(f"seed {seed}: {e}" for e in errors)
+            srv.stop(timeout_s=120)
+            if plan["crash_leg"]:
+                # runs with the campaign server fully stopped: the chaos
+                # seam is process-global, and exactly ONE server must be
+                # ticking when it fires
+                summary["crash_leg"] = _crash_leg(seed, run_dir, failures)
+        finally:
+            set_dispatch_fault_injector(None)
+            tap_stats = tap.close()
+
+        # invariant 2 + 3: per-session loss/duplication and bit-exactness
+        reattaches_total = 0
+        for i, s in enumerate(plan["sessions"]):
+            if results[i] is None:
+                failures.append(f"seed {seed}: session {s['sid']} returned nothing")
+                continue
+            yf, seq_log, reattaches = results[i]
+            reattaches_total += reattaches
+            if sorted(seq_log) != list(range(n_blocks[i])):
+                dup = sorted({q for q in seq_log if seq_log.count(q) > 1})
+                missing = sorted(set(range(n_blocks[i])) - set(seq_log))
+                failures.append(
+                    f"seed {seed}: session {s['sid']} delivered frames "
+                    f"lost={missing} duplicated={dup}"
+                )
+            if not np.array_equal(yf, refs[i]):
+                failures.append(
+                    f"seed {seed}: session {s['sid']} stitched output is not "
+                    f"bit-exact vs offline streaming_tango (max abs diff "
+                    f"{np.abs(yf - refs[i]).max():g})"
+                )
+        # invariant 4: bounded recovery — every block was already delivered
+        # when the clients joined; the tick budget bounds how long the tail
+        # (reattach + quarantine release + drain) took after the LAST fault
+        ticks_total = srv.scheduler.tick_no
+        if ticks_total - recovery_start_tick > RECOVERY_TICK_BOUND:
+            failures.append(
+                f"seed {seed}: drain took {ticks_total - recovery_start_tick} "
+                f"ticks after the campaign (> {RECOVERY_TICK_BOUND})"
+            )
+
+        # invariant 1: no torn artifact or shard
+        state_dir = run_dir / "state"
+        checkpoints = sorted(state_dir.glob("*.msgpack")) if state_dir.is_dir() else []
+        for p in checkpoints:
+            if not probe_session_state(p):
+                failures.append(f"seed {seed}: torn session checkpoint {p}")
+        shards = list_shards(run_dir / "tap")
+        for p in shards:
+            if not probe_shard(p):
+                failures.append(f"seed {seed}: torn tap shard {p}")
+        if tap_stats["blocks_dropped"]:
+            failures.append(
+                f"seed {seed}: tap dropped {tap_stats['blocks_dropped']} "
+                "blocks at soak load")
+
+    # invariant 5: the byte-stable ledger — the plan plus deterministic
+    # survival facts distilled from the validated event log.  Counts whose
+    # value depends on scheduling races (exact park/reattach totals — a
+    # drop can surface once on the read path or twice via read+send,
+    # whether a park checkpoint landed before the reattach, shard rotation
+    # timing) are asserted as INVARIANTS below but summarized as booleans;
+    # wall times and tick counts never enter the summary at all.
+    events = obs.read_events(obs_log)
+    campaign_ids = {s["sid"] for s in plan["sessions"]}
+    acts = [e["attrs"].get("action") for e in events
+            if e["kind"] == "session"
+            and e["attrs"].get("session") in campaign_ids]
+    n_faults = sum(1 for s in plan["sessions"] if s["fault"] != "none")
+    parks, reatt = acts.count("park"), acts.count("reattach")
+    spurious_degrades = sum(
+        1 for e in events if e["kind"] == "degraded"
+        and e["attrs"].get("controller") == "ladder")
+    summary.update({
+        "sessions": len(plan["sessions"]),
+        "blocks": n_blocks,
+        "connection_faults": n_faults,
+        "transport_faults_planned": len(plan["transport_attempts"]),
+        "transport_faults_injected": inj_state["injected"],
+        "quarantines": acts.count("quarantine"),
+        "evictions": acts.count("evict"),
+        "all_parks_reattached": parks == reatt and parks >= n_faults,
+        "spurious_ladder_degrades": spurious_degrades,
+        "torn_artifacts": 0,   # any torn probe above is a failure + exit 1
+    })
+    if summary["transport_faults_injected"] != len(plan["transport_attempts"]):
+        failures.append(
+            f"seed {seed}: injected {summary['transport_faults_injected']} "
+            f"transport faults, planned {len(plan['transport_attempts'])}"
+        )
+    if not summary["all_parks_reattached"]:
+        failures.append(
+            f"seed {seed}: {n_faults} connection fault(s), {parks} park(s), "
+            f"{reatt} reattach(es) — a park never reattached (or a fault "
+            f"evicted instead of parking)"
+        )
+    if summary["evictions"]:
+        failures.append(
+            f"seed {seed}: {summary['evictions']} eviction(s) during the "
+            "soak — every faulted session must park and reattach")
+    if spurious_degrades:
+        failures.append(
+            f"seed {seed}: the ladder degraded {spurious_degrades}x during "
+            "a light-load soak — outage latency is leaking into the "
+            "ladder's queue-wait p95")
+    return summary
+
+
+def _crash_leg(seed: int, run_dir: Path, failures: list) -> dict:
+    """The crash schedule of the final seed: a parked session's checkpoint
+    survives a ChaosCrash server death and resumes bit-exact on a fresh
+    server via the resume token.
+
+    No reference counterpart (module docstring)."""
+    import numpy as np
+
+    from disco_tpu.runs import chaos
+    from disco_tpu.serve import EnhanceServer, ServeClient, ServeError
+
+    Y, m = _scene(seed * 100 + 77)
+    F, T = Y.shape[-2:]
+    ref = _offline(Y, m)
+    n_blocks = -(-T // BLOCK)
+    half = max(1, n_blocks // 2)
+    state_dir = run_dir / "crash_state"
+
+    srv = EnhanceServer(max_sessions=4, state_dir=state_dir, park_ttl_s=60.0)
+    addr = srv.start()
+    cl = ServeClient(addr, reattach_retries=0)
+    cl.open(_config(F), session_id="crashee")
+    outs = {}
+    for i in range(half):
+        lo, hi = i * BLOCK, (i + 1) * BLOCK
+        cl.send_block(Y[..., lo:hi], m[..., lo:hi], m[..., lo:hi])
+        outs[i] = cl.recv_enhanced(i, timeout_s=60)
+    cl.shutdown()           # deliberate disconnect: the session PARKS
+    ckpt = state_dir / "session_crashee.state.msgpack"
+    deadline = time.monotonic() + 30.0
+    while not ckpt.is_file() and time.monotonic() < deadline:
+        time.sleep(0.01)    # the park checkpoint lands on the next tick
+    if not ckpt.is_file():
+        failures.append(f"seed {seed}: park checkpoint never written")
+    # now the server dies mid-tick, like a process death: arm the seam and
+    # WAIT for the dispatch loop to hit it (calling stop() here would win
+    # the race — the drain path exits after a single tick)
+    chaos.configure("serve_tick", after=3)
+    crashed = False
+    try:
+        deadline = time.monotonic() + 30.0
+        while srv.crashed is None and time.monotonic() < deadline:
+            time.sleep(0.005)
+        try:
+            srv.wait(timeout_s=30)
+        except chaos.ChaosCrash:
+            crashed = True
+    finally:
+        chaos.disable()
+    if not crashed:
+        failures.append(f"seed {seed}: chaos serve_tick crash never fired")
+    from disco_tpu.serve.session import probe_session_state
+
+    if not probe_session_state(ckpt):
+        failures.append(f"seed {seed}: park checkpoint torn by the crash")
+
+    # a FRESH server: the resume token reattaches through the checkpoint
+    srv2 = EnhanceServer(max_sessions=4, state_dir=state_dir)
+    addr2 = srv2.start()
+    try:
+        cl2 = ServeClient(addr2)
+        cl2.open(_config(F), resume="crashee")
+        if cl2.blocks_done != half:
+            failures.append(
+                f"seed {seed}: crash-resume started at {cl2.blocks_done}, "
+                f"expected {half}")
+        rest = cl2.enhance_clip(Y, m, m)
+        cl2.close()
+        cl2.shutdown()
+    finally:
+        srv2.stop(timeout_s=120)
+    full = np.concatenate(
+        [np.concatenate([outs[i] for i in range(half)], axis=-1), rest],
+        axis=-1)
+    if not np.array_equal(full, ref):
+        failures.append(
+            f"seed {seed}: crash-resume stitch is not bit-exact "
+            f"(max abs diff {np.abs(full - ref).max():g})")
+    return {"blocks_before_park": half, "blocks_total": n_blocks,
+            "crash_injected": crashed}
+
+
+def main(argv=None) -> int:
+    """Run the chaos-soak gate (``make soak-check``); exit 1 on failure.
+
+    No reference counterpart (module docstring)."""
+    import os
+
+    os.environ.setdefault("DISCO_TPU_COMPILE_CACHE", "off")
+    failures: list[str] = []
+    summaries = []
+    with tempfile.TemporaryDirectory() as tmp:
+        tmp = Path(tmp)
+        for seed in SEEDS:
+            summaries.append(run_soak(seed, tmp / "a", failures))
+        # the byte-stability invariant, asserted literally: rerun the first
+        # seed in a fresh directory and compare summaries byte for byte
+        rerun = run_soak(SEEDS[0], tmp / "b", failures)
+        first = json.dumps(summaries[0], sort_keys=True).encode()
+        again = json.dumps(rerun, sort_keys=True).encode()
+        if first != again:
+            failures.append(
+                f"seed {SEEDS[0]}: event summary is not byte-stable across "
+                f"runs:\n  {first.decode()}\n  {again.decode()}"
+            )
+
+    if failures:
+        for f in failures:
+            print(f"soak-check FAIL: {f}", file=sys.stderr)
+        return 1
+    print(json.dumps({
+        "soak_check": "ok",
+        "schedules": len(SEEDS),
+        "connection_faults": sum(s["connection_faults"] for s in summaries),
+        "transport_faults": sum(s["transport_faults_injected"] for s in summaries),
+        "all_parks_reattached": all(s["all_parks_reattached"] for s in summaries),
+        "quarantines": sum(s["quarantines"] for s in summaries),
+        "crash_legs": sum(1 for s in summaries if "crash_leg" in s),
+        "byte_stable_seeds": 1,
+        "recovery_tick_bound": RECOVERY_TICK_BOUND,
+        "jax_processes": 1,
+        "sigkills_issued": 0,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -26,7 +26,13 @@ No reference counterpart: the reference repo has no online story at all
 here.
 """
 from disco_tpu.serve.client import ServeClient, ServeError
-from disco_tpu.serve.scheduler import AdmissionError, QueueFull, Scheduler
+from disco_tpu.serve.ladder import RUNGS, DegradationLadder
+from disco_tpu.serve.scheduler import (
+    AdmissionError,
+    QueueFull,
+    Scheduler,
+    set_dispatch_fault_injector,
+)
 from disco_tpu.serve.server import EnhanceServer
 from disco_tpu.serve.session import (
     Session,
@@ -39,8 +45,10 @@ from disco_tpu.serve.session import (
 
 __all__ = [
     "AdmissionError",
+    "DegradationLadder",
     "EnhanceServer",
     "QueueFull",
+    "RUNGS",
     "Scheduler",
     "ServeClient",
     "ServeError",
@@ -50,4 +58,5 @@ __all__ = [
     "load_session_state",
     "probe_session_state",
     "save_session_state",
+    "set_dispatch_fault_injector",
 ]

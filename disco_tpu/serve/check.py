@@ -30,6 +30,16 @@ clients are numpy-only threads) and asserts the serve acceptance contract:
    drops at this load, every rotated shard must pass its integrity probe,
    and no session may be evicted or backpressured because of the tap.
 
+5. **Overload drill**: sustained flooding past the per-tick block budget
+   plus admission attempts past capacity — the server never crashes or
+   wedges, over-capacity opens get clean ``capacity`` error frames, the
+   degradation ladder steps DOWN deterministically (strictly stepwise ±1
+   transitions, ``degraded`` obs events) while queue-wait p95 is hot, no
+   parity client is ever shed (``max_rung=2`` for the drill), every
+   flooded session still finishes **bit-exact**, and once the load drops
+   to a trickle the ladder recovers to rung 0 (``recovery`` events) with
+   queue-wait p95 back under the threshold.
+
 All crashes are simulated in-process; nothing is ever SIGKILLed
 (environment contract).  Wired into ``make test`` alongside ``obs-check``,
 ``fault-check``, ``chaos-check`` and ``perf-check``.
@@ -296,6 +306,128 @@ def _check_chaos(failures: list, state_dir: Path,
     return {"crashes_injected": n_crashes, "blocks_before_crash": len(received)}
 
 
+def _check_overload(failures: list) -> dict:
+    """Experiment 5: the overload drill (module docstring)."""
+    import time
+
+    import numpy as np
+
+    from disco_tpu.serve import (
+        DegradationLadder,
+        EnhanceServer,
+        ServeClient,
+        ServeError,
+    )
+
+    scenes = [_scene(60 + i, L=16000) for i in range(4)]
+    refs = [_offline(Y, m) for (Y, m) in scenes]
+    F = scenes[0][0].shape[-2]
+    ladder = DegradationLadder(p95_high_ms=4.0, p95_low_ms=2.5,
+                               recover_ticks=10, max_rung=2)
+    # a deliberately starved tick budget: 4 clients × an 8-block window
+    # against 8 blocks/tick keeps real backlog in the queues, so queue-wait
+    # p95 goes hot and the ladder must answer
+    srv = EnhanceServer(max_sessions=4, max_queue_blocks=8,
+                        max_blocks_per_tick=8, blocks_per_super_tick=2,
+                        tick_interval_s=0.001, ladder=ladder)
+    addr = srv.start()
+    results = [None] * len(scenes)
+    errors: list = []
+
+    def worker(i):
+        Y, m = scenes[i]
+        try:
+            cl = ServeClient(addr)
+            cl.open(_config(F))
+            results[i] = cl.enhance_clip(Y, m, m, window=8)
+            cl.close()
+            cl.shutdown()
+        except Exception as e:
+            errors.append(f"overload client {i}: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(scenes))]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)   # let the flood establish itself
+    # sustained admission past capacity: every extra open gets a clean
+    # 'capacity' error frame, never a crash or a hang
+    rejects = 0
+    for _ in range(3):
+        extra = ServeClient(addr)
+        try:
+            extra.open(_config(F))
+            extra.close()
+        except ServeError as e:
+            if e.code == "capacity":
+                rejects += 1
+        finally:
+            extra.shutdown()
+    for t in threads:
+        t.join(timeout=300)
+    failures.extend(errors)
+    peak_rung = max((to for (_t, _f, to, _r) in ladder.transitions),
+                    default=0)
+    if peak_rung < 1:
+        failures.append(
+            "overload: the ladder never degraded under a flooded tick "
+            "budget (queue-wait p95 never went hot?)")
+    if rejects < 1:
+        failures.append(
+            "overload: no admission attempt was rejected past capacity")
+    for (tick, frm, to, _r) in ladder.transitions:
+        if abs(to - frm) != 1:
+            failures.append(
+                f"overload: non-stepwise ladder transition {frm}->{to} "
+                f"at tick {tick}")
+    for i, ref in enumerate(refs):
+        if results[i] is None:
+            failures.append(f"overload: session {i} returned nothing")
+        elif not np.array_equal(results[i], ref):
+            failures.append(
+                f"overload: session {i} output not bit-exact under the "
+                f"degraded ladder (max abs diff "
+                f"{np.abs(results[i] - ref).max():g})")
+
+    # phase 2: the load drops to a trickle — the ladder must walk back to
+    # rung 0 (recovery events) once the hot samples age out of the window
+    Y, m = scenes[0]
+    T = Y.shape[-1]
+    cl = ServeClient(addr)
+    cl.open(_config(F))
+    deadline = time.monotonic() + 60.0
+    i = 0
+    n_blocks = -(-T // BLOCK)
+    while ladder.rung > 0 and time.monotonic() < deadline:
+        lo, hi = i * BLOCK, min((i + 1) * BLOCK, T)
+        cl.send_block(Y[..., lo:hi], m[..., lo:hi], m[..., lo:hi])
+        cl.recv_enhanced(i, timeout_s=60)
+        i += 1
+        if i >= n_blocks:
+            break
+        time.sleep(0.02)
+    cl.close()
+    cl.shutdown()
+    srv.stop(timeout_s=120)   # never crashes, never wedges
+    if ladder.rung != 0:
+        failures.append(
+            f"overload: ladder stuck at rung {ladder.rung} after the load "
+            f"dropped (no recovery)")
+    downs = sum(1 for (_t, frm, to, _r) in ladder.transitions if to < frm)
+    if not downs:
+        failures.append("overload: no recovery transitions recorded")
+    from disco_tpu.obs.metrics import REGISTRY
+
+    p95_after = REGISTRY.gauge("queue_wait_p95_ms").value or 0.0
+    if p95_after > ladder.p95_high_ms:
+        failures.append(
+            f"overload: queue-wait p95 still {p95_after:.1f}ms after the "
+            f"load dropped (> {ladder.p95_high_ms}ms)")
+    return {"peak_rung": peak_rung, "capacity_rejects": rejects,
+            "transitions": len(ladder.transitions),
+            "recoveries": downs, "p95_after_ms": round(p95_after, 2)}
+
+
 def main(argv=None) -> int:
     """Run the online-serving gate (``make serve-check``); exit 1 on failure."""
     import os
@@ -350,6 +482,7 @@ def main(argv=None) -> int:
             st_chaos = _check_chaos(failures, tmp / "st_chaos_state",
                                     server_kw=st_kw)
             chaos_stats["crashes_injected"] += st_chaos["crashes_injected"]
+            overload = _check_overload(failures)
             obs.record("counters", **obs.REGISTRY.snapshot())
         events = obs.read_events(obs_log)  # schema-validating read
 
@@ -366,6 +499,16 @@ def main(argv=None) -> int:
             failures.append(
                 f"event log carries {len(chaos_events)} chaos_crash events, "
                 f"expected {chaos_stats['crashes_injected']}"
+            )
+        ladder_down = [e for e in events if e["kind"] == "degraded"
+                       and e["attrs"].get("controller") == "ladder"]
+        ladder_up = [e for e in events if e["kind"] == "recovery"
+                     and e["attrs"].get("controller") == "ladder"]
+        if not ladder_down or not ladder_up:
+            failures.append(
+                f"event log missing ladder degraded/recovery events "
+                f"({len(ladder_down)} down, {len(ladder_up)} up) — "
+                "disco-obs report would show no overload story"
             )
         snap = obs.REGISTRY.snapshot()
         lat = snap["histograms"].get("serve_block_latency_ms") or {}
@@ -387,6 +530,9 @@ def main(argv=None) -> int:
         "tap_shards": tap_stats["shards_written"],
         "drain_blocks": drain["blocks_before_drain"],
         "crashes_injected": chaos_stats["crashes_injected"],
+        "overload_peak_rung": overload["peak_rung"],
+        "overload_capacity_rejects": overload["capacity_rejects"],
+        "overload_recoveries": overload["recoveries"],
         "jax_processes": 1,   # by construction: clients are numpy threads
         "sigkills_issued": 0,
     }))

@@ -13,9 +13,14 @@ server; ``tests/test_serve.py`` pins the import graph).
 
 Frame types (client → server):
 
-* ``open``    — start (or resume) a session; carries the
-  :class:`~disco_tpu.serve.session.SessionConfig` fields and an optional
-  ``z_mask`` / ``resume`` session id.
+* ``open``    — start (or resume/reattach) a session; carries the
+  :class:`~disco_tpu.serve.session.SessionConfig` fields and optionally:
+  ``z_mask``; ``resume`` (the resume token — a parked session reattaches
+  in place, otherwise the server falls back to its checkpoint); ``have``
+  (the next output seq the client still needs — the server replays the
+  parked session's missed deliveries from its bounded replay buffer, so
+  nothing is lost or duplicated); ``priority`` (ladder shedding spares
+  priority sessions).
 * ``block``   — one streaming input block: ``seq`` (0-based block index),
   ``Y`` (K, C, F, T) complex64 mixture STFT frames, ``mask_z`` / ``mask_w``
   (K, F, T) step-1/2 masks.
@@ -24,7 +29,10 @@ Frame types (client → server):
 Server → client:
 
 * ``open_ok``  — session admitted: ``session`` id, ``blocks_done`` (>0 when
-  resumed from a checkpoint).
+  resumed from a checkpoint), ``next_seq`` (the next INPUT seq the server
+  expects — after a reattach the client re-sends from here, the same
+  rollback that serves backpressure), ``reattached`` (true when a parked
+  session was stitched in place).
 * ``enhanced`` — one enhanced output block: ``seq``, ``yf`` (K, F, T)
   complex64 — the streaming TANGO outputs for the matching input block.
 * ``draining`` — the server received a graceful stop: the session's queued
@@ -33,7 +41,11 @@ Server → client:
 * ``closed``   — session over: ``blocks_done``, optional ``state_path`` of
   the checkpoint a resumed session can continue from.
 * ``error``    — admission rejection, eviction, protocol violation;
-  ``code`` + human-readable ``message``.
+  ``code`` + human-readable ``message``.  Code ``parked`` is special: the
+  session was parked (connection trouble or ladder shedding), and the
+  frame carries ``resume`` (the token to reattach with) and
+  ``retry_after_s`` (a back-off hint for shed sessions) —
+  :class:`~disco_tpu.serve.client.ServeClient` reattaches transparently.
 
 No reference counterpart: the reference pipeline is strictly offline
 (SURVEY.md §2) — this protocol is the seam that turns it into a service.

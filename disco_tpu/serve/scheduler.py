@@ -41,9 +41,37 @@ Admission control is first-class: a bounded session count
 eviction hooks (``session_evicted``).  Telemetry: ``sessions_active`` /
 ``queue_depth`` / ``batch_occupancy`` gauges and the
 ``serve_block_latency_ms`` histogram, all rendered by ``disco-obs report``.
+
+The serving survival layer (the third leg after PR 2's z-exchange fault
+tolerance and PR 3's crash safety) lives at this tick loop's seams:
+
+* **transport-aware dispatch** — every per-session dispatch and the tick's
+  batched readback go through ``utils.resilience.call_with_retries``
+  (``TRANSPORT_ERRORS`` only, seeded-jitter backoff): a transient tunnel
+  RPC error retries in place instead of evicting an innocent session; a
+  non-transport error keeps today's evict-with-clean-error-frame shape;
+  an *exhausted* transport budget re-queues the undispatched blocks (the
+  carry never advanced — a later retry is bit-identical) and moves the
+  session to **quarantine** (``QUARANTINED``: skipped by the tick loop for
+  ``quarantine_ticks``, re-opened after; repeat offenders are evicted).
+* **dispatch deadline** — a host-only ``DispatchDeadline`` watchdog bounds
+  each tick's dispatch+readback wall time; on expiry the tick is marked
+  suspect, the device is fenced via ``preflight_probe`` (a sick attachment
+  unwinds cleanly — never SIGKILL), and the deadline hit feeds the ladder.
+* **session parking** — a dropped connection parks the session (bounded
+  TTL, ``sessions_parked`` gauge, checkpointed through the atomic
+  ``save_session_state`` path on the next tick) instead of evicting;
+  delivered outputs land in a bounded per-session **replay buffer** so a
+  reattaching client stitches the stream bit-exact with zero lost or
+  duplicated frames.
+* **degradation ladder** — :class:`~disco_tpu.serve.ladder.
+  DegradationLadder` steps through declared rungs (per-block dispatch →
+  tap off → shed-to-park) from queue-wait p95 and deadline hits, fully
+  deterministic given the metric trace.
 """
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 
@@ -56,8 +84,11 @@ from disco_tpu.serve.session import (
     DRAINING,
     EVICTED,
     OPEN,
+    PARKED,
+    QUARANTINED,
     Session,
     SessionConfig,
+    SessionStateError,
     load_session_state,
 )
 
@@ -82,6 +113,23 @@ class AdmissionError(RuntimeError):
 
 class QueueFull(RuntimeError):
     """Per-session input queue bound hit — backpressure, not a crash."""
+
+
+#: The fakeable dispatch seam of the soak gate: when set, called as
+#: ``injector(session_id, seqs)`` at the top of every dispatch attempt
+#: (INSIDE the retry wrapper, so each retry re-consults it) and may raise a
+#: transport error — which is how ``disco_tpu/runs/soak.py`` and the
+#: regression tests exercise the retry/quarantine machinery on CPU without
+#: a flaky tunnel.  Never set in production.
+_DISPATCH_FAULT_INJECTOR = None
+
+
+def set_dispatch_fault_injector(fn) -> None:
+    """Install (or clear, with ``None``) the dispatch fault injector above.
+
+    No reference counterpart: a pure test/soak seam (module docstring)."""
+    global _DISPATCH_FAULT_INJECTOR
+    _DISPATCH_FAULT_INJECTOR = fn
 
 
 _STEPS: dict = {}
@@ -150,7 +198,18 @@ class Scheduler:
                  max_blocks_per_tick: int = DEFAULT_MAX_BLOCKS_PER_TICK,
                  blocks_per_super_tick: int = 1,
                  overlap_readback: bool | None = None,
-                 fault_spec=None, tap=None):
+                 fault_spec=None, tap=None,
+                 dispatch_retries: int = 2,
+                 dispatch_retry_base_s: float = 0.05,
+                 retry_seed: int = 0,
+                 tick_deadline_s: float | None = None,
+                 park_ttl_s: float = 60.0,
+                 replay_blocks: int = 64,
+                 quarantine_ticks: int = 20,
+                 max_quarantines: int = 2,
+                 shed_retry_after_s: float = 1.0,
+                 wait_window_ticks: int = 50,
+                 ladder=None, state_dir=None):
         if max_sessions < 1 or max_queue_blocks < 1 or max_blocks_per_tick < 1:
             raise ValueError("scheduler bounds must be >= 1")
         if blocks_per_super_tick < 1:
@@ -190,12 +249,59 @@ class Scheduler:
         #: and never raises — overflow drops-and-counts inside the tap —
         #: so serving cannot backpressure or crash on its own telemetry.
         self.tap = tap
+        if dispatch_retries < 0 or park_ttl_s <= 0 or replay_blocks < 1:
+            raise ValueError("survival knobs out of range (dispatch_retries "
+                             ">= 0, park_ttl_s > 0, replay_blocks >= 1)")
+        if quarantine_ticks < 1 or max_quarantines < 0 or wait_window_ticks < 1:
+            raise ValueError("quarantine/window knobs out of range")
+        #: transport-retry budget per dispatch/readback call (retries past
+        #: the first attempt; exhausted transport budget = quarantine)
+        self.dispatch_retries = dispatch_retries
+        self.dispatch_retry_base_s = dispatch_retry_base_s
+        #: base seed of the per-dispatch jittered backoff draws (each call
+        #: derives seed + dispatch counter — deterministic, desynchronized)
+        self.retry_seed = retry_seed
+        #: per-tick wall deadline for dispatch+readback (None = watchdog
+        #: off); on expiry the tick is marked suspect, the device is fenced
+        #: via preflight_probe and the hit feeds the degradation ladder
+        self.tick_deadline_s = tick_deadline_s
+        #: how long a parked session waits for its client to reattach
+        #: before the slot is reclaimed (EVICTED, ``park_expired`` counter)
+        self.park_ttl_s = park_ttl_s
+        #: per-session replay-buffer depth (bit-exact reattach window)
+        self.replay_blocks = replay_blocks
+        self.quarantine_ticks = quarantine_ticks
+        self.max_quarantines = max_quarantines
+        #: reattach back-off hint carried in the shed/park error frame
+        self.shed_retry_after_s = shed_retry_after_s
+        #: queue-wait samples older than this many ticks age out of the
+        #: ladder's p95 window (recovery after load drops)
+        self.wait_window_ticks = wait_window_ticks
+        #: optional DegradationLadder (serve/ladder.py); None = ladder off
+        self.ladder = ladder
+        #: park-checkpoint directory (the server's --state-dir); parked
+        #: sessions are checkpointed here on the next tick so a reattach
+        #: survives even a server death in between
+        self.state_dir = state_dir
         self.draining = False
         self._lock = threading.Lock()
         self._sessions: dict[str, Session] = {}
+        self._parked: dict[str, Session] = {}
         self._session_seq = 0
         self._rotate = 0
         self.ticks_with_work = 0
+        #: monotonically increasing tick number (quarantine release + the
+        #: ladder's deterministic clock)
+        self.tick_no = 0
+        self._dispatch_seq = 0
+        self._to_checkpoint: list = []
+        #: (session, reason, retry_after_s) park notices the server posts
+        #: as ``parked`` error frames (shed happens on the dispatch thread,
+        #: frames go out on the I/O thread)
+        self._park_notices: list = []
+        #: (tick_no, wait_ms) samples feeding the ladder's p95 window
+        self._wait_samples: list = []
+        self._tap_suspended = False
         #: dispatched-but-not-read-back units from the previous tick
         #: (overlap_readback):
         #: [(session, [seq, ...], yf_device, t_dispatch, raw_blocks)] where
@@ -213,8 +319,13 @@ class Scheduler:
             return self._sessions.get(session_id)
 
     def open_session(self, config, *, session_id: str | None = None,
-                     z_mask=None, resume_from=None) -> Session:
+                     z_mask=None, resume_from=None,
+                     priority: bool = False) -> Session:
         """Admit one session (or resume a checkpointed one).
+
+        Parked sessions count toward ``max_sessions`` — a park holds its
+        slot for the TTL (so a reattach can never be rejected for
+        capacity), and the TTL bounds how long an absent client can do so.
 
         Raises :class:`AdmissionError` on capacity / draining / config
         problems — the server turns those into clean ``error`` frames.
@@ -230,7 +341,7 @@ class Scheduler:
                 raise AdmissionError("bad_config", str(e)) from None
 
         with self._lock:
-            if len(self._sessions) >= self.max_sessions:
+            if len(self._sessions) + len(self._parked) >= self.max_sessions:
                 obs_registry.counter("admission_reject").inc()
                 raise AdmissionError(
                     "capacity",
@@ -250,6 +361,8 @@ class Scheduler:
                 )
             if session_id is not None:
                 session.id = session_id
+            session.priority = bool(priority)
+            session.replay = type(session.replay)(maxlen=self.replay_blocks)
         else:
             from disco_tpu.enhance.streaming import initial_stream_state
 
@@ -258,13 +371,16 @@ class Scheduler:
             session = Session(
                 sid, config,
                 z_avail=z_avail,
+                priority=priority,
+                replay_blocks=self.replay_blocks,
                 state=initial_stream_state(
                     config.n_nodes, config.mics_per_node, config.n_freq,
                     update_every=config.update_every, ref_mic=config.ref_mic,
                 ),
             )
+        session.open_seq = seq
         with self._lock:
-            if session.id in self._sessions:
+            if session.id in self._sessions or session.id in self._parked:
                 obs_registry.counter("admission_reject").inc()
                 raise AdmissionError(
                     "duplicate", f"session id {session.id!r} already live"
@@ -349,16 +465,203 @@ class Scheduler:
 
     def evict(self, session: Session, reason: str) -> None:
         """Drop a session that is not keeping up (unread output backlog,
-        dead connection).  The server sends the clean ``error`` frame; this
-        records the decision and frees the slot."""
+        exhausted quarantine budget).  The server sends the clean ``error``
+        frame; this records the decision and frees the slot."""
         with self._lock:
             self._sessions.pop(session.id, None)
+            self._parked.pop(session.id, None)
         session.status = EVICTED
         session.error = reason
         obs_registry.counter("session_evicted").inc()
         obs_events.record("session", stage="serve", action="evict",
                           session=session.id, reason=reason)
         self._set_gauges()
+
+    # -- parking / reattach (I/O + dispatch threads) -------------------------
+    def parked_sessions(self) -> list:
+        """Snapshot of the parked registry (drain checkpoints these too).
+
+        No reference counterpart (module docstring)."""
+        with self._lock:
+            return list(self._parked.values())
+
+    def park(self, session: Session, reason: str, *, notice: bool = False,
+             retry_after_s: float = 0.0) -> bool:
+        """Park a live session instead of evicting it: keep carry + queue +
+        replay buffer, hold the admission slot, and wait ``park_ttl_s`` for
+        the client to reattach.  ``notice=True`` queues a ``parked`` error
+        frame (resume token + back-off hint) for the server to post — the
+        shed path, where the connection is still up.  Returns False when
+        the session already left the live registry (close/evict race).
+
+        Called from the I/O thread (connection drop, protocol truncation)
+        and the dispatch thread (ladder shedding); the checkpoint itself is
+        deferred to the next tick, the only place jax may be entered.
+
+        No reference counterpart (module docstring)."""
+        with self._lock:
+            live = self._sessions.pop(session.id, None)
+            if live is None:
+                return False
+            self._parked[session.id] = session
+            session.status = PARKED
+            session.parked_at = time.monotonic()
+            session.outage_tick = self.tick_no
+            self._to_checkpoint.append(session)
+            if notice:
+                self._park_notices.append((session, reason, retry_after_s))
+        obs_registry.counter("sessions_parked_total").inc()
+        obs_events.record("session", stage="serve", action="park",
+                          session=session.id, reason=reason,
+                          blocks_done=session.blocks_done)
+        self._set_gauges()
+        return True
+
+    def reattach(self, session_id: str, config, have: int | None):
+        """Reattach a parked session in place (I/O thread): validate the
+        config and the replay coverage, move the session back to the live
+        registry, and return ``(session, resume_seq)`` — the output seq the
+        server's posting cursor restarts from (the actual frame re-sends
+        happen on the dispatch loop, the ONE thread that ever posts
+        ``enhanced`` frames, so replay can never race an in-flight
+        delivery into a duplicate or a loss).  ``have`` is the next output
+        seq the client still needs; ``None`` means a FRESH client resuming
+        with just the token (plain ``open(resume=...)``) — it gets resume
+        semantics, ``blocks_done``, nothing replayed.  Returns ``None``
+        when ``session_id`` is not parked here (the server then falls back
+        to the checkpoint-resume path).
+
+        No reference counterpart (module docstring)."""
+        with self._lock:
+            session = self._parked.get(session_id)
+        if session is None:
+            return None
+        if config is not None and not isinstance(config, SessionConfig):
+            try:
+                config = SessionConfig.from_dict(config)
+            except ValueError as e:
+                obs_registry.counter("admission_reject").inc()
+                raise AdmissionError("bad_config", str(e)) from None
+        if config is not None and session.config != config:
+            obs_registry.counter("admission_reject").inc()
+            raise AdmissionError(
+                "config_mismatch",
+                f"session {session_id} was parked with a different config; "
+                "reattach with the original one",
+            )
+        if have is None:
+            resume_seq = session.blocks_done
+        else:
+            resume_seq = int(have)
+            try:
+                session.replay_from(resume_seq)   # coverage validation only
+            except SessionStateError as e:
+                obs_registry.counter("admission_reject").inc()
+                raise AdmissionError("resume_gap", str(e)) from None
+        with self._lock:
+            if self._parked.pop(session_id, None) is None:
+                return None   # TTL expiry raced us; treat as not parked
+            self._sessions[session_id] = session
+            session.status = OPEN
+            session.parked_at = None
+            session.outage_tick = self.tick_no
+        obs_registry.counter("session_reattached").inc()
+        obs_events.record("session", stage="serve", action="reattach",
+                          session=session.id, resume_seq=resume_seq,
+                          blocks_done=session.blocks_done)
+        self._set_gauges()
+        return session, resume_seq
+
+    def drain_park_notices(self) -> list:
+        """Take the pending ``parked`` notices (dispatch loop → server,
+        which posts the error frames on the I/O thread).
+
+        No reference counterpart (module docstring)."""
+        with self._lock:
+            notices, self._park_notices = self._park_notices, []
+        return notices
+
+    def _expire_parks(self) -> None:
+        """Reclaim parked slots whose TTL ran out (dispatch thread)."""
+        now = time.monotonic()
+        with self._lock:
+            expired = [s for s in self._parked.values()
+                       if s.parked_at is not None
+                       and now - s.parked_at > self.park_ttl_s]
+            for s in expired:
+                self._parked.pop(s.id, None)
+        for s in expired:
+            s.status = EVICTED
+            s.error = f"parked session expired after {self.park_ttl_s:g}s TTL"
+            obs_registry.counter("park_expired").inc()
+            obs_events.record("session", stage="serve", action="park_expire",
+                              session=s.id, blocks_done=s.blocks_done)
+        if expired:
+            self._set_gauges()
+
+    def _checkpoint_parked(self) -> None:
+        """Checkpoint freshly parked sessions (dispatch thread — the one
+        place the device carry may be read back).  An IO failure demotes to
+        a ``warning`` event: the in-memory park still works, only the
+        crash-survival copy is missing.  A ChaosCrash (BaseException) from
+        the mid_write seam still unwinds like a process death."""
+        with self._lock:
+            batch, self._to_checkpoint = self._to_checkpoint, []
+        if self.state_dir is None or not batch:
+            return
+        from pathlib import Path
+
+        from disco_tpu.serve.session import save_session_state
+
+        state_dir = Path(self.state_dir)
+        state_dir.mkdir(parents=True, exist_ok=True)
+        for s in batch:
+            if s.status != PARKED:
+                continue   # reattached (or expired) before we got here
+            try:
+                save_session_state(
+                    state_dir / f"session_{s.id}.state.msgpack", s)
+            except Exception as e:
+                obs_events.record(
+                    "warning", stage="serve",
+                    reason=f"park checkpoint failed for {s.id}: "
+                           f"{type(e).__name__}: {e}",
+                )
+
+    # -- quarantine (dispatch thread) ----------------------------------------
+    def _quarantine(self, session: Session, error: BaseException) -> None:
+        """Transport budget exhausted for one session: cool it off instead
+        of letting it poison every tick with a fresh retry storm.  The
+        ``max_quarantines``-th offense evicts."""
+        session.quarantine_count += 1
+        if session.quarantine_count > self.max_quarantines:
+            self.evict(
+                session,
+                f"transport failures exhausted the quarantine budget "
+                f"({self.max_quarantines}): {type(error).__name__}: {error}",
+            )
+            return
+        session.status = QUARANTINED
+        session.quarantine_until_tick = self.tick_no + self.quarantine_ticks
+        session.outage_tick = self.tick_no
+        obs_registry.counter("session_quarantined").inc()
+        obs_events.record(
+            "session", stage="serve", action="quarantine",
+            session=session.id, strike=session.quarantine_count,
+            until_tick=session.quarantine_until_tick,
+            error=f"{type(error).__name__}: {error}",
+        )
+        self._set_gauges()
+
+    def _release_quarantined(self) -> None:
+        """Re-open quarantined sessions whose cool-off elapsed."""
+        for s in self.sessions():
+            if s.status == QUARANTINED and self.tick_no >= s.quarantine_until_tick:
+                s.status = OPEN
+                s.outage_tick = self.tick_no
+                obs_events.record("session", stage="serve",
+                                  action="unquarantine", session=s.id)
+                self._set_gauges()
 
     def _finish(self, session: Session) -> None:
         with self._lock:
@@ -385,8 +688,13 @@ class Scheduler:
         while the host reads super-tick T.
         """
         from disco_tpu.runs import chaos
+        from disco_tpu.utils.resilience import DispatchDeadline, TRANSPORT_ERRORS
 
         chaos.tick("serve_tick")
+        self.tick_no += 1
+        self._release_quarantined()
+        self._expire_parks()
+        self._checkpoint_parked()
         sessions = self.sessions()
         if sessions:
             # rotate the starting session each tick: under sustained overload
@@ -396,77 +704,83 @@ class Scheduler:
             self._rotate += 1
             sessions = sessions[k:] + sessions[:k]
         units: list = []  # (session, [seq, ...], yf_device, t_dispatch, raw)
-        keep_raw = self.tap is not None
+        keep_raw = self.tap is not None and not self._tap_suspended
         budget = self.max_blocks_per_tick
-        n_super = self.blocks_per_super_tick
+        # ladder rung >= 1: fall back to the per-block path (the program
+        # every shape bucket already has — no new trace)
+        n_super = (1 if self.ladder is not None and self.ladder.rung >= 1
+                   else self.blocks_per_super_tick)
         n_busy = 0
         t0 = time.perf_counter()
-        for session in sessions:
-            if session.status not in (OPEN, DRAINING) or budget <= 0:
-                continue
-            if n_super > 1:
-                # align the pop to a multiple of N: a deeper-than-budget
-                # queue must never shed a sub-N remainder through per-block
-                # dispatches every tick just because max_blocks_per_tick
-                # isn't a multiple of N — blocks left queued join the next
-                # tick's scan group instead.  A sub-N *queue* (stream tail /
-                # starved input) still pops in full below and rides the
-                # per-block fallback.  When the budget remainder is < N
-                # (later sessions of a crowded tick), skip — the per-tick
-                # rotation hands this session a full-width slot next tick.
-                cap = budget // n_super * n_super
-                if cap == 0:
+        deadline = (DispatchDeadline(self.tick_deadline_s, label="serve_tick")
+                    if self.tick_deadline_s else contextlib.nullcontext())
+        with deadline:
+            for session in sessions:
+                if session.status not in (OPEN, DRAINING) or budget <= 0:
                     continue
-            else:
-                cap = budget
-            blocks = session.pop_blocks(cap)
-            if not blocks:
-                continue
-            n_busy += 1
-            budget -= len(blocks)
-            bf = session.config.block_frames
-            try:
-                # every run of N consecutive full blocks rides one scanned
-                # dispatch; the sub-N remainder (or a group holding the
-                # ragged final block — always the stream's last) goes
-                # per-block, so a deep queue amortizes at the same 1-fence-
-                # per-N rate as an exactly-N one (the scanned program only
-                # ever sees N full refresh-aligned blocks).
-                for g in range(0, len(blocks), n_super):
-                    group = blocks[g:g + n_super]
-                    if (n_super > 1 and len(group) == n_super
-                            and all(b[1].shape[-1] == bf for b in group)):
-                        yf = self._dispatch_scan(session, group)
-                        units.append(
-                            (session, [b[0] for b in group], yf, time.time(),
-                             group if keep_raw else None)
-                        )
-                        session.inflight += len(group)
-                    else:
-                        for seq, Y, mz, mw in group:
-                            yf = self._dispatch(session, seq, Y, mz, mw)
-                            units.append(
-                                (session, [seq], yf, time.time(),
-                                 [(seq, Y, mz, mw)] if keep_raw else None)
-                            )
-                            session.inflight += 1
-            except Exception as e:
-                # per-session isolation: one block the device rejects
-                # (validation can't anticipate every jax TypeError) must
-                # not unwind the dispatch thread and kill every other
-                # live session — evict the offender and keep serving.
-                # ChaosCrash is a BaseException and still dies here.
-                self.evict(
-                    session, f"dispatch failed: {type(e).__name__}: {e}"
-                )
+                if n_super > 1:
+                    # align the pop to a multiple of N: a deeper-than-budget
+                    # queue must never shed a sub-N remainder through per-block
+                    # dispatches every tick just because max_blocks_per_tick
+                    # isn't a multiple of N — blocks left queued join the next
+                    # tick's scan group instead.  A sub-N *queue* (stream tail /
+                    # starved input) still pops in full below and rides the
+                    # per-block fallback.  When the budget remainder is < N
+                    # (later sessions of a crowded tick), skip — the per-tick
+                    # rotation hands this session a full-width slot next tick.
+                    cap = budget // n_super * n_super
+                    if cap == 0:
+                        continue
+                else:
+                    cap = budget
+                blocks = session.pop_blocks(cap)
+                if not blocks:
+                    continue
+                n_busy += 1
+                budget -= len(blocks)
+                # progress rides a mutable cell, NOT the return value: when
+                # the dispatch raises mid-pop, the blocks dispatched BEFORE
+                # the failure are already in `units` with the carry advanced
+                # — requeueing them too would re-enhance them through a
+                # double-advanced carry (duplicated, wrong deliveries)
+                progress = [0]
+                try:
+                    self._dispatch_blocks(session, blocks, n_super,
+                                          units, keep_raw, progress)
+                except TRANSPORT_ERRORS as e:
+                    # transport budget exhausted even after the per-call
+                    # retries: the carry never advanced for the blocks past
+                    # `progress`, so they re-queue in order (bit-identical
+                    # later retry) and the session cools off in quarantine
+                    # instead of retrying into a sick tunnel every tick
+                    session.requeue_front(blocks[progress[0]:])
+                    self._quarantine(session, e)
+                except Exception as e:
+                    # per-session isolation: one block the device rejects
+                    # (validation can't anticipate every jax TypeError) must
+                    # not unwind the dispatch thread and kill every other
+                    # live session — a NON-transport error is deterministic,
+                    # so evict the offender and keep serving.
+                    # ChaosCrash is a BaseException and still dies here.
+                    self.evict(
+                        session, f"dispatch failed: {type(e).__name__}: {e}"
+                    )
 
-        if self.overlap_readback:
-            # double buffer: read back the PREVIOUS tick's batch while this
-            # tick's dispatches compute; an idle tick flushes the buffer
-            to_read, self._inflight = self._inflight, units
-        else:
-            to_read = units
-        deliveries = self._readback(to_read) if to_read else []
+            if self.overlap_readback:
+                # double buffer: read back the PREVIOUS tick's batch while this
+                # tick's dispatches compute; an idle tick flushes the buffer
+                to_read, self._inflight = self._inflight, units
+            else:
+                to_read = units
+            deliveries = self._readback(to_read) if to_read else []
+        deadline_hits = 0
+        if isinstance(deadline, DispatchDeadline) and deadline.expired:
+            # the tick is suspect: fence the device through the bounded
+            # preflight probe BEFORE deciding anything — a wedged attachment
+            # must unwind the dispatch thread cleanly (PreflightFailed; the
+            # server catches and drains), never hang silently or be killed
+            deadline_hits = 1
+            self._probe_after_deadline(deadline)
         if to_read:
             obs_registry.histogram("serve_tick_ms").observe(
                 (time.perf_counter() - t0) * 1e3
@@ -479,8 +793,129 @@ class Scheduler:
             if (session.close_requested and session.status in (OPEN, DRAINING)
                     and session.queue_depth() == 0 and session.inflight == 0):
                 self._finish(session)
+        self._step_ladder(deadline_hits)
         self._set_gauges()
         return deliveries
+
+    def _dispatch_blocks(self, session: Session, blocks: list, n_super: int,
+                         units: list, keep_raw: bool,
+                         progress: list | None = None) -> int:
+        """Dispatch one session's popped blocks (scan groups + per-block
+        tail — the grouping comments live in :meth:`tick`); every dispatch
+        goes through the transport-retry wrapper.  ``progress`` (a 1-cell
+        list) is advanced after every successful dispatch so the caller
+        knows exactly which blocks to re-queue when this RAISES mid-pop —
+        a plain return value would read as zero on the exception path and
+        re-enqueue already-dispatched blocks (delivered twice, through a
+        double-advanced carry).  Also returns the final count."""
+        bf = session.config.block_frames
+        if progress is None:
+            progress = [0]
+        done = 0
+        # every run of N consecutive full blocks rides one scanned
+        # dispatch; the sub-N remainder (or a group holding the
+        # ragged final block — always the stream's last) goes
+        # per-block, so a deep queue amortizes at the same 1-fence-
+        # per-N rate as an exactly-N one (the scanned program only
+        # ever sees N full refresh-aligned blocks).
+        for g in range(0, len(blocks), n_super):
+            group = blocks[g:g + n_super]
+            if (n_super > 1 and len(group) == n_super
+                    and all(b[1].shape[-1] == bf for b in group)):
+                yf = self._dispatch_resilient(self._dispatch_scan,
+                                              session, group)
+                units.append(
+                    (session, [b[0] for b in group], yf, time.time(),
+                     group if keep_raw else None)
+                )
+                session.inflight += len(group)
+                done += len(group)
+                progress[0] = done
+            else:
+                for seq, Y, mz, mw in group:
+                    yf = self._dispatch_resilient(self._dispatch,
+                                                  session, seq, Y, mz, mw)
+                    units.append(
+                        (session, [seq], yf, time.time(),
+                         [(seq, Y, mz, mw)] if keep_raw else None)
+                    )
+                    session.inflight += 1
+                    done += 1
+                    progress[0] = done
+        return done
+
+    def _dispatch_resilient(self, fn, session: Session, *args):
+        """One dispatch under the transport-retry contract: transient
+        ``TRANSPORT_ERRORS`` retry with seeded-jitter backoff (each failed
+        attempt is a ``fault`` event, each late success a ``recovery`` —
+        utils/resilience.py), deterministic per (retry_seed, dispatch
+        counter); any other exception raises straight through to the
+        evict path.  The carry only advances on success, so a retried
+        attempt is bit-identical to a first try."""
+        from disco_tpu.utils.resilience import TRANSPORT_ERRORS, call_with_retries
+
+        self._dispatch_seq += 1
+        return call_with_retries(
+            fn, session, *args,
+            retries=self.dispatch_retries,
+            base_delay_s=self.dispatch_retry_base_s,
+            max_delay_s=0.5,
+            retry_on=TRANSPORT_ERRORS,
+            jitter=0.5,
+            jitter_seed=self.retry_seed + self._dispatch_seq,
+            label="serve_dispatch",
+        )
+
+    def _probe_after_deadline(self, deadline) -> None:
+        """A tick blew its wall deadline: fence the device via the bounded
+        preflight probe.  Success means the device answers again (the
+        suspect tick merely finished late — the ladder handles the rest);
+        ``PreflightFailed`` propagates and unwinds the dispatch thread
+        cleanly (never SIGKILL — parked/checkpointed sessions resume on the
+        next server)."""
+        from disco_tpu.utils.resilience import preflight_probe
+
+        probe = preflight_probe(deadline_s=max(self.tick_deadline_s, 5.0),
+                                retries=1)
+        obs_events.record(
+            "warning", stage="serve",
+            reason=f"tick {self.tick_no} exceeded its "
+                   f"{self.tick_deadline_s:g}s dispatch deadline "
+                   f"(finished in {deadline.elapsed_s():.3f}s); device "
+                   f"probe ok in {probe['dur_s']}s",
+        )
+
+    def _step_ladder(self, deadline_hits: int) -> None:
+        """Feed the degradation ladder this tick's metrics and apply the
+        rung's effects (super-tick shrink is read by the next tick; the tap
+        gate and shedding apply here)."""
+        if self.ladder is None:
+            return
+        cutoff = self.tick_no - self.wait_window_ticks
+        self._wait_samples = [s for s in self._wait_samples if s[0] > cutoff]
+        window = [ms for (_t, ms) in self._wait_samples]
+        p95 = float(np.percentile(window, 95)) if window else 0.0
+        obs_registry.gauge("queue_wait_p95_ms").set(p95)
+        rung = self.ladder.observe(queue_wait_p95_ms=p95,
+                                   deadline_hits=deadline_hits,
+                                   tick=self.tick_no)
+        self._tap_suspended = rung >= 2
+        if rung >= 3:
+            self._shed_one()
+
+    def _shed_one(self) -> None:
+        """Shed rung: park the NEWEST non-priority open session (resume
+        token + back-off hint ride the ``parked`` error frame), one per
+        tick while the rung holds — load sheds gradually and reversibly,
+        and every shed client can come back."""
+        candidates = [s for s in self.sessions()
+                      if s.status == OPEN and not s.priority]
+        if not candidates:
+            return
+        victim = max(candidates, key=lambda s: s.open_seq)
+        obs_registry.counter("sessions_shed").inc()
+        self.park(victim, "shed: overload (degradation ladder)",
+                  notice=True, retry_after_s=self.shed_retry_after_s)
 
     def _readback(self, units: list) -> list:
         """ONE batched readback over ``units`` and the per-block delivery
@@ -497,13 +932,30 @@ class Scheduler:
         the total, so the delivery cost of the overlap is charged here, not
         hidden).
         """
+        from disco_tpu.utils.resilience import TRANSPORT_ERRORS, call_with_retries
         from disco_tpu.utils.transfer import device_get_tree
 
         n_blocks = sum(len(seqs) for (_, seqs, _, _, _) in units)
         n_sessions = len({s.id for (s, _, _, _, _) in units})
         with obs_events.stage("serve_tick", n_blocks=n_blocks,
                               n_sessions=n_sessions):
-            host = device_get_tree([yf for (_, _, yf, _, _) in units])
+            # the batched readback is a tunnel crossing like any other:
+            # transient failures retry under the same seeded-jitter budget
+            # as dispatch.  An EXHAUSTED budget raises — the carries already
+            # advanced on device, so there is no bit-exact way to replay
+            # this tick; the server unwinds cleanly and parked/checkpointed
+            # sessions resume on a healthy attachment.
+            self._dispatch_seq += 1
+            host = call_with_retries(
+                device_get_tree, [yf for (_, _, yf, _, _) in units],
+                retries=self.dispatch_retries,
+                base_delay_s=self.dispatch_retry_base_s,
+                max_delay_s=0.5,
+                retry_on=TRANSPORT_ERRORS,
+                jitter=0.5,
+                jitter_seed=self.retry_seed + self._dispatch_seq,
+                label="serve_readback",
+            )
         now = time.time()
         lat_hist = obs_registry.histogram("serve_block_latency_ms")
         wait_hist = obs_registry.histogram("serve_queue_wait_ms")
@@ -517,12 +969,28 @@ class Scheduler:
                 lat_s = (now - t_in) if t_in is not None else 0.0
                 lat_hist.observe(lat_s * 1e3)
                 if t_in is not None:
-                    wait_hist.observe(max(t_disp - t_in, 0.0) * 1e3)
+                    wait_ms = max(t_disp - t_in, 0.0) * 1e3
+                    wait_hist.observe(wait_ms)
+                    if (self.ladder is not None
+                            and self.tick_no - session.outage_tick
+                            > self.wait_window_ticks):
+                        # post-outage backlog flush measures the outage,
+                        # not the load: keep it out of the ladder's p95
+                        # (session.outage_tick docstring has the rationale);
+                        # with no ladder, nothing prunes the window, so
+                        # nothing may feed it either
+                        self._wait_samples.append((self.tick_no, wait_ms))
                 disp_hist.observe(max(now - t_disp, 0.0) * 1e3)
                 session.blocks_done = max(session.blocks_done, seq + 1)
                 session.inflight = max(session.inflight - 1, 0)
+                # the reattach replay buffer: a copy of the delivered block
+                # survives the connection it was meant for (super-tick
+                # slices are copied so a parked stream never pins the whole
+                # N-block readback buffer)
+                session.record_delivery(
+                    seq, blk if len(seqs) == 1 else np.ascontiguousarray(blk))
                 deliveries.append((session, seq, blk, lat_s))
-            if self.tap is not None and raw:
+            if self.tap is not None and not self._tap_suspended and raw:
                 # THE corpus-tap seam: every delivered block's full training
                 # tuple is host-resident right here (inputs were retained at
                 # dispatch, yf just crossed in the one batched readback).
@@ -546,6 +1014,8 @@ class Scheduler:
         readback).  The call goes through the exact offline entry point
         with the session's carry; only ``out["yf"]`` is fetched later, but
         the whole program (z exchange, hold, both steps) runs as offline."""
+        if _DISPATCH_FAULT_INJECTOR is not None:
+            _DISPATCH_FAULT_INJECTOR(session.id, [seq])
         import jax
 
         from disco_tpu.utils.transfer import to_device
@@ -585,6 +1055,8 @@ class Scheduler:
         availability columns (the scan slices them back into exactly the
         per-block chunks), same traced-float discipline — so the result is
         bit-identical to N per-block dispatches (the stream-check gate)."""
+        if _DISPATCH_FAULT_INJECTOR is not None:
+            _DISPATCH_FAULT_INJECTOR(session.id, [b[0] for b in blocks])
         import jax
 
         from disco_tpu.utils.transfer import to_device
@@ -626,8 +1098,13 @@ class Scheduler:
     def _set_gauges(self) -> None:
         with self._lock:
             n = len(self._sessions)
+            n_parked = len(self._parked)
+            n_quar = sum(1 for s in self._sessions.values()
+                         if s.status == QUARANTINED)
             depth = sum(s.queue_depth() for s in self._sessions.values())
         obs_registry.gauge("sessions_active").set(n)
+        obs_registry.gauge("sessions_parked").set(n_parked)
+        obs_registry.gauge("sessions_quarantined").set(n_quar)
         obs_registry.gauge("queue_depth").set(depth)
 
     # -- drain / checkpoint (dispatch thread) --------------------------------
@@ -641,7 +1118,12 @@ class Scheduler:
         from disco_tpu.serve.session import fetch_state_host, save_session_state
 
         state_dir = Path(state_dir)
-        sessions = [s for s in self.sessions() if s.status in (OPEN, DRAINING)]
+        sessions = [s for s in self.sessions()
+                    if s.status in (OPEN, DRAINING, QUARANTINED)]
+        # parked sessions checkpoint too: their client may reattach to the
+        # NEXT server via the resume token, which only works if the carry
+        # survives this one
+        sessions += self.parked_sessions()
         if not sessions:
             return {}
         host_states = fetch_state_host({s.id: s.state for s in sessions})

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import itertools
 import threading
 import time
 
@@ -44,7 +45,7 @@ from disco_tpu.serve.scheduler import (
     QueueFull,
     Scheduler,
 )
-from disco_tpu.serve.session import CLOSED, EVICTED
+from disco_tpu.serve.session import CLOSED, DRAINING, EVICTED, OPEN, PARKED, QUARANTINED
 
 #: Writer-queue bound per connection: a client that stops reading while the
 #: scheduler keeps producing gets evicted (with a clean ``error`` frame)
@@ -56,11 +57,23 @@ class _Conn:
     """Per-connection bookkeeping shared between the I/O and dispatch
     threads (the queue crossing happens via call_soon_threadsafe)."""
 
+    _born = itertools.count()
+
     def __init__(self):
         self.session = None
         self.outq: asyncio.Queue | None = None
         self.notified_draining = False
         self.closed_sent = False
+        #: creation order: after a park+reattach two conns can briefly
+        #: reference one session — deliveries go to the newest live one
+        self.born = next(_Conn._born)
+        #: the posting cursor: next output seq this connection is owed.
+        #: ONLY the dispatch loop advances it, draining the session's
+        #: replay buffer — one poster thread, so a reattach's replay can
+        #: never race an in-flight delivery into a duplicate or a loss.
+        #: None until a session is attached (the I/O thread sets it BEFORE
+        #: ``session``, which is the dispatch loop's gate).
+        self.next_out: int | None = None
 
 
 class EnhanceServer:
@@ -79,15 +92,38 @@ class EnhanceServer:
                  max_backlog: int = DEFAULT_MAX_BACKLOG,
                  tick_interval_s: float = 0.002,
                  state_dir=None, fault_spec=None, tap=None,
+                 park_on_disconnect: bool = True,
+                 park_ttl_s: float = 60.0,
+                 replay_blocks: int = 64,
+                 dispatch_retries: int = 2,
+                 retry_seed: int = 0,
+                 tick_deadline_s: float | None = None,
+                 quarantine_ticks: int = 20,
+                 ladder=None,
                  run_info: dict | None = None):
         self.host, self.port, self.unix_path = host, port, unix_path
+        if ladder is True:
+            from disco_tpu.serve.ladder import DegradationLadder
+
+            ladder = DegradationLadder()
+        elif not ladder:
+            ladder = None   # False/None both mean: no overload controller
         self.scheduler = scheduler or Scheduler(
             max_sessions=max_sessions, max_queue_blocks=max_queue_blocks,
             max_blocks_per_tick=max_blocks_per_tick,
             blocks_per_super_tick=blocks_per_super_tick,
             overlap_readback=overlap_readback, fault_spec=fault_spec,
             tap=tap,
+            park_ttl_s=park_ttl_s, replay_blocks=replay_blocks,
+            dispatch_retries=dispatch_retries, retry_seed=retry_seed,
+            tick_deadline_s=tick_deadline_s,
+            quarantine_ticks=quarantine_ticks,
+            ladder=ladder, state_dir=state_dir,
         )
+        #: connection drops / mid-frame protocol truncations PARK the
+        #: session (resume token, bounded TTL, bit-exact reattach) instead
+        #: of evicting; False restores the old evict-on-drop behavior
+        self.park_on_disconnect = park_on_disconnect
         self.max_backlog = max_backlog
         self.tick_interval_s = tick_interval_s
         self.state_dir = state_dir
@@ -169,8 +205,16 @@ class EnhanceServer:
                 try:
                     frame = await self._read_frame(reader)
                 except protocol.ProtocolError as e:
-                    self._post(conn, {"type": "error", "code": "protocol",
-                                      "message": str(e)})
+                    # a mid-frame truncation must never corrupt the stream:
+                    # the partial block never reached push_block, so parking
+                    # here (resume token in the error frame) lets the client
+                    # reattach and RESEND it — bit-exact, nothing torn
+                    if self._park(conn.session, f"protocol error: {e}"):
+                        self._post(conn, self._parked_frame(
+                            conn.session, f"protocol error: {e}"))
+                    else:
+                        self._post(conn, {"type": "error", "code": "protocol",
+                                          "message": str(e)})
                     break
                 if frame is None:
                     break
@@ -180,8 +224,10 @@ class EnhanceServer:
                     break
         finally:
             if (conn.session is not None
-                    and conn.session.status not in (CLOSED, EVICTED)):
-                # connection died with a live session: free the slot
+                    and conn.session.status not in (CLOSED, EVICTED, PARKED)
+                    and not self._park(conn.session, "connection dropped")):
+                # connection died with a live session and parking is off
+                # (or raced a close): free the slot the old way
                 self.scheduler.evict(conn.session, "connection closed")
             with self._conns_lock:
                 self._conns.discard(conn)
@@ -192,6 +238,26 @@ class EnhanceServer:
                 await asyncio.wait_for(wtask, timeout=5.0)
             with contextlib.suppress(Exception):
                 writer.close()
+
+    def _park(self, session, reason: str) -> bool:
+        """Park a live session on connection trouble (I/O thread); False
+        when parking is off or the session already left the registry."""
+        if session is None or not self.park_on_disconnect:
+            return False
+        if session.status not in (OPEN, DRAINING, QUARANTINED):
+            return False
+        return self.scheduler.park(session, reason)
+
+    def _parked_frame(self, session, reason: str,
+                      retry_after_s: float = 0.0) -> dict:
+        """The ``parked`` error frame: carries the resume token the client
+        reattaches with (``open`` + ``resume``/``have``) and a back-off
+        hint for shed sessions."""
+        return {"type": "error", "code": "parked",
+                "message": f"session parked: {reason}; reattach with the "
+                           f"resume token within the park TTL",
+                "session": session.id, "resume": session.id,
+                "retry_after_s": float(retry_after_s)}
 
     def _on_frame(self, conn: _Conn, frame: dict) -> bool:
         """Handle one client frame (asyncio thread).  Returns False to end
@@ -205,6 +271,56 @@ class EnhanceServer:
             resume = frame.get("resume")
             resume_path = None
             if resume is not None:
+                # a PARKED session reattaches in place: same carry, same
+                # queue, missed deliveries replayed from the bounded buffer
+                # — the stream stitches bit-exact with no frame lost or
+                # duplicated.  Only when nothing is parked under the token
+                # do we fall through to the checkpoint-resume path (which
+                # also serves parked sessions of a PREVIOUS server process,
+                # via the park checkpoint).
+                have = frame.get("have")   # None = fresh client, plain resume
+                try:
+                    hit = self.scheduler.reattach(
+                        resume, frame.get("config"), have)
+                    if hit is None and self.park_on_disconnect:
+                        # the client reconnected FASTER than the dead
+                        # connection's teardown parked the session (both
+                        # run on this I/O thread, so the check is
+                        # race-free): park it now and reattach — the
+                        # resume token is authoritative, newest
+                        # connection wins
+                        live = self.scheduler.get(resume)
+                        if (live is not None
+                                and live.status in (OPEN, DRAINING,
+                                                    QUARANTINED)):
+                            self.scheduler.park(
+                                live, "reattach raced the disconnect")
+                            hit = self.scheduler.reattach(
+                                resume, frame.get("config"), have)
+                except Exception as e:
+                    code = getattr(e, "code", "bad_open")
+                    self._post(conn, {"type": "error", "code": code,
+                                      "message": str(e)})
+                    return False
+                if hit is not None:
+                    session, resume_seq = hit
+                    with self._conns_lock:
+                        for c in self._conns:
+                            if c is not conn and c.session is session:
+                                c.session = None   # detach the dead conn
+                    # cursor BEFORE session: session is the dispatch
+                    # loop's gate, and the loop (not this thread) re-sends
+                    # the missed frames from the replay buffer
+                    conn.next_out = resume_seq
+                    conn.session = session
+                    self._post(conn, {
+                        "type": "open_ok", "session": session.id,
+                        "blocks_done": session.blocks_done,
+                        "next_seq": session.blocks_in, "reattached": True,
+                    })
+                    if self.scheduler.draining:
+                        self._notify_draining(conn)
+                    return True
                 if self.state_dir is None:
                     self._post(conn, {"type": "error", "code": "no_state_dir",
                                       "message": "server has no --state-dir; cannot resume"})
@@ -222,13 +338,16 @@ class EnhanceServer:
                     session_id=frame.get("session") or resume,
                     z_mask=frame.get("z_mask"),
                     resume_from=resume_path,
+                    priority=bool(frame.get("priority", False)),
                 )
             except Exception as e:  # AdmissionError carries .code; rest default
                 code = getattr(e, "code", "bad_open")
                 self._post(conn, {"type": "error", "code": code, "message": str(e)})
                 return False
+            conn.next_out = conn.session.blocks_done
             self._post(conn, {"type": "open_ok", "session": conn.session.id,
-                              "blocks_done": conn.session.blocks_done})
+                              "blocks_done": conn.session.blocks_done,
+                              "next_seq": conn.session.blocks_in})
             if self.scheduler.draining:
                 # admitted in the race window right before draining flipped
                 self._notify_draining(conn)
@@ -281,12 +400,19 @@ class EnhanceServer:
                     for conn in conns:
                         self._notify_draining(conn)
                 deliveries = self.scheduler.tick()
-                for session, seq, yf, _lat in deliveries:
+                self._post_enhanced()
+                for session, reason, retry_after in \
+                        self.scheduler.drain_park_notices():
+                    # shed-to-park happened on the dispatch thread with the
+                    # connection still up: name it to the client (resume
+                    # token + back-off hint), then end the stream
                     conn = self._conn_of(session)
                     if conn is None:
                         continue
-                    self._post(conn, {"type": "enhanced", "session": session.id,
-                                      "seq": int(seq), "yf": yf})
+                    conn.closed_sent = True
+                    self._post(conn, self._parked_frame(
+                        session, reason, retry_after_s=retry_after))
+                    self._post_end(conn)
                 self._flush_finished()
                 if self.scheduler.draining and self.scheduler.pending_blocks() == 0:
                     self._drain_finish()
@@ -297,12 +423,48 @@ class EnhanceServer:
             self.crashed = e
             self._shutdown_loop()
 
+    def _post_enhanced(self) -> None:
+        """Post every connection's owed ``enhanced`` frames (dispatch
+        thread — the ONE poster).  Frames are drained from the session's
+        replay buffer through the per-conn cursor, so a delivery landing
+        mid-reattach is posted exactly once: either the cursor was set
+        before it landed (the loop picks it up next pass) or after (the
+        cursor starts past it) — never both, never neither."""
+        from disco_tpu.serve.session import SessionStateError
+
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            s, nxt = conn.session, conn.next_out
+            if s is None or nxt is None or conn.closed_sent:
+                continue
+            if s.blocks_done <= nxt:
+                continue
+            try:
+                entries = s.replay_from(nxt)
+            except SessionStateError as e:
+                # the cursor fell behind the bounded buffer — impossible
+                # while this loop keeps up (it drains every pass), kept as
+                # a refuse-to-corrupt guard rather than a silent hole
+                self.scheduler.evict(s, f"replay cursor gap: {e}")
+                continue
+            for seq, yf in entries:
+                if conn.session is not s or s.status == EVICTED:
+                    break   # evicted mid-drain (slow client) / detached
+                self._post(conn, {"type": "enhanced", "session": s.id,
+                                  "seq": int(seq), "yf": yf})
+                conn.next_out = seq + 1
+
     def _conn_of(self, session) -> _Conn | None:
         with self._conns_lock:
+            best = None
             for conn in self._conns:
-                if conn.session is session:
-                    return conn
-        return None
+                if conn.session is session and not conn.closed_sent:
+                    # after a reattach two conns can briefly share a session
+                    # (the dead one not torn down yet): newest wins
+                    if best is None or conn.born > best.born:
+                        best = conn
+            return best
 
     def _flush_finished(self) -> None:
         """Send ``closed`` frames for sessions the scheduler finished (close
@@ -413,7 +575,12 @@ class EnhanceServer:
             self._loop.run_until_complete(_bind())
             self._started.set()
             self._loop.run_forever()
-            # loop stopped: cancel whatever is left and close
+            # loop stopped: close the listener FIRST (a stopped server must
+            # refuse connections, not accept into a void — clients' connect
+            # retries need the refusal to find the next server), then cancel
+            # whatever is left and close
+            if self._server is not None:
+                self._server.close()
             for task in asyncio.all_tasks(self._loop):
                 task.cancel()
             with contextlib.suppress(Exception):

@@ -24,13 +24,19 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import threading
+from collections import deque
 from pathlib import Path
 
 import msgpack
 import numpy as np
 
-#: Session lifecycle states.
+#: Session lifecycle states.  ``OPEN``/``DRAINING`` sessions are dispatched
+#: by the scheduler tick; ``PARKED`` (connection lost, awaiting reattach
+#: within the park TTL) and ``QUARANTINED`` (repeated transport-failed
+#: dispatches, cooling off) sessions keep their carry + queue but are
+#: skipped by the tick loop; ``CLOSED``/``EVICTED`` are terminal.
 OPEN, DRAINING, CLOSED, EVICTED = "open", "draining", "closed", "evicted"
+PARKED, QUARANTINED = "parked", "quarantined"
 
 _STATE_VERSION = 1
 
@@ -139,7 +145,8 @@ class Session:
     """
 
     def __init__(self, session_id: str, config: SessionConfig, *,
-                 z_avail=None, state=None, blocks_done: int = 0):
+                 z_avail=None, state=None, blocks_done: int = 0,
+                 priority: bool = False, replay_blocks: int = 64):
         self.id = session_id
         self.config = config
         #: (K,) or (K, B_plan) float availability of the exchanged streams —
@@ -161,6 +168,34 @@ class Session:
         self.error: str | None = None
         #: wall-clock enqueue time per pending seq (latency accounting)
         self.enqueued_at: dict[int, float] = {}
+        #: newest delivered (seq, yf) host blocks, bounded — the reattach
+        #: replay buffer: outputs delivered while the connection was down
+        #: are re-sent from here so a parked-and-reattached stream stitches
+        #: bit-exact with zero lost frames (scheduler-side, so it survives
+        #: the connection that died)
+        self.replay: "deque[tuple[int, np.ndarray]]" = deque(maxlen=max(1, replay_blocks))
+        #: ladder shedding spares priority sessions (wire ``open`` field)
+        self.priority = bool(priority)
+        #: admission sequence number (shedding targets the NEWEST
+        #: non-priority session — earlier streams keep their progress)
+        self.open_seq = 0
+        #: monotonic park timestamp while PARKED (TTL accounting), else None
+        self.parked_at: float | None = None
+        #: lifetime count of transport-exhausted quarantines — the
+        #: scheduler's ``max_quarantines``-th offense evicts
+        self.quarantine_count = 0
+        #: scheduler tick number at which a QUARANTINED session re-opens
+        self.quarantine_until_tick = 0
+        #: tick of this session's last outage transition (park, reattach,
+        #: quarantine, release).  Queue-wait samples observed within the
+        #: scheduler's grace window after it are EXCLUDED from the
+        #: degradation ladder's p95: a block that waited out a park or a
+        #: retry storm measures the outage, not the load, and feeding it to
+        #: the ladder would shed the very session that just survived
+        #: (outage → hot p95 → shed → park → outage: a feedback loop).
+        #: The serve_queue_wait_ms histogram still sees every sample —
+        #: latency accounting stays honest, only the controller is gated.
+        self.outage_tick = -(1 << 30)
 
     # -- input side (I/O thread) --------------------------------------------
     def push_block(self, seq: int, Y, mask_z, mask_w, t_wall: float) -> None:
@@ -179,6 +214,56 @@ class Session:
         with self._lock:
             take, self._pending = self._pending[:max_n], self._pending[max_n:]
             return take
+
+    def requeue_front(self, blocks: list) -> None:
+        """Return popped-but-undispatched blocks to the FRONT of the queue,
+        order preserved — a transport-exhausted dispatch must not lose or
+        reorder the stream (the carry never advanced for these blocks, so a
+        later retry is bit-identical).  Enqueue times stay in
+        ``enqueued_at``: the eventual latency observation charges the whole
+        outage, honestly.
+
+        No reference counterpart (module docstring)."""
+        if not blocks:
+            return
+        with self._lock:
+            self._pending = list(blocks) + self._pending
+
+    def record_delivery(self, seq: int, yf) -> None:
+        """Remember one delivered output block in the bounded replay buffer
+        — the source of truth the server's posting cursor drains, and what
+        a reattaching client's missed frames are re-sent from (see
+        :attr:`replay`).  Locked: the dispatch thread appends while the I/O
+        thread may be validating a reattach.
+
+        No reference counterpart (module docstring)."""
+        with self._lock:
+            self.replay.append((int(seq), yf))
+
+    def replay_from(self, have: int) -> list:
+        """Buffered deliveries with ``seq >= have``, in order — the frames
+        a client's posting cursor at ``have`` has not seen.  Raises
+        :class:`SessionStateError` when the buffer no longer reaches back
+        to ``have`` (delivered frames would be lost; the reattach must be
+        refused, not stitched with a hole).  Locked against concurrent
+        :meth:`record_delivery`; the consistency check uses the buffer's
+        own newest seq, so a ``blocks_done`` racing ahead can never fail a
+        valid reattach.
+
+        No reference counterpart (module docstring)."""
+        with self._lock:
+            entries = list(self.replay)
+        missing = [(s, yf) for (s, yf) in entries if s >= have]
+        newest = entries[-1][0] if entries else self.blocks_done - 1
+        expect = list(range(have, newest + 1))
+        if [s for (s, _) in missing] != expect:
+            raise SessionStateError(
+                f"session {self.id}: replay buffer no longer covers blocks "
+                f"[{have}, {newest + 1}) — the client was gone longer "
+                f"than replay_blocks deliveries; cannot reattach without "
+                f"losing frames"
+            )
+        return missing
 
     def block_z_avail(self, seq: int, n_blocks: int):
         """Availability columns for input block ``seq`` (``n_blocks``

@@ -28,6 +28,8 @@ transport-layer retries do not exist there.
 from __future__ import annotations
 
 import functools
+import random
+import threading
 import time
 
 from disco_tpu.obs import events as _events
@@ -36,6 +38,7 @@ from disco_tpu.obs.metrics import REGISTRY as _REGISTRY
 _RETRIES = _REGISTRY.counter("retries")
 _RECOVERIES = _REGISTRY.counter("retry_recoveries")
 _GIVEUPS = _REGISTRY.counter("retry_giveups")
+_DEADLINE_HITS = _REGISTRY.counter("dispatch_deadline_hits")
 
 
 def _transport_errors() -> tuple:
@@ -77,6 +80,8 @@ def call_with_retries(
     deadline_s: float | None = None,
     retry_on: type | tuple = Exception,
     label: str | None = None,
+    jitter: float = 0.0,
+    jitter_seed: int = 0,
     sleep=time.sleep,
     **kwargs,
 ):
@@ -85,9 +90,19 @@ def call_with_retries(
     Args:
       retries: maximum number of RE-tries (so at most ``retries + 1``
         calls).
-      base_delay_s / backoff / max_delay_s: deterministic exponential
-        backoff ``min(base * backoff**i, max)`` between attempts — no
-        jitter, so a seeded run's retry schedule is reproducible.
+      base_delay_s / backoff / max_delay_s: exponential backoff
+        ``min(base * backoff**i, max)`` between attempts — deterministic by
+        default (``jitter=0``), so a seeded run's retry schedule is
+        reproducible.
+      jitter / jitter_seed: fraction of each backoff delay to SUBTRACT at
+        random (``delay * (1 - jitter * u)``, ``u`` drawn from a
+        ``random.Random(jitter_seed)`` stream, one draw per sleep, in
+        ``[0, 1)``).  Desynchronizes the thundering herd of K parked
+        clients all reconnecting after the same outage, while staying
+        fully deterministic given the seed (same seed, same failure
+        pattern → same schedule) and never exceeding the un-jittered
+        delay — deadline accounting stays conservative.  ``jitter`` must
+        be in ``[0, 1]``.
       deadline_s: overall wall budget from the first call; if the next
         backoff sleep would cross it, :class:`DeadlineExceeded` is raised
         (chained to the last error) instead of sleeping.
@@ -103,7 +118,10 @@ def call_with_retries(
     """
     if retries < 0:
         raise ValueError(f"retries must be >= 0, got {retries}")
+    if not 0.0 <= jitter <= 1.0:
+        raise ValueError(f"jitter must be in [0, 1], got {jitter}")
     name = label or getattr(fn, "__name__", "call")
+    rng = random.Random(jitter_seed) if jitter else None
     t0 = time.monotonic()
     attempt = 0
     while True:
@@ -120,6 +138,8 @@ def call_with_retries(
                 _GIVEUPS.inc()
                 raise
             delay = min(base_delay_s * backoff ** (attempt - 1), max_delay_s)
+            if rng is not None:
+                delay *= 1.0 - jitter * rng.random()
             if deadline_s is not None and (time.monotonic() - t0) + delay > deadline_s:
                 _GIVEUPS.inc()
                 raise DeadlineExceeded(
@@ -192,6 +212,78 @@ def resilient_to_device(x, **retry_opts):
     retry_opts.setdefault("label", "to_device")
     retry_opts.setdefault("retry_on", TRANSPORT_ERRORS)
     return call_with_retries(to_device, x, **retry_opts)
+
+
+class DispatchDeadline:
+    """Host-only wall-clock watchdog for one dispatch window.
+
+    The tunneled chip can wedge mid-dispatch, and the environment contract
+    forbids the classic answer (kill the worker): a SIGKILLed holder wedges
+    the remote claim for hours.  So the watchdog never interrupts anything —
+    it is a pure ``threading.Timer`` (no jax, safe on any thread) that, when
+    the deadline passes with the guarded block still running, marks the
+    window **suspect**: flips :attr:`expired`, ticks the
+    ``dispatch_deadline_hits`` counter, records a ``fault`` obs event (kind
+    ``dispatch_deadline``) and calls the optional ``on_expire`` callback
+    (host-only by contract).  The guarded code observes :attr:`expired`
+    AFTER its (late) completion and decides what to do — the serve
+    scheduler fences via :func:`preflight_probe` and then lets the
+    degradation ladder choose retry vs. degrade.
+
+    Usage::
+
+        with DispatchDeadline(2.0, label="serve_tick") as dd:
+            ...dispatch + readback...
+        if dd.expired:
+            ...probe, then degrade...
+
+    No reference counterpart: the reference never has a device that can
+    hang (utils/resilience.py module docstring).
+    """
+
+    def __init__(self, deadline_s: float, *, label: str = "dispatch",
+                 on_expire=None):
+        if deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        self.deadline_s = deadline_s
+        self.label = label
+        self.on_expire = on_expire
+        self.expired = False
+        self.t0: float | None = None
+        self._timer: threading.Timer | None = None
+
+    def _fire(self) -> None:
+        # timer thread: host-only telemetry, never touches jax, never kills
+        self.expired = True
+        _DEADLINE_HITS.inc()
+        _events.record(
+            "fault", stage=self.label, fault="dispatch_deadline",
+            deadline_s=self.deadline_s,
+        )
+        if self.on_expire is not None:
+            try:
+                self.on_expire()
+            except Exception:
+                pass  # a watchdog must never crash the run it watches
+
+    def __enter__(self) -> "DispatchDeadline":
+        self.expired = False
+        self.t0 = time.monotonic()
+        self._timer = threading.Timer(self.deadline_s, self._fire)
+        self._timer.daemon = True
+        self._timer.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+
+    def elapsed_s(self) -> float:
+        """Seconds since the guarded window opened (0 before ``__enter__``).
+
+        No reference counterpart (class docstring)."""
+        return 0.0 if self.t0 is None else time.monotonic() - self.t0
 
 
 class PreflightFailed(RuntimeError):

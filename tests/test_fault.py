@@ -725,3 +725,81 @@ def test_fault_check_smoke_passes(capsys):
     out = capsys.readouterr().out.strip().splitlines()
     rec = json.loads(out[-1])
     assert rec["fault_check"] == "ok" and rec["n_fault_events"] == 2
+
+
+def test_call_with_retries_seeded_jitter_pins_the_draw_sequence():
+    """The jittered schedule is deterministic given the seed: exactly the
+    ``random.Random(seed).random()`` stream, one draw per sleep, scaling
+    each delay by ``1 - jitter * u`` — never above the un-jittered delay
+    (deadline accounting stays conservative)."""
+    import random
+
+    from disco_tpu.utils.resilience import call_with_retries
+
+    def run(seed, jitter):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 4:
+                raise ConnectionError("hiccup")
+            return "ok"
+
+        slept = []
+        assert call_with_retries(flaky, retries=5, base_delay_s=0.1,
+                                 backoff=2.0, max_delay_s=10.0,
+                                 jitter=jitter, jitter_seed=seed,
+                                 sleep=slept.append) == "ok"
+        return slept
+
+    rng = random.Random(7)
+    expect = [d * (1.0 - 0.5 * rng.random()) for d in (0.1, 0.2, 0.4)]
+    assert run(7, 0.5) == expect                 # the pinned draw sequence
+    assert run(7, 0.5) == expect                 # same seed, same schedule
+    assert run(8, 0.5) != expect                 # different seed, different
+    base = run(9, 0.0)
+    assert base == [0.1, 0.2, 0.4]               # jitter=0: the old exact path
+    for got, cap in zip(run(11, 1.0), (0.1, 0.2, 0.4)):
+        assert 0.0 <= got <= cap                 # never above the deterministic delay
+
+
+def test_call_with_retries_rejects_bad_jitter():
+    from disco_tpu.utils.resilience import call_with_retries
+
+    with pytest.raises(ValueError, match="jitter"):
+        call_with_retries(lambda: 1, jitter=1.5)
+    with pytest.raises(ValueError, match="jitter"):
+        call_with_retries(lambda: 1, jitter=-0.1)
+
+
+def test_dispatch_deadline_marks_suspect_never_kills(tmp_path):
+    """The DispatchDeadline watchdog: on expiry it flips the flag, ticks
+    the counter and records the fault event — the guarded block always
+    runs to completion (never interrupted, never killed)."""
+    import time
+
+    from disco_tpu import obs
+    from disco_tpu.obs.metrics import REGISTRY
+    from disco_tpu.utils.resilience import DispatchDeadline
+
+    before = REGISTRY.counter("dispatch_deadline_hits").value
+    log = tmp_path / "deadline.jsonl"
+    ran = []
+    with obs.recording(log):
+        with DispatchDeadline(0.02, label="serve_tick") as dd:
+            time.sleep(0.08)     # blow the deadline; the work still finishes
+            ran.append("finished")
+    assert ran == ["finished"] and dd.expired
+    assert dd.elapsed_s() >= 0.02
+    assert REGISTRY.counter("dispatch_deadline_hits").value == before + 1
+    events = obs.read_events(log)
+    (ev,) = [e for e in events if e["attrs"].get("fault") == "dispatch_deadline"]
+    assert ev["stage"] == "serve_tick"
+
+    # the happy path: cancelled cleanly, no flag, no counter
+    with DispatchDeadline(5.0) as dd2:
+        pass
+    assert not dd2.expired
+    assert REGISTRY.counter("dispatch_deadline_hits").value == before + 1
+    with pytest.raises(ValueError, match="deadline_s"):
+        DispatchDeadline(0.0)

@@ -30,8 +30,8 @@ def rule_ids(res):
 # -- registry ----------------------------------------------------------------
 def test_rule_catalog_shape():
     rules = analysis.get_rules()
-    assert len(rules) == 12
-    assert sorted(rules) == [f"DL{i:03d}" for i in range(1, 13)]
+    assert len(rules) == 13
+    assert sorted(rules) == [f"DL{i:03d}" for i in range(1, 14)]
     for rid, rule in rules.items():
         assert rule.id == rid and rule.name and rule.summary
 
@@ -567,3 +567,114 @@ def test_dl012_near_misses():
         return jnp.abs(stft(y)), y.astype(jnp.bfloat16)
     """
     assert rule_ids(lint(src2, "disco_tpu/ops/stft_ops.py", rules={"DL012"})) == []
+
+
+# -- DL013 adhoc-transport-retry ----------------------------------------------
+def test_dl013_flags_retry_loops_swallowing_transport_errors():
+    # the classic while-retry that swallows and goes again
+    src = """
+    def fetch(x):
+        while True:
+            try:
+                return readback(x)
+            except OSError:
+                continue
+    """
+    assert rule_ids(lint(src, "disco_tpu/enhance/foo.py",
+                         rules={"DL013"})) == ["DL013"]
+    # attempt-counting for-range with a transport tuple and a sleep
+    src = """
+    def fetch(x):
+        for attempt in range(5):
+            try:
+                return readback(x)
+            except (ConnectionError, TimeoutError):
+                time.sleep(0.1)
+    """
+    assert rule_ids(lint(src, "disco_tpu/serve/foo.py",
+                         rules={"DL013"})) == ["DL013"]
+    # socket.error spelling counts too
+    src = """
+    def fetch(x):
+        while not done:
+            try:
+                step(x)
+            except socket.error:
+                pass
+    """
+    assert rule_ids(lint(src, "disco_tpu/io/foo.py",
+                         rules={"DL013"})) == ["DL013"]
+
+
+def test_dl013_near_misses():
+    # a fail-fast handler (re-raise) is not a retry
+    src = """
+    def fetch(x):
+        while True:
+            try:
+                return readback(x)
+            except OSError as e:
+                raise RuntimeError("dead tunnel") from e
+    """
+    assert rule_ids(lint(src, "disco_tpu/enhance/foo.py", rules={"DL013"})) == []
+    # a break leaves the loop: bounded, not a silent retry
+    src = """
+    def fetch(x):
+        while True:
+            try:
+                return readback(x)
+            except OSError:
+                break
+    """
+    assert rule_ids(lint(src, "disco_tpu/enhance/foo.py", rules={"DL013"})) == []
+    # skipping a failed ITEM of a for-each is different work next
+    # iteration, not a re-attempt of the same crossing
+    src = """
+    def load_all(paths):
+        out = []
+        for p in paths:
+            try:
+                out.append(read(p))
+            except OSError:
+                continue
+        return out
+    """
+    assert rule_ids(lint(src, "disco_tpu/enhance/foo.py", rules={"DL013"})) == []
+    # non-transport exceptions are out of scope
+    src = """
+    def parse(xs):
+        while True:
+            try:
+                return decode(xs)
+            except ValueError:
+                xs = fix(xs)
+    """
+    assert rule_ids(lint(src, "disco_tpu/enhance/foo.py", rules={"DL013"})) == []
+    # one attempt inside a try (loop INSIDE the try) is not a retry loop
+    src = """
+    def drain(q):
+        try:
+            while q:
+                send(q.pop())
+        except OSError:
+            pass
+    """
+    assert rule_ids(lint(src, "disco_tpu/enhance/foo.py", rules={"DL013"})) == []
+
+
+def test_dl013_allowed_files_are_exempt():
+    src = """
+    def connect(addr):
+        while True:
+            try:
+                return dial(addr)
+            except OSError:
+                time.sleep(0.05)
+    """
+    # the one sanctioned implementation...
+    assert rule_ids(lint(src, "disco_tpu/utils/resilience.py",
+                         rules={"DL013"})) == []
+    # ...and the numpy-only client files, which the DL005 purity contract
+    # bars from importing utils.resilience at all
+    assert rule_ids(lint(src, "disco_tpu/serve/client.py",
+                         rules={"DL013"})) == []

@@ -800,3 +800,274 @@ def test_server_concurrent_sessions_parity_and_drain(tmp_path):
         [np.concatenate([outs[i] for i in range(half)], axis=-1), rest], axis=-1
     )
     np.testing.assert_array_equal(full, ref)
+
+
+# -- serving survival layer (disco-soak PR) ----------------------------------
+def test_transient_transport_error_does_not_evict(stream):
+    """THE regression of the survival layer: a transient XlaRuntimeError
+    during dispatch must retry in place — the old scheduler evicted the
+    innocent session on ANY exception (serve/scheduler.py per-session
+    isolation), turning every tunnel hiccup into a dropped stream."""
+    from jax.errors import JaxRuntimeError
+
+    from disco_tpu.serve import EnhanceServer, ServeClient
+    from disco_tpu.serve.scheduler import set_dispatch_fault_injector
+
+    Y, m, ref = stream
+    F = Y.shape[-2]
+    calls = [0]
+
+    def flaky(_sid, _seqs):
+        calls[0] += 1
+        if calls[0] == 2:
+            raise JaxRuntimeError("tunnel RPC dropped (injected)")
+
+    set_dispatch_fault_injector(flaky)
+    try:
+        srv = EnhanceServer(max_sessions=2)
+        srv.scheduler.dispatch_retry_base_s = 0.001
+        addr = srv.start()
+        cl = ServeClient(addr)
+        cl.open(_config(F))
+        yf = cl.enhance_clip(Y, m, m)
+        cl.close()
+        cl.shutdown()
+        srv.stop()
+    finally:
+        set_dispatch_fault_injector(None)
+    assert calls[0] > 2, "the injected fault never fired (seam moved?)"
+    np.testing.assert_array_equal(yf, ref)  # retried, not evicted
+
+
+def test_exhausted_transport_budget_quarantines_then_recovers(stream):
+    """A transport burst past the retry budget must quarantine (blocks
+    re-queued in order, carry untouched) and the released session must
+    finish bit-exact — never evict, never corrupt."""
+    from disco_tpu.serve import EnhanceServer, ServeClient
+    from disco_tpu.serve.scheduler import set_dispatch_fault_injector
+    from disco_tpu.serve.session import QUARANTINED  # noqa: F401  (state exists)
+
+    Y, m, ref = stream
+    F = Y.shape[-2]
+    n = [0]
+
+    def burst(_sid, _seqs):
+        n[0] += 1
+        if n[0] <= 4:   # > retries+1 of the first dispatch: exhausts
+            raise TimeoutError("injected transport burst")
+
+    set_dispatch_fault_injector(burst)
+    try:
+        srv = EnhanceServer(max_sessions=2, quarantine_ticks=3)
+        srv.scheduler.dispatch_retry_base_s = 0.001
+        addr = srv.start()
+        cl = ServeClient(addr, timeout_s=60)
+        cl.open(_config(F))
+        yf = cl.enhance_clip(Y, m, m)
+        cl.close()
+        cl.shutdown()
+        srv.stop()
+    finally:
+        set_dispatch_fault_injector(None)
+    np.testing.assert_array_equal(yf, ref)
+
+
+def test_reconnect_after_drop_stitches_bit_exact(stream):
+    """Kill the socket mid-stream; the client reattaches with its resume
+    token and the stitched stream equals offline streaming_tango byte for
+    byte (missed deliveries replayed, eaten input blocks resent)."""
+    import socket as socket_mod
+
+    from disco_tpu.serve import EnhanceServer, ServeClient
+
+    Y, m, ref = stream
+    F = Y.shape[-2]
+    srv = EnhanceServer(max_sessions=2)
+    addr = srv.start()
+    try:
+        cl = ServeClient(addr, retry_seed=3)
+        cl.open(_config(F))
+        killed = [False]
+
+        def on_block(seq, _yf):
+            if seq == 1 and not killed[0]:
+                killed[0] = True
+                cl._sock.shutdown(socket_mod.SHUT_RDWR)
+
+        yf = cl.enhance_clip(Y, m, m, on_block=on_block)
+        cl.close()
+        cl.shutdown()
+    finally:
+        srv.stop()
+    assert killed[0] and cl.reattaches >= 1
+    np.testing.assert_array_equal(yf, ref)
+
+
+def test_mid_frame_truncation_parks_not_corrupts(stream):
+    """A partial frame followed by EOF must PARK the session (the torn
+    block never reaches push_block) and the reattached stream must still
+    be bit-exact — the wire fault corrupts nothing."""
+    import socket as socket_mod
+
+    from disco_tpu.serve import EnhanceServer, ServeClient, protocol as proto
+
+    Y, m, ref = stream
+    F = Y.shape[-2]
+    srv = EnhanceServer(max_sessions=2)
+    addr = srv.start()
+    try:
+        cl = ServeClient(addr)
+        cl.open(_config(F))
+        fired = [False]
+
+        def on_block(seq, _yf):
+            if seq == 1 and not fired[0]:
+                fired[0] = True
+                half = proto.pack_frame({"type": "close"})
+                cl._sock.sendall(half[: len(half) // 2])
+                cl._sock.shutdown(socket_mod.SHUT_WR)
+
+        yf = cl.enhance_clip(Y, m, m, on_block=on_block)
+        info = cl.close()
+        cl.shutdown()
+    finally:
+        srv.stop()
+    assert fired[0] and cl.reattaches >= 1
+    assert info["blocks_done"] == -(-Y.shape[-1] // BLOCK)
+    np.testing.assert_array_equal(yf, ref)
+
+
+def test_client_connect_retries_survive_server_restart_window():
+    """First OSError on connect used to be fatal; the bounded seeded
+    backoff must ride out a late-binding server (and still fail cleanly
+    when nothing ever listens)."""
+    import socket as socket_mod
+
+    from disco_tpu.serve import EnhanceServer, ServeClient
+
+    # reserve a port, release it, bind the server there AFTER the client
+    # starts dialing — the first connect attempts get connection-refused
+    probe = socket_mod.socket()
+    probe.bind(("127.0.0.1", 0))
+    host, port = probe.getsockname()[:2]
+    probe.close()
+    srv = EnhanceServer(host=host, port=port)
+    binder = threading.Timer(0.15, srv.start)
+    binder.start()
+    try:
+        cl = ServeClient((host, port), connect_retries=8,
+                         connect_base_delay_s=0.05, retry_seed=1)
+        cl.shutdown()
+    finally:
+        binder.join()
+        srv.stop()
+    # no listener at all: bounded retries then a clean OSError
+    with pytest.raises(OSError):
+        ServeClient((host, port), connect_retries=1,
+                    connect_base_delay_s=0.01)
+
+
+def test_park_ttl_expires_and_frees_the_slot(stream):
+    """A parked session whose client never returns must not hold its
+    admission slot forever: the TTL reclaims it (park_expired counter,
+    EVICTED status) and a new session can open."""
+    import time as time_mod
+
+    from disco_tpu.serve import EnhanceServer, ServeClient, ServeError
+
+    Y, m, _ = stream
+    F = Y.shape[-2]
+    srv = EnhanceServer(max_sessions=1, park_ttl_s=0.2)
+    addr = srv.start()
+    try:
+        cl = ServeClient(addr, reattach_retries=0)
+        cl.open(_config(F), session_id="ghost")
+        cl.send_block(Y[..., :BLOCK], m[..., :BLOCK], m[..., :BLOCK])
+        cl.recv_enhanced(0, timeout_s=60)
+        cl.shutdown()              # drops the connection: session parks
+        # while parked, the slot is held: an open must be rejected
+        deadline = time_mod.monotonic() + 5.0
+        cl3 = None
+        while time_mod.monotonic() < deadline:
+            cl2 = ServeClient(addr, reattach_retries=0)
+            try:
+                cl2.open(_config(F), session_id="taker")
+                cl3 = cl2
+                break
+            except ServeError as e:
+                assert e.code == "capacity"   # parked ghost holds the slot
+                cl2.shutdown()
+                time_mod.sleep(0.05)
+        assert cl3 is not None, "park TTL never freed the slot"
+        cl3.close()
+        cl3.shutdown()
+    finally:
+        srv.stop()
+    from disco_tpu.obs.metrics import REGISTRY
+
+    assert REGISTRY.counter("park_expired").value >= 1
+
+
+def test_exhausted_mid_pop_requeues_only_undispatched_blocks():
+    """THE multi-block-pop regression: when a transport budget exhausts on
+    the 4th block of a 4-block pop, only the failed block may be re-queued
+    — re-queueing the already-dispatched ones would deliver them twice
+    through a double-advanced carry (duplicated, WRONG frames)."""
+    from disco_tpu.serve.scheduler import Scheduler, set_dispatch_fault_injector
+
+    Y, m = _serve_scene(77, L=16000)
+    ref = np.asarray(
+        streaming_tango(Y, m, m, update_every=U, policy="local")["yf"])
+    F = Y.shape[-2]
+    T = Y.shape[-1]
+    n_blocks = -(-T // BLOCK)
+    assert n_blocks >= 4
+    sched = Scheduler(max_sessions=1, max_queue_blocks=8,
+                      quarantine_ticks=1, dispatch_retries=1)
+    sched.dispatch_retry_base_s = 0.001
+    s = sched.open_session(_config(F))
+
+    def fail_block_3(_sid, seqs):
+        if 3 in seqs:
+            raise TimeoutError("injected: block 3's tunnel is down")
+
+    set_dispatch_fault_injector(fail_block_3)
+    try:
+        # queue 4 blocks BEFORE the first tick: one pop covers all four
+        for i in range(4):
+            lo, hi = i * BLOCK, min((i + 1) * BLOCK, T)
+            sched.push_block(s, i, Y[..., lo:hi], m[..., lo:hi], m[..., lo:hi])
+        outs = {}
+        for _s, seq, yf, _lat in sched.tick():
+            assert seq not in outs, f"block {seq} delivered twice"
+            outs[seq] = yf
+        # blocks 0-2 dispatched once; 3 re-queued; session quarantined
+        assert sorted(outs) == [0, 1, 2]
+        assert s.status == "quarantined"
+        assert [b[0] for b in s._pending] == [3]
+    finally:
+        set_dispatch_fault_injector(None)
+    # tunnel heals: a quarantined session backpressures input (QueueFull)
+    # until the cool-off releases it, then the stream finishes bit-exact
+    with pytest.raises(QueueFull, match="quarantined"):
+        sched.push_block(s, 4, Y[..., 4 * BLOCK:5 * BLOCK],
+                         m[..., 4 * BLOCK:5 * BLOCK], m[..., 4 * BLOCK:5 * BLOCK])
+    for _ in range(20):
+        for _s, seq, yf, _lat in sched.tick():
+            assert seq not in outs, f"block {seq} delivered twice"
+            outs[seq] = yf
+        if s.status == "open":
+            break
+    assert s.status == "open"
+    for i in range(4, n_blocks):
+        lo, hi = i * BLOCK, min((i + 1) * BLOCK, T)
+        sched.push_block(s, i, Y[..., lo:hi], m[..., lo:hi], m[..., lo:hi])
+    for _ in range(200):
+        for _s, seq, yf, _lat in sched.tick():
+            assert seq not in outs, f"block {seq} delivered twice"
+            outs[seq] = yf
+        if len(outs) == n_blocks:
+            break
+    assert sorted(outs) == list(range(n_blocks))
+    got = np.concatenate([outs[i] for i in range(n_blocks)], axis=-1)
+    np.testing.assert_array_equal(got, ref)

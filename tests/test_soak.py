@@ -1,0 +1,141 @@
+"""Serving survival layer units: the degradation ladder controller, the
+park/replay/requeue session primitives, and the disco-soak campaign
+planner (the heavy multi-fault integration lives in ``make soak-check`` —
+disco_tpu/runs/soak.py; these are its fast deterministic parts)."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from disco_tpu.serve.ladder import RUNGS, DegradationLadder
+from disco_tpu.serve.session import Session, SessionConfig, SessionStateError
+
+
+def _session(**kw):
+    cfg = SessionConfig(n_nodes=2, mics_per_node=1, n_freq=5, block_frames=4)
+    return Session("s1", cfg, **kw)
+
+
+# -- degradation ladder ------------------------------------------------------
+def test_ladder_steps_up_immediately_and_down_with_hysteresis():
+    lad = DegradationLadder(p95_high_ms=100.0, p95_low_ms=50.0,
+                            recover_ticks=3, max_rung=3)
+    trace = []
+    # hot ticks step up one rung per tick, immediately
+    for t in range(1, 4):
+        trace.append(lad.observe(queue_wait_p95_ms=500.0, deadline_hits=0,
+                                 tick=t))
+    assert trace == [1, 2, 3]
+    # capped at max_rung
+    assert lad.observe(queue_wait_p95_ms=500.0, deadline_hits=0, tick=4) == 3
+    # calm ticks only step down after recover_ticks consecutive ones
+    t = 5
+    downs = []
+    for _ in range(9):
+        downs.append(lad.observe(queue_wait_p95_ms=1.0, deadline_hits=0,
+                                 tick=t))
+        t += 1
+    assert downs == [3, 3, 2, 2, 2, 1, 1, 1, 0]
+    # every transition is stepwise and recorded
+    assert [(frm, to) for (_t, frm, to, _r) in lad.transitions] == [
+        (0, 1), (1, 2), (2, 3), (3, 2), (2, 1), (1, 0)]
+
+
+def test_ladder_deadline_hits_step_up_and_break_calm_streaks():
+    lad = DegradationLadder(p95_high_ms=100.0, p95_low_ms=50.0,
+                            recover_ticks=2, max_rung=2)
+    assert lad.observe(queue_wait_p95_ms=0.0, deadline_hits=1, tick=1) == 1
+    # a deadline hit mid-streak resets the calm counter
+    assert lad.observe(queue_wait_p95_ms=1.0, deadline_hits=0, tick=2) == 1
+    assert lad.observe(queue_wait_p95_ms=1.0, deadline_hits=1, tick=3) == 2
+    assert lad.observe(queue_wait_p95_ms=1.0, deadline_hits=0, tick=4) == 2
+    assert lad.observe(queue_wait_p95_ms=1.0, deadline_hits=0, tick=5) == 1
+    # the band between low and high neither degrades nor recovers
+    assert lad.observe(queue_wait_p95_ms=75.0, deadline_hits=0, tick=6) == 1
+    assert lad.observe(queue_wait_p95_ms=75.0, deadline_hits=0, tick=7) == 1
+
+
+def test_ladder_is_deterministic_given_the_metric_trace():
+    trace = [(500.0, 0), (800.0, 0), (1.0, 0), (1.0, 0), (1.0, 0),
+             (200.0, 1), (1.0, 0), (1.0, 0), (1.0, 0), (1.0, 0)]
+
+    def run():
+        lad = DegradationLadder(p95_high_ms=100.0, p95_low_ms=50.0,
+                                recover_ticks=2, max_rung=3)
+        return [lad.observe(queue_wait_p95_ms=p, deadline_hits=d, tick=t)
+                for t, (p, d) in enumerate(trace, 1)], lad.transitions
+
+    rungs1, tr1 = run()
+    rungs2, tr2 = run()
+    assert rungs1 == rungs2 and tr1 == tr2
+
+
+def test_ladder_validation_and_rung_names():
+    assert RUNGS == ("full", "per_block", "no_tap", "shed")
+    with pytest.raises(ValueError):
+        DegradationLadder(p95_high_ms=10.0, p95_low_ms=20.0)
+    with pytest.raises(ValueError):
+        DegradationLadder(max_rung=4)
+    with pytest.raises(ValueError):
+        DegradationLadder(recover_ticks=0)
+
+
+# -- session park/replay/requeue primitives ----------------------------------
+def test_replay_buffer_replays_exactly_the_missing_tail():
+    s = _session(replay_blocks=8)
+    for seq in range(5):
+        s.record_delivery(seq, np.full((2, 5, 4), seq, np.complex64))
+    s.blocks_done = 5
+    missing = s.replay_from(3)
+    assert [q for (q, _) in missing] == [3, 4]
+    assert all(np.all(yf == q) for (q, yf) in missing)
+    assert s.replay_from(5) == []          # client saw everything
+
+
+def test_replay_buffer_gap_refuses_instead_of_stitching_a_hole():
+    s = _session(replay_blocks=2)          # deliveries 0..4, buffer keeps 3,4
+    for seq in range(5):
+        s.record_delivery(seq, np.zeros((1,), np.complex64))
+    s.blocks_done = 5
+    with pytest.raises(SessionStateError, match="replay buffer"):
+        s.replay_from(1)                   # blocks 1,2 are gone forever
+    assert [q for (q, _) in s.replay_from(3)] == [3, 4]
+
+
+def test_requeue_front_preserves_stream_order():
+    s = _session()
+    for seq in range(4):
+        s.push_block(seq, f"Y{seq}", "mz", "mw", 0.0)
+    popped = s.pop_blocks(4)
+    assert [b[0] for b in popped] == [0, 1, 2, 3]
+    s.requeue_front(popped[2:])            # blocks 2,3 failed to dispatch
+    s.push_block(4, "Y4", "mz", "mw", 0.0)
+    assert [b[0] for b in s.pop_blocks(10)] == [2, 3, 4]
+    s.requeue_front([])                    # no-op
+
+
+# -- the soak campaign planner ------------------------------------------------
+def test_plan_campaign_is_deterministic_and_always_multi_fault():
+    from disco_tpu.runs.soak import SEEDS, plan_campaign
+
+    for seed in SEEDS:
+        a, b = plan_campaign(seed), plan_campaign(seed)
+        assert a == b
+        assert 2 <= len(a["sessions"]) <= 3
+        assert any(s["fault"] != "none" for s in a["sessions"])
+        for s in a["sessions"]:
+            assert s["fault"] in ("drop", "truncate", "none")
+            assert s["drop_after"] >= 1
+        if a["transport_attempts"]:
+            # per-block schedules only, and always one exhausting triple
+            assert a["super_tick"] == 1
+            idx = set(a["transport_attempts"])
+            assert any(i + 1 in idx and i + 2 in idx for i in idx)
+    assert plan_campaign(SEEDS[-1])["crash_leg"]
+
+
+def test_soak_scene_is_whole_blocks_and_warm_matches_serve_shapes():
+    from disco_tpu.runs.soak import BLOCK, _scene
+
+    Y, m = _scene(123)
+    assert Y.shape[-1] % BLOCK == 0 and Y.shape[-1] == m.shape[-1]
