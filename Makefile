@@ -3,9 +3,9 @@
 
 PYTHON ?= python
 
-.PHONY: test coverage doc install native clean bench milestone-corpus dryrun lint-check trace-check obs-check fault-check chaos-check perf-check serve-check stream-check flywheel-check soak-check
+.PHONY: test coverage doc install native clean bench milestone-corpus dryrun lint-check trace-check obs-check fault-check chaos-check perf-check serve-check stream-check flywheel-check soak-check scope-check
 
-test: lint-check trace-check obs-check fault-check chaos-check perf-check stream-check serve-check flywheel-check soak-check
+test: lint-check trace-check obs-check fault-check chaos-check perf-check stream-check serve-check flywheel-check soak-check scope-check
 	$(PYTHON) -m pytest tests/ -q
 
 # Static-analysis gate (runs FIRST: it needs no jax, no device and ~2 s):
@@ -136,6 +136,22 @@ flywheel-check:
 soak-check:
 	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= DISCO_TPU_COMPILE_CACHE=off \
 	    $(PYTHON) -m disco_tpu.runs.soak
+
+# Causal-scope gate (the twelfth gate): disco-scope runs a loopback serve
+# cycle with causal tracing, the flight recorder and the corpus tap all
+# armed and asserts (1) every delivered frame reconstructs a COMPLETE
+# causal chain client_block → enqueue → dispatch → readback → deliver →
+# tap with intact parent links, bit-exact outputs, and a pre-span client
+# served unchanged with zero spans; (2) the read-only `status` protocol
+# frame agrees with the counters registry exactly and the SLO evaluator
+# judges it; (3) an injected transport fault quarantines the session and
+# produces a byte-stable flight-recorder dump naming the failing span,
+# after which the stream still finishes bit-exact.  Hermetic: CPU,
+# loopback only, compile cache off, one JAX process, zero SIGKILLs
+# (disco_tpu/obs/scope.py).
+scope-check:
+	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= DISCO_TPU_COMPILE_CACHE=off \
+	    $(PYTHON) -m disco_tpu.obs.scope
 
 coverage:
 	$(PYTHON) -m coverage run --branch --source=disco_tpu -m pytest tests/ -q

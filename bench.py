@@ -613,6 +613,44 @@ def bench_tap(n_blocks=64):
     return n_blocks / dt, stats
 
 
+def bench_span_overhead(n_disabled=200_000, n_enabled=2000):
+    """Causal-tracing seam cost: ``span_overhead_ns`` — the per-call delta
+    between the tracing-ENABLED hot path (span bookkeeping + flight-ring
+    append, the ``disco-serve --trace`` configuration) and the DISABLED
+    production seam, which must be a measured no-op (one attribute check;
+    the strict-no-op contract of ``obs.trace`` that ``make perf-check``
+    asserts at ≈0).  Pure host work, no jax.
+
+    Returns (span_overhead_ns, stats) where stats carries the two raw
+    lanes (``disabled_ns`` is the number the no-op contract is judged on).
+    """
+    from disco_tpu.obs import flight as obs_flight
+    from disco_tpu.obs import trace as obs_trace
+
+    ctx = obs_trace.SpanCtx(trace=obs_trace.new_id(), span=obs_trace.new_id())
+    t0 = time.perf_counter()
+    for _ in range(n_disabled):
+        obs_trace.span("dispatch", ctx)
+    disabled_ns = (time.perf_counter() - t0) / n_disabled * 1e9
+    obs_flight.enable(capacity=64)   # the ring sink; JSONL rides --obs-log
+    obs_trace.enable()
+    try:
+        t0 = time.perf_counter()
+        for _ in range(n_enabled):
+            obs_trace.span("dispatch", ctx, tick=0)
+        enabled_ns = (time.perf_counter() - t0) / n_enabled * 1e9
+    finally:
+        obs_trace.disable()
+        obs_flight.disable()
+    stats = {
+        "disabled_ns": round(disabled_ns, 1),
+        "enabled_ns": round(enabled_ns, 1),
+        "n_disabled": n_disabled,
+        "n_enabled": n_enabled,
+    }
+    return enabled_ns - disabled_ns, stats
+
+
 def bench_numpy(dur_s=2.0):
     from tests.reference_impls import tango_np
 
@@ -792,6 +830,15 @@ def main(argv=None):
                 tap_bps, tap_stats = bench_tap(n_blocks=n_tap)
         except Exception as e:
             tap_error = f"{type(e).__name__}: {e}"[:200]
+    # causal-tracing seam cost: enabled-vs-disabled per-span delta, with
+    # the disabled lane doubling as the measured proof of the strict-no-op
+    # contract (always on — it costs milliseconds of pure host work)
+    span_overhead = span_stats = span_error = None
+    try:
+        with obs_events.stage("bench_span"):
+            span_overhead, span_stats = bench_span_overhead()
+    except Exception as e:
+        span_error = f"{type(e).__name__}: {e}"[:200]
     if done is not None:
         done.set()
     # BENCH_NP_DUR_S=0 skips the float64 NumPy baseline (CPU smoke runs —
@@ -846,10 +893,14 @@ def main(argv=None):
         "tap_blocks_per_s": round(tap_bps, 2) if tap_bps else None,
         "tap_stats": tap_stats,
         "tap_error": tap_error,
+        "span_overhead_ns": (round(span_overhead, 1)
+                             if span_overhead is not None else None),
+        "span_stats": span_stats,
+        "span_error": span_error,
         "mfu": round(r["mfu"], 6) if r["mfu"] else None,
         "flops_per_clip": round(r["flops_per_clip"]) if r["flops_per_clip"] else None,
         "stage_ms": r["stage_ms"],
-        "notes": "value = DEFAULT pipeline (solver=power since round 4; rtf_eigh_solver is the reference-bit-matching lane; cov_impl/stft_impl fields name the ACTIVE kernels behind the 'auto' defaults — fused pallas on TPU, DISCO_TPU_COV_IMPL/DISCO_TPU_STFT_IMPL override; the hot path is fused: one spec+magnitude STFT over the stacked y/s/n streams, irm masks from the emitted magnitudes, mask-folded covariance accumulation; precision names the default lane, rtf_bf16/bf16_max_rel_err the opt-in bf16 compute lane measured against it), on-device RTF via k-queued slope timing (tunnel adds ~80ms/dispatch, reported separately; value_single_dispatch includes it); stages timed as separate fenced programs (full pipeline fuses tighter); streaming_rtf_scan / streaming_rtf_block = tunnel-included realtime factors of the scanned super-tick (blocks_per_dispatch blocks per fenced dispatch, streaming_tango_scan) vs per-block block-recursive deployment, dispatches_per_block from the obs fence accounting; corpus_clips_per_s = end-to-end miniature-corpus throughput through the pipelined prefetch/dispatch/readback engine (load+scoring included); serve_blocks_per_s / serve_p95_ms = online-service continuous-batching throughput and request-latency p95 over loopback (BENCH_SERVE_SESSIONS concurrent streaming sessions, compile warm-up excluded; serve_queue_wait/dispatch p95s split admission wait from device time); train_steps_per_s = flywheel CRNN train-step throughput (reduced-width model pinned in train_stats, one fence over the async step chain); tap_blocks_per_s = host-side corpus-tap spool throughput (offer -> shard rotation -> atomic write); numpy baseline at 2s clips; MFU vs dense-f32 peak (pipeline is FFT/small-eig bound by design)",
+        "notes": "value = DEFAULT pipeline (solver=power since round 4; rtf_eigh_solver is the reference-bit-matching lane; cov_impl/stft_impl fields name the ACTIVE kernels behind the 'auto' defaults — fused pallas on TPU, DISCO_TPU_COV_IMPL/DISCO_TPU_STFT_IMPL override; the hot path is fused: one spec+magnitude STFT over the stacked y/s/n streams, irm masks from the emitted magnitudes, mask-folded covariance accumulation; precision names the default lane, rtf_bf16/bf16_max_rel_err the opt-in bf16 compute lane measured against it), on-device RTF via k-queued slope timing (tunnel adds ~80ms/dispatch, reported separately; value_single_dispatch includes it); stages timed as separate fenced programs (full pipeline fuses tighter); streaming_rtf_scan / streaming_rtf_block = tunnel-included realtime factors of the scanned super-tick (blocks_per_dispatch blocks per fenced dispatch, streaming_tango_scan) vs per-block block-recursive deployment, dispatches_per_block from the obs fence accounting; corpus_clips_per_s = end-to-end miniature-corpus throughput through the pipelined prefetch/dispatch/readback engine (load+scoring included); serve_blocks_per_s / serve_p95_ms = online-service continuous-batching throughput and request-latency p95 over loopback (BENCH_SERVE_SESSIONS concurrent streaming sessions, compile warm-up excluded; serve_queue_wait/dispatch p95s split admission wait from device time); train_steps_per_s = flywheel CRNN train-step throughput (reduced-width model pinned in train_stats, one fence over the async step chain); tap_blocks_per_s = host-side corpus-tap spool throughput (offer -> shard rotation -> atomic write); span_overhead_ns = causal-tracing per-span cost, enabled (span bookkeeping + flight ring) minus disabled (the strict-no-op seam — span_stats.disabled_ns is the measured no-op, perf-check asserts it ~0); numpy baseline at 2s clips; MFU vs dense-f32 peak (pipeline is FFT/small-eig bound by design)",
     }
     # sideband first (mirror of the stdout record + final counter snapshot),
     # THEN the one stdout line — events go to the file, never stdout.

@@ -1,12 +1,13 @@
 """AST extraction of the repo's in-code string registries.
 
-The registry rules (DL009 obs event kinds, DL010 chaos seams) check string
-literals at call sites against the closed sets declared in
-``disco_tpu/obs/events.py`` (``EVENT_KINDS``) and
-``disco_tpu/runs/chaos.py`` (``SEAMS``).  The sets are read by PARSING
-those files, not importing them: the linter must stay importable with no
-jax (or any production dependency) in the process — ``make lint-check`` is
-a hermetic CPU gate.
+The registry rules (DL009 obs event kinds, DL010 chaos seams, DL014 span
+stages / status sections) check string literals at call sites against the
+closed sets declared in ``disco_tpu/obs/events.py`` (``EVENT_KINDS``),
+``disco_tpu/runs/chaos.py`` (``SEAMS``), ``disco_tpu/obs/trace.py``
+(``SPAN_STAGES``) and ``disco_tpu/serve/status.py`` (``STATUS_SECTIONS``).
+The sets are read by PARSING those files, not importing them: the linter
+must stay importable with no jax (or any production dependency) in the
+process — ``make lint-check`` is a hermetic CPU gate.
 
 No reference counterpart: the reference repo has neither telemetry kinds
 nor chaos seams to register.
@@ -20,6 +21,8 @@ from pathlib import Path
 REGISTRY_SOURCES = {
     "event_kinds": ("disco_tpu/obs/events.py", "EVENT_KINDS"),
     "chaos_seams": ("disco_tpu/runs/chaos.py", "SEAMS"),
+    "span_stages": ("disco_tpu/obs/trace.py", "SPAN_STAGES"),
+    "status_sections": ("disco_tpu/serve/status.py", "STATUS_SECTIONS"),
 }
 
 _cache: dict = {}
@@ -68,3 +71,13 @@ def event_kinds(root) -> frozenset:
 def chaos_seams(root) -> frozenset:
     """``SEAMS`` as declared in ``disco_tpu/runs/chaos.py``."""
     return load(root, "chaos_seams")
+
+
+def span_stages(root) -> frozenset:
+    """``SPAN_STAGES`` as declared in ``disco_tpu/obs/trace.py``."""
+    return load(root, "span_stages")
+
+
+def status_sections(root) -> frozenset:
+    """``STATUS_SECTIONS`` as declared in ``disco_tpu/serve/status.py``."""
+    return load(root, "status_sections")

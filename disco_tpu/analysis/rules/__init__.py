@@ -16,6 +16,7 @@ is registration order):
 * DL011 ``scan-unroll``           — :mod:`.scanunroll`
 * DL012 ``fused-magnitude-precision`` — :mod:`.magnitude`
 * DL013 ``adhoc-transport-retry`` — :mod:`.retryloop`
+* DL014 ``span-stage-status-section`` — :mod:`.registered`
 
 (DL000 ``lint-suppression`` is the engine's own hygiene rule — see
 :mod:`disco_tpu.analysis.suppressions`.)

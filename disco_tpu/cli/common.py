@@ -67,12 +67,19 @@ def solver_spec(v: str):
 
 # -- the shared production seams (obs / ledger / preflight / faults) ---------
 def add_obs_log_arg(parser, what: str = "run") -> None:
-    """Attach the shared ``--obs-log`` telemetry argument."""
+    """Attach the shared ``--obs-log`` telemetry arguments."""
     parser.add_argument(
         "--obs-log", default=None,
         help=f"record structured {what} telemetry (manifest, per-stage "
              "events, fence/RPC accounting, counters) to this JSONL file; "
              "render with `python -m disco_tpu.cli.obs report PATH`",
+    )
+    parser.add_argument(
+        "--obs-log-max-bytes", type=int, default=None, metavar="N",
+        help="rotate the --obs-log file once it exceeds N bytes "
+             "(events.jsonl -> events.1.jsonl, ...; `disco-obs report` "
+             "spans the segments transparently) — bounds the log of a "
+             "week-long serve/soak run; default: no rotation",
     )
 
 
@@ -217,7 +224,8 @@ def obs_session(args, tool: str):
     if obs_log:
         from disco_tpu import obs
 
-        obs.enable(obs_log)
+        obs.enable(obs_log,
+                   max_bytes=getattr(args, "obs_log_max_bytes", None))
         obs.write_manifest(
             config={k: v for k, v in vars(args).items() if v is not None},
             tool=tool,

@@ -1,6 +1,7 @@
-"""Telemetry reader CLI: render an event log, diff two bench artifacts.
+"""Telemetry reader CLI: render an event log, diff two bench artifacts,
+reconstruct causal traces, and introspect a live server.
 
-Two subcommands:
+Five subcommands:
 
 * ``report LOG.jsonl`` — aggregate a JSONL event log (``disco_tpu.obs``
   schema) into a manifest summary, a per-stage time/call/fence table with
@@ -23,6 +24,22 @@ Two subcommands:
   (the flywheel's training-step and corpus-tap spool lanes — losing a
   measured lane is a REGRESSION, not a skip).  Exits nonzero on a regression beyond ``--threshold``,
   which is what lets ``make obs-check`` gate CI on the bench trajectory.
+  ``span_overhead_ns`` (the causal-tracing hot-path delta) is judged
+  lower-is-better with an absolute floor: nanosecond noise around the
+  ≈0 disabled cost never flags, a real (>1 µs) regression does.
+* ``trace LOG.jsonl [TRACE_ID]`` — causal-trace reconstruction
+  (``disco_tpu.obs.trace``): without an id, list the log's trace ids;
+  with one, render the per-hop waterfall (client block → enqueue →
+  dispatch → readback → deliver → tap → train batch) with queue-wait /
+  readback / delivery attribution.
+* ``top ADDRESS`` — live serve introspection over the read-only
+  ``status`` protocol frame (no session, never jax): session states,
+  ladder rung, counters/gauges, latency percentiles, in-flight spans.
+  ``--watch N`` refreshes every N seconds until interrupted.
+* ``slo ADDRESS|STATUS.json`` — verdict over declared SLO targets
+  (``--serve-p95-ms``, ``--queue-wait-p95-ms``, ``--max-drop-rate``,
+  ``--max-evict-rate``); exits nonzero on violation, so a cron probe or
+  CI smoke can gate on a live server's health.
 
 No reference counterpart (the reference has no observability, SURVEY.md
 §5.1) — this is the first-class reader the BENCH_r01–r05 trajectory never
@@ -57,6 +74,30 @@ def build_parser():
     cmp_.add_argument("--threshold", type=float, default=0.05,
                       help="relative RTF drop that counts as a regression "
                            "(default 0.05; BENCH_r04→r05 headline noise was ~0.2%%)")
+
+    trc = sub.add_parser("trace", help="list / render causal traces from an event log")
+    trc.add_argument("log", help="event log written via --obs-log (span events)")
+    trc.add_argument("trace_id", nargs="?", default=None,
+                     help="trace id to render as a waterfall; omit to list ids")
+    trc.add_argument("--limit", type=int, default=20,
+                     help="max trace ids to list (newest-first; default 20)")
+
+    top = sub.add_parser("top", help="live serve introspection (status frame)")
+    top.add_argument("address", help="server address: HOST:PORT or a unix socket path")
+    top.add_argument("--watch", type=float, default=None, metavar="SECONDS",
+                     help="refresh every N seconds until interrupted "
+                          "(default: one snapshot)")
+
+    slo = sub.add_parser("slo", help="SLO verdict over a live server or a saved status")
+    slo.add_argument("target", help="HOST:PORT, unix socket path, or a status JSON file")
+    slo.add_argument("--serve-p95-ms", type=float, default=None,
+                     help="delivered-block latency p95 target (ms)")
+    slo.add_argument("--queue-wait-p95-ms", type=float, default=None,
+                     help="enqueue-to-dispatch wait p95 target (ms)")
+    slo.add_argument("--max-drop-rate", type=float, default=None,
+                     help="tap drops / tap offers ceiling")
+    slo.add_argument("--max-evict-rate", type=float, default=None,
+                     help="evictions / finished sessions ceiling")
     return p
 
 
@@ -140,8 +181,17 @@ def summarize(events: list[dict]) -> dict:
         if (name.startswith("jit_recompiles{") and name.endswith("}")
                 and int(v) > 0):
             by_label.setdefault(name[len("jit_recompiles{"):-1], int(v))
+    # -- causal tracing + flight dumps (the scope layer)
+    span_events = [e for e in events if e["kind"] == "span"]
+    traces: dict[str, int] = {}
+    for e in span_events:
+        t = e["attrs"].get("trace")
+        traces[t] = traces.get(t, 0) + 1
     return {
         "manifest": manifest["attrs"] if manifest else None,
+        "spans": len(span_events),
+        "n_traces": len(traces),
+        "flights": [e for e in events if e["kind"] == "flight"],
         "stages": dict(sorted(stages.items(), key=lambda kv: -kv[1]["total_s"])),
         "counters": counters,
         "recompiles": [e for e in events if e["kind"] == "jit_trace"],
@@ -247,6 +297,18 @@ def render_report(summary: dict) -> str:
         lines.append(
             f"flywheel train: {fw['train_steps']} steps  "
             f"corrupt shards skipped={fw['shards_skipped']}"
+        )
+    if summary.get("spans"):
+        lines.append(
+            f"causal spans: {summary['spans']} over {summary['n_traces']} "
+            f"trace(s) — render one with `disco-obs trace <log> <trace_id>`"
+        )
+    for e in summary.get("flights") or []:
+        a = e["attrs"]
+        lines.append(
+            f"FLIGHT DUMP ({a.get('trigger')}): {a.get('path')} "
+            f"[{a.get('n_entries')} entries]"
+            + (f" — {a.get('reason')}" if a.get("reason") else "")
         )
     by_label = summary.get("recompiles_by_label") or {}
     if by_label:
@@ -401,6 +463,7 @@ def compare_records(old: dict, new: dict, threshold: float = 0.05) -> dict:
         ("tap_blocks_per_s", True),
         ("latency_ms_frame", False),
         ("dispatch_overhead_ms", False),
+        ("span_overhead_ns", False),
         ("mfu", True),
     ):
         o, n = old.get(key), new.get(key)
@@ -441,15 +504,19 @@ def compare_records(old: dict, new: dict, threshold: float = 0.05) -> dict:
             return (rec.get("stage_ms") or {}).get(key[len("stage_ms."):])
         return rec.get(key)
 
-    for key, label, unit, higher_is_better in (
-        ("streaming_rtf_scan", "streaming-scan", "x realtime", True),
-        ("corpus_clips_per_s", "corpus", "clips/s", True),
-        ("serve_blocks_per_s", "serve", "blocks/s", True),
-        ("train_steps_per_s", "train", "steps/s", True),
-        ("tap_blocks_per_s", "tap", "blocks/s", True),
-        ("mfu", "mfu", "", True),
-        ("stage_ms.stft_x3", "stft stage", "ms", False),
-        ("stage_ms.step2_exchange_mwf", "step2 stage", "ms", False),
+    # floor: an absolute value below which a relative drop never flags —
+    # the span-overhead lane hovers at the ≈0 ns disabled cost, where
+    # nanosecond scheduler noise would otherwise read as a 2x regression
+    for key, label, unit, higher_is_better, floor in (
+        ("streaming_rtf_scan", "streaming-scan", "x realtime", True, None),
+        ("corpus_clips_per_s", "corpus", "clips/s", True, None),
+        ("serve_blocks_per_s", "serve", "blocks/s", True, None),
+        ("train_steps_per_s", "train", "steps/s", True, None),
+        ("tap_blocks_per_s", "tap", "blocks/s", True, None),
+        ("span_overhead_ns", "span-overhead", "ns", False, 1000.0),
+        ("mfu", "mfu", "", True, None),
+        ("stage_ms.stft_x3", "stft stage", "ms", False, None),
+        ("stage_ms.step2_exchange_mwf", "step2 stage", "ms", False, None),
     ):
         o_lane, n_lane = lane(old, key), lane(new, key)
         if o_lane is None:
@@ -458,10 +525,13 @@ def compare_records(old: dict, new: dict, threshold: float = 0.05) -> dict:
             lane_verdict = "REGRESSION"
             lane_detail = f"{key} lost (null in candidate)"
         else:
-            rl = (n_lane - o_lane) / o_lane
+            rl = (n_lane - o_lane) / o_lane if o_lane else 0.0
             good = rl if higher_is_better else -rl
             lane_verdict = ("REGRESSION" if good < -threshold
                             else "IMPROVED" if good > threshold else "OK")
+            if (lane_verdict == "REGRESSION" and floor is not None
+                    and n_lane <= floor):
+                lane_verdict = "OK"   # sub-floor noise, not a regression
             lane_detail = f"{label} {o_lane:g} → {n_lane:g} {unit} ({rl:+.1%})".rstrip()
         detail = f"{detail}; {lane_detail}"
         if lane_verdict == "REGRESSION":
@@ -482,6 +552,143 @@ def render_compare(diff: dict) -> str:
     return "\n".join(lines)
 
 
+# -- trace / top / slo -------------------------------------------------------
+def parse_address(target: str):
+    """``HOST:PORT`` -> (host, port) tuple; anything else is a unix-socket
+    path (the two shapes ``disco-serve`` binds)."""
+    host, sep, port = target.rpartition(":")
+    if sep and port.isdigit():
+        return (host or "127.0.0.1", int(port))
+    return target
+
+
+def cmd_trace(args):
+    """``disco-obs trace``: list trace ids, or render one waterfall."""
+    from disco_tpu.obs import trace as obs_trace
+
+    events = read_events(args.log)
+    if args.trace_id is None:
+        ids = obs_trace.trace_ids(events)
+        if not ids:
+            print("(no span events in this log — run with tracing enabled: "
+                  "disco-serve --trace, or obs.trace.enable())")
+            return ids
+        print(f"{len(ids)} trace(s); newest {min(args.limit, len(ids))}:")
+        for tid in ids[-args.limit:][::-1]:
+            spans = obs_trace.spans_of(events, tid)
+            stages = [e["stage"] for e in spans]
+            sess = next((e["attrs"].get("session") for e in spans
+                         if e["attrs"].get("session") is not None), "?")
+            seq = next((e["attrs"].get("seq") for e in spans
+                        if e["attrs"].get("seq") is not None), "?")
+            print(f"  {tid}  session={sess} seq={seq} hops={len(stages)} "
+                  f"({stages[0]}→{stages[-1]})")
+        return ids
+    print(obs_trace.render_waterfall(events, args.trace_id))
+    return obs_trace.chain(events, args.trace_id)
+
+
+def render_status(payload: dict) -> str:
+    """Render one ``status_ok`` payload (the ``disco-obs top`` screen)."""
+    from disco_tpu.serve.status import status_section
+
+    lines = []
+    sch = status_section(payload, "scheduler")
+    lines.append(
+        f"tick {sch['tick_no']} ({sch['ticks_with_work']} with work)  "
+        f"pending={sch['pending_blocks']}  "
+        f"super-tick={sch['blocks_per_super_tick']}  "
+        + ("DRAINING" if sch["draining"] else "serving")
+    )
+    lad = status_section(payload, "ladder")
+    if lad:
+        lines.append(f"ladder: rung {lad['rung']} ({lad['mode']}), "
+                     f"{lad['transitions']} transition(s)")
+    sessions = status_section(payload, "sessions")
+    lines.append(f"{'session':<14}{'status':<13}{'in':>6}{'done':>6}"
+                 f"{'queue':>7}{'inflight':>9}")
+    for s in sessions:
+        lines.append(
+            f"{s['id']:<14}{s['status']:<13}{s['blocks_in']:>6}"
+            f"{s['blocks_done']:>6}{s['queue_depth']:>7}{s['inflight']:>9}"
+            + ("  priority" if s.get("priority") else "")
+        )
+    if not sessions:
+        lines.append("(no live sessions)")
+    fmt = lambda v: "-" if v is None else f"{v:g}"
+    counters = status_section(payload, "counters")
+    keys = ("serve_blocks", "serve_ticks", "admission_reject",
+            "session_evicted", "session_closed", "session_quarantined",
+            "sessions_shed", "tap_blocks", "tap_dropped")
+    lines.append("counters: " + "  ".join(
+        f"{k}={counters[k]}" for k in keys if k in counters))
+    gauges = status_section(payload, "gauges")
+    gkeys = ("sessions_active", "sessions_parked", "queue_depth",
+             "batch_occupancy", "queue_wait_p95_ms", "ladder_rung")
+    lines.append("gauges:   " + "  ".join(
+        f"{k}={fmt(gauges[k])}" for k in gkeys if k in gauges))
+    for name, h in sorted(status_section(payload, "latency").items()):
+        if h.get("count"):
+            lines.append(
+                f"{name}: n={h['count']} p50={fmt(h.get('p50'))} "
+                f"p95={fmt(h.get('p95'))} p99={fmt(h.get('p99'))} "
+                f"max={fmt(h.get('max'))}"
+            )
+    inflight = status_section(payload, "inflight")
+    if inflight.get("count"):
+        lines.append(f"in-flight spans: {inflight['count']} "
+                     f"(oldest {fmt(inflight.get('oldest_s'))}s)")
+        for sp in inflight.get("spans") or []:
+            lines.append(f"  {sp.get('trace')}  stage={sp.get('stage')} "
+                         f"session={sp.get('session')} seq={sp.get('seq')} "
+                         f"age={fmt(sp.get('age_s'))}s")
+    return "\n".join(lines)
+
+
+def cmd_top(args):
+    """``disco-obs top``: one status snapshot, or a --watch loop."""
+    import time as time_mod
+
+    from disco_tpu.serve.status import fetch_status
+
+    address = parse_address(args.address)
+    while True:
+        payload = fetch_status(address)
+        print(render_status(payload))
+        if args.watch is None:
+            return payload
+        print("-" * 72)
+        time_mod.sleep(args.watch)
+
+
+def cmd_slo(args):
+    """``disco-obs slo``: judge a live server (or saved status JSON)
+    against the declared targets; exit 1 on violation."""
+    from disco_tpu.serve.status import evaluate_slo, fetch_status
+
+    if Path(args.target).is_file():
+        payload = json.loads(Path(args.target).read_text())
+    else:
+        payload = fetch_status(parse_address(args.target))
+    targets = {}
+    for flag, name in (("serve_p95_ms", "serve_p95_ms"),
+                       ("queue_wait_p95_ms", "queue_wait_p95_ms"),
+                       ("max_drop_rate", "max_drop_rate"),
+                       ("max_evict_rate", "max_evict_rate")):
+        v = getattr(args, flag)
+        if v is not None:
+            targets[name] = v
+    verdict = evaluate_slo(payload, targets)
+    fmt = lambda v: "-" if v is None else f"{v:g}"
+    for c in verdict["checks"]:
+        mark = "ok " if c["ok"] else "VIOLATED"
+        print(f"{c['name']:<22}{fmt(c['value']):>12}  target {fmt(c['target']):>10}  {mark}")
+    print(f"SLO VERDICT: {verdict['verdict']}")
+    if verdict["verdict"] != "OK":
+        raise SystemExit(1)
+    return verdict
+
+
 def main(argv=None):
     """``disco-obs`` console entry point."""
     args = build_parser().parse_args(argv)
@@ -489,6 +696,12 @@ def main(argv=None):
         summary = summarize(read_events(args.log))
         print(render_report(summary))
         return summary
+    if args.cmd == "trace":
+        return cmd_trace(args)
+    if args.cmd == "top":
+        return cmd_top(args)
+    if args.cmd == "slo":
+        return cmd_slo(args)
     diff = compare_records(
         load_bench_record(args.old), load_bench_record(args.new), args.threshold
     )

@@ -129,6 +129,21 @@ def build_parser():
                    help="disable the degradation ladder (overload control: "
                         "super-tick shrink -> tap off -> shed-to-park, "
                         "driven by queue-wait p95 and deadline hits)")
+    p.add_argument("--trace", action="store_true",
+                   help="enable causal tracing (disco_tpu.obs.trace): every "
+                        "traced block records a span chain (enqueue -> "
+                        "dispatch -> readback -> deliver -> tap) into the "
+                        "--obs-log, rendered by `disco-obs trace`; strict "
+                        "no-op for pre-span clients")
+    p.add_argument("--flight-dir", default=None, metavar="DIR",
+                   help="arm the flight recorder (disco_tpu.obs.flight): a "
+                        "bounded in-memory ring of recent events/spans, "
+                        "dumped atomically under DIR on quarantine, park, "
+                        "watchdog trip, ladder step-up, sentinel trip or "
+                        "chaos crash — post-mortems with zero steady-state "
+                        "I/O")
+    p.add_argument("--flight-capacity", type=int, default=256,
+                   help="flight-ring depth per subsystem (entries)")
     add_tap_args(p)
     add_fault_args(p)
     add_preflight_arg(p, what="the server")
@@ -141,6 +156,15 @@ def main(argv=None):
     args = build_parser().parse_args(argv)
     args.fault_spec = resolve_fault_spec(args)
     with obs_session(args, tool="disco-serve"):
+        if args.trace:
+            from disco_tpu.obs import trace as obs_trace
+
+            obs_trace.enable()
+        if args.flight_dir:
+            from disco_tpu.obs import flight as obs_flight
+
+            obs_flight.enable(dump_dir=args.flight_dir,
+                              capacity=args.flight_capacity)
         preflight = run_preflight(args)
         tap = resolve_tap(args)
         from disco_tpu.runs import GracefulInterrupt
@@ -170,6 +194,8 @@ def main(argv=None):
                       "park_ttl_s": args.park_ttl,
                       "tick_deadline_s": args.tick_deadline,
                       "ladder": bool(args.ladder),
+                      "trace": bool(args.trace),
+                      "flight_dir": args.flight_dir,
                       "tap_dir": args.tap_dir},
         )
         try:

@@ -127,6 +127,22 @@ def _check_bench_one_line(failures: list) -> dict | None:
                 f"bench: {key} missing/null in the record "
                 f"({err_key}={rec.get(err_key)!r})"
             )
+    # the causal-tracing lane: the field must be measured, and the
+    # DISABLED seam must be a measured no-op (strict-no-op contract of
+    # obs.trace — a sub-microsecond attribute check; 2 µs leaves CI-load
+    # headroom without admitting real work on the hot path)
+    if not isinstance(rec.get("span_overhead_ns"), (int, float)):
+        failures.append(
+            f"bench: span_overhead_ns missing/null in the record "
+            f"(span_error={rec.get('span_error')!r})"
+        )
+    else:
+        disabled_ns = (rec.get("span_stats") or {}).get("disabled_ns")
+        if not isinstance(disabled_ns, (int, float)) or disabled_ns > 2000.0:
+            failures.append(
+                f"bench: tracing-disabled span seam cost {disabled_ns!r} ns "
+                "— the strict-no-op contract is broken (must be ~0)"
+            )
     for key, allowed in (("stft_impl", ("xla", "pallas")),
                          ("precision", ("f32", "bf16"))):
         if rec.get(key) not in allowed:

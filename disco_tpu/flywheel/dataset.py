@@ -42,6 +42,7 @@ import numpy as np
 
 from disco_tpu.flywheel.shards import ShardError, list_shards, read_shard
 from disco_tpu.obs import events as obs_events
+from disco_tpu.obs import trace as obs_trace
 from disco_tpu.obs.metrics import REGISTRY as obs_registry
 
 
@@ -99,7 +100,19 @@ class ShardDataset:
                               reason=f"corrupt shard skipped: {e}")
             return None
         xs, ys = [], []
+        tracing = obs_trace.enabled()
         for rec in records:
+            if tracing and rec.get("trace") is not None:
+                # the chain's last hop: this served block's tuple became
+                # training input.  The span chains under the tap hop whose
+                # ids the shard record carries, closing client→train
+                # end-to-end (one span per traced record — bounded by
+                # records_per_shard, and only while tracing is on).
+                obs_trace.span(
+                    "train_batch", obs_trace.from_wire(rec["trace"]),
+                    shard=path.name, epoch=int(epoch),
+                    session=rec.get("session"), seq=rec.get("seq"),
+                )
             Y, mz = rec["Y"], rec["mask_z"]
             mag = np.abs(np.asarray(Y)[:, self.ref_mic]).astype(np.float32)
             K, _F, T = mag.shape

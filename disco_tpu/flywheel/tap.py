@@ -43,6 +43,7 @@ import numpy as np
 
 from disco_tpu.flywheel.shards import SHARD_SUFFIX, unit_shard, write_shard
 from disco_tpu.obs import events as obs_events
+from disco_tpu.obs import trace as obs_trace
 from disco_tpu.obs.metrics import REGISTRY as obs_registry
 from disco_tpu.runs.ledger import RunLedger
 
@@ -94,13 +95,21 @@ class CorpusTap:
             self.start()
 
     # -- producer side (the scheduler's dispatch thread) ---------------------
-    def offer(self, session_id: str, seq: int, Y, mask_z, mask_w, yf) -> bool:
+    def offer(self, session_id: str, seq: int, Y, mask_z, mask_w, yf,
+              trace=None) -> bool:
         """Spool one delivered block; True when accepted.
 
         Non-blocking and exception-free by contract: a full queue (or a
         closing tap) drops the block, ticks ``tap_dropped`` and returns
         False — the dispatch thread that calls this between a readback and
         the next tick must never stall or unwind because of the tap.
+
+        ``trace``: the delivered block's causal-trace context
+        (``obs.trace.SpanCtx``) — the ``tap`` hop is recorded as the block
+        enters the spool and the advanced trace/span ids are embedded in
+        the shard record, so a training batch can be traced back to the
+        client block that produced it.  None (untraced block / tracing
+        off) costs nothing.
 
         No reference counterpart (module docstring).
         """
@@ -117,12 +126,24 @@ class CorpusTap:
             "mask_z": np.asarray(mask_z),
             "mask_w": np.asarray(mask_w),
         }
+        tap_ctx = None
+        if trace is not None and obs_trace.enabled():
+            # mint-then-commit: the span id must live in the record (it is
+            # about to be queued away), but the EVENT is recorded only if
+            # the spool accepts — a dropped block must never log a 'tap'
+            # hop it did not take
+            tap_ctx = obs_trace.SpanCtx(trace=trace.trace,
+                                        span=obs_trace.new_id())
+            record["trace"] = tap_ctx.to_wire()
         try:
             self._q.put_nowait(record)
         except queue_mod.Full:
             self.dropped += 1
             obs_registry.counter("tap_dropped").inc()
             return False
+        if tap_ctx is not None:
+            obs_trace.record_span("tap", tap_ctx, parent=trace.span,
+                                  session=str(session_id), seq=int(seq))
         self.accepted += 1
         obs_registry.counter("tap_blocks").inc()
         return True

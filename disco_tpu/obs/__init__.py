@@ -19,6 +19,19 @@ returns without waiting, CLAUDE.md):
 * :mod:`disco_tpu.obs.sentinels`  — opt-in numerics watchdogs
   (:func:`check_finite`) at stage boundaries that record the offending
   stage + tensor stats instead of silently propagating NaNs.
+* :mod:`disco_tpu.obs.trace`      — causal tracing: a
+  trace/span/parent triple minted at client block submission and advanced
+  hop by hop (enqueue → dispatch → readback → deliver → tap →
+  train_batch), recorded as ``span`` events and rendered by ``disco-obs
+  trace`` as a per-hop waterfall.  Strict no-op while disabled.
+* :mod:`disco_tpu.obs.flight`     — the flight recorder: a bounded
+  in-memory ring of recent events/spans per subsystem, dumped atomically
+  (byte-stable JSON) on quarantine, park, watchdog, ladder step-up,
+  sentinel trip or ChaosCrash — post-mortems without foresight.
+* :mod:`disco_tpu.obs.scope`      — the ``make scope-check`` gate: full
+  causal chains for every delivered serve frame, byte-stable flight dumps
+  on an injected fault, and a ``status`` frame consistent with the
+  counters registry.
 
 Consumers: ``enhance/driver.py`` and ``enhance/streaming.py`` (per-stage
 events, per-clip counters), ``nn/training.py`` (per-epoch events),
@@ -43,6 +56,7 @@ from disco_tpu.obs.events import (
     validate_event,
     write_manifest,
 )
+from disco_tpu.obs import flight, trace
 from disco_tpu.obs.metrics import REGISTRY, StageTimer, trace_to
 from disco_tpu.obs.accounting import (
     counted_jit,
@@ -65,6 +79,7 @@ __all__ = [
     "enabled",
     "fence_count",
     "fence_tick",
+    "flight",
     "read_events",
     "recompile_count",
     "record",
@@ -72,6 +87,7 @@ __all__ = [
     "recording",
     "rpc_overhead_s",
     "stage",
+    "trace",
     "trace_to",
     "validate_event",
     "write_manifest",

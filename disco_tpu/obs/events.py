@@ -49,6 +49,8 @@ EVENT_KINDS = frozenset(
         "tap",          # flywheel corpus-tap lifecycle (attrs: action=shard/close)
         "interrupted",  # graceful stop requested (SIGTERM/SIGINT; runs.interrupt)
         "warning",      # degraded input / requeued unit — visible, non-fatal
+        "span",         # one causal-trace hop (obs.trace; attrs: trace/span/parent)
+        "flight",       # a flight-recorder dump landed (obs.flight; attrs: trigger/path)
         "note",         # freeform annotation
     }
 )
@@ -85,27 +87,53 @@ def _jsonable(x):
 class Recorder:
     """Process-global JSONL event sink.
 
-    Strict no-op while disabled: :meth:`record` returns after a single
-    attribute check.  When enabled, lines are appended and flushed per event
-    (the watchdog path calls ``os._exit`` right after recording), behind a
-    lock (the batched driver scores on a thread pool; the bench watchdog is
-    a daemon thread).
+    Strict no-op while inactive: :meth:`record` returns after a single
+    attribute check (``_active`` folds the JSONL sink and the flight-ring
+    sink into one flag — see :func:`refresh_sinks`).  When enabled, lines
+    are appended and flushed per event (the watchdog path calls
+    ``os._exit`` right after recording), behind a lock (the batched driver
+    scores on a thread pool; the bench watchdog is a daemon thread).
+
+    **Rotation**: ``enable(path, max_bytes=N)`` bounds the live file — once
+    an append pushes it past ``N`` bytes the file is atomically renamed to
+    the next numbered segment (``events.jsonl`` → ``events.1.jsonl``,
+    ``events.2.jsonl``, ...; ``os.replace``, so a crash never leaves a
+    half-rotated log) and a fresh live file is opened.  :func:`read_events`
+    transparently spans the rotated segments in order, tolerating a torn
+    final line at each rotation seam (a crash mid-append before the next
+    process rotated) — long soak/serve runs no longer grow one file
+    without bound.
     """
 
     def __init__(self):
         self.enabled = False
         self.path: Path | None = None
+        self.max_bytes: int | None = None
+        self.rotations = 0
         self._fh = None
         self._lock = threading.Lock()
+        #: the armed FlightRecorder (obs.flight), or None — events fan out
+        #: to its ring even when the JSONL sink is off
+        self._flight = None
+        self._active = False
 
-    def enable(self, path) -> None:
+    def _refresh_active(self) -> None:
+        fl = self._flight
+        self._active = self.enabled or (fl is not None and fl.enabled)
+
+    def enable(self, path, max_bytes: int | None = None) -> None:
+        if max_bytes is not None and int(max_bytes) < 1:
+            raise ValueError(f"max_bytes must be >= 1, got {max_bytes}")
         with self._lock:
             if self._fh is not None:
                 self._fh.close()
             self.path = Path(path)
             self.path.parent.mkdir(parents=True, exist_ok=True)
             self._fh = open(self.path, "a")
+            self.max_bytes = int(max_bytes) if max_bytes is not None else None
+            self.rotations = 0
             self.enabled = True
+            self._refresh_active()
 
     def disable(self) -> None:
         with self._lock:
@@ -114,17 +142,41 @@ class Recorder:
                 self._fh.close()
                 self._fh = None
             self.path = None
+            self.max_bytes = None
+            self._refresh_active()
 
     def record(self, kind: str, stage: str | None = None, **attrs) -> Event | None:
-        if not self.enabled:
+        if not self._active:
             return None
         ev = Event(kind=kind, stage=stage, t_wall=time.time(), attrs=attrs)
+        fl = self._flight
+        if fl is not None:
+            fl.add(stage or kind, kind, attrs, ev.t_wall)
+        if not self.enabled:
+            return ev
         with self._lock:
             if self._fh is None:  # disabled between the check and the lock
                 return None
             self._fh.write(ev.to_json() + "\n")
             self._fh.flush()
+            if self.max_bytes is not None and self._fh.tell() >= self.max_bytes:
+                self._rotate_locked()
         return ev
+
+    def _rotate_locked(self) -> None:
+        """Roll the live file over to the next numbered segment (caller
+        holds the lock).  The rename is atomic; the live path is reopened
+        fresh, so every line lives in exactly one segment."""
+        self._fh.close()
+        n = self.rotations + 1
+        while True:  # a re-enabled path may already have older segments
+            target = _segment_path(self.path, n)
+            if not target.exists():
+                break
+            n += 1
+        os.replace(self.path, target)
+        self.rotations = n
+        self._fh = open(self.path, "a")
 
 
 _RECORDER = Recorder()
@@ -140,9 +192,31 @@ def enabled() -> bool:
     return _RECORDER.enabled
 
 
-def enable(path) -> None:
-    """Start recording to ``path`` (JSONL, append)."""
-    _RECORDER.enable(path)
+def active() -> bool:
+    """True while ANY event sink is live: the JSONL recorder OR the
+    flight-recorder ring (the flag :meth:`Recorder.record` gates on).
+    Opt-in instrumentation that should run in post-mortem-only mode — the
+    numerics sentinels under ``--flight-dir`` without ``--obs-log`` —
+    gates on this, not :func:`enabled`."""
+    return _RECORDER._active
+
+
+def enable(path, max_bytes: int | None = None) -> None:
+    """Start recording to ``path`` (JSONL, append).  ``max_bytes`` arms
+    size-bounded rotation (see :class:`Recorder`)."""
+    _RECORDER.enable(path, max_bytes=max_bytes)
+
+
+def refresh_sinks() -> None:
+    """Re-derive the recorder's one-check activity flag from its sinks
+    (called by ``obs.flight`` enable/disable — the flight ring receives
+    events even while the JSONL sink is off, without adding a second check
+    to the disabled hot path)."""
+    from disco_tpu.obs import flight as _flight_mod
+
+    fl = _flight_mod.flight()
+    _RECORDER._flight = fl if fl.enabled else None
+    _RECORDER._refresh_active()
 
 
 def disable() -> None:
@@ -156,10 +230,10 @@ def record(kind: str, stage: str | None = None, **attrs) -> Event | None:
 
 
 @contextlib.contextmanager
-def recording(path):
+def recording(path, max_bytes: int | None = None):
     """Scoped recording: enable for the block, disable after (test helper and
     the CLI wiring — guarantees the file handle is released)."""
-    enable(path)
+    enable(path, max_bytes=max_bytes)
     try:
         yield _RECORDER
     finally:
@@ -176,7 +250,7 @@ def stage(name: str, **attrs):
     (on the Axon attachment each fence is a fixed ~80 ms round-trip, so the
     *count* is the cost model — see ``obs.accounting``).
     """
-    if not _RECORDER.enabled:
+    if not _RECORDER._active:
         yield
         return
     from disco_tpu.obs import accounting
@@ -277,22 +351,61 @@ def validate_event(d: dict) -> None:
         raise ValueError(f"event 'attrs' must be an object, got {d['attrs']!r}")
 
 
-def read_events(path, validate: bool = True) -> list[dict]:
-    """Load a JSONL event log (the ``cli/obs.py report`` input)."""
-    events = []
+def _segment_path(path: Path, n: int) -> Path:
+    """Rotated-segment path ``n`` of a live log (``events.jsonl`` →
+    ``events.1.jsonl``)."""
+    return path.with_name(f"{path.stem}.{n}{path.suffix}")
+
+
+def rotated_segments(path) -> list[Path]:
+    """The live log's rotated segments, oldest first (``events.1.jsonl``
+    before ``events.2.jsonl``).  Pure discovery — missing segments are
+    simply absent (a cleaned-up tail is legal)."""
+    path = Path(path)
+    prefix, suffix = path.stem + ".", path.suffix
+    found = []
+    for p in path.parent.glob(f"{path.stem}.*{path.suffix}"):
+        mid = p.name[len(prefix):len(p.name) - len(suffix)] if suffix else \
+            p.name[len(prefix):]
+        if mid.isdigit():
+            found.append((int(mid), p))
+    return [p for _n, p in sorted(found)]
+
+
+def _read_one(path, validate: bool, tolerate_torn_tail: bool) -> list[dict]:
+    """One file's events.  ``tolerate_torn_tail`` skips a final line that is
+    not valid JSON — the rotation-seam tear (a crash mid-append whose file
+    was later rotated); a bad line anywhere ELSE still raises, and schema
+    violations always raise."""
     with open(path) as fh:
-        for lineno, line in enumerate(fh, 1):
-            line = line.strip()
-            if not line:
-                continue
+        raw = [(i, ln.strip()) for i, ln in enumerate(fh, 1)]
+    raw = [(i, ln) for i, ln in raw if ln]
+    events = []
+    for pos, (lineno, line) in enumerate(raw):
+        try:
+            d = json.loads(line)
+        except json.JSONDecodeError as e:
+            if tolerate_torn_tail and pos == len(raw) - 1:
+                break  # the torn final line of a rotated segment
+            raise ValueError(f"{path}:{lineno}: not valid JSON: {e}") from None
+        if validate:
             try:
-                d = json.loads(line)
-            except json.JSONDecodeError as e:
-                raise ValueError(f"{path}:{lineno}: not valid JSON: {e}") from None
-            if validate:
-                try:
-                    validate_event(d)
-                except ValueError as e:
-                    raise ValueError(f"{path}:{lineno}: {e}") from None
-            events.append(d)
+                validate_event(d)
+            except ValueError as e:
+                raise ValueError(f"{path}:{lineno}: {e}") from None
+        events.append(d)
+    return events
+
+
+def read_events(path, validate: bool = True) -> list[dict]:
+    """Load a JSONL event log (the ``cli/obs.py report`` input), spanning
+    any rotated segments (``events.1.jsonl``, ``events.2.jsonl``, ...,
+    oldest first, then the live file).  A torn final line at a rotation
+    seam is skipped — the crash-mid-append shape rotation can strand —
+    while any other malformed line still raises."""
+    segments = rotated_segments(path)
+    events = []
+    for seg in segments:
+        events.extend(_read_one(seg, validate, tolerate_torn_tail=True))
+    events.extend(_read_one(path, validate, tolerate_torn_tail=False))
     return events

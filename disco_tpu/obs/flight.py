@@ -1,0 +1,240 @@
+"""The flight recorder: a bounded in-memory ring of recent telemetry,
+dumped atomically when something goes wrong.
+
+Post-mortems used to require foresight: unless a run was started with
+``--obs-log``, a quarantine or a watchdog trip left nothing to read.  The
+flight recorder inverts that: when armed, every obs event and causal span
+is *also* appended to a bounded per-subsystem ring (steady-state cost: one
+deque append — no I/O, no serialization), and the interesting triggers —
+session **quarantine**, **park**, dispatch-deadline **watchdog** trips,
+degradation-ladder **step-ups**, numerics **sentinel** trips and injected
+**ChaosCrash** deaths — dump the ring to disk through
+:func:`disco_tpu.io.atomic.atomic_write`, so the final dump path is either
+complete or absent (the repo-wide crash-safety invariant).
+
+Dumps are **byte-stable**: the JSON payload is a pure function of the ring
+contents (sorted keys, fixed separators), so dumping the same state twice
+yields identical bytes — what lets ``make scope-check`` pin a dump against
+a re-dump, and what makes dumps diffable across post-mortems.
+
+Like the :class:`~disco_tpu.obs.events.Recorder` and the
+:class:`~disco_tpu.obs.trace.Tracer`, the process-global
+:class:`FlightRecorder` is a strict no-op while disabled (one attribute
+check), and no flight failure may ever break the pipeline it observes:
+:func:`auto_dump` swallows I/O errors into a counter.
+
+No reference counterpart: the reference has no observability at all
+(SURVEY.md §5.1); the design is the standard black-box/flight-recorder
+pattern of long-lived serving stacks, sized down to a dependency-free ring
++ JSON dump.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+
+from disco_tpu.obs import events as _events
+from disco_tpu.obs import metrics as _metrics
+
+#: Default per-subsystem ring depth (entries, not bytes).
+DEFAULT_CAPACITY = 256
+
+#: The dump triggers wired through the stack (documentation + the
+#: ``flight`` event's ``trigger`` attr; runtime stays permissive so tests
+#: can dump under synthetic triggers).
+TRIGGERS = frozenset(
+    {
+        "quarantine",    # serve/scheduler.py: transport budget exhausted
+        "park",          # serve/scheduler.py: session parked
+        "watchdog",      # serve/scheduler.py: tick blew its dispatch deadline
+        "ladder_step_up",  # serve/ladder.py: the overload controller degraded
+        "sentinel",      # obs/sentinels.py: non-finite tensor detected
+        "chaos_crash",   # runs/chaos.py: injected in-process death
+        "manual",        # explicit dump() calls (CLI / tests)
+    }
+)
+
+
+class FlightRecorder:
+    """Bounded per-subsystem rings + atomic dump-on-trigger.
+
+    ``enable(dump_dir=...)`` arms collection; events flow in through
+    :meth:`add` (the obs recorder fans every event here — see
+    ``events.Recorder.record``) keyed by their stage (falling back to the
+    kind), each ring bounded at ``capacity``.  :meth:`dump` serializes a
+    deterministic snapshot through ``io.atomic``; :meth:`auto_dump` is the
+    trigger-site entry point — a no-op unless armed *with* a dump dir, and
+    exception-free by contract.
+    """
+
+    def __init__(self):
+        self.enabled = False
+        self.dump_dir = None
+        self.capacity = DEFAULT_CAPACITY
+        self._rings: dict = {}
+        self._lock = threading.Lock()
+        self._dump_seq = 0
+        self.entries_added = 0
+        self.dumps_written = 0
+
+    def enable(self, dump_dir=None, capacity: int = DEFAULT_CAPACITY) -> None:
+        from pathlib import Path
+
+        if capacity < 1:
+            raise ValueError(f"flight capacity must be >= 1, got {capacity}")
+        with self._lock:
+            self._rings.clear()
+            self.capacity = capacity
+            self.dump_dir = Path(dump_dir) if dump_dir is not None else None
+            self._dump_seq = 0
+            self.enabled = True
+        _events.refresh_sinks()
+
+    def disable(self) -> None:
+        with self._lock:
+            self.enabled = False
+            self._rings.clear()
+            self.dump_dir = None
+        _events.refresh_sinks()
+
+    # -- collection (hot path) -----------------------------------------------
+    def add(self, subsystem: str, kind: str, attrs: dict,
+            t_wall: float | None = None) -> None:
+        """Append one entry to a subsystem's ring (bounded: the deque drops
+        the oldest).  Called by the obs recorder for every event while
+        armed; safe from any thread.  ``flight`` events themselves are NOT
+        collected — a ring that ingests its own dump notices would never
+        dump the same bytes twice (the byte-stability contract)."""
+        if not self.enabled or kind == "flight":
+            return
+        entry = {"t": time.time() if t_wall is None else t_wall,
+                 "kind": kind, "attrs": attrs}
+        with self._lock:
+            ring = self._rings.get(subsystem)
+            if ring is None:
+                ring = self._rings[subsystem] = collections.deque(
+                    maxlen=self.capacity)
+            ring.append(entry)
+            self.entries_added += 1
+
+    # -- snapshot / dump -----------------------------------------------------
+    def snapshot(self) -> dict:
+        """{subsystem: [entry, ...]} — oldest first, a deep-enough copy that
+        a dump cannot race later appends."""
+        with self._lock:
+            return {k: [dict(e) for e in ring] for k, ring in self._rings.items()}
+
+    def dump_bytes(self, trigger: str, reason: str | None = None,
+                   snapshot: dict | None = None) -> bytes:
+        """The deterministic dump payload: a pure function of the ring
+        contents (sorted keys, fixed separators) — dumping unchanged state
+        twice yields identical bytes (the byte-stability scope-check pins).
+        ``snapshot``: reuse an already-taken :meth:`snapshot` (the dump
+        path takes exactly one, so the written bytes and the dump notice
+        can never disagree)."""
+        payload = {
+            "flight_recorder": 1,
+            "trigger": trigger,
+            "reason": reason,
+            "capacity": self.capacity,
+            "entries_added": self.entries_added,
+            "subsystems": self.snapshot() if snapshot is None else snapshot,
+        }
+        return (json.dumps(payload, sort_keys=True, default=_events._jsonable,
+                           separators=(",", ":")) + "\n").encode()
+
+    def dump(self, path=None, *, trigger: str = "manual",
+             reason: str | None = None):
+        """Write the ring snapshot atomically; returns the final path (or
+        None when no path could be derived).  ``path`` defaults to
+        ``<dump_dir>/flight-<seq:04d>-<trigger>.json`` — the sequence
+        number keeps repeated triggers from overwriting each other."""
+        from pathlib import Path
+
+        from disco_tpu.io.atomic import atomic_write
+
+        if path is None:
+            if self.dump_dir is None:
+                return None
+            with self._lock:
+                self._dump_seq += 1
+                seq = self._dump_seq
+            path = Path(self.dump_dir) / f"flight-{seq:04d}-{trigger}.json"
+        snap = self.snapshot()
+        data = self.dump_bytes(trigger, reason, snapshot=snap)
+        path = Path(path)
+        with atomic_write(path) as fh:
+            fh.write(data)
+        self.dumps_written += 1
+        _metrics.REGISTRY.counter("flight_dumps").inc()
+        _events.record("flight", stage=None, trigger=trigger, reason=reason,
+                       path=str(path),
+                       n_entries=sum(len(v) for v in snap.values()))
+        return path
+
+    def auto_dump(self, trigger: str, reason: str | None = None):
+        """The trigger-site seam: dump if armed with a dump dir, swallow
+        any failure into ``flight_dump_errors`` — a post-mortem aid must
+        never break the pipeline it observes (obs package contract)."""
+        if not self.enabled or self.dump_dir is None:
+            return None
+        try:
+            return self.dump(trigger=trigger, reason=reason)
+        except BaseException as e:  # ChaosCrash included: a dump during a
+            # simulated death must not mask the death itself
+            from disco_tpu.runs.chaos import ChaosCrash
+
+            if isinstance(e, ChaosCrash):
+                raise
+            _metrics.REGISTRY.counter("flight_dump_errors").inc()
+            return None
+
+
+_FLIGHT = FlightRecorder()
+
+
+def flight() -> FlightRecorder:
+    """The process-global :class:`FlightRecorder`.
+
+    No reference counterpart (module docstring)."""
+    return _FLIGHT
+
+
+def enabled() -> bool:
+    """True while the flight recorder is collecting.
+
+    No reference counterpart (module docstring)."""
+    return _FLIGHT.enabled
+
+
+def enable(dump_dir=None, capacity: int = DEFAULT_CAPACITY) -> None:
+    """Arm the process-global flight recorder (``disco-serve
+    --flight-dir``, the scope-check gate).
+
+    No reference counterpart (module docstring)."""
+    _FLIGHT.enable(dump_dir=dump_dir, capacity=capacity)
+
+
+def disable() -> None:
+    """Disarm and clear the process-global flight recorder.
+
+    No reference counterpart (module docstring)."""
+    _FLIGHT.disable()
+
+
+def auto_dump(trigger: str, reason: str | None = None):
+    """Module-level :meth:`FlightRecorder.auto_dump` on the process-global
+    recorder — the one-liner the trigger sites call.
+
+    No reference counterpart (module docstring)."""
+    return _FLIGHT.auto_dump(trigger, reason=reason)
+
+
+def dump(path=None, *, trigger: str = "manual", reason: str | None = None):
+    """Module-level :meth:`FlightRecorder.dump` on the process-global
+    recorder.
+
+    No reference counterpart (module docstring)."""
+    return _FLIGHT.dump(path, trigger=trigger, reason=reason)

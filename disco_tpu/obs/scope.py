@@ -1,0 +1,348 @@
+"""``make scope-check`` — the causal-tracing / flight-recorder / live-status
+gate (the twelfth gate).
+
+Runs the enhancement server in-process on the CPU backend (hermetic:
+loopback only, compile cache off, ONE jax process, zero SIGKILLs — the
+serve-check discipline) with tracing, the flight recorder and the corpus
+tap all armed, and asserts the disco-scope acceptance contract:
+
+1. **Chain completeness**: every delivered frame of every traced client
+   reconstructs a COMPLETE causal chain from client seq to tap shard —
+   ``client_block → enqueue → dispatch → readback → deliver → tap`` —
+   with intact parent links and causal hop order
+   (:func:`disco_tpu.obs.trace.verify_chain`), while every session's
+   output stays **bit-identical** to the offline ``streaming_tango`` run
+   (tracing must observe, never perturb).
+2. **Back-compat**: a pre-span client (``trace=False`` — no ``trace``
+   header on the wire) is served unchanged (bit-exact) and leaves ZERO
+   span events naming its session.
+3. **Status/registry agreement**: the read-only ``status`` protocol frame
+   answers without a session, and its ``counters`` section equals
+   ``obs.REGISTRY.snapshot()["counters"]`` exactly; the SLO evaluator
+   renders a verdict over it.
+4. **Fault leg**: an injected transport fault (the scheduler's fakeable
+   dispatch hook) exhausts the retry budget, quarantines the session, and
+   the flight recorder auto-dumps — the dump must **name the failing
+   span** (a ``dispatch`` span with ``failed: true`` and the fault's
+   error text, same trace as the wounded block) and be **byte-stable**
+   (dumping the unchanged ring again yields identical bytes).  The
+   wounded session then finishes bit-exact after the injector clears —
+   quarantine cost latency, never correctness.
+
+No reference counterpart: the reference has no serving layer and no
+telemetry (SURVEY.md §2, §5.1).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import tempfile
+import threading
+from pathlib import Path
+
+K, C, U = 4, 2, 4
+BLOCK = 2 * U
+
+#: the serve chain every delivered frame must reconstruct (tap included:
+#: the gate runs with the corpus tap armed)
+CHAIN = ("client_block", "enqueue", "dispatch", "readback", "deliver", "tap")
+
+
+def _scene(seed, L=6000):
+    import numpy as np
+
+    from disco_tpu.core.dsp import stft
+
+    rng = np.random.default_rng(seed)
+    Y = np.asarray(stft(rng.standard_normal((K, C, L)).astype(np.float32)))
+    F, T = Y.shape[-2:]
+    m = rng.uniform(0.05, 0.95, size=(K, F, T)).astype(np.float32)
+    return Y, m
+
+
+def _offline(Y, m, **kw):
+    import numpy as np
+
+    from disco_tpu.enhance.streaming import streaming_tango
+
+    return np.asarray(streaming_tango(Y, m, m, update_every=U,
+                                      policy="local", **kw)["yf"])
+
+
+def _config(F, **kw):
+    from disco_tpu.serve import SessionConfig
+
+    return SessionConfig(n_nodes=K, mics_per_node=C, n_freq=F,
+                         block_frames=BLOCK, update_every=U, **kw)
+
+
+def _check_chains_and_status(failures: list, tmp: Path) -> dict:
+    """Experiments 1-3: traced clients + one pre-span client through a
+    tap-armed loopback server; chain completeness, bit-parity, back-compat
+    and status/registry agreement."""
+    import numpy as np
+
+    from disco_tpu.flywheel import CorpusTap
+    from disco_tpu.obs.metrics import REGISTRY
+    from disco_tpu.serve import EnhanceServer, ServeClient
+    from disco_tpu.serve.status import evaluate_slo, status_section
+
+    specs = [  # (seed, config kwargs, traced?)
+        (71, {}, True),
+        (72, {"mu": 1.2}, True),
+        (73, {"lambda_cor": 0.97}, True),
+        (74, {}, False),   # the pre-span client: no trace header on the wire
+    ]
+    scenes = [(_scene(seed), ckw, traced) for seed, ckw, traced in specs]
+    refs = [_offline(Y, m, **{k: v for k, v in ckw.items()})
+            for (Y, m), ckw, _tr in scenes]
+    F = scenes[0][0][0].shape[-2]
+
+    tap = CorpusTap(tmp / "tap", records_per_shard=8)
+    srv = EnhanceServer(max_sessions=8, tap=tap)
+    addr = srv.start()
+    results = [None] * len(scenes)
+    session_ids = [None] * len(scenes)
+    errors: list = []
+
+    def worker(i):
+        (Y, m), ckw, traced = scenes[i]
+        try:
+            cl = ServeClient(addr, trace=True if traced else False)
+            session_ids[i] = cl.open(_config(F, **ckw),
+                                     session_id=f"scope{i}")
+            results[i] = cl.enhance_clip(Y, m, m)
+            cl.close()
+            cl.shutdown()
+        except Exception as e:
+            errors.append(f"scope client {i}: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(scenes))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    failures.extend(errors)
+
+    # quiesce the tap BEFORE the exact counters comparison: its background
+    # writer thread bumps tap_shards_written/tap_blocks asynchronously, and
+    # a shard landing between the server-side snapshot and the local one
+    # would read as disagreement (the gate demands exact equality)
+    tap_stats = tap.close()
+    if tap_stats["blocks_dropped"]:
+        failures.append(
+            f"tap dropped {tap_stats['blocks_dropped']} blocks at gate load")
+
+    # experiment 3 while the server is still up and idle: the status frame
+    # must agree with the counters registry EXACTLY
+    status_client = ServeClient(addr)
+    status = status_client.status(timeout_s=30)
+    status_client.shutdown()
+    counters_now = REGISTRY.snapshot()["counters"]
+    if status_section(status, "counters") != counters_now:
+        drift = {
+            k: (status_section(status, "counters").get(k), counters_now.get(k))
+            for k in set(status_section(status, "counters")) | set(counters_now)
+            if status_section(status, "counters").get(k) != counters_now.get(k)
+        }
+        failures.append(f"status counters disagree with the registry: {drift}")
+    for name in ("sessions", "scheduler", "latency", "inflight", "gauges"):
+        if name not in status:
+            failures.append(f"status frame missing the {name!r} section")
+    slo = evaluate_slo(status, {"serve_p95_ms": 60000.0,
+                                "queue_wait_p95_ms": 60000.0})
+    if slo["verdict"] != "OK" or len(slo["checks"]) != 4:
+        failures.append(f"SLO evaluator returned {slo} on a healthy idle server")
+    srv.stop()
+
+    for i, ref in enumerate(refs):
+        if results[i] is None:
+            failures.append(f"session {i} returned nothing")
+        elif not np.array_equal(results[i], ref):
+            failures.append(
+                f"session {i} ({'traced' if scenes[i][2] else 'pre-span'}) "
+                f"output differs from offline streaming_tango — tracing "
+                f"perturbed the pipeline "
+                f"(max abs diff {np.abs(results[i] - ref).max():g})"
+            )
+    return {
+        "n_clients": len(scenes),
+        "n_blocks": sum(-(-ref.shape[-1] // BLOCK) for ref in refs[:3]),
+        "untraced_session": session_ids[3],
+        "session_ids": session_ids[:3],
+        "tap_shards": tap_stats["shards_written"],
+    }
+
+
+def _verify_chains(failures: list, events: list, info: dict) -> int:
+    """Experiment 1's log half: every delivered (session, seq) of every
+    traced client has a complete verified chain; experiment 2's half: the
+    pre-span session appears in ZERO span events."""
+    from disco_tpu.obs import trace as obs_trace
+
+    spans = [e for e in events if e["kind"] == "span"]
+    untraced = [e for e in spans
+                if e["attrs"].get("session") == info["untraced_session"]]
+    if untraced:
+        failures.append(
+            f"back-compat broken: {len(untraced)} span event(s) name the "
+            f"pre-span client's session {info['untraced_session']!r}"
+        )
+    # deliver spans are the per-frame terminals: group trace ids by
+    # (session, seq) and verify each one's full chain
+    delivered: dict = {}
+    for e in spans:
+        if e["stage"] == "deliver":
+            key = (e["attrs"].get("session"), e["attrs"].get("seq"))
+            delivered[key] = e["attrs"]["trace"]
+    expect_per_session = info["n_blocks"] // len(info["session_ids"])
+    n_verified = 0
+    for sid in info["session_ids"]:
+        seqs = sorted(seq for (s, seq) in delivered if s == sid)
+        if seqs != list(range(expect_per_session)):
+            failures.append(
+                f"session {sid}: deliver spans cover seqs {seqs}, expected "
+                f"0..{expect_per_session - 1} — not every delivered frame "
+                "is traced"
+            )
+            continue
+        for seq in seqs:
+            tid = delivered[(sid, seq)]
+            try:
+                obs_trace.verify_chain(events, tid, require=CHAIN)
+                n_verified += 1
+            except ValueError as e:
+                failures.append(f"chain verification failed: {e}")
+    return n_verified
+
+
+def _check_fault_dump(failures: list, tmp: Path) -> dict:
+    """Experiment 4: injected transport fault → quarantine → byte-stable
+    flight dump naming the failing span → bit-exact finish."""
+    import numpy as np
+
+    from disco_tpu.obs import flight as obs_flight
+    from disco_tpu.serve import EnhanceServer, ServeClient
+    from disco_tpu.serve.scheduler import set_dispatch_fault_injector
+
+    Y, m = _scene(81)
+    F = Y.shape[-2]
+    ref = _offline(Y, m)
+    dump_dir = tmp / "flight"
+    state = {"failures": 0}
+
+    def injector(session_id, seqs):
+        if session_id == "wounded" and state["failures"] < 3:
+            state["failures"] += 1
+            raise OSError("scope-check: injected transport fault")
+
+    # short quarantine so the wounded stream finishes inside the gate
+    srv = EnhanceServer(max_sessions=4, quarantine_ticks=3,
+                        tick_interval_s=0.001, dispatch_retries=2)
+    addr = srv.start()
+    set_dispatch_fault_injector(injector)
+    try:
+        cl = ServeClient(addr, trace=True)
+        cl.open(_config(F), session_id="wounded")
+        got = cl.enhance_clip(Y, m, m)
+        cl.close()
+        cl.shutdown()
+    finally:
+        set_dispatch_fault_injector(None)
+        srv.stop()
+    if state["failures"] < 3:
+        failures.append(
+            f"fault injector only fired {state['failures']}/3 times — the "
+            "retry budget was never exhausted, nothing was quarantined"
+        )
+    if not np.array_equal(got, ref):
+        failures.append(
+            "wounded session's post-quarantine output is not bit-exact "
+            f"(max abs diff {np.abs(got - ref).max():g})"
+        )
+    dumps = sorted(dump_dir.glob("flight-*-quarantine.json"))
+    if not dumps:
+        failures.append(
+            f"no quarantine flight dump under {dump_dir} "
+            f"(present: {[p.name for p in dump_dir.glob('*')]})"
+        )
+        return {"dumps": 0}
+    payload = json.loads(dumps[0].read_text())
+    entries = [e for ring in payload["subsystems"].values() for e in ring]
+    failing = [e for e in entries
+               if e["kind"] == "span" and e["attrs"].get("failed")]
+    if not failing:
+        failures.append(
+            "quarantine dump does not name the failing span "
+            "(no span entry with failed=true)"
+        )
+    elif "injected transport fault" not in failing[0]["attrs"].get("error", ""):
+        failures.append(
+            f"failing span names the wrong error: {failing[0]['attrs']}"
+        )
+    # byte-stability: the ring is quiet now (server stopped, recorder off
+    # for this leg's sinks) — two dumps of the unchanged state must be
+    # byte-identical
+    a = obs_flight.flight().dump(tmp / "stable_a.json", trigger="manual",
+                                 reason="byte-stability probe")
+    b = obs_flight.flight().dump(tmp / "stable_b.json", trigger="manual",
+                                 reason="byte-stability probe")
+    if Path(a).read_bytes() != Path(b).read_bytes():
+        failures.append(
+            "flight dump is not byte-stable: two dumps of the unchanged "
+            "ring differ"
+        )
+    return {"dumps": len(dumps), "failing_spans": len(failing),
+            "injected_failures": state["failures"]}
+
+
+def main(argv=None) -> int:
+    """Run the scope gate (``make scope-check``); exit 1 on any failure."""
+    import os
+
+    os.environ.setdefault("DISCO_TPU_COMPILE_CACHE", "off")
+    from disco_tpu import obs
+    from disco_tpu.obs import flight as obs_flight
+    from disco_tpu.obs import trace as obs_trace
+
+    failures: list[str] = []
+    with tempfile.TemporaryDirectory() as tmp:
+        tmp = Path(tmp)
+        obs_log = tmp / "scope_check.jsonl"
+        obs_trace.enable()
+        obs_flight.enable(dump_dir=tmp / "flight")
+        try:
+            with obs.recording(obs_log):
+                obs.write_manifest(tool="scope-check")
+                info = _check_chains_and_status(failures, tmp)
+                fault = _check_fault_dump(failures, tmp)
+                obs.record("counters", **obs.REGISTRY.snapshot())
+            events = obs.read_events(obs_log)  # schema-validating read
+            n_verified = _verify_chains(failures, events, info)
+            if not any(e["kind"] == "flight" for e in events):
+                failures.append("event log carries no flight events "
+                                "(dump notices missing)")
+        finally:
+            obs_trace.disable()
+            obs_flight.disable()
+
+    if failures:
+        for f in failures:
+            print(f"scope-check FAIL: {f}", file=sys.stderr)
+        return 1
+    print(json.dumps({
+        "scope_check": "ok",
+        "clients": info["n_clients"],
+        "chains_verified": n_verified,
+        "tap_shards": info["tap_shards"],
+        "flight_dumps": fault["dumps"],
+        "injected_failures": fault["injected_failures"],
+        "jax_processes": 1,
+        "sigkills_issued": 0,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

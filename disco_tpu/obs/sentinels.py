@@ -31,11 +31,26 @@ _CHECKS = _metrics.REGISTRY.counter("sentinel_checks")
 _TRIPS = _metrics.REGISTRY.counter("sentinel_trips")
 
 
+def _narrow_float(dtype: np.dtype) -> bool:
+    """True for sub-f32 float dtypes whose reductions must NOT run in
+    their own arithmetic: f16 (kind 'f') and the ml_dtypes extension
+    floats bf16/f8 (kind 'V' — numpy exposes registered custom dtypes as
+    void-kind).  Ints/bools/f32/f64 pass through untouched."""
+    return (dtype.kind in ("f", "V")) and dtype.itemsize < 4
+
+
 def _leaf_stats(arr: np.ndarray) -> dict:
     """Summary stats of one host array, split finite / non-finite.  Complex
     input: ``np.isfinite`` is False if either component is non-finite, and
-    magnitude stats are reported on ``abs``."""
+    magnitude stats are reported on ``abs``.  Narrow floats (bf16/f16 —
+    the PR-9 ``precision='bf16'`` compute lane) are upcast to float32
+    BEFORE reduction: the stats must use f32 accumulators, not inherit the
+    checked tensor's 8-bit-mantissa arithmetic (a bf16 mean over a long
+    tensor is itself wrong-but-plausible — exactly what a sentinel exists
+    to rule out)."""
     mag = np.abs(arr) if np.iscomplexobj(arr) else arr
+    if _narrow_float(mag.dtype):
+        mag = mag.astype(np.float32)
     finite = np.isfinite(mag)
     n_bad = int(arr.size - finite.sum())
     stats = {
@@ -53,25 +68,36 @@ def _leaf_stats(arr: np.ndarray) -> dict:
     return stats
 
 
-def check_finite(name: str, tree, stage: str | None = None) -> bool:
+def check_finite(name: str, tree, stage: str | None = None,
+                 precision: str | None = None) -> bool:
     """Record a ``sentinel`` event for every non-finite leaf of ``tree``.
 
     Args:
       name: what is being checked ("stft_Y", "mwf_yf", ...).
       tree: array / pytree of arrays (device or host).
       stage: pipeline stage to attribute a trip to (defaults to ``name``).
+      precision: the ACTIVE compute-lane precision ("f32"/"bf16" —
+        ``ops.resolve``); carried in the sentinel event's attrs so a trip
+        under the opt-in bf16 lane (PR 9) is attributable to the lane, not
+        misread as an f32 pipeline bug.
 
-    Returns True when every leaf is finite (always True when recording is
-    disabled — the check is skipped entirely; observability must never
-    change pipeline behavior, so this *records*, it does not raise).
+    Returns True when every leaf is finite (always True when NO event sink
+    is live — neither the JSONL recorder nor the flight ring
+    (``events.active()``); the check is skipped entirely, so the default
+    pipeline's async dispatch is untouched.  Observability must never
+    change pipeline behavior: this *records*, it does not raise).  A check
+    that tripped also triggers ONE flight-recorder dump when a dump dir is
+    armed (``obs.flight`` — the non-finite tensor's recent causal context
+    is exactly what the post-mortem needs).
     """
-    if not _events.enabled():
+    if not _events.active():
         return True
     import jax
 
     from disco_tpu.utils.resilience import resilient_to_host
 
     ok = True
+    tripped: list[str] = []
     leaves = jax.tree_util.tree_leaves(tree)
     for i, leaf in enumerate(leaves):
         # Device arrays: to_host (complex dtypes cannot cross the Axon tunnel
@@ -88,13 +114,29 @@ def check_finite(name: str, tree, stage: str | None = None) -> bool:
             arr = np.asarray(leaf)
         _CHECKS.inc()
         mag = np.abs(arr) if np.iscomplexobj(arr) else arr
+        if _narrow_float(mag.dtype):
+            mag = mag.astype(np.float32)  # f32 accumulators for bf16/f16 lanes
         if not np.isfinite(mag).all():
             ok = False
             _TRIPS.inc()
+            leaf_name = name if len(leaves) == 1 else f"{name}[{i}]"
+            tripped.append(leaf_name)
+            extra = {"precision": precision} if precision is not None else {}
             _events.record(
                 "sentinel",
                 stage=stage or name,
-                name=name if len(leaves) == 1 else f"{name}[{i}]",
+                name=leaf_name,
+                **extra,
                 **_leaf_stats(arr),
             )
+    if tripped:
+        # ONE dump per check, after the loop: a fully-diverged pytree must
+        # not serialize the ring once per leaf on the very path that just
+        # detected numerical distress
+        from disco_tpu.obs import flight as _flight
+
+        _flight.auto_dump(
+            "sentinel",
+            reason=f"non-finite {', '.join(tripped)} at {stage or name}",
+        )
     return ok

@@ -159,10 +159,15 @@ def tick(seam: str, **attrs) -> None:
     if hit != plan.after:
         return
     from disco_tpu.obs import events as _events
+    from disco_tpu.obs import flight as _flight
     from disco_tpu.obs.metrics import REGISTRY as _REGISTRY
 
     _REGISTRY.counter("chaos_crashes").inc()
     _events.record("fault", stage=seam, fault="chaos_crash", hit=hit, **attrs)
+    # the flight ring's last act before the simulated death: dump what led
+    # here (no-op unless armed; the dump is atomic, so even this crash
+    # cannot leave a torn post-mortem)
+    _flight.auto_dump("chaos_crash", reason=f"seam {seam!r} hit {hit}")
     raise ChaosCrash(seam, hit)
 
 

@@ -34,6 +34,14 @@ from disco_tpu.serve.scheduler import (
     set_dispatch_fault_injector,
 )
 from disco_tpu.serve.server import EnhanceServer
+from disco_tpu.serve.status import (
+    DEFAULT_SLO,
+    STATUS_SECTIONS,
+    evaluate_slo,
+    fetch_status,
+    status_payload,
+    status_section,
+)
 from disco_tpu.serve.session import (
     Session,
     SessionConfig,
@@ -45,18 +53,24 @@ from disco_tpu.serve.session import (
 
 __all__ = [
     "AdmissionError",
+    "DEFAULT_SLO",
     "DegradationLadder",
     "EnhanceServer",
     "QueueFull",
     "RUNGS",
+    "STATUS_SECTIONS",
     "Scheduler",
     "ServeClient",
     "ServeError",
     "Session",
     "SessionConfig",
     "SessionStateError",
+    "evaluate_slo",
+    "fetch_status",
     "load_session_state",
     "probe_session_state",
     "save_session_state",
     "set_dispatch_fault_injector",
+    "status_payload",
+    "status_section",
 ]

@@ -42,6 +42,7 @@ import time
 
 import numpy as np
 
+from disco_tpu.obs import trace as obs_trace
 from disco_tpu.serve import protocol
 from disco_tpu.serve.session import SessionConfig
 
@@ -70,6 +71,13 @@ class ServeClient:
       retry_seed: drives every backoff jitter draw (deterministic
         schedules; give concurrent clients distinct seeds to spread their
         reconnect storm).
+      trace: causal-tracing opt-in — True mints a trace/span header
+        (``disco_tpu.obs.trace``, stdlib-only) per submitted block and
+        rides it in the ``block`` frame so the server can thread the
+        block's end-to-end span chain; False never sends one (the
+        pre-span wire shape); None (default) follows the process-global
+        tracer (``obs.trace.enabled()``), so enabling tracing in-process
+        traces loopback clients with zero per-call-site wiring.
     """
 
     def __init__(self, address, timeout_s: float = 120.0, *,
@@ -77,12 +85,14 @@ class ServeClient:
                  connect_base_delay_s: float = 0.05,
                  reattach_retries: int = 3,
                  reattach_timeout_s: float = 15.0,
-                 retry_seed: int = 0):
+                 retry_seed: int = 0,
+                 trace: bool | None = None):
         self.timeout_s = timeout_s
         self.address = address
         self.connect_retries = int(connect_retries)
         self.connect_base_delay_s = float(connect_base_delay_s)
         self.reattach_timeout_s = float(reattach_timeout_s)
+        self._trace = trace
         self._reattach_left = int(reattach_retries)
         self._rng = random.Random(retry_seed)
         self.session_id: str | None = None
@@ -321,12 +331,28 @@ class ServeClient:
         seq = self.next_seq if seq is None else int(seq)
         if self.resend_from is not None and seq <= self.resend_from:
             self.resend_from = None      # resending from the rejection point
-        self._send({
+        frame = {
             "type": "block", "seq": seq,
             "Y": np.ascontiguousarray(Y, dtype=np.complex64),
             "mask_z": np.ascontiguousarray(mask_z, dtype=np.float32),
             "mask_w": np.ascontiguousarray(mask_w, dtype=np.float32),
-        })
+        }
+        if self._trace or (self._trace is None and obs_trace.enabled()):
+            # mint the causal root at submission: the client_block span is
+            # the chain's origin, and the wire header lets the server
+            # thread every later hop under it (a resend of the same seq
+            # after backpressure/reattach mints a fresh trace — honest:
+            # it IS a new submission).  With the process-global tracer off
+            # (explicit trace=True in a bare client process) the ids are
+            # minted without a local span event — the server-side chain
+            # then starts at its enqueue hop, by design.
+            ctx = obs_trace.root("client_block", seq=seq,
+                                 session=self.session_id)
+            if ctx is None:
+                ctx = obs_trace.SpanCtx(trace=obs_trace.new_id(),
+                                        span=obs_trace.new_id())
+            frame["trace"] = ctx.to_wire()
+        self._send(frame)
         self.next_seq = seq + 1
         return seq
 
@@ -366,6 +392,18 @@ class ServeClient:
                 self._send(frame)
                 sent_gen = self.reattaches
         return self.closed_info
+
+    def status(self, timeout_s=None) -> dict:
+        """Read-only live introspection: send one ``status`` frame, return
+        the server's ``status_ok`` payload.  Works with or without an open
+        session; session-level frames that arrive first are folded into
+        client state as usual."""
+        self._send({"type": "status"})
+        while True:
+            frame = self._next_frame(timeout_s)
+            if frame.get("type") == "status_ok":
+                return frame
+            self._fold(frame)
 
     def wait_closed(self, timeout_s=None) -> dict:
         """Wait for a server-initiated close (a drain) without sending

@@ -124,5 +124,13 @@ class DegradationLadder:
         )
         if to_rung > frm:
             obs_registry.counter("ladder_degrades").inc()
+            from disco_tpu.obs import flight as obs_flight
+
+            # a step-up is distress: dump the flight ring so the post-
+            # mortem has the ticks/spans that led here (no-op unless armed)
+            obs_flight.auto_dump(
+                "ladder_step_up",
+                reason=f"rung {frm}->{to_rung} ({RUNGS[to_rung]}): {reason}",
+            )
         else:
             obs_registry.counter("ladder_recoveries").inc()

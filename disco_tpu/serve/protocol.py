@@ -23,8 +23,14 @@ Frame types (client → server):
   priority sessions).
 * ``block``   — one streaming input block: ``seq`` (0-based block index),
   ``Y`` (K, C, F, T) complex64 mixture STFT frames, ``mask_z`` / ``mask_w``
-  (K, F, T) step-1/2 masks.
+  (K, F, T) step-1/2 masks; optionally ``trace`` — the causal-tracing
+  header (``{"trace": <id>, "span": <id>}``, ``disco_tpu.obs.trace``)
+  minted at submission so the server can thread the block's span chain.
+  **Back-compat**: the header is optional and unvalidated-by-rejection — a
+  pre-span client (no ``trace`` key) is served byte-for-byte unchanged.
 * ``close``   — no more blocks; flush and finish the session.
+* ``status``  — read-only live introspection: no session required, never
+  mutates anything; the server answers with one ``status_ok`` frame.
 
 Server → client:
 
@@ -40,6 +46,10 @@ Server → client:
   checkpointed and closed; stop sending new blocks.
 * ``closed``   — session over: ``blocks_done``, optional ``state_path`` of
   the checkpoint a resumed session can continue from.
+* ``status_ok`` — the ``status`` reply: the
+  :func:`~disco_tpu.serve.status.status_payload` sections (session states,
+  scheduler tick, ladder rung, counters/gauges, latency percentiles,
+  in-flight spans) — the ``disco-obs top`` / ``disco-obs slo`` surface.
 * ``error``    — admission rejection, eviction, protocol violation;
   ``code`` + human-readable ``message``.  Code ``parked`` is special: the
   session was parked (connection trouble or ladder shedding), and the

@@ -78,6 +78,8 @@ import time
 import numpy as np
 
 from disco_tpu.obs import events as obs_events
+from disco_tpu.obs import flight as obs_flight
+from disco_tpu.obs import trace as obs_trace
 from disco_tpu.obs.metrics import REGISTRY as obs_registry
 from disco_tpu.serve.session import (
     CLOSED,
@@ -423,9 +425,16 @@ class Scheduler:
             return None
         return np.asarray(plan.avail_streaming, np.float32)
 
-    def push_block(self, session: Session, seq: int, Y, mask_z, mask_w) -> None:
+    def push_block(self, session: Session, seq: int, Y, mask_z, mask_w,
+                   trace=None) -> None:
         """Accept one input block (I/O thread).  Validates shape/order and
-        enforces the queue bound (:class:`QueueFull` = backpressure)."""
+        enforces the queue bound (:class:`QueueFull` = backpressure).
+
+        ``trace``: the block frame's causal-trace header (a wire dict with
+        ``trace``/``span`` ids — ``obs.trace``), or None for a pre-span
+        client.  With tracing enabled, acceptance records the ``enqueue``
+        hop and threads the advanced context through the session; with it
+        disabled (or for untraced blocks) this costs one attribute check."""
         cfg = session.config
         if session.status not in (OPEN, DRAINING):
             raise QueueFull(f"session {session.id} is {session.status}")
@@ -457,7 +466,19 @@ class Scheduler:
                 f"session {session.id} input queue at max_queue_blocks="
                 f"{self.max_queue_blocks}; wait for enhanced blocks"
             )
-        session.push_block(seq, Y, np.asarray(mask_z), np.asarray(mask_w), time.time())
+        ctx = None
+        if obs_trace.enabled() and trace is not None:
+            ctx = obs_trace.from_wire(trace)
+            ctx = obs_trace.span(
+                "enqueue", ctx, session=session.id, seq=int(seq),
+                depth=session.queue_depth(),
+            )
+            obs_trace.tracer().inflight_begin(
+                (session.id, int(seq)), ctx, "enqueue",
+                session=session.id, seq=int(seq),
+            )
+        session.push_block(seq, Y, np.asarray(mask_z), np.asarray(mask_w),
+                           time.time(), trace_ctx=ctx)
         self._set_gauges()
 
     def request_close(self, session: Session) -> None:
@@ -472,6 +493,7 @@ class Scheduler:
             self._parked.pop(session.id, None)
         session.status = EVICTED
         session.error = reason
+        self._drop_traces(session)
         obs_registry.counter("session_evicted").inc()
         obs_events.record("session", stage="serve", action="evict",
                           session=session.id, reason=reason)
@@ -514,6 +536,7 @@ class Scheduler:
         obs_events.record("session", stage="serve", action="park",
                           session=session.id, reason=reason,
                           blocks_done=session.blocks_done)
+        obs_flight.auto_dump("park", reason=f"session {session.id}: {reason}")
         self._set_gauges()
         return True
 
@@ -593,6 +616,7 @@ class Scheduler:
         for s in expired:
             s.status = EVICTED
             s.error = f"parked session expired after {self.park_ttl_s:g}s TTL"
+            self._drop_traces(s)
             obs_registry.counter("park_expired").inc()
             obs_events.record("session", stage="serve", action="park_expire",
                               session=s.id, blocks_done=s.blocks_done)
@@ -651,6 +675,11 @@ class Scheduler:
             until_tick=session.quarantine_until_tick,
             error=f"{type(error).__name__}: {error}",
         )
+        obs_flight.auto_dump(
+            "quarantine",
+            reason=f"session {session.id} strike {session.quarantine_count}: "
+                   f"{type(error).__name__}: {error}",
+        )
         self._set_gauges()
 
     def _release_quarantined(self) -> None:
@@ -663,10 +692,22 @@ class Scheduler:
                                   action="unquarantine", session=s.id)
                 self._set_gauges()
 
+    def _drop_traces(self, session: Session) -> None:
+        """Terminal-state trace cleanup: a session that will never deliver
+        its pending blocks must not leave ghost entries in the tracer's
+        bounded in-flight table (an hours-long traced run would otherwise
+        fill MAX_INFLIGHT and stop tracking real blocks).
+
+        No reference counterpart (module docstring)."""
+        for seq in session.drain_traces():
+            obs_trace.tracer().inflight_end((session.id, seq))
+
     def _finish(self, session: Session) -> None:
         with self._lock:
             self._sessions.pop(session.id, None)
         session.status = CLOSED
+        self._drop_traces(session)
+        obs_registry.counter("session_closed").inc()
         obs_events.record("session", stage="serve", action="close",
                           session=session.id, blocks=session.blocks_done)
         self._set_gauges()
@@ -753,6 +794,8 @@ class Scheduler:
                     # `progress`, so they re-queue in order (bit-identical
                     # later retry) and the session cools off in quarantine
                     # instead of retrying into a sick tunnel every tick
+                    self._trace_dispatch_failed(session,
+                                                blocks[progress[0]:], e)
                     session.requeue_front(blocks[progress[0]:])
                     self._quarantine(session, e)
                 except Exception as e:
@@ -831,6 +874,8 @@ class Scheduler:
                 session.inflight += len(group)
                 done += len(group)
                 progress[0] = done
+                self._trace_dispatch(session, [b[0] for b in group],
+                                     len(group))
             else:
                 for seq, Y, mz, mw in group:
                     yf = self._dispatch_resilient(self._dispatch,
@@ -842,7 +887,56 @@ class Scheduler:
                     session.inflight += 1
                     done += 1
                     progress[0] = done
+                    self._trace_dispatch(session, [seq], 1)
         return done
+
+    def _trace_dispatch(self, session: Session, seqs: list, n_group: int) -> None:
+        """Record the ``dispatch`` hop for each just-dispatched block and
+        advance its stored trace head (dispatch thread).  ``wait_ms`` is
+        the enqueue→dispatch queue wait — the waterfall's admission-wait
+        attribution.  One attribute check when tracing is off or the
+        blocks are untraced.
+
+        No reference counterpart (module docstring)."""
+        if not obs_trace.enabled():
+            return
+        now = time.time()
+        for seq in seqs:
+            ctx = session.get_trace(seq)
+            if ctx is None:
+                continue
+            t_in = session.enqueued_at.get(seq)
+            ctx = obs_trace.span(
+                "dispatch", ctx, session=session.id, seq=int(seq),
+                tick=self.tick_no, group=n_group,
+                wait_ms=(round(max(now - t_in, 0.0) * 1e3, 3)
+                         if t_in is not None else None),
+            )
+            session.set_trace(seq, ctx)
+            obs_trace.tracer().inflight_update((session.id, int(seq)),
+                                               "dispatch")
+
+    def _trace_dispatch_failed(self, session: Session, blocks: list,
+                               error: BaseException) -> None:
+        """Record a FAILED ``dispatch`` span for the first undispatched
+        block of a transport-exhausted pop (dispatch thread).  The stored
+        trace head is NOT advanced — the eventual retry re-chains its own
+        ``dispatch`` hop from the same ``enqueue`` parent, so the surviving
+        chain stays linear while the flight dump still names the failing
+        span (the scope-check fault leg pins this).
+
+        No reference counterpart (module docstring)."""
+        if not obs_trace.enabled() or not blocks:
+            return
+        seq = blocks[0][0]
+        ctx = session.get_trace(seq)
+        if ctx is None:
+            return
+        obs_trace.span(
+            "dispatch", ctx, session=session.id, seq=int(seq),
+            tick=self.tick_no, failed=True,
+            error=f"{type(error).__name__}: {error}",
+        )
 
     def _dispatch_resilient(self, fn, session: Session, *args):
         """One dispatch under the transport-retry contract: transient
@@ -883,6 +977,11 @@ class Scheduler:
                    f"{self.tick_deadline_s:g}s dispatch deadline "
                    f"(finished in {deadline.elapsed_s():.3f}s); device "
                    f"probe ok in {probe['dur_s']}s",
+        )
+        obs_flight.auto_dump(
+            "watchdog",
+            reason=f"tick {self.tick_no} blew its "
+                   f"{self.tick_deadline_s:g}s dispatch deadline",
         )
 
     def _step_ladder(self, deadline_hits: int) -> None:
@@ -946,6 +1045,7 @@ class Scheduler:
             # this tick; the server unwinds cleanly and parked/checkpointed
             # sessions resume on a healthy attachment.
             self._dispatch_seq += 1
+            t_rb0 = time.perf_counter()
             host = call_with_retries(
                 device_get_tree, [yf for (_, _, yf, _, _) in units],
                 retries=self.dispatch_retries,
@@ -956,11 +1056,14 @@ class Scheduler:
                 jitter_seed=self.retry_seed + self._dispatch_seq,
                 label="serve_readback",
             )
+        readback_ms = round((time.perf_counter() - t_rb0) * 1e3, 3)
         now = time.time()
         lat_hist = obs_registry.histogram("serve_block_latency_ms")
         wait_hist = obs_registry.histogram("serve_queue_wait_ms")
         disp_hist = obs_registry.histogram("serve_dispatch_ms")
         deliveries = []
+        tracing = obs_trace.enabled()
+        delivered_ctx: dict = {}
         for (session, seqs, _, t_disp, raw), yf in zip(units, host):
             bf = session.config.block_frames
             for j, seq in enumerate(seqs):
@@ -968,6 +1071,20 @@ class Scheduler:
                 t_in = session.enqueued_at.pop(seq, None)
                 lat_s = (now - t_in) if t_in is not None else 0.0
                 lat_hist.observe(lat_s * 1e3)
+                if tracing:
+                    ctx = session.pop_trace(seq)
+                    if ctx is not None:
+                        ctx = obs_trace.span(
+                            "readback", ctx, session=session.id, seq=int(seq),
+                            tick=self.tick_no, readback_ms=readback_ms,
+                            n_blocks=n_blocks,
+                        )
+                        ctx = obs_trace.span(
+                            "deliver", ctx, session=session.id, seq=int(seq),
+                            latency_ms=round(lat_s * 1e3, 3),
+                        )
+                        delivered_ctx[(session.id, int(seq))] = ctx
+                        obs_trace.tracer().inflight_end((session.id, int(seq)))
                 if t_in is not None:
                     wait_ms = max(t_disp - t_in, 0.0) * 1e3
                     wait_hist.observe(wait_ms)
@@ -1001,7 +1118,9 @@ class Scheduler:
                 for j, (seq, Y, mz, mw) in enumerate(raw):
                     blk = (yf if len(seqs) == 1
                            else np.ascontiguousarray(yf[..., j * bf:(j + 1) * bf]))
-                    self.tap.offer(session.id, seq, Y, mz, mw, blk)
+                    self.tap.offer(session.id, seq, Y, mz, mw, blk,
+                                   trace=delivered_ctx.get((session.id,
+                                                            int(seq))))
         self.ticks_with_work += 1
         obs_registry.counter("serve_ticks").inc()
         obs_registry.counter("serve_blocks").inc(n_blocks)

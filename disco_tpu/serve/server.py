@@ -263,6 +263,17 @@ class EnhanceServer:
         """Handle one client frame (asyncio thread).  Returns False to end
         the connection."""
         kind = frame.get("type")
+        if kind == "status":
+            # read-only live introspection: allowed before (or without) an
+            # open session, never touches jax — session states, ladder
+            # rung, counters/gauges, latency percentiles and in-flight
+            # spans, all host-side reads under their own locks (the
+            # ``disco-obs top`` / ``slo`` surface)
+            from disco_tpu.serve.status import status_payload
+
+            self._post(conn, {"type": "status_ok",
+                              **status_payload(self.scheduler)})
+            return True
         if kind == "open":
             if conn.session is not None:
                 self._post(conn, {"type": "error", "code": "protocol",
@@ -361,6 +372,7 @@ class EnhanceServer:
                 self.scheduler.push_block(
                     conn.session, int(frame.get("seq", -1)),
                     frame.get("Y"), frame.get("mask_z"), frame.get("mask_w"),
+                    trace=frame.get("trace"),
                 )
             except QueueFull as e:
                 self._post(conn, {"type": "error", "code": "backpressure",

@@ -168,6 +168,12 @@ class Session:
         self.error: str | None = None
         #: wall-clock enqueue time per pending seq (latency accounting)
         self.enqueued_at: dict[int, float] = {}
+        #: causal-trace context per in-flight seq (obs.trace SpanCtx) —
+        #: populated only for traced blocks while tracing is enabled;
+        #: empty (and untouched) for pre-span clients, so back-compat is
+        #: structural.  Guarded by the queue lock: the I/O thread stores at
+        #: enqueue while the dispatch thread advances per hop.
+        self.trace_ctx: dict[int, object] = {}
         #: newest delivered (seq, yf) host blocks, bounded — the reattach
         #: replay buffer: outputs delivered while the connection was down
         #: are re-sent from here so a parked-and-reattached stream stitches
@@ -198,11 +204,48 @@ class Session:
         self.outage_tick = -(1 << 30)
 
     # -- input side (I/O thread) --------------------------------------------
-    def push_block(self, seq: int, Y, mask_z, mask_w, t_wall: float) -> None:
+    def push_block(self, seq: int, Y, mask_z, mask_w, t_wall: float,
+                   trace_ctx=None) -> None:
         with self._lock:
             self._pending.append((int(seq), Y, mask_z, mask_w))
             self.enqueued_at[int(seq)] = t_wall
             self.blocks_in = max(self.blocks_in, int(seq) + 1)
+            if trace_ctx is not None:
+                self.trace_ctx[int(seq)] = trace_ctx
+
+    def set_trace(self, seq: int, ctx) -> None:
+        """Advance one in-flight block's causal-trace head (dispatch
+        thread; see :attr:`trace_ctx`).
+
+        No reference counterpart (module docstring)."""
+        with self._lock:
+            self.trace_ctx[int(seq)] = ctx
+
+    def get_trace(self, seq: int):
+        """The block's current trace context, or None (untraced).
+
+        No reference counterpart (module docstring)."""
+        with self._lock:
+            return self.trace_ctx.get(int(seq))
+
+    def pop_trace(self, seq: int):
+        """Take (and drop) the block's trace context at delivery.
+
+        No reference counterpart (module docstring)."""
+        with self._lock:
+            return self.trace_ctx.pop(int(seq), None)
+
+    def drain_traces(self) -> list:
+        """Clear every stored trace context, returning the seqs — the
+        terminal-state cleanup (evict/close/park-expiry) that keeps the
+        tracer's in-flight table from accumulating ghost entries for
+        blocks that will never deliver.
+
+        No reference counterpart (module docstring)."""
+        with self._lock:
+            seqs = list(self.trace_ctx)
+            self.trace_ctx.clear()
+        return seqs
 
     def queue_depth(self) -> int:
         with self._lock:

@@ -455,3 +455,80 @@ def test_chunk_prefetcher_accepts_lazy_generators():
         pf.close()
     assert got == [0, 10, 20, 30]
     assert all(name == "disco-chunk-prefetch" for name in drained_on)
+
+
+def test_trace_ids_ride_shard_records_into_train_batch_spans(tmp_path, rng):
+    """disco-scope's flywheel leg: a traced delivered block's trace/span
+    ids survive the shard roundtrip, and reading the shard into training
+    windows records a ``train_batch`` span chaining under the tap hop —
+    the client→train end of the causal chain."""
+    from disco_tpu import obs
+    from disco_tpu.obs import trace as obs_trace
+
+    log = tmp_path / "fw.jsonl"
+    with obs.recording(log):
+        obs_trace.enable()
+        try:
+            tap = CorpusTap(tmp_path / "tap", records_per_shard=2)
+            ctxs = {}
+            for i in range(2):
+                b = _block(rng, seq=i)
+                ctx = obs_trace.root("client_block", seq=i, session="s1")
+                ctx = obs_trace.span("deliver", ctx, session="s1", seq=i)
+                ctxs[i] = ctx
+                assert tap.offer("s1", i, b["Y"], b["mask_z"], b["mask_w"],
+                                 b["yf"], trace=ctx)
+            tap.close()
+            (shard,) = list_shards(tmp_path / "tap")
+            _meta, records = read_shard(shard)
+            for i, rec in enumerate(records):
+                assert rec["trace"]["trace"] == ctxs[i].trace
+            ds = ShardDataset(tmp_path / "tap", win_len=4)
+            n = sum(1 for _ in ds.batches(2, epoch=0))
+            assert n >= 1
+        finally:
+            obs_trace.disable()
+    events = obs.read_events(log)
+    for i in range(2):
+        path = obs_trace.verify_chain(
+            events, ctxs[i].trace,
+            require=("client_block", "deliver", "tap", "train_batch"))
+        assert path[-1]["attrs"]["shard"] == shard.name
+    # untraced offers stay untraced end to end (back-compat)
+    tap2 = CorpusTap(tmp_path / "tap2", records_per_shard=1)
+    b = _block(rng, seq=0)
+    assert tap2.offer("s2", 0, b["Y"], b["mask_z"], b["mask_w"], b["yf"])
+    tap2.close()
+    (_m, (rec,)) = read_shard(list_shards(tmp_path / "tap2")[0])
+    assert "trace" not in rec
+
+
+def test_dropped_tap_offer_records_no_tap_span(tmp_path, rng):
+    """Mint-then-commit: a block the full tap queue DROPS must not log a
+    'tap' hop it never took — the chain may not claim a shard that does
+    not exist."""
+    from disco_tpu import obs
+    from disco_tpu.obs import trace as obs_trace
+
+    log = tmp_path / "drop.jsonl"
+    with obs.recording(log):
+        obs_trace.enable()
+        try:
+            tap = CorpusTap(tmp_path / "tap", max_queue_blocks=1,
+                            records_per_shard=1, start=False)
+            ctxs = []
+            for i in range(3):
+                b = _block(rng, seq=i)
+                ctx = obs_trace.root("client_block", seq=i, session="s1")
+                ctxs.append(ctx)
+                ok = tap.offer("s1", i, b["Y"], b["mask_z"], b["mask_w"],
+                               b["yf"], trace=ctx)
+                assert ok == (i < 1)
+            tap.close()
+        finally:
+            obs_trace.disable()
+    events = obs.read_events(log)
+    tap_spans = [e for e in events
+                 if e["kind"] == "span" and e["stage"] == "tap"]
+    assert len(tap_spans) == 1
+    assert tap_spans[0]["attrs"]["trace"] == ctxs[0].trace

@@ -30,8 +30,8 @@ def rule_ids(res):
 # -- registry ----------------------------------------------------------------
 def test_rule_catalog_shape():
     rules = analysis.get_rules()
-    assert len(rules) == 13
-    assert sorted(rules) == [f"DL{i:03d}" for i in range(1, 14)]
+    assert len(rules) == 14
+    assert sorted(rules) == [f"DL{i:03d}" for i in range(1, 15)]
     for rid, rule in rules.items():
         assert rule.id == rid and rule.name and rule.summary
 
@@ -341,9 +341,54 @@ def test_dl011_near_misses():
 def test_registries_extracted_from_source():
     root = analysis.repo_root()
     kinds = registries.event_kinds(root)
-    assert {"manifest", "clip", "fault", "session"} <= kinds
+    assert {"manifest", "clip", "fault", "session", "span", "flight"} <= kinds
     seams = registries.chaos_seams(root)
     assert {"mid_write", "serve_tick", "between_blocks"} <= seams
+    stages = registries.span_stages(root)
+    assert {"client_block", "enqueue", "dispatch", "readback", "deliver",
+            "tap", "train_batch"} <= stages
+    sections = registries.status_sections(root)
+    assert {"sessions", "counters", "gauges", "latency", "inflight"} <= sections
+
+
+# -- DL014 span-stage / status-section ----------------------------------------
+def test_dl014_flags_unregistered_span_stage():
+    src = ("from disco_tpu.obs import trace as obs_trace\n"
+           "obs_trace.span('despatch', ctx)\n")
+    assert rule_ids(lint(src, "disco_tpu/serve/foo.py",
+                         rules={"DL014"})) == ["DL014"]
+    # the root() form (stage kwarg) is checked too
+    src = ("from disco_tpu.obs import trace as obs_trace\n"
+           "obs_trace.root(stage='client_blok')\n")
+    assert rule_ids(lint(src, "disco_tpu/serve/foo.py",
+                         rules={"DL014"})) == ["DL014"]
+    # ... and the mint-then-commit form (record_span — the tap's shape)
+    src = ("from disco_tpu.obs import trace as obs_trace\n"
+           "obs_trace.record_span('tapp', ctx, parent=p)\n")
+    assert rule_ids(lint(src, "disco_tpu/flywheel/foo.py",
+                         rules={"DL014"})) == ["DL014"]
+
+
+def test_dl014_flags_unregistered_status_section():
+    src = ("from disco_tpu.serve.status import status_section\n"
+           "status_section(payload, 'counterz')\n")
+    assert rule_ids(lint(src, "disco_tpu/cli/foo.py",
+                         rules={"DL014"})) == ["DL014"]
+
+
+def test_dl014_near_misses():
+    src = """
+    from disco_tpu.obs import trace as obs_trace
+    from disco_tpu.serve.status import status_section
+    obs_trace.span("dispatch", ctx)          # registered hop
+    obs_trace.root("client_block", seq=1)    # registered root
+    obs_trace.span(stage_var, ctx)           # non-literal: skipped
+    status_section(payload, "counters")      # registered section
+    status_section(payload, name_var)        # non-literal: skipped
+    tree.span(3)                             # a DIFFERENT span() API
+    math.root(x)                             # a DIFFERENT root()
+    """
+    assert rule_ids(lint(src, "disco_tpu/serve/foo.py", rules={"DL014"})) == []
 
 
 # -- suppressions ------------------------------------------------------------

@@ -24,11 +24,15 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))  # for bench.py
 
 @pytest.fixture(autouse=True)
 def _clean_recorder():
-    """Every test starts and ends with recording off (the recorder is
-    process-global)."""
+    """Every test starts and ends with recording, tracing and the flight
+    recorder off (all three are process-global)."""
     obs.disable()
+    obs.trace.disable()
+    obs.flight.disable()
     yield
     obs.disable()
+    obs.trace.disable()
+    obs.flight.disable()
 
 
 # -- events / recorder ------------------------------------------------------
@@ -146,12 +150,28 @@ def test_emitted_log_conforms_to_schema(tmp_path):
         with GracefulInterrupt():
             request_stop("schema-test")  # emits "interrupted"
         obs.record("warning", stage="load_input", reason="schema-test")
+        # the causal-tracing + flight-recorder producers (obs.trace/flight)
+        from disco_tpu.obs import flight as obs_flight
+        from disco_tpu.obs import trace as obs_trace
+
+        obs_trace.enable()
+        try:
+            ctx = obs_trace.root("client_block", seq=0, session="s1")
+            obs_trace.span("enqueue", ctx, session="s1", seq=0)
+        finally:
+            obs_trace.disable()
+        obs_flight.enable(dump_dir=tmp_path / "flight")
+        try:
+            obs_flight.dump(trigger="manual", reason="schema-test")
+        finally:
+            obs_flight.disable()
         obs.record("counters", **obs.REGISTRY.snapshot())
     events = obs.read_events(log, validate=True)  # raises on any drift
     assert {e["kind"] for e in events} == {
         "manifest", "stage_end", "jit_trace", "sentinel", "clip", "epoch",
         "watchdog", "bench_result", "fault", "recovery", "degraded",
-        "run_start", "run_resume", "interrupted", "warning", "counters",
+        "run_start", "run_resume", "interrupted", "warning", "span",
+        "flight", "counters",
     }
 
 
@@ -163,6 +183,304 @@ def test_read_events_rejects_schema_drift(tmp_path):
     log.write_text("not json\n")
     with pytest.raises(ValueError, match="not valid JSON"):
         obs.read_events(log)
+
+
+# -- rotation (the size-bounded JSONL satellite) ----------------------------
+def test_recorder_rotation_spans_segments(tmp_path):
+    """A size-capped log rotates atomically (events.jsonl → events.N.jsonl)
+    and read_events transparently spans the segments in order."""
+    from disco_tpu.obs.events import rotated_segments
+
+    log = tmp_path / "events.jsonl"
+    with obs.recording(log, max_bytes=220):
+        for i in range(30):
+            obs.record("note", i=i)
+    segs = rotated_segments(log)
+    assert len(segs) >= 2, "no rotation at a 220-byte cap over 30 events"
+    assert segs[0].name == "events.1.jsonl"
+    # nothing lost, order preserved across every seam
+    events = obs.read_events(log)
+    assert [e["attrs"]["i"] for e in events] == list(range(30))
+    # the bound holds per segment (one in-flight line of slack)
+    for seg in segs:
+        assert seg.stat().st_size <= 220 + 120
+
+
+def test_recorder_rotation_schema_validates_and_appends_fresh(tmp_path):
+    """Re-enabling onto a rotated path keeps counting segments upward
+    instead of clobbering the history."""
+    from disco_tpu.obs.events import rotated_segments
+
+    log = tmp_path / "events.jsonl"
+    with obs.recording(log, max_bytes=150):
+        for i in range(6):
+            obs.record("note", i=i)
+    n0 = len(rotated_segments(log))
+    assert n0 >= 1
+    with obs.recording(log, max_bytes=150):
+        for i in range(6, 12):
+            obs.record("note", i=i)
+    assert len(rotated_segments(log)) > n0
+    assert [e["attrs"]["i"] for e in obs.read_events(log)] == list(range(12))
+
+
+def test_read_events_tolerates_torn_rotation_seam(tmp_path):
+    """A crash mid-append leaves a torn final line; after rotation that
+    tear sits at a segment seam and must be skipped — while a torn line in
+    the LIVE file (or mid-segment) still raises."""
+    good0 = '{"t": 1.0, "kind": "note", "stage": null, "attrs": {"i": 0}}'
+    good1 = '{"t": 3.0, "kind": "note", "stage": null, "attrs": {"i": 1}}'
+    torn = '{"t": 2.0, "kind": "no'
+    log = tmp_path / "events.jsonl"
+    (tmp_path / "events.1.jsonl").write_text(good0 + "\n" + torn)
+    log.write_text(good1 + "\n")
+    events = obs.read_events(log)
+    assert [e["attrs"]["i"] for e in events] == [0, 1]
+    # mid-segment corruption is NOT a seam tear: still an error
+    (tmp_path / "events.1.jsonl").write_text(torn + "\n" + good0 + "\n")
+    with pytest.raises(ValueError, match="not valid JSON"):
+        obs.read_events(log)
+    # and the live file keeps the strict contract
+    log.write_text(torn + "\n")
+    (tmp_path / "events.1.jsonl").unlink()
+    with pytest.raises(ValueError, match="not valid JSON"):
+        obs.read_events(log)
+
+
+# -- causal tracing (obs.trace) ---------------------------------------------
+def test_trace_disabled_is_strict_noop():
+    from disco_tpu.obs import trace as obs_trace
+
+    assert not obs_trace.enabled()
+    assert obs_trace.root("client_block") is None
+    ctx = obs_trace.SpanCtx(trace="t" * 16, span="s" * 16)
+    assert obs_trace.span("enqueue", ctx) is ctx  # unchanged, unrecorded
+    assert obs_trace.span("enqueue", None) is None
+
+
+def test_trace_from_wire_rejects_malformed_headers():
+    """A malformed trace header must degrade to untraced, never raise —
+    the pre-span back-compat contract at the protocol seam."""
+    from disco_tpu.obs import trace as obs_trace
+
+    assert obs_trace.from_wire(None) is None
+    assert obs_trace.from_wire("nope") is None
+    assert obs_trace.from_wire({"trace": 3, "span": "s"}) is None
+    assert obs_trace.from_wire({"trace": "", "span": "s"}) is None
+    assert obs_trace.from_wire({"trace": "x" * 99, "span": "s"}) is None
+    ctx = obs_trace.from_wire({"trace": "abc", "span": "def"})
+    assert ctx.trace == "abc" and ctx.span == "def"
+
+
+def test_trace_chain_reconstruction_and_verification(tmp_path):
+    from disco_tpu.obs import trace as obs_trace
+
+    log = tmp_path / "run.jsonl"
+    with obs.recording(log):
+        obs_trace.enable()
+        try:
+            ctx = obs_trace.root("client_block", seq=0, session="sA")
+            ctx = obs_trace.span("enqueue", ctx, session="sA", seq=0)
+            # a failed attempt forks off the chain; the retry re-chains
+            # from the same parent and the walk keeps only the survivors
+            obs_trace.span("dispatch", ctx, failed=True, error="boom")
+            ctx = obs_trace.span("dispatch", ctx, tick=3, wait_ms=1.5)
+            ctx = obs_trace.span("readback", ctx, tick=3, readback_ms=2.0)
+            ctx = obs_trace.span("deliver", ctx, session="sA", seq=0,
+                                 latency_ms=4.0)
+        finally:
+            obs_trace.disable()
+    events = obs.read_events(log)
+    (tid,) = obs_trace.trace_ids(events)
+    path = obs_trace.verify_chain(
+        events, tid,
+        require=("client_block", "enqueue", "dispatch", "readback", "deliver"))
+    assert [e["stage"] for e in path] == [
+        "client_block", "enqueue", "dispatch", "readback", "deliver"]
+    assert not path[2]["attrs"].get("failed")  # the fork is off the path
+    # waterfall renders every hop + the attribution fields
+    art = obs_trace.render_waterfall(events, tid)
+    for token in ("client_block", "queue-wait=1.50ms", "readback=2.00ms",
+                  "latency=4.00ms", "session=sA"):
+        assert token in art, art
+    # a chain missing its terminal hop fails loudly
+    with pytest.raises(ValueError, match="no 'tap' span"):
+        obs_trace.verify_chain(events, tid, require=("enqueue", "tap"))
+    with pytest.raises(ValueError, match="no span events"):
+        obs_trace.chain(events, "not-a-trace")
+
+
+def test_trace_cross_process_chain_stops_at_enqueue(tmp_path):
+    """A server-side log whose enqueue hop names a client-process root
+    (never recorded here) still reconstructs — the chain legitimately
+    starts at enqueue; a dangling parent anywhere else still raises."""
+    from disco_tpu.obs import trace as obs_trace
+
+    log = tmp_path / "run.jsonl"
+    with obs.recording(log):
+        obs_trace.enable()
+        try:
+            remote = obs_trace.SpanCtx(trace=obs_trace.new_id(),
+                                       span=obs_trace.new_id())
+            ctx = obs_trace.span("enqueue", remote, session="sB", seq=0)
+            ctx = obs_trace.span("dispatch", ctx, tick=1)
+        finally:
+            obs_trace.disable()
+    events = obs.read_events(log)
+    path = obs_trace.chain(events, remote.trace)
+    assert [e["stage"] for e in path] == ["enqueue", "dispatch"]
+    # drop the enqueue span: dispatch's dangling parent must now raise
+    broken = [e for e in events if e["stage"] != "enqueue"]
+    with pytest.raises(ValueError, match="broken chain"):
+        obs_trace.chain(broken, remote.trace)
+
+
+def test_obs_cli_trace_lists_and_renders(tmp_path, capsys):
+    from disco_tpu.obs import trace as obs_trace
+
+    log = tmp_path / "run.jsonl"
+    with obs.recording(log):
+        obs_trace.enable()
+        try:
+            ctx = obs_trace.root("client_block", seq=0, session="sC")
+            ctx = obs_trace.span("enqueue", ctx, session="sC", seq=0)
+            ctx = obs_trace.span("dispatch", ctx, tick=1, wait_ms=0.5)
+        finally:
+            obs_trace.disable()
+    ids = obs_cli.main(["trace", str(log)])
+    out = capsys.readouterr().out
+    assert len(ids) == 1 and ids[0] in out and "session=sC" in out
+    obs_cli.main(["trace", str(log), ids[0]])
+    out = capsys.readouterr().out
+    assert "client_block" in out and "waterfall" in out
+
+
+# -- flight recorder (obs.flight) -------------------------------------------
+def test_flight_ring_bounded_and_collects_without_recorder(tmp_path):
+    """The ring collects events with the JSONL sink OFF (that is the
+    point: post-mortems without foresight), bounded per subsystem."""
+    import json as json_mod
+
+    from disco_tpu.obs import flight as obs_flight
+
+    assert not obs.enabled()
+    obs_flight.enable(dump_dir=tmp_path, capacity=8)
+    try:
+        for i in range(50):
+            obs.record("note", stage="subsys", i=i)
+        snap = obs_flight.flight().snapshot()
+        assert len(snap["subsys"]) == 8
+        assert [e["attrs"]["i"] for e in snap["subsys"]] == list(range(42, 50))
+        a = obs_flight.dump(tmp_path / "a.json", trigger="manual", reason="t")
+        b = obs_flight.dump(tmp_path / "b.json", trigger="manual", reason="t")
+        # byte-stable: same ring state, identical bytes
+        assert a.read_bytes() == b.read_bytes()
+        payload = json_mod.loads(a.read_text())
+        assert payload["trigger"] == "manual"
+        assert [e["attrs"]["i"] for e in payload["subsystems"]["subsys"]] \
+            == list(range(42, 50))
+    finally:
+        obs_flight.disable()
+    # disarmed: strict no-op again
+    assert obs_flight.auto_dump("quarantine") is None
+    assert obs.record("note", i=0) is None
+
+
+def test_flight_auto_dump_names_trigger_and_records_event(tmp_path):
+    from disco_tpu.obs import flight as obs_flight
+
+    log = tmp_path / "run.jsonl"
+    with obs.recording(log):
+        obs_flight.enable(dump_dir=tmp_path / "dumps")
+        try:
+            obs.record("warning", stage="serve", reason="context")
+            p1 = obs_flight.auto_dump("quarantine", reason="s1 strike 1")
+            p2 = obs_flight.auto_dump("watchdog", reason="tick 9")
+        finally:
+            obs_flight.disable()
+    assert p1.name == "flight-0001-quarantine.json"
+    assert p2.name == "flight-0002-watchdog.json"
+    flights = [e for e in obs.read_events(log) if e["kind"] == "flight"]
+    assert [e["attrs"]["trigger"] for e in flights] == ["quarantine", "watchdog"]
+    assert obs.REGISTRY.peek_counter("flight_dumps") >= 2
+
+
+def test_flight_dump_without_dir_is_none_and_sentinel_trips_dump(tmp_path):
+    """auto_dump without a dump dir is a no-op; a sentinel trip triggers a
+    dump when armed with one (the sentinel → flight wiring)."""
+    from disco_tpu.obs import flight as obs_flight
+
+    obs_flight.enable()   # ring only, no dir
+    try:
+        assert obs_flight.auto_dump("sentinel") is None
+    finally:
+        obs_flight.disable()
+    log = tmp_path / "run.jsonl"
+    with obs.recording(log):
+        obs_flight.enable(dump_dir=tmp_path / "d")
+        try:
+            # a whole diverged pytree trips ONCE: one dump per check, one
+            # reason naming every bad leaf — not one ring write per leaf
+            obs.check_finite("state", (jnp.asarray([np.nan]),
+                                       jnp.ones(3),
+                                       jnp.asarray([np.inf])), stage="mwf")
+        finally:
+            obs_flight.disable()
+    dumps = list((tmp_path / "d").glob("flight-*-sentinel.json"))
+    assert len(dumps) == 1
+    assert "state[0], state[2]" in json.loads(dumps[0].read_text())["reason"]
+
+
+def test_check_finite_runs_in_flight_only_mode(tmp_path):
+    """The post-mortem-without-foresight mode: --flight-dir with NO
+    --obs-log must still run the sentinels and dump on a trip (check_finite
+    gates on events.active(), not the JSONL-only enabled())."""
+    from disco_tpu.obs import flight as obs_flight
+
+    assert not obs.enabled()
+    obs_flight.enable(dump_dir=tmp_path / "d")
+    try:
+        assert obs.check_finite("bad", jnp.asarray([np.nan]),
+                                stage="mwf") is False
+    finally:
+        obs_flight.disable()
+    dumps = list((tmp_path / "d").glob("flight-*-sentinel.json"))
+    assert len(dumps) == 1
+    payload = json.loads(dumps[0].read_text())
+    # the sentinel event itself is in the dumped ring (mwf subsystem)
+    kinds = [e["kind"] for e in payload["subsystems"].get("mwf", [])]
+    assert "sentinel" in kinds
+
+
+# -- sentinels under the bf16 lane (PR 9) -----------------------------------
+def test_check_finite_bf16_carries_precision_and_f32_stats(tmp_path):
+    """The bf16 compute lane's sentinel story: the event names the active
+    precision, and the tensor stats use f32 accumulators — a bf16 mean
+    over 4096 ones would stick near 256/4096 (8-bit mantissa), f32 gives
+    exactly 1.0."""
+    log = tmp_path / "run.jsonl"
+    bad = np.concatenate([[np.nan], np.ones(4095, np.float32)])
+    with obs.recording(log):
+        x = jnp.asarray(bad, dtype=jnp.bfloat16)
+        assert obs.check_finite("step2_yf", x, stage="mwf",
+                                precision="bf16") is False
+        # clean bf16 tensor: no trip, still no error from the cast path
+        assert obs.check_finite("clean", jnp.ones(16, jnp.bfloat16),
+                                precision="bf16") is True
+    (ev,) = [e for e in obs.read_events(log) if e["kind"] == "sentinel"]
+    assert ev["attrs"]["precision"] == "bf16"
+    assert ev["attrs"]["dtype"] == "bfloat16"
+    assert ev["attrs"]["n_nan"] == 1
+    assert ev["attrs"]["finite_mean"] == 1.0
+    assert ev["attrs"]["finite_absmax"] == 1.0
+
+
+def test_check_finite_f32_has_no_precision_attr(tmp_path):
+    log = tmp_path / "run.jsonl"
+    with obs.recording(log):
+        obs.check_finite("y", jnp.asarray([np.inf]), stage="mwf")
+    (ev,) = [e for e in obs.read_events(log) if e["kind"] == "sentinel"]
+    assert "precision" not in ev["attrs"]
 
 
 # -- metrics registry -------------------------------------------------------
@@ -511,6 +829,33 @@ def test_obs_compare_streaming_scan_lane_judged_like_serve(tmp_path):
     diff = obs_cli.main(["compare", rec("pre.json", 6700.0),
                          rec("cand.json", 6700.0, scan=50.0)])
     assert diff["verdict"] == "OK"
+
+
+def test_obs_compare_span_overhead_floor_gates_noise(tmp_path):
+    """span_overhead_ns: judged lower-is-better like a latency lane, but
+    with an absolute floor — nanosecond noise around the ≈0 disabled cost
+    never flags, a real (>1 µs) blow-up does, and a lost measured lane is
+    still a REGRESSION."""
+    def rec(path, span=None):
+        d = _bench_record(6700.0)
+        if span is not None:
+            d["span_overhead_ns"] = span
+        p = tmp_path / path
+        p.write_text(json.dumps(d))
+        return str(p)
+
+    old = rec("old.json", span=100.0)
+    # 8x worse but still under the 1 µs floor: noise, not a regression
+    assert obs_cli.main(
+        ["compare", old, rec("noise.json", span=800.0)])["verdict"] == "OK"
+    with pytest.raises(SystemExit):  # a real overhead appeared
+        obs_cli.main(["compare", old, rec("slow.json", span=5000.0)])
+    with pytest.raises(SystemExit):  # measured lane lost entirely
+        obs_cli.main(["compare", old, rec("lost.json")])
+    # pre-span baseline: candidate's lane rides along unjudged
+    assert obs_cli.main(
+        ["compare", rec("pre.json"), rec("cand.json", span=5000.0)]
+    )["verdict"] == "OK"
 
 
 def test_obs_compare_reads_event_log_bench_result(tmp_path):

@@ -1071,3 +1071,173 @@ def test_exhausted_mid_pop_requeues_only_undispatched_blocks():
     assert sorted(outs) == list(range(n_blocks))
     got = np.concatenate([outs[i] for i in range(n_blocks)], axis=-1)
     np.testing.assert_array_equal(got, ref)
+
+
+# -- disco-scope: causal tracing, status frame, pre-span back-compat ----------
+@pytest.fixture
+def _tracing():
+    """Tracing + a fresh obs log for the scope tests; everything off after."""
+    from disco_tpu import obs
+    from disco_tpu.obs import trace as obs_trace
+
+    obs_trace.enable()
+    yield obs_trace
+    obs_trace.disable()
+    obs.disable()
+
+
+def test_pre_span_client_served_unchanged(stream, _tracing, tmp_path):
+    """THE back-compat pin: a client that never sends a trace header (the
+    pre-span wire shape) is served bit-for-bit unchanged — even with
+    tracing enabled server-side — and leaves ZERO span events."""
+    from disco_tpu import obs
+    from disco_tpu.serve import EnhanceServer, ServeClient
+
+    Y, m, ref = stream
+    F = Y.shape[-2]
+    log = tmp_path / "serve.jsonl"
+    srv = EnhanceServer(max_sessions=2)
+    addr = srv.start()
+    try:
+        with obs.recording(log):
+            cl = ServeClient(addr, trace=False)
+            cl.open(_config(F), session_id="prespan")
+            yf = cl.enhance_clip(Y, m, m)
+            cl.close()
+            cl.shutdown()
+    finally:
+        srv.stop()
+    np.testing.assert_array_equal(yf, ref)
+    from disco_tpu import obs as obs_pkg
+
+    events = obs_pkg.read_events(log)
+    spans = [e for e in events if e["kind"] == "span"]
+    assert spans == [], f"pre-span client produced {len(spans)} span events"
+    # the session itself was served and closed normally
+    actions = [e["attrs"]["action"] for e in events if e["kind"] == "session"]
+    assert "open" in actions and "close" in actions
+
+
+def test_traced_client_chains_every_delivered_block(stream, _tracing, tmp_path):
+    """With tracing on end to end, every delivered block reconstructs the
+    serve chain client_block → enqueue → dispatch → readback → deliver,
+    and the output stays bit-exact (tracing observes, never perturbs)."""
+    from disco_tpu import obs
+    from disco_tpu.obs import trace as obs_trace
+    from disco_tpu.serve import EnhanceServer, ServeClient
+
+    Y, m, ref = stream
+    F, T = Y.shape[-2:]
+    n_blocks = -(-T // BLOCK)
+    log = tmp_path / "serve.jsonl"
+    srv = EnhanceServer(max_sessions=2)
+    addr = srv.start()
+    try:
+        with obs.recording(log):
+            cl = ServeClient(addr)   # trace=None: follows the enabled tracer
+            cl.open(_config(F), session_id="traced")
+            yf = cl.enhance_clip(Y, m, m)
+            cl.close()
+            cl.shutdown()
+    finally:
+        srv.stop()
+    np.testing.assert_array_equal(yf, ref)
+    events = obs.read_events(log)
+    delivered = {e["attrs"]["seq"]: e["attrs"]["trace"]
+                 for e in events if e["kind"] == "span"
+                 and e["stage"] == "deliver"
+                 and e["attrs"].get("session") == "traced"}
+    assert sorted(delivered) == list(range(n_blocks))
+    for seq, tid in delivered.items():
+        path = obs_trace.verify_chain(
+            events, tid,
+            require=("client_block", "enqueue", "dispatch", "readback",
+                     "deliver"))
+        # per-hop attribution rides the chain
+        stages = {e["stage"]: e["attrs"] for e in path}
+        assert stages["dispatch"]["wait_ms"] is not None
+        assert stages["readback"]["readback_ms"] >= 0.0
+        assert stages["deliver"]["latency_ms"] >= 0.0
+        assert stages["client_block"]["seq"] == seq
+
+
+def test_status_frame_agrees_with_registry(stream):
+    """The read-only status frame: works without an open session, its
+    counters section equals the registry snapshot exactly, and the SLO
+    evaluator judges it."""
+    from disco_tpu.obs.metrics import REGISTRY
+    from disco_tpu.serve import EnhanceServer, ServeClient, evaluate_slo
+    from disco_tpu.serve.status import fetch_status, status_section
+
+    Y, m, _ref = stream
+    F = Y.shape[-2]
+    srv = EnhanceServer(max_sessions=2)
+    addr = srv.start()
+    try:
+        cl = ServeClient(addr)
+        cl.open(_config(F), session_id="statustest")
+        cl.send_block(Y[..., :BLOCK], m[..., :BLOCK], m[..., :BLOCK])
+        cl.recv_enhanced(0, timeout_s=60)
+        status = cl.status(timeout_s=30)
+        assert status_section(status, "counters") == \
+            REGISTRY.snapshot()["counters"]
+        sessions = {s["id"]: s for s in status_section(status, "sessions")}
+        assert sessions["statustest"]["status"] == "open"
+        assert sessions["statustest"]["blocks_done"] == 1
+        lat = status_section(status, "latency")["serve_block_latency_ms"]
+        assert lat["count"] >= 1
+        # a sessionless probe sees the same surface (disco-obs top path)
+        bare = fetch_status(addr)
+        assert status_section(bare, "scheduler")["tick_no"] >= 1
+        # permissive targets: the registry is process-global, and earlier
+        # tests legitimately evicted sessions — shape is what is pinned
+        verdict = evaluate_slo(status, {"serve_p95_ms": 1e9,
+                                        "queue_wait_p95_ms": 1e9,
+                                        "max_drop_rate": 1.0,
+                                        "max_evict_rate": 1.0})
+        assert verdict["verdict"] == "OK" and len(verdict["checks"]) == 4
+        # ... and a tight target flips the verdict deterministically
+        tight = evaluate_slo(status, {"serve_p95_ms": 1e-9})
+        assert tight["verdict"] == "VIOLATED"
+        # unknown sections fail loudly at the accessor
+        with pytest.raises(KeyError, match="unknown status section"):
+            status_section(status, "countrz")
+        cl.close()
+        cl.shutdown()
+    finally:
+        srv.stop()
+
+
+def test_status_section_registry_matches_payload_schema(stream):
+    """Every registered STATUS_SECTIONS name is present in a real payload
+    and vice versa (the DL014 registry and the builder cannot drift)."""
+    from disco_tpu.serve import STATUS_SECTIONS, Scheduler, status_payload
+
+    Y, m, _ref = stream
+    F = Y.shape[-2]
+    sched = Scheduler(max_sessions=2)
+    sched.open_session(_config(F))
+    payload = status_payload(sched)
+    assert set(payload) == set(STATUS_SECTIONS)
+
+
+def test_evicted_session_clears_tracer_inflight(stream, _tracing):
+    """Terminal states drop the tracer's in-flight entries: an eviction
+    with pending traced blocks must not leave ghost spans growing the
+    bounded table forever (the `disco-obs top` live view would rot)."""
+    from disco_tpu.obs import trace as obs_trace
+    from disco_tpu.serve.scheduler import Scheduler
+
+    Y, m, _ref = stream
+    F = Y.shape[-2]
+    sched = Scheduler(max_sessions=2)
+    s = sched.open_session(_config(F), session_id="ghost")
+    for i in range(2):
+        lo, hi = i * BLOCK, (i + 1) * BLOCK
+        ctx = obs_trace.root("client_block", seq=i, session=s.id)
+        sched.push_block(s, i, Y[..., lo:hi], m[..., lo:hi], m[..., lo:hi],
+                         trace=ctx.to_wire())
+    assert obs_trace.tracer().inflight_snapshot()["count"] == 2
+    sched.evict(s, "test: slow client")
+    assert obs_trace.tracer().inflight_snapshot()["count"] == 0
+    assert s.trace_ctx == {}
