@@ -3,9 +3,9 @@
 
 PYTHON ?= python
 
-.PHONY: test coverage doc install native clean bench milestone-corpus dryrun lint-check trace-check obs-check fault-check chaos-check perf-check serve-check stream-check flywheel-check soak-check scope-check
+.PHONY: test coverage doc install native clean bench milestone-corpus dryrun lint-check trace-check race-check obs-check fault-check chaos-check perf-check serve-check stream-check flywheel-check soak-check scope-check
 
-test: lint-check trace-check obs-check fault-check chaos-check perf-check stream-check serve-check flywheel-check soak-check scope-check
+test: lint-check trace-check race-check obs-check fault-check chaos-check perf-check stream-check serve-check flywheel-check soak-check scope-check
 	$(PYTHON) -m pytest tests/ -q
 
 # Static-analysis gate (runs FIRST: it needs no jax, no device and ~2 s):
@@ -15,9 +15,11 @@ test: lint-check trace-check obs-check fault-check chaos-check perf-check stream
 # writes (DL004), jax-free serve client / lazy-jax CLIs (DL005), reference
 # citations (DL006), traced-float literals (DL007), never-SIGKILL (DL008),
 # registered obs kinds / chaos seams (DL009/DL010), explicit scan unroll
-# in the bit-exactness-gated modules (DL011), and fused-magnitude /
+# in the bit-exactness-gated modules (DL011), fused-magnitude /
 # precision-seam discipline (DL012: no abs(stft(...)), no bfloat16
-# literals outside ops/).  Zero unsuppressed findings, and every
+# literals outside ops/), and registered thread primitives (DL015:
+# Thread/Timer targets and Lock creations outside the disco-race
+# role/lock registries).  Zero unsuppressed findings, and every
 # suppression must carry a justification (DL000).
 # Hermetic by construction: the linter is stdlib-only and never touches
 # the chip claim (doc/source/static_analysis.rst).
@@ -40,6 +42,26 @@ lint-check:
 trace-check:
 	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= DISCO_TPU_COMPILE_CACHE=off \
 	    $(PYTHON) -m disco_tpu.analysis.trace.cli
+
+# Thread-contract gate (the thirteenth gate, right after trace-check —
+# hermetic and stdlib-only like lint, so it fails fast before the heavy
+# gates): disco-race builds a call graph rooted at the declared thread-role
+# registry (race/roles.py) and enforces the concurrency invariants that
+# lived only in docstrings until PR 13 — every Thread/Timer/executor/signal
+# spawn resolves to a registered role (DR001), jax-touching code reachable
+# only from jax_ok roles (DR002: the single-chip-claim contract), signal
+# handlers restricted to the flag-set allowlist (DR003: the PR 3
+# handler-in-lock bug class, now structural), no blocking calls under a
+# held lock (DR004), every lock registered + the global lock-acquisition
+# graph acyclic (DR005/DR006), no cross-role unlocked shared writes
+# (DR007), and the committed concurrency manifest
+# (disco_tpu/analysis/golden/threads.json) reproduced bit-identically
+# (DR008; `disco-race --update` after a REVIEWED topology change).  Zero
+# unsuppressed findings; every waiver justified (DR000).  No jax import
+# anywhere in the analyzer (pinned by test) — never touches the chip claim
+# (doc/source/static_analysis.rst, "Thread contracts").
+race-check:
+	$(PYTHON) -m disco_tpu.analysis.race.cli
 
 # Telemetry gates (run before the suite so drift fails fast):
 # 1. the bench trajectory must not regress between the last two committed

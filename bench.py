@@ -39,6 +39,7 @@ reference-bit-matching eigh lane in every record.
 import argparse
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -750,10 +751,27 @@ def main(argv=None):
         # UNAVAILABLE, as in BENCH_r02) must still leave a PARSEABLE record:
         # one JSON line naming the cause, then a nonzero exit.  A raw stack
         # trace is an artifact only a human can read.
+        # Name the backend when init got far enough to know it.  Probe
+        # BEFORE disarming the watchdog, and only when a backend is
+        # ALREADY initialized (xla_bridge._backends non-empty — merely
+        # having `jax` imported is not enough): default_backend() on an
+        # uninitialized jax would be the FIRST device use, and on the
+        # tunnel that claims the chip and can block indefinitely while
+        # the failure record must still print.
+        backend = None
+        try:
+            if "jax" in sys.modules:
+                from jax._src import xla_bridge as _xb
+
+                if getattr(_xb, "_backends", None):
+                    backend = sys.modules["jax"].default_backend()
+        except Exception:
+            backend = None
         if done is not None:
             done.set()
         record = {
             "metric": "rtf_8node_mwf_enhancement",
+            "backend": backend,
             "value": None,
             "unit": "x_realtime",
             "error": f"{type(e).__name__}: {e}"[:500],
@@ -850,8 +868,15 @@ def main(argv=None):
     except Exception:
         rtf_np = None
     vs = (r["rtf"] / rtf_np) if rtf_np else None
+    # the ACTIVE jax backend, recorded so `disco-obs compare` can refuse
+    # to judge a CPU-fallback run against an on-TPU baseline (the
+    # BENCH_r06 hazard: a silently-degraded backend poisons the r05
+    # trajectory with a bogus "regression")
+    import jax
+
     record = {
         "metric": "rtf_8node_mwf_enhancement",
+        "backend": jax.default_backend(),
         "value": round(r["rtf"], 2),
         "unit": "x_realtime",
         "vs_baseline": round(vs, 2) if vs else None,

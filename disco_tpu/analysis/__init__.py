@@ -10,7 +10,7 @@ CI via ``make lint-check`` (no jax import anywhere in the linter — the gate
 is hermetic and never touches the chip claim).
 
 * :mod:`.registry`     — Rule base class + ``DLnnn`` registry
-* :mod:`.rules`        — the eleven rule implementations (catalog in its docstring)
+* :mod:`.rules`        — the fifteen rule implementations (catalog in its docstring)
 * :mod:`.suppressions` — ``# disco-lint: disable=... -- justification`` parsing
 * :mod:`.registries`   — AST extraction of EVENT_KINDS / SEAMS (no imports)
 * :mod:`.runner`       — file collection + the lint engine (:func:`lint_paths`)

@@ -1,0 +1,40 @@
+"""disco_tpu.analysis.race — static thread-contract analysis.
+
+The paper's "distributed" arrays were simulated in one single-threaded
+process (SURVEY §0: inter-node communication is ``np.concatenate``), but
+this rebuild made concurrency real: the serve stack alone runs an asyncio
+I/O thread against a single jax dispatch thread, with prefetch loaders,
+the corpus-tap writer, watchdog timers, client readers and signal handlers
+around it — and every invariant that keeps those from deadlocking ("ONE
+jax thread per the chip-claim contract", "handlers only set flags", "never
+block a tick holding the registry lock") lived only in docstrings until
+this package.  ``disco-race`` turns them into whole-program checks over a
+statically built call graph, gated in CI as ``make race-check`` — the
+thirteenth gate, right after ``trace-check``.
+
+Like :mod:`disco_tpu.analysis` (disco-lint) the analyzer is stdlib-only:
+no jax import anywhere under ``race/`` (pinned by test), so the gate is
+hermetic and never touches the tunneled chip claim.
+
+* :mod:`.roles`      — the declared thread-role registry (every spawn site
+  must resolve into it) + the explicit dynamic-dispatch fallbacks
+* :mod:`.registries` — the named-lock registry (every ``threading.Lock``
+  must be a registered module- or instance-level attribute)
+* :mod:`.callgraph`  — AST index + module-qualified call resolution
+* :mod:`.checks`     — the DRnnn contract checks (catalog in its docstring)
+* :mod:`.manifest`   — the committed concurrency manifest
+  (``analysis/golden/threads.json``) and its drift diff
+* :mod:`.runner`     — the whole-program engine (:func:`analyze`)
+* :mod:`.cli`        — the ``disco-race`` console entry
+
+Suppressions reuse the shared machinery of
+:mod:`disco_tpu.analysis.suppressions` with the ``disco-race`` marker::
+
+    self.expired = True  # disco-race: disable=DR007 -- single bool store
+
+No reference counterpart: the reference repo is single-threaded end to end
+and has no static analysis of any kind.
+"""
+from disco_tpu.analysis.race.runner import RaceResult, analyze
+
+__all__ = ["RaceResult", "analyze"]

@@ -1,0 +1,95 @@
+"""The named-lock registry: every lock in the repo, by declaration.
+
+Lock-order analysis needs stable lock *identities*: a deadlock cycle is a
+statement about specific locks, so every ``threading.Lock``/``RLock``/
+``Condition`` must be a named module- or instance-level attribute
+registered here.  The id format is
+
+* ``module::name``        — module-level lock (``disco_tpu.runs.interrupt::_lock``)
+* ``module:Class::attr``  — instance lock assigned ``self.attr = Lock()``
+  (``disco_tpu.flywheel.tap:CorpusTap::_lock``)
+
+The value is the one-line statement of WHAT the lock guards — reviewers
+review that sentence when a new lock lands, exactly like the obs
+``EVENT_KINDS`` / chaos ``SEAMS`` registries.  A ``Lock()`` created
+outside this table is a DR005 finding (and DL015 at lint time): an
+anonymous lock cannot participate in the order analysis, so it is an
+unreviewed deadlock surface.
+
+Per-instance locks (one lock object per Counter/Session instance) are
+registered once by their attribute id: the order analysis is about the
+*classes* of locks code acquires, not object identity — two instances of
+the same attr id never nest in the repo's designs, and if a design ever
+needs that, the registry comment is where it gets said.
+
+No reference counterpart: the reference repo has no locks at all.
+"""
+from __future__ import annotations
+
+#: lock id -> what it guards (the reviewed contract line)
+LOCKS = {
+    "disco_tpu.utils.compile_cache::_lock":
+        "the on-disk compile-cache manifest during read-modify-write",
+    "disco_tpu.io.fastwav::_lock":
+        "lazy one-time dlopen of libfastwav",
+    "disco_tpu.nn.fastload::_lock":
+        "lazy one-time dlopen of libfastloader",
+    "disco_tpu.enhance.driver::_FIG_LOCK":
+        "matplotlib's non-thread-safe figure state across scoring workers",
+    "disco_tpu.nn.training::_STEP_FNS_LOCK":
+        "the lazily-built jitted train/eval step cache",
+    "disco_tpu.serve.scheduler::_STEP_LOCK":
+        "the lazily-resolved serve step-callable cache",
+    "disco_tpu.runs.interrupt::_lock":
+        "the active GracefulInterrupt scope stack",
+    "disco_tpu.runs.chaos:_Plan::lock":
+        "a chaos plan's hit counter (ticked from any seam's thread)",
+    "disco_tpu.runs.ledger:RunLedger::_lock":
+        "ledger append + in-memory state (dispatch loop vs tap writer)",
+    "disco_tpu.flywheel.tap:CorpusTap::_lock":
+        "tap writer-thread lifecycle (start-once) and the ChaosCrash "
+        "stash handoff between the writer and close()",
+    "disco_tpu.serve.session:Session::_lock":
+        "one session's queue/state (I/O thread pushes, dispatch pops)",
+    "disco_tpu.serve.scheduler:Scheduler::_lock":
+        "the session registry; NEVER held across device work "
+        "(Scheduler docstring)",
+    "disco_tpu.serve.server:EnhanceServer::_conns_lock":
+        "the live-connection set (asyncio thread vs drain)",
+    "disco_tpu.obs.events:Recorder::_lock":
+        "the JSONL sink file handle + rotation state",
+    "disco_tpu.obs.trace:Tracer::_lock":
+        "the in-flight span table",
+    "disco_tpu.obs.flight:FlightRecorder::_lock":
+        "the per-subsystem rings + dump bookkeeping",
+    "disco_tpu.obs.metrics:Counter::_lock":
+        "one counter's value (scoring workers vs main)",
+    "disco_tpu.obs.metrics:Histogram::_lock":
+        "one histogram's reservoir",
+    "disco_tpu.obs.metrics:Registry::_lock":
+        "the instrument name tables (get-or-create)",
+}
+
+
+#: Functions that ASSUME a lock is already held by their caller (the
+#: ``_locked`` suffix convention) — the analyzer seeds their held set so
+#: writes inside them are judged as lock-guarded.  Each entry is a
+#: reviewed contract: "every caller of this function holds that lock".
+ASSUMED_LOCKS = {
+    "disco_tpu.obs.events:Recorder._rotate_locked": (
+        "disco_tpu.obs.events:Recorder::_lock",
+    ),
+}
+
+
+def lock_id(module: str, cls, attr: str) -> str:
+    """The registry id for a lock assigned at ``module`` level (``cls``
+    None) or as ``self.attr`` inside ``cls`` — the ONE id-construction
+    rule shared by DL015 (rules/threads.py) and this registry's readers."""
+    return f"{module}:{cls}::{attr}" if cls else f"{module}::{attr}"
+
+
+def is_registered(lid: str) -> bool:
+    """Whether a lock id is in the registry (the DL015-side membership
+    check; the race engine consults its injectable ``Index.locks`` copy)."""
+    return lid in LOCKS

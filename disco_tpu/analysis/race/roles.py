@@ -1,0 +1,237 @@
+"""The thread-role registry: who runs on which thread, by declaration.
+
+A **role** names one kind of thread the repo deliberately runs, rooted at
+the exact functions those threads execute (entry points are
+``module:dotted.qualname`` — class and enclosing-function names dotted in,
+no ``<locals>`` marker).  The race analyzer builds the call graph from
+these roots; every ``threading.Thread(target=...)``, ``threading.Timer``,
+``executor.submit`` and ``signal.signal`` site in the repo must resolve to
+a registered entry point or is itself a finding (DR001) — an unregistered
+thread is an unreviewed concurrency surface.
+
+Role policy is part of the declaration:
+
+* ``jax_ok`` — only ``dispatch`` and ``main`` may reach jax-touching code
+  (the single-chip-claim contract of CLAUDE.md: every process claims the
+  tunneled chip at first jax use, so a second jax-entering thread contends
+  for the one claim; until this gate the contract was enforced by
+  convention plus DL005's narrow client/protocol carve-out);
+* ``flag_only`` — the ``signal_handler`` role runs at an arbitrary
+  bytecode boundary of the main thread, possibly INSIDE a non-reentrant
+  lock of the interrupted frame; its reachable code may only set flags
+  (no lock acquisition, no obs emission, no I/O — the PR 3 bug class,
+  checked structurally by DR003).
+
+No reference counterpart: the reference repo is single-threaded end to
+end (SURVEY §0).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Role:
+    """One declared thread role (module docstring)."""
+
+    name: str
+    #: ``module:dotted.qualname`` roots the threads of this role execute
+    entry_points: tuple
+    #: may code reachable from this role enter jax? (chip-claim contract)
+    jax_ok: bool = False
+    #: restricted to the flag-set allowlist (signal handlers)
+    flag_only: bool = False
+    summary: str = ""
+
+
+#: name -> Role.  Every spawn site in the repo resolves into this table.
+ROLES = {
+    r.name: r
+    for r in (
+        Role(
+            "main",
+            entry_points=(
+                # operational entry points that run on the caller's thread
+                # CONCURRENTLY with the worker roles below: CLI mains, the
+                # gate harness mains, the server/tap/prefetcher lifecycle
+                # methods an embedding caller drives.
+                "bench:main",
+                "__graft_entry__:entry",
+                "__graft_entry__:dryrun_multichip",
+                "disco_tpu.serve.server:EnhanceServer.start",
+                "disco_tpu.serve.server:EnhanceServer.stop",
+                "disco_tpu.serve.server:EnhanceServer.wait",
+                "disco_tpu.serve.server:EnhanceServer.serve_forever",
+                "disco_tpu.flywheel.tap:CorpusTap.start",
+                "disco_tpu.flywheel.tap:CorpusTap.close",
+                "disco_tpu.flywheel.tap:CorpusTap.stats",
+                "disco_tpu.enhance.pipeline:ChunkPrefetcher.__iter__",
+                "disco_tpu.enhance.pipeline:ChunkPrefetcher.close",
+                "disco_tpu.utils.resilience:DispatchDeadline.__enter__",
+                "disco_tpu.utils.resilience:DispatchDeadline.__exit__",
+                "disco_tpu.runs.interrupt:GracefulInterrupt.__enter__",
+                "disco_tpu.runs.interrupt:GracefulInterrupt.__exit__",
+                "disco_tpu.runs.interrupt:request_stop",
+                "disco_tpu.runs.interrupt:stop_requested",
+                "disco_tpu.enhance.driver:enhance_rirs_batched",
+                "disco_tpu.serve.check:main",
+                "disco_tpu.flywheel.check:main",
+                "disco_tpu.obs.scope:main",
+                "disco_tpu.runs.soak:main",
+            ),
+            jax_ok=True,
+            summary="the process main thread: CLI/check mains + the "
+                    "lifecycle methods embedding callers drive",
+        ),
+        Role(
+            "dispatch",
+            entry_points=("disco_tpu.serve.server:EnhanceServer._dispatch_loop",),
+            jax_ok=True,
+            summary="the single jax dispatch thread of the serve stack "
+                    "(the ONLY non-main thread allowed to enter jax)",
+        ),
+        Role(
+            "asyncio_io",
+            entry_points=(
+                "disco_tpu.serve.server:EnhanceServer.start._run",
+                "disco_tpu.serve.server:EnhanceServer._handle",
+            ),
+            summary="the serve event-loop thread: socket framing only, "
+                    "host-side, never jax",
+        ),
+        Role(
+            "prefetch_loader",
+            entry_points=(
+                "disco_tpu.enhance.pipeline:ChunkPrefetcher._run",
+                "disco_tpu.utils.transfer:prefetch_to_device.feeder",
+            ),
+            summary="background chunk/batch loaders: disk + numpy work "
+                    "overlapping device compute, never jax",
+        ),
+        Role(
+            "tap_writer",
+            entry_points=("disco_tpu.flywheel.tap:CorpusTap._run",),
+            summary="the corpus-tap shard writer: msgpack + io.atomic, "
+                    "never jax (DL005 pins the module; DR002 pins the role)",
+        ),
+        Role(
+            "watchdog_timer",
+            entry_points=(
+                "disco_tpu.utils.resilience:DispatchDeadline._fire",
+                "bench:_start_watchdog.fire",
+            ),
+            summary="watchdog timer threads: host-only telemetry, never "
+                    "interrupt or kill anything",
+        ),
+        Role(
+            "signal_handler",
+            entry_points=("disco_tpu.runs.interrupt:GracefulInterrupt._handler",),
+            flag_only=True,
+            summary="SIGTERM/SIGINT handlers: flag-set allowlist only "
+                    "(runs inside an arbitrary interrupted frame)",
+        ),
+        Role(
+            "client_reader",
+            entry_points=("disco_tpu.serve.client:ServeClient._read_loop",),
+            summary="the numpy-only serve client's socket reader thread",
+        ),
+        Role(
+            "harness_worker",
+            entry_points=(
+                "disco_tpu.serve.check:_check_parity.worker",
+                "disco_tpu.serve.check:_check_overload.worker",
+                "disco_tpu.obs.scope:_check_chains_and_status.worker",
+                "disco_tpu.flywheel.check:_check_tap_serve.worker",
+                "disco_tpu.runs.soak:_client_worker",
+                "bench:bench_serve.worker",
+            ),
+            summary="gate-harness loopback clients: concurrent numpy-only "
+                    "ServeClient drivers, never jax",
+        ),
+        Role(
+            "score_worker",
+            entry_points=(
+                "disco_tpu.enhance.driver:enhance_rirs_batched.score_unit",
+            ),
+            # jax_ok is DELIBERATE: in the pipelined default the workers
+            # score host arrays fetched by ONE batched readback and never
+            # enter jax, but the sequential escape hatch (--no-pipeline)
+            # still pays the per-clip ISTFT + device_get_tree ON the
+            # worker (_persist_and_score's time_domain=None branch).
+            # Threads share the process's single chip claim (CLAUDE.md
+            # forbids a second PROCESS, not a second thread), so this is
+            # contention, not a claim violation — tighten to jax_ok=False
+            # if the sequential path ever drops its device work.
+            jax_ok=True,
+            summary="corpus scoring pool workers: host-side in the "
+                    "pipelined default; the sequential escape hatch still "
+                    "does per-clip ISTFT+readback on the worker",
+        ),
+    )
+}
+
+
+#: Explicit dynamic-dispatch fallbacks: call sites the module-qualified
+#: resolver cannot see through (callables stored on ``self``, callback
+#: parameters) mapped to their real targets BY DECLARATION, so the call
+#: graph stays complete without guessing.  Key: ``caller_qual::callee
+#: text`` exactly as written at the site; value: tuple of function quals.
+#: An entry here is a reviewed statement of "this indirect call can only
+#: ever land on these functions" — extend it when a new callback seam
+#: appears (the manifest diff will prompt you).
+DYNAMIC_CALLS = {
+    # ChunkPrefetcher's injected loader: the corpus driver's chunk loader
+    # and the training batch feed's identity loader
+    "disco_tpu.enhance.pipeline:ChunkPrefetcher._run::self._load": (
+        "disco_tpu.enhance.driver:enhance_rirs_batched.load_chunk",
+    ),
+    # ChunkPrefetcher's injected stop poll (runs.interrupt.stop_requested)
+    "disco_tpu.enhance.pipeline:ChunkPrefetcher._run::self._stop_requested": (
+        "disco_tpu.runs.interrupt:stop_requested",
+    ),
+    # DispatchDeadline's on_expire callback: no in-repo caller passes one
+    # today (the scheduler polls .expired after the window instead); the
+    # empty tuple DECLARES that, and a future callback must be added here
+    "disco_tpu.utils.resilience:DispatchDeadline._fire::self.on_expire": (),
+    # the scoring pool's partial(_persist_and_score, ...) thunk
+    "disco_tpu.enhance.driver:enhance_rirs_batched.score_unit::score_fn": (
+        "disco_tpu.enhance.driver:_persist_and_score",
+    ),
+    # the GracefulInterrupt scope stack: scopes popped off module-level
+    # ``_active`` lose their static type, but every element is a
+    # GracefulInterrupt by construction
+    "disco_tpu.runs.interrupt:request_stop::scope._trip": (
+        "disco_tpu.runs.interrupt:GracefulInterrupt._trip",
+    ),
+    "disco_tpu.runs.interrupt:stop_requested::g._flush_telemetry": (
+        "disco_tpu.runs.interrupt:GracefulInterrupt._flush_telemetry",
+    ),
+}
+
+
+#: Declared instance-attribute types the resolver cannot infer from a
+#: constructor assignment (the attribute is bound from a parameter).
+#: ``"module:Class.attr" -> "module:Class"`` — lets ``self.tap.offer(...)``
+#: resolve through the declared type.
+ATTR_TYPES = {
+    "disco_tpu.serve.scheduler:Scheduler.tap": "disco_tpu.flywheel.tap:CorpusTap",
+    "disco_tpu.serve.server:EnhanceServer.scheduler": "disco_tpu.serve.scheduler:Scheduler",
+    "disco_tpu.serve.server:EnhanceServer.tap": "disco_tpu.flywheel.tap:CorpusTap",
+}
+
+
+def entry_point_index() -> dict:
+    """``entry qual -> role name`` over every registered role."""
+    out = {}
+    for role in ROLES.values():
+        for ep in role.entry_points:
+            out[ep] = role.name
+    return out
+
+
+def entry_point_leaves() -> frozenset:
+    """The last dotted component of every registered entry point — the
+    lexical surface DL015 (bare-thread lint rule) checks spawn targets
+    against without building the call graph."""
+    return frozenset(ep.rpartition(":")[2].rpartition(".")[2]
+                     for ep in entry_point_index())

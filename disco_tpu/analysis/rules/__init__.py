@@ -17,6 +17,7 @@ is registration order):
 * DL012 ``fused-magnitude-precision`` — :mod:`.magnitude`
 * DL013 ``adhoc-transport-retry`` — :mod:`.retryloop`
 * DL014 ``span-stage-status-section`` — :mod:`.registered`
+* DL015 ``bare-thread-primitive``  — :mod:`.threads`
 
 (DL000 ``lint-suppression`` is the engine's own hygiene rule — see
 :mod:`disco_tpu.analysis.suppressions`.)
@@ -34,6 +35,7 @@ from disco_tpu.analysis.rules import (  # noqa: F401  (import = register)
     retryloop,
     scanunroll,
     sigkill,
+    threads,
     tracedfloat,
     transfer,
 )

@@ -16,6 +16,12 @@ rule ids and suppressions that no finding actually needed are reported as
 No reference counterpart: the reference repo has no static analysis; the
 syntax follows the ``# noqa``/``# pylint: disable`` lineage with the
 justification made load-bearing instead of optional.
+
+The machinery is shared by every analyzer in this package: ``tool``
+selects the comment marker (``disco-lint`` by default; ``disco-race``
+passes its own name and hygiene rule id), so the race analyzer's waivers
+carry exactly the same syntax, the same mandatory justification and the
+same dead-waiver policing without a second implementation.
 """
 from __future__ import annotations
 
@@ -27,11 +33,16 @@ import tokenize
 from disco_tpu.analysis.findings import Finding
 from disco_tpu.analysis.registry import SUPPRESSION_RULE_ID, SUPPRESSION_RULE_NAME
 
-_PATTERN = re.compile(
-    r"#\s*disco-lint:\s*(?P<kind>file-disable|disable)\s*=\s*"
-    r"(?P<ids>[A-Za-z0-9_,\s-]*?)\s*(?:--\s*(?P<just>.*))?$"
-)
-_MARKER = re.compile(r"#\s*disco-lint\b")
+
+def _pattern(tool: str):
+    return re.compile(
+        rf"#\s*{re.escape(tool)}:\s*(?P<kind>file-disable|disable)\s*=\s*"
+        r"(?P<ids>[A-Za-z0-9_,\s-]*?)\s*(?:--\s*(?P<just>.*))?$"
+    )
+
+
+def _marker(tool: str):
+    return re.compile(rf"#\s*{re.escape(tool)}\b")
 
 
 @dataclasses.dataclass
@@ -45,18 +56,23 @@ class Suppression:
     used: bool = False
 
 
-def _hygiene(path, line, message) -> Finding:
-    return Finding(path=path, line=line, col=0, rule=SUPPRESSION_RULE_ID,
-                   name=SUPPRESSION_RULE_NAME, message=message)
+def _hygiene(path, line, message, hygiene_rule=None) -> Finding:
+    rid, name = hygiene_rule or (SUPPRESSION_RULE_ID, SUPPRESSION_RULE_NAME)
+    return Finding(path=path, line=line, col=0, rule=rid,
+                   name=name, message=message)
 
 
-def parse(rel: str, source: str, known_ids: frozenset):
+def parse(rel: str, source: str, known_ids: frozenset,
+          tool: str = "disco-lint", hygiene_rule=None):
     """Extract suppressions from ``source``.
 
-    Returns ``(suppressions, problems)`` — ``problems`` are DL000 findings
-    for malformed comments (bad syntax, unknown rule id, missing
-    justification).  A malformed comment suppresses nothing: failing open
-    would let a typo silently waive a rule.
+    Returns ``(suppressions, problems)`` — ``problems`` are hygiene-rule
+    findings (DL000 for disco-lint, DR000 for disco-race) for malformed
+    comments (bad syntax, unknown rule id, missing justification).  A
+    malformed comment suppresses nothing: failing open would let a typo
+    silently waive a rule.  ``tool`` selects the comment marker
+    (``# <tool>: disable=...``); ``hygiene_rule`` is the ``(id, name)``
+    pair the problems are reported under.
     """
     sups: list = []
     problems: list = []
@@ -76,36 +92,45 @@ def parse(rel: str, source: str, known_ids: frozenset):
         # degrade to "no suppressions" rather than crash the linter.
         return [], []
 
+    hyg_id = (hygiene_rule or (SUPPRESSION_RULE_ID, SUPPRESSION_RULE_NAME))[0]
+    marker, pattern = _marker(tool), _pattern(tool)
+    sample = "DLnnn" if tool == "disco-lint" else "DRnnn"
     for line, text in comments:
-        if not _MARKER.search(text):
+        if not marker.search(text):
             continue
-        m = _PATTERN.search(text)
+        m = pattern.search(text)
         if not m:
             problems.append(_hygiene(
                 rel, line,
-                "malformed disco-lint comment (expected "
-                "'# disco-lint: disable=DLnnn[,DLnnn] -- justification')",
+                f"malformed {tool} comment (expected "
+                f"'# {tool}: disable={sample}[,{sample}] -- justification')",
+                hygiene_rule,
             ))
             continue
         ids = [s.strip() for s in m.group("ids").split(",") if s.strip()]
         just = (m.group("just") or "").strip()
         ok = True
         if not ids:
-            problems.append(_hygiene(rel, line, "suppression names no rule ids"))
+            problems.append(_hygiene(rel, line, "suppression names no rule ids",
+                                     hygiene_rule))
             ok = False
         for rid in ids:
             if rid not in known_ids:
-                problems.append(_hygiene(rel, line, f"suppression names unknown rule id {rid!r}"))
-                ok = False
-            elif rid == SUPPRESSION_RULE_ID:
                 problems.append(_hygiene(
-                    rel, line, f"{SUPPRESSION_RULE_ID} (suppression hygiene) cannot be suppressed"))
+                    rel, line, f"suppression names unknown rule id {rid!r}",
+                    hygiene_rule))
+                ok = False
+            elif rid == hyg_id:
+                problems.append(_hygiene(
+                    rel, line, f"{hyg_id} (suppression hygiene) cannot be suppressed",
+                    hygiene_rule))
                 ok = False
         if not just:
             problems.append(_hygiene(
                 rel, line,
                 "suppression carries no justification (policy: every waiver "
                 "states WHY the flagged code honors the contract anyway)",
+                hygiene_rule,
             ))
             ok = False
         if not ok:
@@ -142,14 +167,15 @@ def apply(findings, suppressions):
     return kept, suppressed
 
 
-def unused_problems(rel: str, suppressions) -> list:
-    """DL000 findings for waivers that matched nothing."""
+def unused_problems(rel: str, suppressions, hygiene_rule=None) -> list:
+    """Hygiene findings for waivers that matched nothing."""
     return [
         _hygiene(
             rel, s.comment_line,
             f"unused suppression of {s.rule_id} (no finding on "
             f"{'this file' if s.line is None else f'line {s.line}'}): "
             "remove it, or the contract it waives has silently drifted",
+            hygiene_rule,
         )
         for s in suppressions
         if not s.used

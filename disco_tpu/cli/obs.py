@@ -437,6 +437,23 @@ def load_bench_record(path) -> dict:
     return d
 
 
+def backend_mismatch(old: dict, new: dict) -> str | None:
+    """The refusal message when two bench records come from different jax
+    backends, else None.  A CPU-fallback run regressing "5000x realtime →
+    3x" is not a performance signal, it is a broken environment — judging
+    it against an on-TPU baseline poisons the trajectory (the BENCH_r06
+    hazard: ROADMAP warns a CPU record must never become the baseline).
+    Records older than the ``backend`` field (BENCH_r01–r05) carry no
+    claim, so comparisons stay judged unless BOTH records disagree."""
+    ob, nb = old.get("backend"), new.get("backend")
+    if ob and nb and ob != nb:
+        return (f"refusing to judge records from different backends "
+                f"(baseline '{ob}' vs candidate '{nb}') — rerun the "
+                "candidate on the baseline's backend, or re-baseline "
+                "deliberately")
+    return None
+
+
 def compare_records(old: dict, new: dict, threshold: float = 0.05) -> dict:
     """Diff two bench records into {verdict, headline, rows}.  Verdict is on
     the headline RTF: REGRESSION below ``-threshold``, IMPROVED above
@@ -702,9 +719,19 @@ def main(argv=None):
         return cmd_top(args)
     if args.cmd == "slo":
         return cmd_slo(args)
-    diff = compare_records(
-        load_bench_record(args.old), load_bench_record(args.new), args.threshold
-    )
+    old_rec = load_bench_record(args.old)
+    new_rec = load_bench_record(args.new)
+    refusal = backend_mismatch(old_rec, new_rec)
+    if refusal:
+        import sys
+
+        # REFUSE, do not judge: exit 2 (usage-class), distinct from the
+        # regression exit 1, so CI can tell "wrong comparison" from
+        # "slower code"
+        print(f"disco-obs compare: {refusal} "
+              f"({args.old} vs {args.new})", file=sys.stderr)
+        raise SystemExit(2)
+    diff = compare_records(old_rec, new_rec, args.threshold)
     print(render_compare(diff))
     if diff["verdict"] == "REGRESSION":
         raise SystemExit(1)

@@ -184,7 +184,11 @@ class CorpusTap:
             obs_events.record("warning", stage="flywheel",
                               reason=f"tap writer died: {type(e).__name__}: {e}")
         except BaseException as e:  # ChaosCrash: a simulated process death
-            self._crashed = e      # must kill the run — re-raised at close()
+            # must kill the run — re-raised at close().  Under the lock:
+            # close() reads-and-clears the stash, and a writer that
+            # outlived its join timeout must never tear that exchange
+            with self._lock:
+                self._crashed = e
 
     def _rotate(self):
         """Finalize the buffered records as one shard: atomic write, then
@@ -239,8 +243,9 @@ class CorpusTap:
         self.ledger.close()
         obs_events.record("tap", stage="flywheel", action="close",
                           **self.stats())
-        if self._crashed is not None:
+        with self._lock:
             crash, self._crashed = self._crashed, None
+        if crash is not None:
             raise crash
         return self.stats()
 

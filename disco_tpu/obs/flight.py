@@ -167,7 +167,11 @@ class FlightRecorder:
         path = Path(path)
         with atomic_write(path) as fh:
             fh.write(data)
-        self.dumps_written += 1
+        with self._lock:
+            # any role's trigger site may dump (scheduler quarantine on the
+            # dispatch thread, chaos seams anywhere, manual CLI calls):
+            # the bump shares the ring lock like every other counter here
+            self.dumps_written += 1
         _metrics.REGISTRY.counter("flight_dumps").inc()
         _events.record("flight", stage=None, trigger=trigger, reason=reason,
                        path=str(path),

@@ -178,7 +178,11 @@ class Tracer:
         self._record(stage, ctx, parent=parent, **attrs)
 
     def _record(self, stage: str, ctx: SpanCtx, parent: str | None, **attrs):
-        self.spans_recorded += 1
+        with self._lock:
+            # spans flow in from the I/O thread (client block arrival), the
+            # dispatch thread (enqueue/dispatch/readback hops) and main —
+            # += alone drops counts exactly like the metrics Counter would
+            self.spans_recorded += 1
         _events.record("span", stage=stage, trace=ctx.trace, span=ctx.span,
                        parent=parent, **attrs)
 

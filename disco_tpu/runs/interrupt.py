@@ -7,10 +7,12 @@ operational consequence: the ONLY way to stop a long run is to ask it
 nicely, so stopping nicely must actually work.  :class:`GracefulInterrupt`
 is that story:
 
-* the **first** SIGTERM/SIGINT sets a flag, records an ``interrupted`` obs
-  event and returns — no exception is raised into the pipeline, so the
-  in-flight fenced dispatch drains normally and the current work unit
-  completes and persists (atomically, ``disco_tpu.io.atomic``);
+* the **first** SIGTERM/SIGINT sets flags and returns — nothing else: no
+  exception into the pipeline, and no telemetry from handler context (the
+  ``interrupted`` obs event is emitted by the next poll; the handler is
+  flag-only by the disco-race DR003 contract), so the in-flight fenced
+  dispatch drains normally and the current work unit completes and
+  persists (atomically, ``disco_tpu.io.atomic``);
 * the long-running loops (batched enhancement chunks, datagen scenes,
   training epochs) poll :func:`stop_requested` between units and wind down:
   flush the run ledger, record final counters, return partial results —
@@ -87,28 +89,32 @@ class GracefulInterrupt(contextlib.AbstractContextManager):
         self._prev: dict[int, object] = {}
         self._installed = False
         self._sigint_count = 0
-        self._telemetry_pending = False
         self._telemetry_sent = False
 
     # -- signal plumbing ----------------------------------------------------
-    def _trip(self, reason: str, in_signal_handler: bool = False) -> None:
+    def _trip(self, reason: str) -> None:
+        """Programmatic stop (``request_stop``, tests, chaos harness):
+        set the flags and emit immediately — normal code, locks allowed."""
+        # disco-race: disable=DR007 -- monotonic one-way flag: _trip (main) and the handler both only ever store True; a racing pair of stores is idempotent
         self.stopped = True
-        self.reason = self.reason or reason
-        if in_signal_handler:
-            # A signal handler runs on the main thread at an arbitrary
-            # bytecode boundary — possibly INSIDE obs's non-reentrant locks
-            # (Recorder._lock, Counter._lock).  Emitting telemetry here
-            # could self-deadlock the interrupted frame, so only flag it;
-            # the next stop_requested() poll (normal code) emits.
-            self._telemetry_pending = True
-        else:
-            self._flush_telemetry()
+        self.reason = self.reason or reason  # disco-race: disable=DR007 -- first-writer-wins string; both writers guard with `or`, and a torn outcome only affects the human-readable reason label
+        self._flush_telemetry()
 
     def _flush_telemetry(self) -> None:
+        # Unlocked fast path first: both flags are monotonic, so the
+        # common not-stopped/already-sent poll (this is the prefetch
+        # loader's per-iteration stop callback) pays no lock.  The SENT
+        # transition is lock-guarded: stop_requested() polls from ANY
+        # thread, and two pollers racing an unguarded check would both
+        # emit the one-shot `interrupted` event.  The emission itself
+        # happens OUTSIDE the lock — obs takes its own non-reentrant locks
+        # (disco-race DR004: never block or nest under a held lock).
         if not self.stopped or self._telemetry_sent:
             return
-        self._telemetry_sent = True
-        self._telemetry_pending = False
+        with _lock:
+            if self._telemetry_sent:
+                return
+            self._telemetry_sent = True
         from disco_tpu.obs import events as _events
         from disco_tpu.obs.metrics import REGISTRY as _REGISTRY
 
@@ -116,6 +122,16 @@ class GracefulInterrupt(contextlib.AbstractContextManager):
         _events.record("interrupted", reason=self.reason)
 
     def _handler(self, signum, frame):
+        # FLAG-ONLY by contract (disco-race DR003, the PR 3 bug class): a
+        # signal handler runs on the main thread at an arbitrary bytecode
+        # boundary — possibly INSIDE obs's non-reentrant locks
+        # (Recorder._lock, Counter._lock) or our own module _lock.
+        # Emitting telemetry or taking ANY lock here could self-deadlock
+        # the interrupted frame, so the handler stores the stop flags and
+        # returns; the next stop_requested() poll (normal code) emits.
+        # tests/test_race.py pins this shape from both sides: the live
+        # handler passes the gate, and a revert fixture that re-inlines
+        # the telemetry emission fails it.
         name = signal.Signals(signum).name
         if signum == signal.SIGINT:
             self._sigint_count += 1
@@ -123,7 +139,8 @@ class GracefulInterrupt(contextlib.AbstractContextManager):
                 # the operator insists: in-process unwind (contract-safe —
                 # never SIGKILL; resilience never catches KeyboardInterrupt)
                 raise KeyboardInterrupt(f"second {name}")
-        self._trip(name, in_signal_handler=True)
+        self.stopped = True
+        self.reason = self.reason or name
 
     # -- context protocol ---------------------------------------------------
     def __enter__(self):
@@ -147,7 +164,7 @@ class GracefulInterrupt(contextlib.AbstractContextManager):
                 _active.remove(self)
         if self._installed:
             for sig, prev in self._prev.items():
-                signal.signal(sig, prev)
+                signal.signal(sig, prev)  # disco-race: disable=DR001 -- restores the handler SAVED at __enter__ (whatever was installed before this scope); there is no static target to register
             self._installed = False
         self._flush_telemetry()  # a trip no poll observed still records
         return False

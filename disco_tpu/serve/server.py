@@ -432,7 +432,7 @@ class EnhanceServer:
                 if not deliveries:
                     time.sleep(self.tick_interval_s)
         except BaseException as e:  # ChaosCrash included: simulated death
-            self.crashed = e
+            self.crashed = e  # disco-race: disable=DR007 -- wait() reads the stash only after join() proves this thread dead (and clears it on the caller thread); the join is the happens-before edge a lock would duplicate
             self._shutdown_loop()
 
     def _post_enhanced(self) -> None:

@@ -254,7 +254,7 @@ class DispatchDeadline:
 
     def _fire(self) -> None:
         # timer thread: host-only telemetry, never touches jax, never kills
-        self.expired = True
+        self.expired = True  # disco-race: disable=DR007 -- one-way bool handoff: the timer only stores True; __enter__ resets to False strictly BEFORE arming the timer, and __exit__ cancels before the next window
         _DEADLINE_HITS.inc()
         _events.record(
             "fault", stage=self.label, fault="dispatch_deadline",

@@ -60,3 +60,35 @@ def test_time_queued_uses_median(monkeypatch):
     # deltas 0.5, 0.1, 0.9, 0.2, 0.25 -> sorted median = 0.25
     dt = bench._time_queued(lambda: 0, k=1, iters=5)
     assert abs(dt - 0.25) < 1e-12
+
+
+def test_bench_failure_record_names_backend(monkeypatch, capsys):
+    """Even a crashed run's one JSON line carries the active jax backend
+    (when init got far enough to know it) — the field `disco-obs compare`
+    uses to refuse cross-backend verdicts (the BENCH_r06 hazard)."""
+    import json
+
+    import pytest
+
+    import bench
+
+    def boom(**kw):
+        raise RuntimeError("synthetic backend failure")
+
+    # the probe reports only an ALREADY-initialized backend (asking an
+    # uninitialized jax would be a fresh chip claim on the tunnel — it
+    # must yield None there, never block): initialize CPU first so the
+    # reporting path is the one under test
+    import jax
+
+    assert jax.default_backend() == "cpu"
+    monkeypatch.setattr(bench, "bench_jax", boom)
+    monkeypatch.setenv("BENCH_WATCHDOG_S", "0")   # no watchdog thread
+    with pytest.raises(SystemExit) as exc:
+        bench.main([])
+    assert exc.value.code == 2
+    record = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert record["value"] is None
+    assert "synthetic backend failure" in record["error"]
+    assert "backend" in record            # None only if jax never initialized
+    assert record["backend"] == "cpu"     # conftest forces the CPU backend

@@ -30,8 +30,8 @@ def rule_ids(res):
 # -- registry ----------------------------------------------------------------
 def test_rule_catalog_shape():
     rules = analysis.get_rules()
-    assert len(rules) == 14
-    assert sorted(rules) == [f"DL{i:03d}" for i in range(1, 15)]
+    assert len(rules) == 15
+    assert sorted(rules) == [f"DL{i:03d}" for i in range(1, 16)]
     for rid, rule in rules.items():
         assert rule.id == rid and rule.name and rule.summary
 
@@ -504,6 +504,65 @@ def test_outside_root_targets_are_reported(tmp_path, capsys):
     assert res.outside == ["loose.py"]
     assert cli.main([str(f), "--rules", "DL001"]) == 0
     assert "outside the repo root" in capsys.readouterr().err
+
+
+# -- DL015 bare-thread-primitive ---------------------------------------------
+def test_dl015_flags_unregistered_thread_timer_and_lock():
+    src = """
+    import threading
+    _rogue_lock = threading.Lock()
+    def nope(): pass
+    t = threading.Thread(target=nope)
+    threading.Timer(2.0, nope)
+    """
+    res = lint(src, "disco_tpu/foo.py", rules={"DL015"})
+    assert rule_ids(res) == ["DL015"] * 3
+    assert "_rogue_lock" in res.findings[0].message       # unregistered id
+    assert "race-role entry point" in res.findings[1].message
+    # an anonymous (unassigned) lock can never be registered
+    res = lint("import threading\nlocks = [threading.Lock()]\n",
+               "disco_tpu/foo.py", rules={"DL015"})
+    assert rule_ids(res) == ["DL015"]
+    assert "anonymous" in res.findings[0].message or \
+        "not a module-level name" in res.findings[0].message
+
+
+def test_dl015_near_misses():
+    # a registered role entry-point leaf as target is clean anywhere...
+    src = """
+    import threading
+    class Tap:
+        def _run(self): pass
+        def start(self):
+            threading.Thread(target=self._run).start()
+    """
+    assert rule_ids(lint(src, "disco_tpu/foo.py", rules={"DL015"})) == []
+    # ...a registered lock attribute on its registered module:Class too
+    src = """
+    import threading
+    class CorpusTap:
+        def __init__(self):
+            self._lock = threading.Lock()
+    """
+    assert rule_ids(lint(src, "disco_tpu/flywheel/tap.py",
+                         rules={"DL015"})) == []
+    # somebody else's Lock is not threading's
+    src = "from mylib import Lock\nx = Lock()\n"
+    assert rule_ids(lint(src, "disco_tpu/foo.py", rules={"DL015"})) == []
+    # a file that never imports threading is skipped wholesale
+    src = "def Thread(target): pass\nThread(target=1)\n"
+    assert rule_ids(lint(src, "disco_tpu/foo.py", rules={"DL015"})) == []
+
+
+def test_dl015_timer_with_registered_leaf_is_clean():
+    src = """
+    import threading
+    class DispatchDeadlineLike:
+        def _fire(self): pass
+        def arm(self):
+            self._timer = threading.Timer(1.0, self._fire)
+    """
+    assert rule_ids(lint(src, "disco_tpu/foo.py", rules={"DL015"})) == []
 
 
 # -- the repo itself ---------------------------------------------------------

@@ -760,6 +760,43 @@ def test_obs_compare_ok_within_noise_and_improved(tmp_path, capsys):
     assert "VERDICT" in capsys.readouterr().out
 
 
+def test_obs_compare_refuses_cross_backend_records(tmp_path, capsys):
+    """The BENCH_r06 hazard closed: a CPU-fallback candidate must never be
+    judged against an on-TPU baseline — compare REFUSES (exit 2, distinct
+    from the regression exit 1) instead of reporting a bogus verdict."""
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    rec = _bench_record(6700.0)
+    rec["backend"] = "axon"
+    old.write_text(json.dumps(rec))
+    rec = _bench_record(6700.0)
+    rec["backend"] = "cpu"
+    new.write_text(json.dumps(rec))
+    with pytest.raises(SystemExit) as exc:
+        obs_cli.main(["compare", str(old), str(new)])
+    assert exc.value.code == 2
+    err = capsys.readouterr().err
+    assert "refusing to judge" in err and "axon" in err and "cpu" in err
+
+
+def test_obs_compare_backend_judged_when_matching_or_legacy(tmp_path):
+    """Same backend on both sides is judged normally, and records from
+    before the field existed (BENCH_r01–r05) carry no claim: comparisons
+    against them stay judged — obs-check's committed-trajectory invocation
+    must not start failing."""
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    rec = _bench_record(6700.0)
+    rec["backend"] = "axon"
+    old.write_text(json.dumps(rec))
+    new.write_text(json.dumps(rec))
+    assert obs_cli.main(["compare", str(old), str(new)])["verdict"] == "OK"
+    legacy = tmp_path / "legacy.json"
+    legacy.write_text(json.dumps(_bench_record(6700.0)))   # no backend field
+    assert obs_cli.main(["compare", str(legacy), str(new)])["verdict"] == "OK"
+    assert obs_cli.main(["compare", str(new), str(legacy)])["verdict"] == "OK"
+
+
 def test_obs_compare_reads_bench_r_wrappers_and_null_candidate(tmp_path):
     """The committed BENCH_r04→r05 trajectory must read as OK (this is the
     exact invocation `make obs-check` gates CI with), and a null candidate

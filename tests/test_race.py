@@ -1,0 +1,582 @@
+"""disco-race (disco_tpu.analysis.race): call-graph resolution incl. the
+declared dynamic-dispatch fallbacks, per-check true-positive + near-miss
+fixtures, the shared suppression machinery under the ``disco-race``
+marker, manifest determinism (the committed golden must rebuild
+bit-identically), the CLI exit codes + JSON schema (disco-lint key
+shape), the repo-wide self-run gate, and the three revert fixtures the
+ISSUE pins (handler-in-lock, jax-from-tap-thread, unregistered spawn).
+
+Miniature programs are analyzed fully in memory (``analyze(files=...)``)
+with their own role/lock registries, so every check is pinned against at
+least one violation it must catch and one nearby shape it must NOT flag.
+The revert fixtures re-analyze the REAL repo with one file's source
+mutated back to a buggy shape (``overrides=``) — proving the gate is
+load-bearing against exactly the regressions it was built for.
+"""
+from __future__ import annotations
+
+import json
+import signal as signal_mod
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from disco_tpu.analysis.race import analyze, manifest as manifest_mod
+from disco_tpu.analysis.race import runner as race_runner
+from disco_tpu.analysis.race.checks import CHECKS, HYGIENE_RULE
+from disco_tpu.analysis.race.roles import ROLES, Role
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+def role(name, *entry_points, jax_ok=False, flag_only=False):
+    return Role(name=name, entry_points=tuple(entry_points),
+                jax_ok=jax_ok, flag_only=flag_only, summary="test role")
+
+
+def mini(files, roles=(), locks=None, dynamic=None, attrs=None,
+         suppress=True):
+    """Analyze an in-memory miniature program with its own registries."""
+    return analyze(
+        files=[(rel, textwrap.dedent(src)) for rel, src in files.items()],
+        roles={r.name: r for r in roles},
+        locks=dict(locks or {}),
+        dynamic_calls=dict(dynamic or {}),
+        attr_types=dict(attrs or {}),
+        use_suppressions=suppress,
+        golden=False,
+    )
+
+
+def check_ids(res):
+    return [f.rule for f in res.findings]
+
+
+# -- catalog -----------------------------------------------------------------
+def test_check_catalog_shape():
+    assert sorted(CHECKS) == [f"DR{i:03d}" for i in range(1, 9)]
+    for cid, (name, summary) in CHECKS.items():
+        assert name and summary
+    assert HYGIENE_RULE == ("DR000", "race-suppression")
+
+
+# -- DR001 unregistered-thread ------------------------------------------------
+def test_dr001_flags_unregistered_spawns_and_passes_registered():
+    files = {"pkg/a.py": """
+        import threading
+        def run(): pass
+        def rogue(): pass
+        def main_():
+            threading.Thread(target=run).start()
+            threading.Thread(target=rogue).start()
+    """}
+    res = mini(files, roles=[role("worker", "pkg.a:run")])
+    assert check_ids(res) == ["DR001"]
+    assert "rogue" in res.findings[0].message
+
+
+def test_dr001_timer_signal_and_executor_forms():
+    files = {"pkg/a.py": """
+        import signal
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+        def fire(): pass
+        def handler(signum, frame): pass
+        def main_(cb):
+            threading.Timer(2.0, fire).start()
+            signal.signal(signal.SIGTERM, handler)
+            with ThreadPoolExecutor() as ex:
+                ex.submit(fire)
+            signal.signal(signal.SIGTERM, cb)   # unresolvable target
+    """}
+    res = mini(files, roles=[role("watchdog", "pkg.a:fire"),
+                             role("sig", "pkg.a:handler", flag_only=True)])
+    assert check_ids(res) == ["DR001"]
+    assert "'cb' does not resolve" in res.findings[0].message
+
+
+def test_dr001_stale_registry_entry_is_a_finding():
+    files = {"pkg/a.py": "def run(): pass\n"}
+    res = mini(files, roles=[role("worker", "pkg.a:gone")])
+    assert check_ids(res) == ["DR001"]
+    assert "not found in the program model" in res.findings[0].message
+
+
+# -- DR002 jax-outside-dispatch ----------------------------------------------
+_JAXY = {"pkg/a.py": """
+    import jax.numpy as jnp
+    import numpy as np
+    def worker():
+        helper()
+        return np.zeros(3)        # numpy is fine anywhere
+    def helper():
+        return jnp.zeros(3)
+"""}
+
+
+def test_dr002_flags_jax_reachable_from_hostonly_role():
+    res = mini(_JAXY, roles=[role("loader", "pkg.a:worker")])
+    assert check_ids(res) == ["DR002"]
+    assert "jnp.zeros" in res.findings[0].message
+    assert "pkg.a:worker -> pkg.a:helper" in res.findings[0].message
+
+
+def test_dr002_jax_ok_role_and_unreached_code_pass():
+    res = mini(_JAXY, roles=[role("driver", "pkg.a:worker", jax_ok=True)])
+    assert check_ids(res) == []
+    # helper unreached by any role: unconstrained
+    res = mini(_JAXY, roles=[])
+    assert check_ids(res) == []
+
+
+def test_dr002_sees_defs_nested_in_with_for_while_blocks():
+    """Functions declared inside with/for/while bodies (the check-harness
+    closure idiom) must enter the model — code reached through them must
+    not silently escape the reachability checks."""
+    files = {"pkg/a.py": """
+        import jax.numpy as jnp
+        def worker():
+            for _ in range(1):
+                def helper():
+                    return jnp.zeros(3)
+                helper()
+    """}
+    res = mini(files, roles=[role("loader", "pkg.a:worker")])
+    assert check_ids(res) == ["DR002"]
+
+
+def test_dr002_through_declared_dynamic_dispatch_fallback():
+    files = {"pkg/a.py": """
+        import jax
+        class P:
+            def __init__(self, cb):
+                self._cb = cb
+            def loop(self):
+                self._cb()
+        def jaxy():
+            return jax.device_get(1)
+    """}
+    # without the declared fallback the indirect call is invisible...
+    res = mini(files, roles=[role("loader", "pkg.a:P.loop")])
+    assert check_ids(res) == []
+    # ...the DYNAMIC_CALLS declaration closes the edge
+    res = mini(files, roles=[role("loader", "pkg.a:P.loop")],
+               dynamic={"pkg.a:P.loop::self._cb": ("pkg.a:jaxy",)})
+    assert check_ids(res) == ["DR002"]
+
+
+# -- DR003 signal-handler-unsafe ---------------------------------------------
+def test_dr003_flags_lock_and_blocking_in_handler_reach():
+    files = {"pkg/a.py": """
+        import threading
+        import time
+        _lock = threading.Lock()
+        def handler(signum, frame):
+            deeper()
+        def deeper():
+            with _lock:
+                pass
+            time.sleep(0.1)
+    """}
+    res = mini(files, roles=[role("sig", "pkg.a:handler", flag_only=True)],
+               locks={"pkg.a::_lock": "test"})
+    assert check_ids(res) == ["DR003", "DR003"]
+    assert "lock acquisition" in res.findings[0].message
+    assert "time.sleep" in res.findings[1].message
+
+
+def test_dr003_flag_set_only_handler_is_clean():
+    files = {"pkg/a.py": """
+        class G:
+            def handler(self, signum, frame):
+                self.stopped = True
+                self.reason = "sig"
+    """}
+    res = mini(files, roles=[role("sig", "pkg.a:G.handler", flag_only=True)])
+    assert check_ids(res) == []
+
+
+# -- DR004 blocking-under-lock ------------------------------------------------
+def test_dr004_direct_and_transitive_blocking_under_lock():
+    files = {"pkg/a.py": """
+        import queue
+        import threading
+        import time
+        _lock = threading.Lock()
+        q = queue.Queue()
+        def direct():
+            with _lock:
+                q.get()
+        def indirect():
+            with _lock:
+                helper()
+        def helper():
+            time.sleep(1.0)
+    """}
+    res = mini(files, locks={"pkg.a::_lock": "test"})
+    assert check_ids(res) == ["DR004", "DR004"]
+    assert ".get() without timeout" in res.findings[0].message
+    assert "may block" in res.findings[1].message
+
+
+def test_dr004_bounded_calls_and_unlocked_blocking_pass():
+    files = {"pkg/a.py": """
+        import queue
+        import threading
+        _lock = threading.Lock()
+        q = queue.Queue()
+        def bounded(t):
+            with _lock:
+                q.get(timeout=0.05)
+                q.put_nowait(1)
+                t.join(5.0)
+        def unlocked():
+            q.get()
+        def not_a_queue(d):
+            with _lock:
+                return d.get("key")   # dict.get takes args: not blocking
+    """}
+    res = mini(files, locks={"pkg.a::_lock": "test"})
+    assert check_ids(res) == []
+
+
+# -- DR005 unregistered-lock --------------------------------------------------
+def test_dr005_unregistered_and_anonymous_and_dead_entries():
+    files = {"pkg/a.py": """
+        import threading
+        _lock = threading.Lock()
+        _rogue = threading.Lock()
+        def f(x):
+            with x.some_lock:
+                pass
+    """}
+    res = mini(files, locks={"pkg.a::_lock": "test",
+                             "pkg.a::_gone": "no creation site"})
+    msgs = [f.message for f in res.findings]
+    assert check_ids(res) == ["DR005", "DR005", "DR005"]
+    assert any("pkg.a::_rogue" in m for m in msgs)            # unregistered
+    assert any("some_lock" in m for m in msgs)                # unresolvable
+    assert any("pkg.a::_gone" in m for m in msgs)             # dead entry
+
+
+def test_dr005_registered_instance_lock_is_clean():
+    files = {"pkg/a.py": """
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+    """}
+    res = mini(files, locks={"pkg.a:C::_lock": "test"})
+    assert check_ids(res) == []
+
+
+# -- DR006 lock-order-cycle ---------------------------------------------------
+def test_dr006_cycle_and_self_reacquire():
+    files = {"pkg/a.py": """
+        import threading
+        _A = threading.Lock()
+        _B = threading.Lock()
+        def f():
+            with _A:
+                with _B:
+                    pass
+        def g():
+            with _B:
+                helper()
+        def helper():
+            with _A:
+                pass
+    """}
+    res = mini(files, locks={"pkg.a::_A": "a", "pkg.a::_B": "b"})
+    assert check_ids(res) == ["DR006"]
+    assert "cycle" in res.findings[0].message
+    # self re-acquisition through a call is an instant deadlock
+    files = {"pkg/a.py": """
+        import threading
+        _A = threading.Lock()
+        def f():
+            with _A:
+                helper()
+        def helper():
+            with _A:
+                pass
+    """}
+    res = mini(files, locks={"pkg.a::_A": "a"})
+    assert check_ids(res) == ["DR006"]
+    assert "re-acquisition" in res.findings[0].message
+
+
+def test_dr006_consistent_order_is_clean():
+    files = {"pkg/a.py": """
+        import threading
+        _A = threading.Lock()
+        _B = threading.Lock()
+        def f():
+            with _A:
+                with _B:
+                    pass
+        def g():
+            with _A:
+                with _B:
+                    pass
+    """}
+    res = mini(files, locks={"pkg.a::_A": "a", "pkg.a::_B": "b"})
+    assert check_ids(res) == []
+
+
+# -- DR007 unlocked-shared-write ----------------------------------------------
+_SHARED = """
+    import threading
+    class C:
+        def __init__(self):
+            self.x = 0                      # construction: excluded
+            self._lock = threading.Lock()
+        def a(self):
+            {a_body}
+        def b(self):
+            {b_body}
+"""
+
+
+def _shared_files(a_body, b_body):
+    return {"pkg/a.py": _SHARED.format(a_body=a_body, b_body=b_body)}
+
+
+def test_dr007_two_roles_without_common_lock():
+    res = mini(_shared_files("self.x = 1", "self.x = 2"),
+               roles=[role("r1", "pkg.a:C.a"), role("r2", "pkg.a:C.b")],
+               locks={"pkg.a:C::_lock": "test"})
+    assert check_ids(res) == ["DR007"]
+    assert "'pkg.a:C.x'" in res.findings[0].message
+    assert "r1" in res.findings[0].message and "r2" in res.findings[0].message
+
+
+def test_dr007_common_lock_single_role_and_init_pass():
+    guarded = """
+            with self._lock:
+                self.x = 1"""
+    res = mini(_shared_files(guarded, guarded.replace("= 1", "= 2")),
+               roles=[role("r1", "pkg.a:C.a"), role("r2", "pkg.a:C.b")],
+               locks={"pkg.a:C::_lock": "test"})
+    assert check_ids(res) == []
+    # one role writing from two methods: no cross-role hazard
+    res = mini(_shared_files("self.x = 1", "self.x = 2"),
+               roles=[role("r1", "pkg.a:C.a", "pkg.a:C.b")],
+               locks={"pkg.a:C::_lock": "test"})
+    assert check_ids(res) == []
+
+
+# -- suppressions (shared machinery, disco-race marker) -----------------------
+def test_race_suppression_semantics():
+    src = """
+        import threading
+        _rogue = threading.Lock()  # disco-race: disable=DR005 -- test fixture lock
+    """
+    res = mini({"pkg/a.py": src}, locks={})
+    assert check_ids(res) == []
+    assert len(res.suppressed) == 1
+    finding, just = res.suppressed[0]
+    assert finding.rule == "DR005" and just == "test fixture lock"
+    # the disco-LINT marker must not waive a disco-RACE finding
+    src = """
+        import threading
+        _rogue = threading.Lock()  # disco-lint: disable=DL001 -- wrong tool
+    """
+    res = mini({"pkg/a.py": src}, locks={})
+    assert check_ids(res) == ["DR005"]
+
+
+def test_race_suppression_hygiene_dr000():
+    # missing justification and unused waivers are DR000 findings
+    src = """
+        import threading
+        _rogue = threading.Lock()  # disco-race: disable=DR005
+        x = 1  # disco-race: disable=DR004 -- waives nothing
+    """
+    res = mini({"pkg/a.py": src}, locks={})
+    rules = check_ids(res)
+    assert rules.count("DR000") == 2      # no justification + unused
+    assert "DR005" in rules               # malformed comment waives nothing
+
+
+# -- manifest -----------------------------------------------------------------
+def test_manifest_diff_reports_topology_drift():
+    files = {"pkg/a.py": """
+        import threading
+        _lock = threading.Lock()
+        def run():
+            with _lock:
+                pass
+    """}
+    res = mini(files, roles=[role("worker", "pkg.a:run")],
+               locks={"pkg.a::_lock": "test"})
+    m = res.manifest
+    assert m["roles"]["worker"]["locks_held"] == ["pkg.a::_lock"]
+    drifted = json.loads(json.dumps(m))
+    drifted["roles"]["worker"]["locks_held"] = []
+    msgs = manifest_mod.diff(drifted, m)
+    assert msgs and "locks_held" in msgs[0]
+    assert manifest_mod.diff(m, json.loads(json.dumps(m))) == []
+
+
+def test_committed_manifest_rebuilds_bit_identically_twice():
+    """Acceptance criterion: the committed golden is a pure function of
+    the source — two fresh rebuilds and the committed file all agree byte
+    for byte."""
+    committed = (ROOT / manifest_mod.GOLDEN_REL).read_text()
+    one = manifest_mod.dumps(analyze(golden=False).manifest)
+    two = manifest_mod.dumps(analyze(golden=False).manifest)
+    assert one == two
+    assert one == committed, (
+        "concurrency manifest drift vs the committed golden — review the "
+        "topology change and run `disco-race --update`"
+    )
+
+
+# -- the repo itself ----------------------------------------------------------
+def test_repo_analyzes_clean():
+    res = analyze()
+    assert res.findings == [], "\n".join(f.render() for f in res.findings)
+    assert res.n_files > 100
+    for f, just in res.suppressed:
+        assert just.strip(), f"unjustified suppression for {f.render()}"
+
+
+def test_shipped_race_suppressions_are_load_bearing():
+    """--no-suppressions must re-surface the real findings behind every
+    shipped waiver: deleting a waiver (or reverting the PR-13 fixes) fails
+    the gate."""
+    res = analyze(use_suppressions=False)
+    got = {(f.rule, f.path) for f in res.findings}
+    expected = {
+        ("DR001", "disco_tpu/runs/interrupt.py"),     # signal-restore site
+        ("DR007", "disco_tpu/runs/interrupt.py"),     # handler flag stores
+        ("DR007", "disco_tpu/serve/server.py"),       # crash stash handoff
+        ("DR007", "disco_tpu/utils/resilience.py"),   # watchdog expired flag
+    }
+    missing = expected - got
+    assert not missing, f"suppressed sites vanished: {missing}"
+
+
+# -- revert fixtures (the gate is load-bearing) -------------------------------
+def _override(rel, old, new):
+    src = (ROOT / rel).read_text()
+    assert old in src, f"revert fixture anchor gone from {rel}: {old!r}"
+    return {rel: src.replace(old, new)}
+
+
+def test_revert_handler_in_lock_shape_fails_dr003():
+    """Re-introducing the PR 3 bug class — the signal handler routing
+    through _trip, whose telemetry flush takes obs's non-reentrant locks —
+    must fail."""
+    rel = "disco_tpu/runs/interrupt.py"
+    src = (ROOT / rel).read_text()
+    anchor = ("        self.stopped = True\n"
+              "        self.reason = self.reason or name\n")
+    assert anchor in src
+    res = analyze(overrides={rel: src.replace(anchor,
+                                              "        self._trip(name)\n")})
+    assert any(f.rule == "DR003" for f in res.findings), \
+        "\n".join(f.render() for f in res.findings)
+
+
+def test_revert_jax_in_tap_thread_fails_dr002():
+    """A jax call reachable from the tap-writer thread must fail (the
+    loader/tap host-only contract)."""
+    res = analyze(overrides=_override(
+        "disco_tpu/flywheel/tap.py",
+        "self._buf.append(item)",
+        "import jax\n                self._buf.append(jax.device_get(item))",
+    ))
+    hits = [f for f in res.findings if f.rule == "DR002"]
+    assert hits and any("tap_writer" in f.message for f in hits)
+
+
+def test_revert_unregistered_spawn_fails_dr001():
+    rel = "disco_tpu/flywheel/tap.py"
+    src = (ROOT / rel).read_text() + textwrap.dedent("""
+        def _rogue_worker():
+            pass
+
+        def _start_rogue():
+            threading.Thread(target=_rogue_worker).start()
+    """)
+    res = analyze(overrides={rel: src})
+    hits = [f for f in res.findings if f.rule == "DR001"]
+    assert hits and any("_rogue_worker" in f.message for f in hits)
+
+
+# -- CLI ----------------------------------------------------------------------
+def test_cli_clean_run_json_schema(capsys):
+    from disco_tpu.analysis.race import cli
+
+    assert cli.main(["--format", "json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert set(doc) == {"clean", "counts", "findings", "suppressed"}
+    assert doc["clean"] is True
+    assert doc["counts"]["files"] > 100
+    assert {"findings", "suppressed", "files", "by_rule"} <= set(doc["counts"])
+    for s in doc["suppressed"]:
+        assert {"path", "line", "col", "rule", "name", "message",
+                "justification"} <= set(s)
+
+
+def test_cli_list_checks_and_failure_exit(capsys, monkeypatch):
+    from disco_tpu.analysis.race import cli
+
+    assert cli.main(["--list-checks"]) == 0
+    out = capsys.readouterr().out
+    assert "DR000" in out and "DR008" in out
+    # a dirty result exits 1 (the gate contract)
+    from disco_tpu.analysis.findings import Finding
+
+    dirty = race_runner.RaceResult(
+        findings=[Finding(path="x.py", line=1, col=0, rule="DR001",
+                          name="unregistered-thread", message="boom")],
+        suppressed=[], n_files=1, manifest={},
+    )
+    monkeypatch.setattr(race_runner, "analyze", lambda **kw: dirty)
+    assert cli.main([]) == 1
+    assert "DR001" in capsys.readouterr().out
+
+
+def test_race_gate_runs_without_jax_import():
+    """The hermetic pin (like disco-lint's): a full disco-race run in a
+    fresh interpreter must never import jax — the gate can run while
+    another process holds the chip."""
+    code = (
+        "import sys\n"
+        "from disco_tpu.analysis.race import analyze\n"
+        "res = analyze()\n"
+        "assert 'jax' not in sys.modules, 'race analyzer imported jax'\n"
+        "sys.exit(0 if not res.findings else 1)\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code], cwd=ROOT,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr
+
+
+# -- the pinned GracefulInterrupt runtime contract ----------------------------
+def test_graceful_interrupt_handler_is_flag_only_at_runtime():
+    """The PR 3 regression pin, runtime side (DR003 pins it statically):
+    the handler itself must emit NOTHING — no counter tick, no event —
+    only set flags; the next stop_requested() poll emits exactly once,
+    and a second poll must not double-emit (the flush transition is
+    lock-guarded against racing pollers)."""
+    from disco_tpu.obs.metrics import REGISTRY
+    from disco_tpu.runs import interrupt as ri
+
+    g = ri.GracefulInterrupt(signals=())   # scope without real handlers
+    counter = REGISTRY.counter("interrupts")
+    with g:
+        before = counter.value
+        g._handler(signal_mod.SIGTERM, None)
+        assert g.stopped
+        assert counter.value == before, "handler emitted telemetry"
+        assert ri.stop_requested()         # the poll flushes...
+        assert counter.value == before + 1
+        assert ri.stop_requested()         # ...exactly once
+        assert counter.value == before + 1
