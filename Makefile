@@ -17,9 +17,12 @@ test: lint-check trace-check race-check obs-check fault-check chaos-check perf-c
 # registered obs kinds / chaos seams (DL009/DL010), explicit scan unroll
 # in the bit-exactness-gated modules (DL011), fused-magnitude /
 # precision-seam discipline (DL012: no abs(stft(...)), no bfloat16
-# literals outside ops/), and registered thread primitives (DL015:
+# literals outside ops/), registered thread primitives (DL015:
 # Thread/Timer targets and Lock creations outside the disco-race
-# role/lock registries).  Zero unsuppressed findings, and every
+# role/lock registries), and seam-routed fused-solver selection (DL016:
+# no direct fused_mwf_*/rank1_gevd_fused calls or 'fused' literal
+# comparisons outside ops/ and the beam/filters.py dispatch table).
+# Zero unsuppressed findings, and every
 # suppression must carry a justification (DL000).
 # Hermetic by construction: the linter is stdlib-only and never touches
 # the chip claim (doc/source/static_analysis.rst).
@@ -92,9 +95,12 @@ chaos-check:
 # Corpus-throughput-engine gate: run the miniature corpus through the
 # pipelined prefetch/dispatch/readback engine AND the sequential escape
 # hatch on CPU, assert byte-identical artifact trees, one batched readback
-# per chunk (device_get_batches), the overlap gauges recorded, and that
-# bench.py still prints exactly ONE JSON line now carrying
-# corpus_clips_per_s (disco_tpu/enhance/check.py).
+# per chunk (device_get_batches), the overlap gauges recorded, the fused
+# kernels (spec+mag STFT, folded covariances, the VMEM-resident rank-1
+# GEVD-MWF solve in interpret mode) at parity with the unfused reference
+# formulations, and that bench.py still prints exactly ONE JSON line now
+# carrying corpus_clips_per_s plus the solve-lane provenance
+# (disco_tpu/enhance/check.py).
 perf-check:
 	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= $(PYTHON) -m disco_tpu.enhance.check
 

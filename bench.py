@@ -146,6 +146,19 @@ def bench_jax(batch=16, dur_s=10.0, iters=5):
         rtf_jacobi = None
         jacobi_error = f"{type(e).__name__}: {e}"[:200]
 
+    # fused solve lane (ops/mwf_ops.py, the step2_exchange_mwf attack): the
+    # whole cov->whiten->Jacobi->filter solve chain as one VMEM-resident
+    # program ('fused' resolves per backend through ops.resolve, like the
+    # cov/stft 'auto' knobs — the ACTIVE impl is recorded in solver_lanes).
+    fused_error = None
+    try:
+        run_f = make_run("fused")
+        dt_f, _ = _slope_time(run_f, yb, sb, nb, iters=iters)
+        rtf_fused = audio_s / dt_f
+    except Exception as e:
+        rtf_fused = None
+        fused_error = f"{type(e).__name__}: {e}"[:200]
+
     # fused masked-covariance kernel (ops/cov_ops.py, round-2 verdict #3):
     # same default solver, covariance stage reads Y once instead of
     # materializing the masked copies.
@@ -197,6 +210,18 @@ def bench_jax(batch=16, dur_s=10.0, iters=5):
     cov_impl_active = resolve_cov_impl("auto")
     stft_impl_active = resolve_stft_impl("auto")
 
+    # resolved provenance of every solve lane (post-ops.resolve): records
+    # must distinguish 'jacobi' XLA from pallas from the fused kernel
+    # without re-running the bench on the same attachment
+    from disco_tpu.beam.filters import solver_lane_info
+
+    solver_lanes = {
+        "rtf": solver_lane_info("power"),
+        "rtf_eigh_solver": solver_lane_info("eigh"),
+        "rtf_jacobi_solver": solver_lane_info("jacobi"),
+        "rtf_fused_solver": solver_lane_info("fused"),
+    }
+
     # ---- per-stage breakdown, each stage's ON-DEVICE time via the slope
     # (stages slightly over-add vs the full pipeline, which fuses tighter).
     # stft_x3 is the fused analysis stage: ONE spec+magnitude program over
@@ -243,6 +268,9 @@ def bench_jax(batch=16, dur_s=10.0, iters=5):
         "rtf_eigh": rtf_eigh,
         "rtf_jacobi": rtf_jacobi,
         "jacobi_error": jacobi_error,
+        "rtf_fused": rtf_fused,
+        "fused_error": fused_error,
+        "solver_lanes": solver_lanes,
         "rtf_covfused": rtf_covfused,
         "covfused_error": covfused_error,
         "dispatch_overhead_ms": round(max(dt1 - dt, 0.0) * 1e3, 2),
@@ -892,6 +920,9 @@ def main(argv=None):
         "rtf_eigh_solver": round(r["rtf_eigh"], 2),
         "rtf_jacobi_solver": round(r["rtf_jacobi"], 2) if r.get("rtf_jacobi") else None,
         "jacobi_error": r.get("jacobi_error"),
+        "rtf_fused_solver": round(r["rtf_fused"], 2) if r.get("rtf_fused") else None,
+        "fused_error": r.get("fused_error"),
+        "solver_lanes": r.get("solver_lanes"),
         "rtf_covfused": round(r["rtf_covfused"], 2) if r.get("rtf_covfused") else None,
         "covfused_error": r.get("covfused_error"),
         "dispatch_overhead_ms": r["dispatch_overhead_ms"],
@@ -925,7 +956,7 @@ def main(argv=None):
         "mfu": round(r["mfu"], 6) if r["mfu"] else None,
         "flops_per_clip": round(r["flops_per_clip"]) if r["flops_per_clip"] else None,
         "stage_ms": r["stage_ms"],
-        "notes": "value = DEFAULT pipeline (solver=power since round 4; rtf_eigh_solver is the reference-bit-matching lane; cov_impl/stft_impl fields name the ACTIVE kernels behind the 'auto' defaults — fused pallas on TPU, DISCO_TPU_COV_IMPL/DISCO_TPU_STFT_IMPL override; the hot path is fused: one spec+magnitude STFT over the stacked y/s/n streams, irm masks from the emitted magnitudes, mask-folded covariance accumulation; precision names the default lane, rtf_bf16/bf16_max_rel_err the opt-in bf16 compute lane measured against it), on-device RTF via k-queued slope timing (tunnel adds ~80ms/dispatch, reported separately; value_single_dispatch includes it); stages timed as separate fenced programs (full pipeline fuses tighter); streaming_rtf_scan / streaming_rtf_block = tunnel-included realtime factors of the scanned super-tick (blocks_per_dispatch blocks per fenced dispatch, streaming_tango_scan) vs per-block block-recursive deployment, dispatches_per_block from the obs fence accounting; corpus_clips_per_s = end-to-end miniature-corpus throughput through the pipelined prefetch/dispatch/readback engine (load+scoring included); serve_blocks_per_s / serve_p95_ms = online-service continuous-batching throughput and request-latency p95 over loopback (BENCH_SERVE_SESSIONS concurrent streaming sessions, compile warm-up excluded; serve_queue_wait/dispatch p95s split admission wait from device time); train_steps_per_s = flywheel CRNN train-step throughput (reduced-width model pinned in train_stats, one fence over the async step chain); tap_blocks_per_s = host-side corpus-tap spool throughput (offer -> shard rotation -> atomic write); span_overhead_ns = causal-tracing per-span cost, enabled (span bookkeeping + flight ring) minus disabled (the strict-no-op seam — span_stats.disabled_ns is the measured no-op, perf-check asserts it ~0); numpy baseline at 2s clips; MFU vs dense-f32 peak (pipeline is FFT/small-eig bound by design)",
+        "notes": "value = DEFAULT pipeline (solver=power since round 4; rtf_eigh_solver is the reference-bit-matching lane; rtf_fused_solver = the VMEM-resident cov->whiten->Jacobi->filter solve (ops/mwf_ops.py); solver_lanes records each solve lane's resolved spec AND concrete impl post-ops.resolve, so records distinguish jacobi XLA from pallas from fused without re-running; cov_impl/stft_impl fields name the ACTIVE kernels behind the 'auto' defaults — fused pallas on TPU, DISCO_TPU_COV_IMPL/DISCO_TPU_STFT_IMPL override; the hot path is fused: one spec+magnitude STFT over the stacked y/s/n streams, irm masks from the emitted magnitudes, mask-folded covariance accumulation; precision names the default lane, rtf_bf16/bf16_max_rel_err the opt-in bf16 compute lane measured against it), on-device RTF via k-queued slope timing (tunnel adds ~80ms/dispatch, reported separately; value_single_dispatch includes it); stages timed as separate fenced programs (full pipeline fuses tighter); streaming_rtf_scan / streaming_rtf_block = tunnel-included realtime factors of the scanned super-tick (blocks_per_dispatch blocks per fenced dispatch, streaming_tango_scan) vs per-block block-recursive deployment, dispatches_per_block from the obs fence accounting; corpus_clips_per_s = end-to-end miniature-corpus throughput through the pipelined prefetch/dispatch/readback engine (load+scoring included); serve_blocks_per_s / serve_p95_ms = online-service continuous-batching throughput and request-latency p95 over loopback (BENCH_SERVE_SESSIONS concurrent streaming sessions, compile warm-up excluded; serve_queue_wait/dispatch p95s split admission wait from device time); train_steps_per_s = flywheel CRNN train-step throughput (reduced-width model pinned in train_stats, one fence over the async step chain); tap_blocks_per_s = host-side corpus-tap spool throughput (offer -> shard rotation -> atomic write); span_overhead_ns = causal-tracing per-span cost, enabled (span bookkeeping + flight ring) minus disabled (the strict-no-op seam — span_stats.disabled_ns is the measured no-op, perf-check asserts it ~0); numpy baseline at 2s clips; MFU vs dense-f32 peak (pipeline is FFT/small-eig bound by design)",
     }
     # sideband first (mirror of the stdout record + final counter snapshot),
     # THEN the one stdout line — events go to the file, never stdout.

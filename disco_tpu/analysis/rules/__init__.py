@@ -18,6 +18,7 @@ is registration order):
 * DL013 ``adhoc-transport-retry`` — :mod:`.retryloop`
 * DL014 ``span-stage-status-section`` — :mod:`.registered`
 * DL015 ``bare-thread-primitive``  — :mod:`.threads`
+* DL016 ``fused-solver-selection`` — :mod:`.fusedsolver`
 
 (DL000 ``lint-suppression`` is the engine's own hygiene rule — see
 :mod:`disco_tpu.analysis.suppressions`.)
@@ -28,6 +29,7 @@ from disco_tpu.analysis.rules import (  # noqa: F401  (import = register)
     atomicio,
     citations,
     fence,
+    fusedsolver,
     magnitude,
     purity,
     readback,

@@ -1,0 +1,95 @@
+"""DL016 — fused-solver selection goes through the dispatch seams.
+
+The solve-fusion round gave the pipeline a fused rank-1 GEVD-MWF solve
+(``ops/mwf_ops.py``) selected by the ``solver='fused'``/``'fused-xla'``/
+``'fused-pallas'`` specs of THE dispatch table
+(``beam.filters.rank1_gevd`` via ``parse_solver_spec``) and resolved per
+backend by the shared ``ops.resolve`` policy
+(``mwf_ops.resolve_mwf_impl``, ``DISCO_TPU_MWF_IMPL``).  Two call-site
+shapes silently bypass those seams:
+
+* calling the fused ops directly (``rank1_gevd_fused`` /
+  ``fused_mwf_xla`` / ``fused_mwf_pallas`` / ``resolve_mwf_impl``)
+  outside ops/ and the dispatch table — the caller picks a kernel without
+  the grammar validation, the env escape hatch, or the sanitize policy the
+  dispatch owns, and the bench provenance (``solver_lanes``) stops
+  describing what actually ran;
+* branching on ``'fused'``-family string literals (``solver == "fused"``,
+  ``base in ("fused", ...)``) — ad-hoc grammar re-implementation, the same
+  drift hazard ``parse_solver_spec`` exists to prevent (a call site that
+  spells the family check itself will miss the next spec added to the
+  table).
+
+Passing a fused spec AS DATA (``solver="fused"`` into ``rank1_gevd``/
+``tango``/the CLI) is the sanctioned path and stays legal — the rule
+targets selection LOGIC, not spec strings.  Inside ``disco_tpu/ops/`` and
+``disco_tpu/beam/filters.py`` (the dispatch table itself) both shapes ARE
+the implementation — exempt.
+
+No reference counterpart: the reference solves every pencil one way only
+(``scipy.linalg.eig``, internal_formulas.py:56-73).
+"""
+from __future__ import annotations
+
+import ast
+
+from disco_tpu.analysis.context import attr_chain
+from disco_tpu.analysis.registry import Rule, register
+
+#: the fused-solve entry points owned by the dispatch seams
+_FUSED_CALLS = ("rank1_gevd_fused", "fused_mwf_xla", "fused_mwf_pallas",
+                "resolve_mwf_impl")
+
+#: the spec bases of the fused solver family (beam.filters._FUSED_IMPLS)
+_FUSED_BASES = ("fused", "fused-xla", "fused-pallas")
+
+
+def _fused_literal(node) -> bool:
+    """True for a string constant of the fused solver family (optionally
+    with a ``:N`` suffix), or a tuple/list/set display containing one.
+
+    No reference counterpart (module docstring)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value.partition(":")[0] in _FUSED_BASES
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return any(_fused_literal(el) for el in node.elts)
+    return False
+
+
+@register
+class FusedSolverSeam(Rule):
+    id = "DL016"
+    name = "fused-solver-selection"
+    summary = ("fused-solve selection bypassing parse_solver_spec / "
+               "ops.resolve — direct fused-op calls or 'fused' literal "
+               "comparisons outside the dispatch seams")
+
+    def applies(self, ctx) -> bool:
+        return not (ctx.in_dir("disco_tpu/ops")
+                    or ctx.is_file("disco_tpu/beam/filters.py"))
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                chain = attr_chain(node.func)
+                if chain and chain[-1] in _FUSED_CALLS:
+                    yield self.finding(
+                        ctx, node,
+                        f"direct call to {chain[-1]} outside ops/ and the "
+                        "rank1_gevd dispatch table: select the fused solve "
+                        "with a solver spec ('fused[:N]'/'fused-xla'/"
+                        "'fused-pallas') through parse_solver_spec so the "
+                        "grammar, the DISCO_TPU_MWF_IMPL resolution and the "
+                        "sanitize policy stay owned by the seams",
+                    )
+            elif isinstance(node, ast.Compare):
+                operands = [node.left, *node.comparators]
+                if any(_fused_literal(op) for op in operands):
+                    yield self.finding(
+                        ctx, node,
+                        "comparison against a 'fused' solver literal: "
+                        "solver-family branching belongs behind "
+                        "parse_solver_spec / the rank1_gevd dispatch table "
+                        "(beam/filters.py) — an ad-hoc family check drifts "
+                        "the moment the spec grammar grows",
+                    )

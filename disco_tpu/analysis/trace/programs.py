@@ -114,6 +114,34 @@ def _build_tango_step2():
     }
 
 
+def _build_tango_step2_fused():
+    """The solve-fusion round's step-2 chain: same unit as
+    :func:`_build_tango_step2` with the fused rank-1 GEVD-MWF solver —
+    pinned to the 'fused-xla' lane so the golden is backend-independent
+    (plain 'fused' resolves per backend, and the pallas lane's interpret
+    flag differs off-TPU).  The contract the golden holds (beyond the
+    fingerprint): the whole chain is ONE traced program whose outputs are
+    (F, T) filtered streams only — no (F, D, D) pencil-shaped intermediate
+    escapes to the output avals (pinned by tests/test_trace.py).
+
+    No reference counterpart (module docstring)."""
+    import jax
+    import jax.numpy as jnp
+
+    from disco_tpu.enhance.tango import tango_step2
+
+    all_z = {key: _c64(K, F, T)
+             for key in ("z_y", "z_s", "z_n", "zn", "z_t1_s", "z_t1_n")}
+    args = (
+        _c64(C, F, T), _c64(C, F, T), _c64(C, F, T), _f32(F, T),
+        jax.ShapeDtypeStruct((), jnp.int32),          # traced node index k
+        all_z, _f32(K, F, T), _c64(K, F, T), _c64(K, F, T),
+    )
+    return tango_step2, args, {
+        "policy": "local", "solver": "fused-xla", "cov_impl": COV_IMPL,
+    }
+
+
 def _streaming_args():
     return (_c64(K, C, F, T), _f32(K, F, T), _f32(K, F, T))
 
@@ -235,6 +263,13 @@ PROGRAMS: dict = {
             "tango_step2",
             "offline step-2 global MWF on [y_k ‖ z_j≠k] (enhance/tango.py)",
             _build_tango_step2,
+        ),
+        ProgramSpec(
+            "tango_step2_fused",
+            "offline step-2 with the fused rank-1 GEVD-MWF solve "
+            "(ops/mwf_ops.py; 'fused-xla' lane pinned backend-independent) "
+            "— one program, no pencil-shaped output escapes",
+            _build_tango_step2_fused,
         ),
         ProgramSpec(
             "streaming_tango",

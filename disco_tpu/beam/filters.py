@@ -209,36 +209,21 @@ def gevd_mwf_power(Rxx: jnp.ndarray, Rnn: jnp.ndarray, mu: float = 1.0, iters: i
     return jnp.where(ok, W, e1), jnp.where(ok, t1, e1)
 
 
-RANK1_SOLVERS = ("eigh", "power", "jacobi", "jacobi-pallas")
+# THE solver-spec grammar, re-exported from its stdlib-only home
+# (disco_tpu/solver_spec.py — jax-free so the serve client and argparse
+# can validate specs without importing jax; this module keeps the names
+# because the dispatch table is the grammar's primary consumer).
+from disco_tpu.solver_spec import (  # noqa: E402  (dataflow grouping)
+    FUSED_IMPLS as _FUSED_IMPLS,
+)
+from disco_tpu.solver_spec import (  # noqa: E402,F401  (re-export)
+    RANK1_SOLVERS,
+    parse_solver_spec,
+)
 
 
-def parse_solver_spec(v: str) -> tuple[str, int | None]:
-    """THE parser for rank-1 GEVD solver specs — ``'base'`` or ``'base:N'``
-    with base in :data:`RANK1_SOLVERS` — shared by :func:`rank1_gevd` and
-    the CLI validator (cli/common.solver_spec), so the dispatch table and
-    argparse can never disagree on the grammar.  Returns (base, N-or-None);
-    raises ValueError on an unknown base, an 'eigh:N' suffix, or a
-    malformed/empty/<1 N (including multi-colon strings)."""
-    base, sep, n_str = v.partition(":")
-    if base not in RANK1_SOLVERS:
-        raise ValueError(
-            f"unknown GEVD solver {v!r}; expected one of {RANK1_SOLVERS}, "
-            "optionally with ':N' (power iterations / jacobi sweeps)"
-        )
-    if not sep:
-        return base, None
-    if base == "eigh":
-        raise ValueError(f"solver spec {v!r}: 'eigh' takes no ':N' suffix")
-    try:
-        n = int(n_str)
-    except ValueError:
-        n = 0
-    if n < 1:
-        raise ValueError(f"malformed solver spec {v!r}: '{base}:N' needs integer N >= 1")
-    return base, n
-
-
-def rank1_gevd(Rss, Rnn, mu: float = 1.0, solver: str = "eigh", sanitize: bool = True):
+def rank1_gevd(Rss, Rnn, mu: float = 1.0, solver: str = "eigh", sanitize: bool = True,
+               precision: str = "f32"):
     """Rank-1 GEVD-MWF by solver spec — THE dispatch table shared by the
     offline TANGO steps, the streaming refreshes and ``intern_filter``:
 
@@ -253,16 +238,59 @@ def rank1_gevd(Rss, Rnn, mu: float = 1.0, solver: str = "eigh", sanitize: bool =
       explicit sweep count; default size-adaptive, eigh_ops.default_sweeps)
       — fixed-sweep cyclic Jacobi full eigendecomposition
       (``disco_tpu.ops.eigh_ops``), as a statically unrolled XLA schedule
-      or one fused VMEM pallas kernel.
+      or one fused VMEM pallas kernel (the eigensolve alone; whiten and
+      filter formation stay separate XLA stages).
+    * ``'fused'`` / ``'fused-xla'`` / ``'fused-pallas'`` (optionally
+      ``':N'`` Jacobi sweeps) — the WHOLE solve chain (scale-normalize ->
+      diagonal-load -> Cholesky whiten -> fixed-sweep Jacobi -> rank-1
+      back-substitution -> filter weights) as one VMEM-resident program
+      (``disco_tpu.ops.mwf_ops.rank1_gevd_fused``): the (F, C, C)
+      intermediates never touch HBM and only the (F, C) weights are
+      written back.  ``'fused'`` resolves per backend through the shared
+      ``ops.resolve`` policy (pallas on real TPUs, XLA elsewhere;
+      ``DISCO_TPU_MWF_IMPL`` escape hatch); the explicit suffixes pin the
+      lane.  The only solver family that consumes ``precision``:
+      ``'bf16'`` quantizes the pencil planes at the HBM->VMEM boundary
+      with every in-VMEM iteration in f32 (documented looser tolerances,
+      tests/test_mwf_ops.py).
+
+    ``precision`` is ignored by the non-fused solvers (their programs are
+    pinned bit-identical by the trace goldens).
     """
     base, n = parse_solver_spec(solver)
     if base == "eigh":
         return gevd_mwf(Rss, Rnn, mu=mu, rank=1, sanitize=sanitize)
+    if base in _FUSED_IMPLS:
+        from disco_tpu.ops.mwf_ops import rank1_gevd_fused
+
+        return rank1_gevd_fused(Rss, Rnn, mu=mu, impl=_FUSED_IMPLS[base],
+                                sweeps=n, precision=precision, sanitize=sanitize)
     if base in ("jacobi", "jacobi-pallas"):
         return gevd_mwf(Rss, Rnn, mu=mu, rank=1, sanitize=sanitize, eigh_impl=base, sweeps=n)
     if n is None:
         return gevd_mwf_power(Rss, Rnn, mu=mu, sanitize=sanitize)
     return gevd_mwf_power(Rss, Rnn, mu=mu, iters=n, sanitize=sanitize)
+
+
+def solver_lane_info(spec: str) -> dict:
+    """Resolved provenance of a solver spec for bench records: the parsed
+    base/N plus the CONCRETE kernel implementation the spec runs on this
+    backend (post-``ops.resolve`` for the fused family) — so a bench
+    record distinguishes 'jacobi' XLA from pallas from the fused kernel
+    without re-running.
+
+    No reference counterpart: bench provenance is a TPU-port concern.
+    """
+    base, n = parse_solver_spec(spec)
+    if base in _FUSED_IMPLS:
+        from disco_tpu.ops.mwf_ops import resolve_mwf_impl
+
+        impl = resolve_mwf_impl(_FUSED_IMPLS[base])
+    elif base in ("jacobi-pallas",):
+        impl = "pallas"
+    else:  # eigh / power / jacobi are XLA formulations
+        impl = "xla"
+    return {"spec": spec, "base": base, "n": n, "impl": impl}
 
 
 @jax.jit

@@ -52,11 +52,13 @@ def snr_value(v: str):
 
 def solver_spec(v: str):
     """argparse type for rank-1 GEVD solver specs — delegates to THE shared
-    grammar (``disco_tpu.beam.filters.parse_solver_spec``): 'eigh',
-    'power[:N]', 'jacobi[:N]' or 'jacobi-pallas[:N]'."""
+    grammar (``disco_tpu.solver_spec.parse_solver_spec``, stdlib-only so
+    rejecting a typo costs no jax import): 'eigh', 'power[:N]',
+    'jacobi[:N]', 'jacobi-pallas[:N]' or the fused solve family
+    'fused[:N]' / 'fused-xla[:N]' / 'fused-pallas[:N]'."""
     import argparse
 
-    from disco_tpu.beam.filters import parse_solver_spec
+    from disco_tpu.solver_spec import parse_solver_spec
 
     try:
         parse_solver_spec(v)

@@ -468,6 +468,7 @@ def compare_records(old: dict, new: dict, threshold: float = 0.05) -> dict:
         ("value_single_dispatch", True),
         ("rtf_eigh_solver", True),
         ("rtf_jacobi_solver", True),
+        ("rtf_fused_solver", True),
         ("rtf_covfused", True),
         ("streaming_rtf", True),
         ("streaming_rtf_scan", True),

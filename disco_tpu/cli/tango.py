@@ -66,9 +66,13 @@ def build_parser():
                         "streaming mode needs ~power:96 for eigh-level quality), "
                         "'jacobi[:N]' or 'jacobi-pallas[:N]' (cyclic Jacobi, "
                         "size-adaptive sweeps; full eig, so it tracks eigh in "
-                        "streaming mode too).  Default: 'power' offline / "
-                        "'eigh' with --streaming (measured on-device, round-3 "
-                        "solver_ab)")
+                        "streaming mode too), or 'fused[:N]'/'fused-xla[:N]'/"
+                        "'fused-pallas[:N]' (the whole cov->whiten->Jacobi->"
+                        "filter solve as ONE VMEM-resident program, "
+                        "ops/mwf_ops.py; 'fused' resolves per backend — "
+                        "DISCO_TPU_MWF_IMPL env overrides).  Default: 'power' "
+                        "offline / 'eigh' with --streaming (measured "
+                        "on-device, round-3 solver_ab)")
     p.add_argument("--cov_impl", choices=["auto", "xla", "pallas"], default="auto",
                    help="masked-covariance stage: 'auto' (fused pallas kernel "
                         "on TPU, folded einsum elsewhere — DISCO_TPU_COV_IMPL "

@@ -63,7 +63,8 @@ class EnhanceConfig:
     filter_type: str = "gevd"
     rank: int = 1
     # rank-1 GEVD solver spec: 'eigh' | 'power' | 'power:N' | 'jacobi' |
-    # 'jacobi-pallas' (beam.filters.rank1_gevd).  The TANGO CLI resolves
+    # 'jacobi-pallas' | 'fused' | 'fused-xla' | 'fused-pallas' (all with
+    # optional ':N'; beam.filters.rank1_gevd).  The TANGO CLI resolves
     # its solver as: explicit --solver > enhance.solver from a --config
     # YAML > this default (cli/tango.py main()).
     #

@@ -153,6 +153,23 @@ def _check_bench_one_line(failures: list) -> dict | None:
             f"bench: bf16_max_rel_err missing/null in the record "
             f"(bf16_error={rec.get('bf16_error')!r})"
         )
+    # the fused-solve lane (solve-fusion round): measured, and its resolved
+    # provenance recorded so records distinguish jacobi XLA from pallas
+    # from the fused kernel without re-running
+    if not isinstance(rec.get("rtf_fused_solver"), (int, float)):
+        failures.append(
+            f"bench: rtf_fused_solver missing/null in the record "
+            f"(fused_error={rec.get('fused_error')!r})"
+        )
+    lanes = rec.get("solver_lanes") or {}
+    for lane_key in ("rtf", "rtf_eigh_solver", "rtf_jacobi_solver",
+                     "rtf_fused_solver"):
+        lane = lanes.get(lane_key) or {}
+        if lane.get("impl") not in ("xla", "pallas"):
+            failures.append(
+                f"bench: solver_lanes[{lane_key!r}].impl missing/invalid: "
+                f"{lane.get('impl')!r} (expected 'xla' or 'pallas')"
+            )
     return rec
 
 
@@ -205,6 +222,27 @@ def _check_fused_parity(failures: list) -> None:
             failures.append(
                 f"fused parity: masked covariance [{name}] drifted from the "
                 f"materializing einsum ({err:.2e} > 1e-4 max rel)"
+            )
+
+    # fused rank-1 GEVD-MWF solve (ops/mwf_ops.py, the solve-fusion round):
+    # both lanes (XLA twin + pallas kernel in interpret mode) against the
+    # separate-stage eigensolve path they replace, through THE dispatch
+    # table — the solver specs are the sanctioned selection seam (DL016)
+    from disco_tpu.beam.filters import rank1_gevd
+
+    Rnn_pd = Rnn_ref + 0.05 * scale_r * np.eye(C, dtype=np.complex64)
+    w_ref, t1_ref = rank1_gevd(Rss_ref, Rnn_pd, solver="eigh")
+    w_ref, t1_ref = np.asarray(w_ref), np.asarray(t1_ref)
+    wscale = np.linalg.norm(w_ref)
+    for spec in ("fused-xla", "fused-pallas"):
+        w, t1 = rank1_gevd(Rss_ref, Rnn_pd, solver=spec)
+        # disco-lint: disable=DL002 -- hermetic CPU gate: interpret-mode/CPU arrays, no tunnel crossing to batch
+        w, t1 = np.asarray(w), np.asarray(t1)
+        err = max(np.linalg.norm(w - w_ref), np.linalg.norm(t1 - t1_ref)) / wscale
+        if err > 1e-3:
+            failures.append(
+                f"fused parity: rank1_gevd[{spec}] drifted from the eigh "
+                f"solve ({err:.2e} > 1e-3 rel l2)"
             )
 
 

@@ -223,7 +223,8 @@ def _stream_filter(X, XS, XN, lam, u, mu, ref: int = 0, extras=None, init_state=
     # adaptive-beamforming fallback) instead of the solvers' e1 selector,
     # which would silently switch the stream to channel 0.
     w = jax.vmap(
-        lambda a, b: rank1_gevd(a, b, mu=mu, solver=solver, sanitize=False)[0]
+        lambda a, b: rank1_gevd(a, b, mu=mu, solver=solver, sanitize=False,
+                                precision=precision)[0]
     )(Rss_ref, Rnn_ref)  # (B, F, D)
     # An ill-conditioned refresh (warm-up covariances can make the stacked
     # [mics ‖ z] channels nearly dependent; TPU f32 eigh then returns

@@ -154,7 +154,10 @@ def tango_step1(
       precision: the ops.resolve compute lane of the masked-covariance
         accumulation — 'f32' (default, the pre-existing program) or 'bf16'
         (bf16 multiplies, f32 accumulators; gated by the documented looser
-        oracle tolerances in tests/test_tango.py).
+        oracle tolerances in tests/test_tango.py).  With a ``'fused*'``
+        solver the lane extends into the solve itself (bf16 pencil planes
+        at the HBM->VMEM boundary, f32 in-VMEM iterations —
+        ops/mwf_ops.py); the other solver families ignore it.
 
     Returns:
       dict with z_y/z_s/z_n/zn (F, T) and t1-projected references
@@ -166,7 +169,7 @@ def tango_step1(
         Rnn = frame_mean_covariance(N, axis_name=frame_axis)
     else:
         Rss, Rnn = _masked_cov_pair(Y, mask_z, cov_impl, frame_axis, precision)
-    w, t1 = rank1_gevd(Rss, Rnn, mu=mu, solver=solver)  # (F, C) each
+    w, t1 = rank1_gevd(Rss, Rnn, mu=mu, solver=solver, precision=precision)  # (F, C) each
     z_y = jnp.einsum("fc,cft->ft", jnp.conj(w), Y)
     z_s = jnp.einsum("fc,cft->ft", jnp.conj(w), S)
     z_n = jnp.einsum("fc,cft->ft", jnp.conj(w), N)
@@ -280,6 +283,7 @@ def tango_step2(
       all_S_ref / all_N_ref: (K, F, T) gathered ref-mic clean components
         (for the 'use_oracle_refs' policy).
       precision: ops.resolve compute lane of the covariance accumulation
+        and, under the ``'fused*'`` solver family, of the GEVD solve
         ('f32' default / 'bf16' opt-in — see :func:`tango_step1`).
       z_avail: optional (K,) availability of the exchanged streams as seen
         by THIS consumer (1 = arrived intact).  Unavailable channels are
@@ -353,7 +357,7 @@ def tango_step2(
         Rnn = frame_mean_covariance(stat_n, axis_name=frame_axis)
     if z_avail is not None:
         Rnn = _regularize_excluded(Rnn, C, a_oth)
-    w, _ = rank1_gevd(Rss, Rnn, mu=mu, solver=solver)  # (F, C+K-1)
+    w, _ = rank1_gevd(Rss, Rnn, mu=mu, solver=solver, precision=precision)  # (F, C+K-1)
 
     in_s = jnp.concatenate([S, sel(all_z["z_s"])], axis=0)
     in_n = jnp.concatenate([N, sel(all_z["z_n"])], axis=0)

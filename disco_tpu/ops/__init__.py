@@ -1,4 +1,10 @@
 from disco_tpu.ops.eigh_ops import eigh_jacobi, eigh_jacobi_pallas
+from disco_tpu.ops.mwf_ops import (
+    fused_mwf_pallas,
+    fused_mwf_xla,
+    rank1_gevd_fused,
+    resolve_mwf_impl,
+)
 from disco_tpu.ops.resolve import resolve_precision
 from disco_tpu.ops.stft_ops import (
     dft_matrices,
@@ -15,8 +21,12 @@ __all__ = [
     "dft_matrices",
     "eigh_jacobi",
     "eigh_jacobi_pallas",
+    "fused_mwf_pallas",
+    "fused_mwf_xla",
     "idft_matrices",
     "istft_matmul",
+    "rank1_gevd_fused",
+    "resolve_mwf_impl",
     "resolve_precision",
     "resolve_stft_impl",
     "stft_fused",

@@ -1,0 +1,491 @@
+"""Fused rank-1 GEVD-MWF solve: one VMEM-resident cov→whiten→Jacobi→filter
+program.
+
+The step-2 exchange MWF is the measured MFU wall after the covariance fold
+(BENCH_r05: ``step2_exchange_mwf`` 115.9 ms of a 190 ms pipeline): the
+batched rank-1 GEVD solve still runs as separate XLA programs — diagonal
+load, Cholesky whiten (two triangular solves), the eigendecomposition and
+the rank-1 filter formation each materialize their (F, C, C) intermediates
+to HBM between fusion boundaries, while the *useful* output is only the
+(F, C) filter weights.  SURVEY §7 anticipated exactly this kernel; ROADMAP
+item 1 names it the remaining lever toward "MFU >= 15% and step-2 under
+40 ms".
+
+:func:`fused_mwf_pallas` runs the WHOLE solve chain as one pallas program:
+each grid step DMAs a lane tile of (C, C) Hermitian pencils (Rss, Rnn)
+HBM->VMEM once and performs
+
+    scale-normalize -> diagonal-load -> Cholesky(Rnn) -> whiten
+    A = L⁻¹ Rss L⁻ᴴ -> fixed-sweep cyclic Jacobi -> dominant eigenpair
+    -> back-substitute q₁ = L⁻ᴴ u₁ -> W = q₁ · λ/(λ+μ) · (Q⁻¹)₀₀
+
+entirely in VMEM, writing back ONLY the (..., C) filter weights W and the
+GEVD selection vector t1 — the whitened matrix, the rotation states and
+the eigenvector planes never touch HBM.  The layout and the rotation
+schedule are :mod:`disco_tpu.ops.eigh_ops`'s batch-in-lanes formulation
+(matrix element (p, q) is a full lane vector of pencils; scatter-free
+masked writes), the filter algebra is :func:`disco_tpu.beam.filters.gevd_mwf`'s
+Cholesky-whitened closed form (reference se_utils/internal_formulas.py:56-73,
+Serizel et al. 2014), and the triangular factor work runs element-wise on
+lane vectors (statically unrolled over C <= 16 — no scatter, no gather).
+
+:func:`fused_mwf_xla` is the same algorithm as plain XLA ops for off-TPU
+backends (whiten via ``beam.filters._whitened``, eigendecomposition via
+``eigh_ops.eigh_jacobi``): same math, ordinary fusion.  Both sit behind
+:func:`rank1_gevd_fused` and the shared ``ops.resolve`` policy seam
+(``impl='auto' | 'xla' | 'pallas'``, :data:`MWF_IMPL_ENV` escape hatch),
+reachable from every pipeline entry point as the ``solver='fused'`` /
+``'fused-xla'`` / ``'fused-pallas'`` specs of the
+:func:`disco_tpu.beam.filters.rank1_gevd` dispatch table.
+
+``precision='bf16'`` extends the PR-9 compute lane into the solve: the
+Hermitian pencil planes are rounded to bfloat16 at the HBM->VMEM boundary
+(halving the fused program's only HBM read), while EVERY in-VMEM iteration
+— whitening, rotations, back-substitution — accumulates in float32.
+Gated like the covariance lane by documented looser oracle tolerances and
+an SDR-within-0.1-dB pin (tests/test_mwf_ops.py).
+
+Parity: pinned against the float64 NumPy oracle
+(``tests/reference_impls.intern_filter_np`` type 'gevd' rank 1) across
+C in {4..11} including near-degenerate warm-up covariances, and against
+``gevd_mwf(rank=1)``; the NaN-sanitize guard matches ``gevd_mwf``'s
+(degenerate bins fall back to the e1 pass-through selector, or surface as
+non-finite under ``sanitize=False`` so the streaming ffill hold keeps the
+previous block's filter).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from disco_tpu.ops.eigh_ops import _lane_rotation, _pairs, default_sweeps
+from disco_tpu.ops.resolve import compute_dtype, resolve_impl, resolve_precision
+
+#: Environment escape hatch for the fused-solve kernel selection:
+#: ``DISCO_TPU_MWF_IMPL=xla`` (or ``pallas``) overrides the ``'auto'``
+#: resolution wherever a caller selected the ``'fused'`` solver spec.
+MWF_IMPL_ENV = "DISCO_TPU_MWF_IMPL"
+
+
+def resolve_mwf_impl(impl: str = "auto") -> str:
+    """Resolve a fused-solve ``impl`` knob to a concrete kernel choice —
+    the MWF twin of ``resolve_cov_impl``/``resolve_stft_impl``, backed by
+    the SAME shared policy (:func:`disco_tpu.ops.resolve.resolve_impl`):
+    ``'auto'`` is the fused pallas kernel on real TPU backends and the XLA
+    formulation elsewhere, with :data:`MWF_IMPL_ENV` as the operator
+    escape hatch.
+
+    No reference counterpart: kernel selection is a TPU-port concern — the
+    reference solves every (node, freq) pencil one way only
+    (``scipy.linalg.eig``, internal_formulas.py:56-73).
+    """
+    return resolve_impl(impl, MWF_IMPL_ENV)
+
+
+def _bf16_round(x):
+    """Round a float plane through bfloat16 — the solve's ``precision='bf16'``
+    input quantization (module docstring).  Lives in ops/ because precision
+    casts are an ops concern (disco-lint DL012).
+
+    No reference counterpart (module docstring)."""
+    return x.astype(jnp.bfloat16).astype(jnp.float32)
+
+
+# ------------------------------------------------------------- XLA twin
+@partial(jax.jit, static_argnames=("sweeps", "precision"))
+def fused_mwf_xla(Rss: jnp.ndarray, Rnn: jnp.ndarray, mu: float = 1.0,
+                  sweeps: int | None = None, precision: str = "f32"):
+    """The fused solve's XLA formulation: identical algorithm chain
+    (scale-normalize -> load -> Cholesky whiten -> fixed-sweep Jacobi ->
+    dominant eigenpair -> rank-1 filter) as ordinary fused XLA ops — the
+    off-TPU twin behind :func:`rank1_gevd_fused`.
+
+    The rank-1 'gevd' branch of reference internal_formulas.py:56-73 in
+    the Cholesky-whitened form of :func:`disco_tpu.beam.filters.gevd_mwf`,
+    restricted to the dominant eigenpair (ascending Jacobi output — the
+    last column).
+
+    Returns:
+      (W, t1): filter and GEVD selection vector, each (..., C), UNsanitized
+      (degenerate bins carry non-finite values; :func:`rank1_gevd_fused`
+      owns the e1 fallback policy).
+    """
+    from jax.scipy.linalg import solve_triangular
+
+    from disco_tpu.beam.filters import EIG_CEIL, EIG_FLOOR, _whitened
+    from disco_tpu.ops.eigh_ops import eigh_jacobi
+
+    Rss = jnp.asarray(Rss)
+    Rnn = jnp.asarray(Rnn)
+    if resolve_precision(precision) == "bf16":
+        Rss = jax.lax.complex(_bf16_round(jnp.real(Rss)), _bf16_round(jnp.imag(Rss)))
+        Rnn = jax.lax.complex(_bf16_round(jnp.real(Rnn)), _bf16_round(jnp.imag(Rnn)))
+    L, A = _whitened(Rss, Rnn)
+    lam, U = eigh_jacobi(A, sweeps=sweeps)  # ascending
+    lam1 = jnp.clip(lam[..., -1], EIG_FLOOR, EIG_CEIL)
+    u1 = U[..., :, -1]
+    # q1 = L^-H u1 ; (Q^-1)[0, 0] = conj(u1[0] * L[0, 0]) (L lower-tri)
+    q1 = solve_triangular(L.conj().swapaxes(-1, -2), u1[..., None], lower=False)[..., 0]
+    qinv00 = jnp.conj(u1[..., 0] * L[..., 0, 0])
+    g = (lam1 / (lam1 + mu)).astype(q1.dtype)
+    W = q1 * (g * qinv00)[..., None]
+    t1 = q1 * qinv00[..., None]
+    return W, t1
+
+
+# ---------------------------------------------------------- pallas kernel
+#
+# Layout: BATCH IN LANES (the eigh_ops round-5 lesson) — a block is
+# (C, C, tile): pencil element (i, j) IS a full (tile,)-lane vector, every
+# rotation is natively-shaped VPU work, and the triangular-factor math runs
+# element-wise on lane vectors with ALL loops statically unrolled over
+# C <= 16 (static python indices — no scatter, no gather, no Mosaic-less
+# primitives).  The (C, C, tile) whitened/rotation/eigenvector planes live
+# and die in VMEM; only the (C, tile) filter planes are stored.
+
+
+def _elem_cholesky(Nr, Ni, load, C):
+    """Element-wise complex Cholesky of the loaded noise pencil batch:
+    ``L[(i, j)]`` lane-vector dicts (re, im) with ``(i >= j)``, statically
+    unrolled (C <= 16).  A non-PSD pencil produces NaN via ``sqrt`` of a
+    negative — the same signal ``jnp.linalg.cholesky`` emits, so the
+    sanitize/ffill guards downstream see identical semantics.
+
+    The Cholesky step of reference internal_formulas.py:56-73's GEVD in
+    the whitened form of ``beam.filters._whitened``.
+    """
+    Lr: dict = {}
+    Li: dict = {}
+    inv_diag: dict = {}
+    for j in range(C):
+        d = Nr[j, j] + load
+        for k in range(j):
+            d = d - (Lr[(j, k)] * Lr[(j, k)] + Li[(j, k)] * Li[(j, k)])
+        ljj = jnp.sqrt(d)  # NaN for non-PSD -> sanitize path downstream
+        inv = 1.0 / ljj
+        Lr[(j, j)] = ljj
+        Li[(j, j)] = jnp.zeros_like(ljj)
+        inv_diag[j] = inv
+        for i in range(j + 1, C):
+            ar = Nr[i, j]
+            ai = Ni[i, j]
+            for k in range(j):
+                # A[i, j] - sum_k L[i, k] conj(L[j, k])
+                ar = ar - (Lr[(i, k)] * Lr[(j, k)] + Li[(i, k)] * Li[(j, k)])
+                ai = ai - (Li[(i, k)] * Lr[(j, k)] - Lr[(i, k)] * Li[(j, k)])
+            Lr[(i, j)] = ar * inv
+            Li[(i, j)] = ai * inv
+    return Lr, Li, inv_diag
+
+
+def _elem_whiten(Sr, Si, Lr, Li, inv_diag, C):
+    """Element-wise whitening ``A = L⁻¹ Rss L⁻ᴴ`` (re-hermitized), as two
+    statically-unrolled forward substitutions on lane vectors; returns
+    element dicts ``A[(i, j)]``.
+
+    The whitening step of ``beam.filters._whitened`` (reference
+    internal_formulas.py:56-73 via Cholesky instead of ``scipy.linalg.eig``).
+    """
+    # forward solve L B = Rss, rows of B as (C, tile) arrays
+    Br: list = []
+    Bi: list = []
+    for i in range(C):
+        rr = Sr[i]
+        ri = Si[i]
+        for k in range(i):
+            lr = Lr[(i, k)][None]
+            li = Li[(i, k)][None]
+            rr = rr - (lr * Br[k] - li * Bi[k])
+            ri = ri - (lr * Bi[k] + li * Br[k])
+        inv = inv_diag[i][None]
+        Br.append(rr * inv)
+        Bi.append(ri * inv)
+    # forward solve L M = B^H (element level), then A = M^H re-hermitized
+    Mr: dict = {}
+    Mi: dict = {}
+    for i in range(C):
+        for j in range(C):
+            rr = Br[j][i]       # B^H[i, j] = conj(B[j, i])
+            ri = -Bi[j][i]
+            for k in range(i):
+                rr = rr - (Lr[(i, k)] * Mr[(k, j)] - Li[(i, k)] * Mi[(k, j)])
+                ri = ri - (Lr[(i, k)] * Mi[(k, j)] + Li[(i, k)] * Mr[(k, j)])
+            inv = inv_diag[i]
+            Mr[(i, j)] = rr * inv
+            Mi[(i, j)] = ri * inv
+    # A = M^H, re-hermitized: A[i, j] = (conj(M[j, i]) + M[i, j]) / 2
+    Ar: dict = {}
+    Ai: dict = {}
+    for i in range(C):
+        for j in range(C):
+            Ar[(i, j)] = 0.5 * (Mr[(j, i)] + Mr[(i, j)])
+            Ai[(i, j)] = 0.5 * (Mi[(i, j)] - Mi[(j, i)])
+    return Ar, Ai
+
+
+def _rows_to_plane(rows, C):
+    """Stack C (C, tile) row vectors into a (C, C, tile) plane by masked
+    selects against a leading-dim iota (scatter-free — the eigh_ops
+    broadcast-write idiom).
+
+    No reference counterpart (module docstring)."""
+    row_idx = jax.lax.broadcasted_iota(jnp.int32, (C, 1, 1), 0)
+    plane = jnp.zeros((C,) + rows[0].shape, rows[0].dtype)
+    for i in range(C):
+        plane = jnp.where(row_idx == i, rows[i][None], plane)
+    return plane
+
+
+def _elems_to_rows(elems, C):
+    """Assemble C (C, tile) rows from a ``{(i, j): (tile,)}`` element dict
+    by masked selects (scatter-free).
+
+    No reference counterpart (module docstring)."""
+    col_idx = jax.lax.broadcasted_iota(jnp.int32, (C, 1), 0)
+    rows = []
+    for i in range(C):
+        row = jnp.zeros((C,) + elems[(i, 0)].shape, elems[(i, 0)].dtype)
+        for j in range(C):
+            row = jnp.where(col_idx == j, elems[(i, j)][None], row)
+        rows.append(row)
+    return rows
+
+
+def _mwf_kernel(ssr_ref, ssi_ref, nnr_ref, nni_ref, mu_ref,
+                wr_ref, wi_ref, t1r_ref, t1i_ref, *, C, sweeps, eps, loading,
+                lam_floor, lam_ceil):
+    """One lane tile: the WHOLE rank-1 GEVD-MWF solve in VMEM, single HBM
+    round trip — inputs are the (C, C, tile) pencil planes (+ the (tile,)
+    mu lane), outputs only the (C, tile) filter/selection planes.
+
+    The chain (module docstring) mirrors ``beam.filters.gevd_mwf`` at
+    rank 1 (reference internal_formulas.py:56-73): scale-normalize ->
+    diagonal-load -> element-wise Cholesky -> element-wise whiten ->
+    fixed-sweep cyclic Jacobi (eigh_ops' lanes-layout rotation schedule,
+    ``fori_loop`` over sweeps) -> unrolled dominant-eigenpair select ->
+    back-substitution -> filter formation.
+    """
+    f32 = jnp.float32
+    Sr = ssr_ref[...].astype(f32)  # (C, C, tile); no-op cast in the f32 lane
+    Si = ssi_ref[...].astype(f32)
+    Nr = nnr_ref[...].astype(f32)
+    Ni = nni_ref[...].astype(f32)
+    mu = mu_ref[0]                 # (tile,)
+
+    # -- joint scale normalization (filters._whitened: filter-invariant,
+    # keeps warm-up ~1e-12 covariances inside f32 iteration range)
+    tr = Nr[0, 0]
+    for c in range(1, C):
+        tr = tr + Nr[c, c]
+    tr = tr * (1.0 / C)
+    scale = (1.0 / jnp.maximum(tr, np.float32(np.finfo(np.float32).smallest_normal)))[None, None]
+    Sr = Sr * scale
+    Si = Si * scale
+    Nr = Nr * scale
+    Ni = Ni * scale
+
+    # -- relative diagonal loading (filters._load_diag)
+    tr2 = Nr[0, 0]
+    for c in range(1, C):
+        tr2 = tr2 + Nr[c, c]
+    load = loading * (tr2 * (1.0 / C)) + np.float32(np.finfo(np.float32).tiny)
+
+    Lr, Li, inv_diag = _elem_cholesky(Nr, Ni, load, C)
+    Ael_r, Ael_i = _elem_whiten(Sr, Si, Lr, Li, inv_diag, C)
+    Ar = _rows_to_plane(_elems_to_rows(Ael_r, C), C)
+    Ai = _rows_to_plane(_elems_to_rows(Ael_i, C), C)
+
+    # -- fixed-sweep cyclic Jacobi with eigenvector accumulation (the
+    # eigh_ops lanes-layout schedule, intermediates VMEM-resident)
+    eye = (
+        jax.lax.broadcasted_iota(jnp.int32, (C, C, 1), 0)
+        == jax.lax.broadcasted_iota(jnp.int32, (C, C, 1), 1)
+    ).astype(f32)
+    Vr = jnp.broadcast_to(eye, Ar.shape)
+    Vi = jnp.zeros_like(Ar)
+
+    def one_sweep(_, carry):
+        Ar, Ai, Vr, Vi = carry
+        for p, q in _pairs(C):
+            Ar, Ai, Vr, Vi = _lane_rotation(Ar, Ai, Vr, Vi, p, q, eps)
+        return Ar, Ai, Vr, Vi
+
+    Ar, Ai, Vr, Vi = jax.lax.fori_loop(0, sweeps, one_sweep, (Ar, Ai, Vr, Vi))
+
+    # -- dominant eigenpair: unrolled running max over the converged
+    # diagonal (no sort — rank 1 needs only the top pair)
+    lam = jnp.sum(Ar * eye, axis=1)  # (C, tile)
+    best = lam[0]
+    ur, ui = Vr[:, 0], Vi[:, 0]      # (C, tile)
+    for c in range(1, C):
+        better = lam[c] > best
+        best = jnp.where(better, lam[c], best)
+        ur = jnp.where(better[None], Vr[:, c], ur)
+        ui = jnp.where(better[None], Vi[:, c], ui)
+    lam1 = jnp.clip(best, lam_floor, lam_ceil)
+
+    # -- back-substitution q1 = L^-H u1 (L^H upper-triangular, unrolled)
+    qr: dict = {}
+    qi: dict = {}
+    for i in reversed(range(C)):
+        rr = ur[i]
+        ri = ui[i]
+        for k in range(i + 1, C):
+            # L^H[i, k] = conj(L[k, i])
+            lr, li = Lr[(k, i)], -Li[(k, i)]
+            rr = rr - (lr * qr[k] - li * qi[k])
+            ri = ri - (lr * qi[k] + li * qr[k])
+        inv = inv_diag[i]            # L[i, i] real
+        qr[i] = rr * inv
+        qi[i] = ri * inv
+
+    # -- filter formation: (Q^-1)[0,0] = conj(u1[0] L[0,0]); W = q1 g qinv00
+    qinv_r = ur[0] * Lr[(0, 0)]
+    qinv_i = -ui[0] * Lr[(0, 0)]
+    g = lam1 / (lam1 + mu)
+    cr = g * qinv_r
+    ci = g * qinv_i
+    w_re = [qr[i] * cr - qi[i] * ci for i in range(C)]
+    w_im = [qr[i] * ci + qi[i] * cr for i in range(C)]
+    t_re = [qr[i] * qinv_r - qi[i] * qinv_i for i in range(C)]
+    t_im = [qr[i] * qinv_i + qi[i] * qinv_r for i in range(C)]
+
+    row_1d = jax.lax.broadcasted_iota(jnp.int32, (C, 1), 0)
+
+    def stack_c(lanes):
+        out = jnp.zeros((C,) + mu.shape, f32)
+        for i in range(C):
+            out = jnp.where(row_1d == i, lanes[i][None], out)
+        return out
+
+    wr_ref[...] = stack_c(w_re)
+    wi_ref[...] = stack_c(w_im)
+    t1r_ref[...] = stack_c(t_re)
+    t1i_ref[...] = stack_c(t_im)
+
+
+@partial(jax.jit, static_argnames=("sweeps", "tile", "interpret", "precision"))
+def fused_mwf_pallas(Rss: jnp.ndarray, Rnn: jnp.ndarray, mu: float = 1.0,
+                     sweeps: int | None = None, tile: int = 512,
+                     interpret: bool = False, precision: str = "f32"):
+    """:func:`fused_mwf_xla` as ONE pallas program (module docstring): the
+    pencil tile is read HBM->VMEM once, the whole whiten/Jacobi/filter
+    chain runs in VMEM, and only the (..., C) filter planes are written
+    back.
+
+    Args:
+      Rss, Rnn: (..., C, C) hermitian PSD pencils, complex64 or float32;
+        batch dims are flattened into the LANE dim in tiles of ``tile``
+        pencils per grid step (``tile`` a multiple of 128).
+      mu: speech-distortion tradeoff (traced — one program per shape
+        bucket, not per mu).
+      sweeps: Jacobi sweep count; None -> ``eigh_ops.default_sweeps``.
+      interpret: pallas interpreter mode (CPU correctness tests; the
+        Mosaic lowering is TPU-only).
+      precision: 'f32' (default) or 'bf16' — the pencil planes cross
+        HBM->VMEM as bfloat16 and are converted once on read; every
+        in-VMEM iteration stays float32 (module docstring; gated by the
+        documented looser oracle tolerances).
+
+    Returns:
+      (W, t1): filter and GEVD selection vector, each (..., C) complex64,
+      UNsanitized (see :func:`rank1_gevd_fused`).
+
+    The rank-1 'gevd' branch of reference internal_formulas.py:56-73 as a
+    single fused device program.
+    """
+    from jax.experimental import pallas as pl
+
+    Rss = jnp.asarray(Rss)
+    Rnn = jnp.asarray(Rnn)
+    C = Rss.shape[-1]
+    if sweeps is None:
+        sweeps = default_sweeps(C)
+    batch_shape = Rss.shape[:-2]
+    dt = compute_dtype(precision)
+
+    def planes(R):
+        # (..., C, C) -> lanes layout (C, C, B); bf16 lane quantizes here
+        re = jnp.real(R).astype(dt).reshape((-1, C, C)).transpose(1, 2, 0)
+        im = jnp.imag(R).astype(dt).reshape((-1, C, C)).transpose(1, 2, 0)
+        return re, im
+
+    Sr, Si = planes(Rss)
+    Nr, Ni = planes(Rnn)
+    B = Sr.shape[-1]
+    n_tiles = -(-B // tile)
+    pad = n_tiles * tile - B
+    if pad:
+        # identity-pencil padding keeps the padded solves well-conditioned
+        eye = jnp.broadcast_to(jnp.eye(C, dtype=dt)[:, :, None], (C, C, pad))
+        zero = jnp.zeros((C, C, pad), dt)
+        Sr = jnp.concatenate([Sr, eye], axis=-1)
+        Si = jnp.concatenate([Si, zero], axis=-1)
+        Nr = jnp.concatenate([Nr, eye], axis=-1)
+        Ni = jnp.concatenate([Ni, zero], axis=-1)
+    mu_lane = jnp.full((1, n_tiles * tile), mu, jnp.float32)
+    eps = float(np.finfo(np.float32).tiny ** 0.5)
+
+    from disco_tpu.beam.filters import DIAG_LOADING, EIG_CEIL, EIG_FLOOR
+
+    wr, wi, t1r, t1i = pl.pallas_call(
+        partial(_mwf_kernel, C=C, sweeps=sweeps, eps=eps,
+                loading=float(DIAG_LOADING),
+                lam_floor=np.float32(EIG_FLOOR), lam_ceil=np.float32(EIG_CEIL)),
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((C, C, tile), lambda i: (0, 0, i)),
+            pl.BlockSpec((C, C, tile), lambda i: (0, 0, i)),
+            pl.BlockSpec((C, C, tile), lambda i: (0, 0, i)),
+            pl.BlockSpec((C, C, tile), lambda i: (0, 0, i)),
+            pl.BlockSpec((1, tile), lambda i: (0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((C, tile), lambda i: (0, i)),
+            pl.BlockSpec((C, tile), lambda i: (0, i)),
+            pl.BlockSpec((C, tile), lambda i: (0, i)),
+            pl.BlockSpec((C, tile), lambda i: (0, i)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((C, n_tiles * tile), jnp.float32)] * 4,
+        interpret=interpret,
+    )(Sr, Si, Nr, Ni, mu_lane)
+    W = jax.lax.complex(wr, wi)[:, :B].transpose(1, 0).reshape(batch_shape + (C,))
+    t1 = jax.lax.complex(t1r, t1i)[:, :B].transpose(1, 0).reshape(batch_shape + (C,))
+    return W, t1
+
+
+def rank1_gevd_fused(Rss, Rnn, mu: float = 1.0, impl: str = "auto",
+                     sweeps: int | None = None, precision: str = "f32",
+                     sanitize: bool = True, interpret: bool | None = None):
+    """The fused rank-1 GEVD-MWF solve with implementation dispatch — the
+    ``solver='fused*'`` target of :func:`disco_tpu.beam.filters.rank1_gevd`
+    (reference internal_formulas.py:56-73 at rank 1).
+
+    ``impl`` resolves through the shared ``ops.resolve`` policy
+    (:func:`resolve_mwf_impl`: 'auto' = pallas on real TPUs, xla
+    elsewhere, :data:`MWF_IMPL_ENV` override); ``interpret=None`` resolves
+    to the pallas interpreter off-TPU.  ``sanitize`` matches
+    ``gevd_mwf``'s degenerate-bin policy: non-finite filters (near-singular
+    pencils past the diagonal loading) fall back to the e1 pass-through
+    selector; ``sanitize=False`` surfaces them for callers with their own
+    fallback (the streaming ffill hold).
+    """
+    impl = resolve_mwf_impl(impl)
+    if impl == "pallas":
+        if interpret is None:
+            from disco_tpu.utils.backend import is_tpu
+
+            interpret = not is_tpu()
+        W, t1 = fused_mwf_pallas(Rss, Rnn, mu=mu, sweeps=sweeps,
+                                 interpret=interpret, precision=precision)
+    else:
+        W, t1 = fused_mwf_xla(Rss, Rnn, mu=mu, sweeps=sweeps,
+                              precision=precision)
+    if not sanitize:
+        return W, t1
+    e1 = jnp.zeros_like(W).at[..., 0].set(1.0)
+    ok = (jnp.isfinite(W.real) & jnp.isfinite(W.imag)).all(-1, keepdims=True)
+    return jnp.where(ok, W, e1), jnp.where(ok, t1, e1)

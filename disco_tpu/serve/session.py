@@ -111,6 +111,19 @@ class SessionConfig:
             raise ValueError(
                 f"session config lambda_cor must be in (0, 1), got {self.lambda_cor!r}"
             )
+        # THE shared solver grammar (disco_tpu.solver_spec — the same
+        # validator the CLI and the rank1_gevd dispatch use), so a bad
+        # wire-decoded spec fails at admission with a clean error instead
+        # of at first dispatch inside the tick loop.  solver_spec is
+        # stdlib-only: SessionConfig is constructed in the numpy-only
+        # CLIENT process too, which must never import jax (DL005 purity /
+        # single-chip-claim contract).
+        from disco_tpu.solver_spec import parse_solver_spec
+
+        try:
+            parse_solver_spec(self.solver)
+        except ValueError as e:
+            raise ValueError(f"session config solver: {e}") from None
 
     @property
     def block_shape(self):

@@ -1,0 +1,56 @@
+"""THE rank-1 GEVD solver-spec grammar — stdlib-only, importable anywhere.
+
+One parser for the ``'base'`` / ``'base:N'`` solver specs shared by the
+:func:`disco_tpu.beam.filters.rank1_gevd` dispatch table, the CLI
+validator (``cli/common.solver_spec``) and the serve admission check
+(``serve.session.SessionConfig``).  It lives OUTSIDE ``beam/filters.py``
+because that module imports jax at module level while two of the
+grammar's consumers must stay jax-free: the numpy-only serve client
+constructs ``SessionConfig`` in its own process (client purity contract,
+DL005 — pulling jax into a client host would also re-trigger the
+single-chip-claim hazard the contract exists to prevent), and argparse
+validation should not pay a jax import to reject a typo.
+
+No reference counterpart: solver selection is a TPU-port concern — the
+reference solves every (node, freq) pencil one way only
+(``scipy.linalg.eig``, internal_formulas.py:31-81).
+"""
+from __future__ import annotations
+
+#: every solver spec base the rank-1 GEVD dispatch table accepts
+RANK1_SOLVERS = ("eigh", "power", "jacobi", "jacobi-pallas",
+                 "fused", "fused-xla", "fused-pallas")
+
+#: the fused solver family's spec -> ``ops.resolve`` impl knob ('fused'
+#: resolves per backend exactly like cov_impl/stft_impl 'auto')
+FUSED_IMPLS = {"fused": "auto", "fused-xla": "xla", "fused-pallas": "pallas"}
+
+
+def parse_solver_spec(v: str) -> tuple[str, int | None]:
+    """THE parser for rank-1 GEVD solver specs — ``'base'`` or ``'base:N'``
+    with base in :data:`RANK1_SOLVERS` — shared by ``rank1_gevd``, the CLI
+    validator and the serve admission check, so the dispatch table,
+    argparse and the wire protocol can never disagree on the grammar.
+    Returns (base, N-or-None); raises ValueError on an unknown base, an
+    'eigh:N' suffix, or a malformed/empty/<1 N (including multi-colon
+    strings).
+
+    No reference counterpart (module docstring).
+    """
+    base, sep, n_str = v.partition(":")
+    if base not in RANK1_SOLVERS:
+        raise ValueError(
+            f"unknown GEVD solver {v!r}; expected one of {RANK1_SOLVERS}, "
+            "optionally with ':N' (power iterations / jacobi sweeps)"
+        )
+    if not sep:
+        return base, None
+    if base == "eigh":
+        raise ValueError(f"solver spec {v!r}: 'eigh' takes no ':N' suffix")
+    try:
+        n = int(n_str)
+    except ValueError:
+        n = 0
+    if n < 1:
+        raise ValueError(f"malformed solver spec {v!r}: '{base}:N' needs integer N >= 1")
+    return base, n

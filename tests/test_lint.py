@@ -30,8 +30,8 @@ def rule_ids(res):
 # -- registry ----------------------------------------------------------------
 def test_rule_catalog_shape():
     rules = analysis.get_rules()
-    assert len(rules) == 15
-    assert sorted(rules) == [f"DL{i:03d}" for i in range(1, 16)]
+    assert len(rules) == 16
+    assert sorted(rules) == [f"DL{i:03d}" for i in range(1, 17)]
     for rid, rule in rules.items():
         assert rule.id == rid and rule.name and rule.summary
 
@@ -563,6 +563,66 @@ def test_dl015_timer_with_registered_leaf_is_clean():
             self._timer = threading.Timer(1.0, self._fire)
     """
     assert rule_ids(lint(src, "disco_tpu/foo.py", rules={"DL015"})) == []
+
+
+# -- DL016 fused-solver-selection ---------------------------------------------
+def test_dl016_flags_direct_fused_op_calls():
+    src = """
+    from disco_tpu.ops.mwf_ops import rank1_gevd_fused
+    def solve(Rss, Rnn):
+        return rank1_gevd_fused(Rss, Rnn, impl="pallas")
+    """
+    assert rule_ids(lint(src, "disco_tpu/enhance/foo.py",
+                         rules={"DL016"})) == ["DL016"]
+    # the resolver and the raw kernels count too, attribute form included
+    src2 = """
+    from disco_tpu.ops import mwf_ops
+    impl = mwf_ops.resolve_mwf_impl("auto")
+    w, t1 = mwf_ops.fused_mwf_pallas(Rss, Rnn)
+    """
+    assert rule_ids(lint(src2, "disco_tpu/serve/scheduler.py",
+                         rules={"DL016"})) == ["DL016", "DL016"]
+
+
+def test_dl016_flags_fused_literal_comparisons():
+    src = """
+    def pick(solver):
+        if solver == "fused":
+            return 1
+        if solver in ("fused-pallas", "eigh"):
+            return 2
+        return 0
+    """
+    assert rule_ids(lint(src, "disco_tpu/cli/foo.py",
+                         rules={"DL016"})) == ["DL016", "DL016"]
+    # the ':N' suffixed spellings are the same family
+    src2 = 'ok = spec != "fused:8"\n'
+    assert rule_ids(lint(src2, "disco_tpu/enhance/foo.py",
+                         rules={"DL016"})) == ["DL016"]
+
+
+def test_dl016_near_misses():
+    # passing a fused spec AS DATA through the dispatch table is the
+    # sanctioned path; other string comparisons are untouched; ops/ and
+    # the dispatch table itself are exempt
+    src = """
+    from disco_tpu.beam.filters import rank1_gevd
+    def run(Rss, Rnn):
+        w, _ = rank1_gevd(Rss, Rnn, solver="fused")
+        mode = "offline"
+        if mode == "streaming":
+            pass
+        return w
+    """
+    assert rule_ids(lint(src, "disco_tpu/enhance/foo.py", rules={"DL016"})) == []
+    src2 = """
+    from disco_tpu.ops.mwf_ops import rank1_gevd_fused
+    def dispatch(base):
+        if base == "fused":
+            return rank1_gevd_fused
+    """
+    assert rule_ids(lint(src2, "disco_tpu/ops/mwf_ops.py", rules={"DL016"})) == []
+    assert rule_ids(lint(src2, "disco_tpu/beam/filters.py", rules={"DL016"})) == []
 
 
 # -- the repo itself ---------------------------------------------------------

@@ -779,6 +779,27 @@ def test_obs_compare_refuses_cross_backend_records(tmp_path, capsys):
     assert "refusing to judge" in err and "axon" in err and "cpu" in err
 
 
+def test_obs_compare_refuses_cpu_fallback_against_committed_r05(tmp_path, capsys):
+    """The COMMITTED on-TPU r05 baseline (its parsed record carries
+    backend='axon' — provenance the run's own stderr tail logged) must
+    refuse a CPU-fallback candidate with exit 2: the exact BENCH_r06
+    hazard of a session without the 'axon' backend producing a
+    CPU-degraded record that would otherwise read as a catastrophic
+    regression against the on-TPU trajectory."""
+    root = Path(__file__).resolve().parents[1]
+    r05 = json.loads((root / "BENCH_r05.json").read_text())
+    assert r05["parsed"]["backend"] == "axon"   # the annotation under test
+    cand = tmp_path / "r06_cpu_fallback.json"
+    rec = _bench_record(3.2)                    # CPU-speed "regression"
+    rec["backend"] = "cpu"
+    cand.write_text(json.dumps(rec))
+    with pytest.raises(SystemExit) as exc:
+        obs_cli.main(["compare", str(root / "BENCH_r05.json"), str(cand)])
+    assert exc.value.code == 2
+    err = capsys.readouterr().err
+    assert "refusing to judge" in err and "axon" in err and "cpu" in err
+
+
 def test_obs_compare_backend_judged_when_matching_or_legacy(tmp_path):
     """Same backend on both sides is judged normally, and records from
     before the field existed (BENCH_r01–r05) carry no claim: comparisons
@@ -1128,3 +1149,46 @@ def test_bench_record_carries_fused_kernel_fields(monkeypatch, capsys):
     assert record["rtf_bf16"] == 7200.0
     assert record["bf16_max_rel_err"] == 0.0021
     assert record["bf16_error"] is None
+
+
+def test_bench_record_carries_fused_solve_lane_and_provenance(monkeypatch, capsys):
+    """The solve-fusion round's record contract: rtf_fused_solver rides the
+    line, and solver_lanes names each solve lane's resolved spec AND
+    concrete impl (post-ops.resolve) so records distinguish jacobi XLA
+    from pallas from the fused kernel without re-running."""
+    import bench
+
+    canned = dict(_canned_bench_jax())
+    canned.update({
+        "rtf_fused": 9100.0, "fused_error": None,
+        "solver_lanes": {
+            "rtf": {"spec": "power", "base": "power", "n": None, "impl": "xla"},
+            "rtf_fused_solver": {"spec": "fused", "base": "fused", "n": None,
+                                 "impl": "pallas"},
+        },
+    })
+    monkeypatch.setattr(bench, "bench_jax", lambda **_: canned)
+    monkeypatch.setattr(bench, "bench_streaming", lambda **_: (0.85, 16.0, 18.9))
+    monkeypatch.setattr(bench, "bench_streaming_scan",
+                        lambda **_: (95.0, 2.7, 0.125,
+                                     {"blocks_per_dispatch": 8}))
+    monkeypatch.setattr(bench, "bench_corpus", _canned_bench_corpus)
+    monkeypatch.setattr(bench, "bench_serve", _canned_bench_serve)
+    monkeypatch.setattr(bench, "bench_numpy", lambda **_: 3.0)
+    bench.main([])
+    out_lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(out_lines) == 1
+    record = json.loads(out_lines[0])
+    assert record["rtf_fused_solver"] == 9100.0
+    assert record["fused_error"] is None
+    assert record["solver_lanes"]["rtf_fused_solver"]["impl"] == "pallas"
+    assert record["solver_lanes"]["rtf"]["spec"] == "power"
+    # a failed lane still distinguishes "crashed" from "not measured"
+    canned2 = dict(_canned_bench_jax())
+    canned2.update({"rtf_fused": None, "fused_error": "XlaRuntimeError: boom"})
+    monkeypatch.setattr(bench, "bench_jax", lambda **_: canned2)
+    bench.main([])
+    record2 = json.loads(
+        [l for l in capsys.readouterr().out.splitlines() if l.strip()][0])
+    assert record2["rtf_fused_solver"] is None
+    assert "XlaRuntimeError" in record2["fused_error"]
