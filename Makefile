@@ -3,9 +3,9 @@
 
 PYTHON ?= python
 
-.PHONY: test coverage doc install native clean bench milestone-corpus dryrun lint-check trace-check race-check obs-check fault-check chaos-check perf-check serve-check stream-check flywheel-check soak-check scope-check
+.PHONY: test coverage doc install native clean bench milestone-corpus dryrun lint-check trace-check race-check meter-check obs-check fault-check chaos-check perf-check serve-check stream-check flywheel-check soak-check scope-check
 
-test: lint-check trace-check race-check obs-check fault-check chaos-check perf-check stream-check serve-check flywheel-check soak-check scope-check
+test: lint-check trace-check race-check meter-check obs-check fault-check chaos-check perf-check stream-check serve-check flywheel-check soak-check scope-check
 	$(PYTHON) -m pytest tests/ -q
 
 # Static-analysis gate (runs FIRST: it needs no jax, no device and ~2 s):
@@ -65,6 +65,26 @@ trace-check:
 # (doc/source/static_analysis.rst, "Thread contracts").
 race-check:
 	$(PYTHON) -m disco_tpu.analysis.race.cli
+
+# Cost-manifest gate (the fourteenth gate, right after race-check — cheap
+# and hermetic like trace-check, whose abstract tracing it reuses):
+# disco-meter walks every canonical hot-path program's jaxpr with the
+# analytic cost model (analysis/meter/costmodel.py) and diffs the
+# resulting manifests — flops, HBM traffic with per-iteration scan-carry
+# accounting and VMEM-resident fused islands at boundary cost, boundary
+# bytes, peak-live-bytes, per-primitive-class breakdown, an EXPLICIT
+# unmodeled bucket — against the goldens committed under
+# disco_tpu/analysis/golden/cost/; enforces the declared budgets (the
+# unmodeled-traffic ceiling, and the fused step-2 solve modeling strictly
+# fewer HBM bytes than the separate-stage eigh path — the solve-fusion
+# thesis as a hard inequality); and keeps the trace catalog and the
+# manifest directory in exact sync (a program added without a manifest
+# fails, as does a stale manifest).  `disco-meter --update` after a
+# REVIEWED cost change (doc/source/observability.rst, "Reading the
+# roofline").
+meter-check:
+	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= DISCO_TPU_COMPILE_CACHE=off \
+	    $(PYTHON) -m disco_tpu.analysis.meter.cli
 
 # Telemetry gates (run before the suite so drift fails fast):
 # 1. the bench trajectory must not regress between the last two committed

@@ -754,6 +754,69 @@ def build_parser():
     return p
 
 
+def _meter_join(r, batch, dur_s, rtf_scan, scan_stats, serve_bps):
+    """The in-record roofline join: measured stage/lane times × the
+    analytic disco-meter stage costs at THIS run's workload
+    (``disco_tpu.analysis.meter.stages`` — abstract tracing, milliseconds
+    of host work, no extra device dispatch).  Returns the record fields:
+    ``mfu_by_stage`` / ``hbm_gbps_by_stage`` (per timed offline stage),
+    ``lane_mfu`` / ``lane_flops`` (streaming-scan window, serve block and
+    fused-solver lanes — the RTF-only lanes finally get attributable
+    flops), ``workload`` and ``cost_model_version``.  These are the
+    MODEL's conservative algorithmic flops, deliberately a different
+    convention from the XLA ``cost_analysis`` flops behind the headline
+    ``mfu``/``flops_per_clip`` — ``cost_model_version`` marks which
+    convention a consumer is joining against."""
+    from disco_tpu.analysis.meter import costmodel, stages
+
+    peak = _peak_flops()
+    w = stages.Workload(batch=batch, dur_s=dur_s, fs=FS,
+                        n_nodes=K, mics_per_node=C)
+    sc = stages.offline_stage_costs(w)
+    mfu_by_stage, gbps_by_stage = {}, {}
+    for sk, ms in (r.get("stage_ms") or {}).items():
+        cost = sc.get(sk)
+        if not cost or not ms:
+            continue
+        secs = ms / 1e3
+        mfu_by_stage[sk] = round(cost["flops"] / secs / peak, 6)
+        gbps_by_stage[sk] = round(cost["traffic_bytes"] / secs / 1e9, 3)
+    lane_mfu, lane_flops = {}, {}
+    if rtf_scan and scan_stats:
+        scost = stages.streaming_scan_cost(
+            dur_s=dur_s, fs=FS,
+            blocks_per_dispatch=scan_stats["blocks_per_dispatch"])
+        if scost and scost["window_frames"] == scan_stats["window_frames"]:
+            # rtf_scan is tunnel-included per-window realtime factor:
+            # wall seconds per window = frames x hop / fs / rtf
+            wall_s = scost["window_frames"] * 256 / FS / rtf_scan
+            lane_flops["streaming_scan_window"] = scost["flops"]
+            lane_mfu["streaming_scan"] = round(
+                scost["flops"] / wall_s / peak, 6)
+    if serve_bps:
+        bcost = stages.serve_block_cost(
+            dur_s=float(os.environ.get("BENCH_SERVE_DUR_S", 4.0)), fs=FS)
+        lane_flops["serve_block"] = bcost["flops"]
+        lane_mfu["serve"] = round(bcost["flops"] * serve_bps / peak, 6)
+    if r.get("rtf_fused"):
+        fcost = stages.fused_pipeline_cost(w)
+        audio_s = batch * K * dur_s
+        dt_fused = audio_s / r["rtf_fused"]
+        lane_flops["fused_pipeline"] = fcost["flops"]
+        lane_mfu["fused_solver"] = round(
+            fcost["flops"] / dt_fused / peak, 6)
+    return {
+        "mfu_by_stage": mfu_by_stage,
+        "hbm_gbps_by_stage": gbps_by_stage,
+        "lane_mfu": lane_mfu,
+        "lane_flops": lane_flops,
+        "workload": {"batch": batch, "dur_s": dur_s, "fs": FS,
+                     "n_nodes": K, "mics_per_node": C},
+        "cost_model_version": costmodel.VERSION,
+        "meter_error": None,
+    }
+
+
 def main(argv=None):
     args = build_parser().parse_args(argv)
     # knobs: BENCH_BATCH / BENCH_DUR_S / BENCH_ITERS override the workload
@@ -896,6 +959,18 @@ def main(argv=None):
     except Exception:
         rtf_np = None
     vs = (r["rtf"] / rtf_np) if rtf_np else None
+    # the roofline join (analysis/meter): per-stage MFU / HBM GB/s and
+    # per-lane flop attribution — pure host-side tracing, and a failure
+    # must degrade to a named error, never fail the bench
+    meter = {"mfu_by_stage": None, "hbm_gbps_by_stage": None,
+             "lane_mfu": None, "lane_flops": None, "workload": None,
+             "cost_model_version": None, "meter_error": None}
+    try:
+        with obs_events.stage("bench_meter"):
+            meter = _meter_join(r, batch, dur_s, rtf_scan, scan_stats,
+                                serve_bps)
+    except Exception as e:
+        meter["meter_error"] = f"{type(e).__name__}: {e}"[:200]
     # the ACTIVE jax backend, recorded so `disco-obs compare` can refuse
     # to judge a CPU-fallback run against an on-TPU baseline (the
     # BENCH_r06 hazard: a silently-degraded backend poisons the r05
@@ -956,7 +1031,14 @@ def main(argv=None):
         "mfu": round(r["mfu"], 6) if r["mfu"] else None,
         "flops_per_clip": round(r["flops_per_clip"]) if r["flops_per_clip"] else None,
         "stage_ms": r["stage_ms"],
-        "notes": "value = DEFAULT pipeline (solver=power since round 4; rtf_eigh_solver is the reference-bit-matching lane; rtf_fused_solver = the VMEM-resident cov->whiten->Jacobi->filter solve (ops/mwf_ops.py); solver_lanes records each solve lane's resolved spec AND concrete impl post-ops.resolve, so records distinguish jacobi XLA from pallas from fused without re-running; cov_impl/stft_impl fields name the ACTIVE kernels behind the 'auto' defaults — fused pallas on TPU, DISCO_TPU_COV_IMPL/DISCO_TPU_STFT_IMPL override; the hot path is fused: one spec+magnitude STFT over the stacked y/s/n streams, irm masks from the emitted magnitudes, mask-folded covariance accumulation; precision names the default lane, rtf_bf16/bf16_max_rel_err the opt-in bf16 compute lane measured against it), on-device RTF via k-queued slope timing (tunnel adds ~80ms/dispatch, reported separately; value_single_dispatch includes it); stages timed as separate fenced programs (full pipeline fuses tighter); streaming_rtf_scan / streaming_rtf_block = tunnel-included realtime factors of the scanned super-tick (blocks_per_dispatch blocks per fenced dispatch, streaming_tango_scan) vs per-block block-recursive deployment, dispatches_per_block from the obs fence accounting; corpus_clips_per_s = end-to-end miniature-corpus throughput through the pipelined prefetch/dispatch/readback engine (load+scoring included); serve_blocks_per_s / serve_p95_ms = online-service continuous-batching throughput and request-latency p95 over loopback (BENCH_SERVE_SESSIONS concurrent streaming sessions, compile warm-up excluded; serve_queue_wait/dispatch p95s split admission wait from device time); train_steps_per_s = flywheel CRNN train-step throughput (reduced-width model pinned in train_stats, one fence over the async step chain); tap_blocks_per_s = host-side corpus-tap spool throughput (offer -> shard rotation -> atomic write); span_overhead_ns = causal-tracing per-span cost, enabled (span bookkeeping + flight ring) minus disabled (the strict-no-op seam — span_stats.disabled_ns is the measured no-op, perf-check asserts it ~0); numpy baseline at 2s clips; MFU vs dense-f32 peak (pipeline is FFT/small-eig bound by design)",
+        "mfu_by_stage": meter["mfu_by_stage"],
+        "hbm_gbps_by_stage": meter["hbm_gbps_by_stage"],
+        "lane_mfu": meter["lane_mfu"],
+        "lane_flops": meter["lane_flops"],
+        "workload": meter["workload"],
+        "cost_model_version": meter["cost_model_version"],
+        "meter_error": meter["meter_error"],
+        "notes": "value = DEFAULT pipeline (solver=power since round 4; rtf_eigh_solver is the reference-bit-matching lane; rtf_fused_solver = the VMEM-resident cov->whiten->Jacobi->filter solve (ops/mwf_ops.py); solver_lanes records each solve lane's resolved spec AND concrete impl post-ops.resolve, so records distinguish jacobi XLA from pallas from fused without re-running; cov_impl/stft_impl fields name the ACTIVE kernels behind the 'auto' defaults — fused pallas on TPU, DISCO_TPU_COV_IMPL/DISCO_TPU_STFT_IMPL override; the hot path is fused: one spec+magnitude STFT over the stacked y/s/n streams, irm masks from the emitted magnitudes, mask-folded covariance accumulation; precision names the default lane, rtf_bf16/bf16_max_rel_err the opt-in bf16 compute lane measured against it), on-device RTF via k-queued slope timing (tunnel adds ~80ms/dispatch, reported separately; value_single_dispatch includes it); stages timed as separate fenced programs (full pipeline fuses tighter); streaming_rtf_scan / streaming_rtf_block = tunnel-included realtime factors of the scanned super-tick (blocks_per_dispatch blocks per fenced dispatch, streaming_tango_scan) vs per-block block-recursive deployment, dispatches_per_block from the obs fence accounting; corpus_clips_per_s = end-to-end miniature-corpus throughput through the pipelined prefetch/dispatch/readback engine (load+scoring included); serve_blocks_per_s / serve_p95_ms = online-service continuous-batching throughput and request-latency p95 over loopback (BENCH_SERVE_SESSIONS concurrent streaming sessions, compile warm-up excluded; serve_queue_wait/dispatch p95s split admission wait from device time); train_steps_per_s = flywheel CRNN train-step throughput (reduced-width model pinned in train_stats, one fence over the async step chain); tap_blocks_per_s = host-side corpus-tap spool throughput (offer -> shard rotation -> atomic write); span_overhead_ns = causal-tracing per-span cost, enabled (span bookkeeping + flight ring) minus disabled (the strict-no-op seam — span_stats.disabled_ns is the measured no-op, perf-check asserts it ~0); numpy baseline at 2s clips; MFU vs dense-f32 peak (pipeline is FFT/small-eig bound by design); mfu_by_stage/hbm_gbps_by_stage = measured stage_ms joined with the analytic disco-meter stage costs at this run's workload (analysis/meter/stages.py — conservative algorithmic flops under cost_model_version conventions, deliberately NOT the XLA cost_analysis flops behind mfu/flops_per_clip), lane_mfu/lane_flops attribute the streaming-scan window, serve block, and fused-solver lanes through the same model (disco-obs roofline renders the full verdict table from this record)",
     }
     # sideband first (mirror of the stdout record + final counter snapshot),
     # THEN the one stdout line — events go to the file, never stdout.

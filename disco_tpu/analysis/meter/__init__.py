@@ -1,0 +1,32 @@
+"""disco-meter: the per-program cost & roofline observatory.
+
+Static cost accounting for every canonical hot-path program in the
+:data:`disco_tpu.analysis.trace.programs.PROGRAMS` catalog: FLOPs, bytes
+moved to/from HBM, arithmetic intensity and a peak-live-bytes estimate,
+derived from the same forced-CPU abstract tracing the disco-trace gate
+already performs — no device work, no chip claim, deterministic on any
+host.  The committed cost manifests under ``analysis/golden/cost/`` turn
+the fusion arc's central claim ("the fused solve reads the pencils from
+HBM once and writes back only the weights") into a hard, regression-gated
+assertion, and the roofline join (``disco-obs roofline``) merges these
+manifests with measured ``stage_ms`` from any bench record into a
+per-stage compute-bound / bandwidth-bound / dispatch-bound verdict.
+
+Modules:
+
+* :mod:`~disco_tpu.analysis.meter.costmodel` — the jaxpr-walking cost
+  model (pure function of a traced program; the ``unmodeled`` bucket is
+  explicit, never a silent zero).
+* :mod:`~disco_tpu.analysis.meter.budgets` — declared per-program
+  unmodeled-fraction ceilings and cross-program traffic assertions.
+* :mod:`~disco_tpu.analysis.meter.stages` — workload-sized stage programs
+  mirroring ``bench.py``'s timed stages, so measured ``stage_ms`` joins a
+  cost computed on the SAME program shape.
+* :mod:`~disco_tpu.analysis.meter.check` — the ``make meter-check`` gate
+  (the fourteenth hermetic gate): manifests diffed against goldens,
+  registry sync with the trace catalog, budget enforcement.
+* :mod:`~disco_tpu.analysis.meter.cli` — the ``disco-meter`` command line.
+
+No reference counterpart: the reference repo has no cost model and no
+performance gates (SURVEY.md §5.1).
+"""

@@ -1,0 +1,97 @@
+"""Declared cost budgets the meter gate enforces.
+
+Two kinds of budget, both *declarations reviewed in this file*, not
+emergent numbers:
+
+* **Unmodeled-traffic ceilings** — the explicit-unknowns contract.  The
+  cost model never silently zeroes a primitive it does not know; the
+  unknown's boundary traffic lands in the ``unmodeled`` bucket and this
+  module holds that bucket's share of total traffic under a declared
+  ceiling per program.  A new primitive drifting into a hot path either
+  gets modeled (extend :mod:`~disco_tpu.analysis.meter.costmodel`'s
+  tables) or the gate goes red — there is no third path.
+* **Cross-program assertions** — relations between programs that encode a
+  design thesis as an inequality.  The one that motivated the meter: the
+  fused rank-1 GEVD-MWF step-2 chain must model strictly fewer HBM bytes
+  than the separate-stage eigh chain (the solve-fusion round's "read the
+  pencils once, write back only the weights", held as a hard gate).
+
+No reference counterpart: the reference repo has no cost model
+(SURVEY.md §5.1).
+"""
+from __future__ import annotations
+
+#: default ceiling on ``unmodeled.traffic_fraction`` — today every
+#: registered program models to exactly 0.0, so the ceiling mostly guards
+#: FUTURE primitives; 5% keeps headroom for a stray cheap unknown without
+#: letting a real hot-loop primitive hide.
+UNMODELED_FRACTION_MAX = 0.05
+
+#: per-program overrides of :data:`UNMODELED_FRACTION_MAX` (none today;
+#: add an entry here — reviewed in the PR diff — to grant a program more
+#: unknown headroom)
+UNMODELED_OVERRIDES: dict = {}
+
+#: cross-program inequalities: (smaller, larger, report key, thesis).
+#: Each asserts ``report[smaller][key] < report[larger][key]`` strictly.
+CROSS_BUDGETS = (
+    (
+        "tango_step2_fused", "tango_step2_eigh", "traffic_bytes",
+        "the fused step-2 solve reads the (F,C,C) pencils from HBM once "
+        "and writes back only the (F,C) weights — fusing must model "
+        "strictly fewer HBM bytes than the separate-stage eigh path",
+    ),
+)
+
+
+def unmodeled_ceiling(program: str) -> float:
+    """The declared unmodeled-traffic ceiling of one program.
+
+    No reference counterpart (module docstring)."""
+    return float(UNMODELED_OVERRIDES.get(program, UNMODELED_FRACTION_MAX))
+
+
+def check_unmodeled(report: dict) -> list:
+    """Messages when a report's unmodeled bucket breaches its ceiling.
+
+    No reference counterpart (module docstring)."""
+    unmodeled = report.get("unmodeled") or {}
+    fraction = float(unmodeled.get("traffic_fraction") or 0.0)
+    ceiling = unmodeled_ceiling(report.get("program", ""))
+    if fraction <= ceiling:
+        return []
+    prims = unmodeled.get("primitives", {})
+    named = ", ".join(f"{k}×{v}" for k, v in sorted(prims.items())) or "?"
+    return [
+        f"unmodeled traffic fraction {fraction:.4f} exceeds the declared "
+        f"ceiling {ceiling:.4f} (primitives: {named}) — model them in "
+        "costmodel.py or raise the ceiling in budgets.py (reviewed)"
+    ]
+
+
+def check_cross(reports: dict) -> list:
+    """Messages for every violated (or unevaluable) cross-program budget.
+
+    ``reports`` maps program name -> cost report; a budget whose programs
+    are missing reports as a finding too — a cross assertion that silently
+    stops being evaluated is a gate hole, not a pass.
+
+    No reference counterpart (module docstring)."""
+    out: list = []
+    for small, large, key, thesis in CROSS_BUDGETS:
+        a, b = reports.get(small), reports.get(large)
+        if a is None or b is None:
+            missing = [n for n, r in ((small, a), (large, b)) if r is None]
+            out.append(
+                f"cross-budget {small} < {large} on {key}: program(s) "
+                f"{', '.join(missing)} missing from the run — the "
+                "assertion cannot be evaluated"
+            )
+            continue
+        va, vb = a.get(key), b.get(key)
+        if not (isinstance(va, int) and isinstance(vb, int) and va < vb):
+            out.append(
+                f"cross-budget violated: {small}.{key}={va} is not "
+                f"strictly below {large}.{key}={vb} — {thesis}"
+            )
+    return out

@@ -1,0 +1,242 @@
+"""The meter gate: cost manifests vs goldens + budgets + registry sync.
+
+``make meter-check`` runs :func:`main` (the FOURTEENTH hermetic gate,
+right after ``race-check``): every canonical hot-path program
+(:data:`~disco_tpu.analysis.trace.programs.PROGRAMS`) is traced on the
+same declared abstract inputs the trace gate uses, costed by the
+jaxpr-walking model (:mod:`~disco_tpu.analysis.meter.costmodel`), and the
+resulting manifest diffed against the golden committed under
+``disco_tpu/analysis/golden/cost/``.  On top of the per-program diff:
+
+* **budgets** — unmodeled-traffic ceilings and the cross-program
+  fused-vs-eigh HBM inequality (:mod:`~disco_tpu.analysis.meter.budgets`);
+  ``--update`` refuses to write a manifest that breaches its own budget,
+  so ``git add golden/cost/`` cannot smuggle an unmodeled hot loop in.
+* **registry sync** — every program in the trace catalog has a committed
+  manifest and every committed manifest names a live program (the DL009
+  pattern): a program added without a manifest fails the gate, as does a
+  stale manifest for a deleted program.
+
+Hermetic by construction: forced CPU via
+:func:`disco_tpu.analysis.trace.check.ensure_cpu`, abstract tracing only —
+no FLOP runs, no chip claim, deterministic manifests on any host.
+
+No reference counterpart: the reference repo has no cost model and no CI
+gates.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+
+from disco_tpu.analysis.trace.check import GOLDEN_DIR as _TRACE_GOLDEN_DIR
+from disco_tpu.analysis.trace.check import ensure_cpu
+
+#: where the committed cost manifests live (one canonical JSON per program)
+GOLDEN_DIR = _TRACE_GOLDEN_DIR / "cost"
+
+
+@dataclasses.dataclass
+class MeterResult:
+    """Everything one gate run produced (the JSON reporter's payload).
+
+    ``findings`` are gate-failing dicts with ``program`` (or ``-`` for
+    catalog-wide checks), ``check`` (``manifest``/``golden``/``budget``/
+    ``cross``/``registry``) and ``message`` — the disco-lint findings
+    shape, same as disco-trace.
+
+    No reference counterpart (module docstring).
+    """
+
+    findings: list
+    reports: dict
+    n_programs: int
+    updated: list
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+
+def _finding(program: str, check: str, message: str) -> dict:
+    return {"program": program, "check": check, "message": message}
+
+
+def golden_path(name: str) -> Path:
+    """The committed cost manifest of one program.
+
+    No reference counterpart (module docstring)."""
+    return GOLDEN_DIR / f"{name}.json"
+
+
+def load_golden(name: str) -> dict | None:
+    """Read one committed cost manifest (None when absent).
+
+    No reference counterpart (module docstring)."""
+    path = golden_path(name)
+    if not path.is_file():
+        return None
+    return json.loads(path.read_text())
+
+
+def build_report(spec, costmodel=None) -> dict:
+    """Trace one catalog program abstractly and cost it.
+
+    No reference counterpart (module docstring)."""
+    from disco_tpu.analysis.meter import costmodel as _cm
+
+    cm = costmodel or _cm
+    fn, args, kwargs = spec.build()
+    return cm.cost_of_fn(fn, args, kwargs=kwargs, program=spec.name)
+
+
+def run_checks(update: bool = False, programs=None) -> MeterResult:
+    """Run the gate.  ``update=True`` regenerates the manifests instead of
+    diffing (budgets still run: a manifest breaching its own unmodeled
+    ceiling, or breaking the cross-budget, must not be committable).
+    ``programs`` optionally restricts the pass; the registry-sync and
+    cross-program checks only run on a full pass (they are catalog-wide
+    statements).
+
+    No reference counterpart (module docstring).
+    """
+    ensure_cpu()
+
+    from disco_tpu.analysis.meter import budgets, costmodel
+    from disco_tpu.analysis.trace.programs import PROGRAMS
+
+    findings: list = []
+    reports: dict = {}
+    updated: list = []
+
+    for name in (programs or ()):
+        if name not in PROGRAMS:
+            raise KeyError(
+                f"unknown program {name!r}; known: {sorted(PROGRAMS)}")
+    selected = {
+        name: spec for name, spec in PROGRAMS.items()
+        if programs is None or name in programs
+    }
+
+    for name, spec in selected.items():
+        report = build_report(spec)
+        reports[name] = report
+        budget_msgs = budgets.check_unmodeled(report)
+        for msg in budget_msgs:
+            findings.append(_finding(name, "budget", msg))
+        if update:
+            if budget_msgs:
+                findings.append(_finding(
+                    name, "golden",
+                    "refusing to write a manifest that breaches its own "
+                    "unmodeled budget (model the primitives, then --update)",
+                ))
+            else:
+                GOLDEN_DIR.mkdir(parents=True, exist_ok=True)
+                golden_path(name).write_text(costmodel.dumps(report))
+                updated.append(name)
+        else:
+            golden = load_golden(name)
+            if golden is None:
+                findings.append(_finding(
+                    name, "golden",
+                    f"no committed cost manifest at {golden_path(name)} — "
+                    "generate one with `disco-meter --update` and commit it",
+                ))
+            else:
+                for line in costmodel.diff_reports(golden, report):
+                    findings.append(_finding(name, "manifest", line))
+
+    if programs is None:
+        # cross-program theses (fused < eigh) hold on the CURRENT reports:
+        # the claim gates the code as it is, not as it was last committed
+        for msg in budgets.check_cross(reports):
+            findings.append(_finding("-", "cross", msg))
+        # registry sync (the DL009 pattern): catalog and manifest dir must
+        # name exactly the same set of programs
+        committed = {p.stem for p in GOLDEN_DIR.glob("*.json")}
+        for name in sorted(set(PROGRAMS) - committed - set(updated)):
+            findings.append(_finding(
+                name, "registry",
+                "program is in the trace catalog but has no cost manifest "
+                "under analysis/golden/cost/ — run `disco-meter --update`",
+            ))
+        for stem in sorted(committed - set(PROGRAMS)):
+            findings.append(_finding(
+                stem, "registry",
+                "stale cost manifest: no such program in the trace catalog "
+                f"— delete {golden_path(stem)} or restore the program",
+            ))
+
+    return MeterResult(
+        findings=findings, reports=reports,
+        n_programs=len(selected), updated=updated,
+    )
+
+
+def format_text(result: MeterResult) -> str:
+    """Human-readable gate report (one line per program + findings).
+
+    No reference counterpart (module docstring)."""
+    lines = []
+    bad = {f["program"] for f in result.findings
+           if f["check"] in ("manifest", "golden")}
+    for name, rep in result.reports.items():
+        status = "DRIFT" if name in bad else "ok"
+        ai = rep.get("arithmetic_intensity")
+        islands = ",".join(rep.get("fused_islands", ())) or "-"
+        unmod = (rep.get("unmodeled") or {}).get("traffic_fraction", 0.0)
+        lines.append(
+            f"manifest {name:<24} {status:>5}  "
+            f"{rep['flops']:>12,d} flops  {rep['traffic_bytes']:>11,d} B  "
+            f"AI={ai if ai is not None else '-':<8}  "
+            f"islands[{islands}]  unmodeled={unmod}"
+        )
+    if result.updated:
+        lines.append("updated manifests: " + ", ".join(result.updated))
+    for f in result.findings:
+        lines.append(f"FINDING [{f['check']}] {f['program']}: {f['message']}")
+    lines.append(
+        f"disco-meter: {len(result.findings)} finding(s), "
+        f"{result.n_programs} program(s) metered"
+    )
+    return "\n".join(lines)
+
+
+def format_json(result: MeterResult) -> str:
+    """Machine-readable report — the disco-lint contract shape
+    (``clean``/``counts``/``findings``) plus the per-program manifests.
+
+    No reference counterpart (module docstring)."""
+    per_check: dict = {}
+    for f in result.findings:
+        per_check[f["check"]] = per_check.get(f["check"], 0) + 1
+    return json.dumps(
+        {
+            "clean": result.clean,
+            "counts": {
+                "findings": len(result.findings),
+                "programs": result.n_programs,
+                "by_check": per_check,
+            },
+            "findings": result.findings,
+            "reports": result.reports,
+            "updated": result.updated,
+        },
+        indent=2,
+    )
+
+
+def main(argv=None) -> int:
+    """``python -m disco_tpu.analysis.meter.check`` — the ``make
+    meter-check`` entry: full gate, text report, exit 1 on findings.
+
+    No reference counterpart (module docstring)."""
+    result = run_checks()
+    print(format_text(result))
+    return 0 if result.clean else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

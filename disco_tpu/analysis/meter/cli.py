@@ -1,0 +1,77 @@
+"""``disco-meter`` — the cost-manifest gate's command line.
+
+Exit codes mirror ``disco-lint``: 0 clean, 1 findings, 2 usage error.
+Like ``disco-trace`` this tool imports jax (it traces programs) but
+forces the CPU backend before any device use, so it never claims the
+tunneled chip.
+
+``--update`` regenerates the cost manifests under
+``disco_tpu/analysis/golden/cost/`` after an *intended* cost change;
+commit them with a message explaining WHAT moved (flops, HBM traffic,
+a fused island) and why (doc/source/observability.rst, "Reading the
+roofline").
+
+No reference counterpart: the reference repo has no cost model.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    """The disco-meter argument parser (no reference counterpart)."""
+    p = argparse.ArgumentParser(
+        prog="disco-meter",
+        description=(
+            "per-program cost observatory: analytic FLOP / HBM-traffic "
+            "manifests of the canonical hot-path programs, diffed against "
+            "committed goldens with budget and registry-sync enforcement "
+            "(CPU-only by construction)."
+        ),
+    )
+    p.add_argument("--update", action="store_true",
+                   help="regenerate the cost manifests instead of diffing "
+                        "(budgets still run); commit the result")
+    p.add_argument("--format", choices=("text", "json"), default="text",
+                   help="report format (json is the machine contract)")
+    p.add_argument("--programs", default=None,
+                   help="comma-separated program names to meter (default: "
+                        "all; registry-sync and cross-budgets run only on "
+                        "a full pass)")
+    p.add_argument("--list-programs", action="store_true",
+                   help="print the program catalog and exit")
+    return p
+
+
+def main(argv=None) -> int:
+    """Entry point (console script ``disco-meter`` / ``python -m
+    disco_tpu.analysis.meter.cli``).  No reference counterpart."""
+    args = build_parser().parse_args(argv)
+    from disco_tpu.analysis.meter import check
+
+    if args.list_programs:
+        from disco_tpu.analysis.trace.programs import PROGRAMS
+
+        for name, spec in PROGRAMS.items():
+            print(f"{name:<26} {spec.summary}")
+        return 0
+
+    programs = None
+    if args.programs:
+        programs = {s.strip() for s in args.programs.split(",") if s.strip()}
+    try:
+        result = check.run_checks(update=args.update, programs=programs)
+    except KeyError as e:
+        print(f"disco-meter: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        print(check.format_json(result))
+    else:
+        print(check.format_text(result))
+    return 0 if result.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

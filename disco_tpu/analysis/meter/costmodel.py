@@ -1,0 +1,582 @@
+"""The jaxpr-walking cost model — FLOPs, HBM traffic and live bytes.
+
+A :func:`cost_of_jaxpr` report is a pure function of a traced program
+(the same abstract ``ShapeDtypeStruct`` tracing the disco-trace gate
+uses: no FLOP runs, no device buffer, no chip claim), so the committed
+manifests rebuild bit-identically on any host.  The model is *declared*,
+not measured — its value is that it is deterministic, attributable per
+primitive class, and moves when (and only when) the program moves:
+
+* **FLOPs** — analytic per-primitive formulas: ``dot_general`` /
+  ``conv_general_dilated`` count ``2·M·N·K`` multiply-adds, ``fft``
+  counts ``5·N·log2(N)`` per transform, dense linear algebra uses the
+  textbook cubics (Cholesky ``n³/3``, ``eigh`` ``12·n³``, triangular
+  solve ``n²·m``), elementwise ops count 1 flop per output element
+  (transcendentals 10, divisions 4), reductions count one flop per input
+  element.  Complex arithmetic scales by the real-flop equivalents
+  (add ×2, multiply ×6, division ×20, dot/linalg ×4).
+* **HBM traffic** (``traffic_bytes``) — the materialization model: every
+  equation reads its operands from and writes its results to HBM once.
+  This deliberately ignores XLA fusion (it is an upper bound), EXCEPT for
+  **declared fused islands** (:data:`FUSED_UNITS`, matched by inner-jit
+  ``pjit`` name, plus any ``pallas_call``): their interior is VMEM-resident
+  by construction — the PR-15 fused-solve contract — so an island
+  contributes only its boundary operands and results.  ``lax.scan`` body
+  traffic is counted **per iteration** (× ``length``), with the carry's
+  HBM round-trip counted once per iteration and the ``xs``/``ys`` streams
+  counted once in total.
+* **Boundary bytes** (``hbm_bytes_in`` / ``hbm_bytes_out``) — the traced
+  program's own input/output avals: the traffic floor a perfectly fused
+  program cannot go below.
+* **Peak live bytes** — a linear-scan liveness estimate over the
+  depth-first equation walk (nested bodies inlined): the high-water mark
+  of simultaneously live array bytes, an HBM footprint estimate.
+* **Unmodeled primitives** are accounted EXPLICITLY: anything outside the
+  tables lands in the ``unmodeled`` bucket with its primitive name, count
+  and traffic share — never a silent zero.  The meter gate holds that
+  share under a declared ceiling (:mod:`disco_tpu.analysis.meter.budgets`).
+
+No reference counterpart: the reference repo has no traced programs and
+no cost model (SURVEY.md §5.1).
+"""
+from __future__ import annotations
+
+import json
+import math
+
+#: bump when the report schema or the model conventions change
+#: incompatibly — a version mismatch against a committed manifest reports
+#: as "regenerate with --update", not as a program drift.  Surfaces in
+#: bench records as ``cost_model_version`` so a roofline join never mixes
+#: conventions.
+VERSION = 1
+
+#: inner-jit (``pjit``) names whose interior is VMEM-resident by contract:
+#: the fused rank-1 GEVD-MWF solve (ops/mwf_ops.py) DMAs its pencil tiles
+#: HBM->VMEM once and writes back only the filter weights — the PR-15
+#: thesis.  The XLA twin is listed too: it is the backend-independent
+#: stand-in the gate traces, and the budget it certifies is the pallas
+#: kernel's HBM contract.
+FUSED_UNITS = ("fused_mwf_xla", "fused_mwf_pallas")
+
+#: primitive classes the per-class breakdown reports (documentation order)
+CLASSES = (
+    "fft", "dot_general", "linalg", "elementwise", "reduction",
+    "gather_scatter", "data_movement", "convert", "random", "unmodeled",
+)
+
+# -- primitive tables -------------------------------------------------------
+#: zero-flop layout/movement primitives
+_MOVEMENT = frozenset((
+    "broadcast_in_dim", "reshape", "transpose", "slice", "dynamic_slice",
+    "dynamic_update_slice", "concatenate", "pad", "squeeze", "expand_dims",
+    "rev", "copy", "iota", "stop_gradient", "split", "device_put",
+    "opt_barrier", "optimization_barrier", "sharding_constraint",
+))
+
+#: dtype-cast primitives (zero flops; the traffic is the point)
+_CONVERT = frozenset((
+    "convert_element_type", "bitcast_convert_type", "reduce_precision",
+))
+
+#: indexed reads/writes (zero flops; address math is free in this model)
+_GATHER_SCATTER = frozenset((
+    "gather", "scatter", "scatter-add", "scatter_add", "scatter_mul",
+    "scatter_min", "scatter_max", "select_and_scatter_add",
+))
+
+#: 1-flop-per-element ops (complex: ×2)
+_ELEMENTWISE_1 = frozenset((
+    "add", "sub", "neg", "abs", "sign", "max", "min", "floor", "ceil",
+    "round", "rem", "nextafter", "conj", "real", "imag", "complex",
+    "eq", "ne", "lt", "le", "gt", "ge", "and", "or", "xor", "not",
+    "shift_left", "shift_right_logical", "shift_right_arithmetic",
+    "select_n", "clamp", "is_finite", "copysign", "population_count",
+    "clz", "add_any", "square",
+))
+
+#: 10-flop-per-element transcendentals (complex: ×2)
+_TRANSCENDENTAL = frozenset((
+    "exp", "exp2", "log", "log1p", "expm1", "sqrt", "rsqrt", "cbrt",
+    "sin", "cos", "tan", "asin", "acos", "atan", "atan2", "sinh", "cosh",
+    "tanh", "asinh", "acosh", "atanh", "erf", "erfc", "erf_inv",
+    "logistic", "pow", "lgamma", "digamma",
+))
+
+#: one-flop-per-INPUT-element reductions
+_REDUCTION = frozenset((
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod", "reduce_and",
+    "reduce_or", "reduce_xor", "argmax", "argmin", "cumsum", "cummax",
+    "cummin", "cumprod", "cumlogsumexp", "reduce_window_sum",
+    "reduce_window_max", "reduce_window_min",
+))
+
+#: counter-based RNG kernels: ~100 flops per output element
+_RANDOM = frozenset((
+    "threefry2x32", "random_bits", "random_seed", "random_wrap",
+    "random_fold_in", "random_gamma", "random_clone", "random_split",
+    "random_unwrap",
+))
+
+#: control primitives: recursed into, no cost of their own
+_CONTROL = frozenset((
+    "pjit", "closed_call", "core_call", "xla_call", "named_call",
+    "custom_jvp_call", "custom_vjp_call", "custom_jvp_call_jaxpr",
+    "custom_vjp_call_jaxpr", "custom_lin", "remat", "remat2", "checkpoint",
+    "scan", "while", "cond",
+))
+
+
+def _nbytes(v) -> int:
+    """Byte size of one variable's aval (0 for abstract tokens).
+
+    No reference counterpart (module docstring)."""
+    aval = getattr(v, "aval", None)
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    itemsize = getattr(dtype, "itemsize", None)
+    if shape is None or itemsize is None:
+        return 0     # abstract tokens, extended dtypes (RNG keys)
+    return int(math.prod(shape)) * int(itemsize)
+
+
+def _nelems(v) -> int:
+    aval = getattr(v, "aval", None)
+    shape = getattr(aval, "shape", ())
+    return int(math.prod(shape)) if shape is not None else 0
+
+
+def _is_complex(v) -> bool:
+    dtype = getattr(getattr(v, "aval", None), "dtype", None)
+    return getattr(dtype, "kind", "") == "c"
+
+
+def _first_shaped(eqn_vars):
+    for v in eqn_vars:
+        if getattr(getattr(v, "aval", None), "shape", None) is not None:
+            return v
+    return None
+
+
+def _dot_general_flops(eqn) -> int:
+    """``2·batch·M·N·K`` multiply-add flops of one dot_general (complex ×4).
+
+    No reference counterpart (module docstring)."""
+    (contract, batch) = eqn.params["dimension_numbers"]
+    (lc, _rc), (lb, _rb) = contract, batch
+    lhs, rhs = eqn.invars[0], eqn.invars[1]
+    lshape = lhs.aval.shape
+    k = math.prod(lshape[d] for d in lc) or 1
+    out = _nelems(eqn.outvars[0])
+    factor = 4 if (_is_complex(lhs) or _is_complex(rhs)) else 1
+    return 2 * out * k * factor
+
+
+def _conv_flops(eqn) -> int:
+    """``2·out·(kernel_spatial·C_in/groups)`` flops of one convolution.
+
+    No reference counterpart (module docstring)."""
+    rhs = eqn.invars[1]
+    rshape = rhs.aval.shape          # kernel: spatial + (in/groups, out)
+    dn = eqn.params.get("dimension_numbers")
+    groups = int(eqn.params.get("feature_group_count", 1))
+    if dn is not None:
+        k_spatial = math.prod(rshape[d] for d in dn.rhs_spec[2:]) or 1
+        c_in = rshape[dn.rhs_spec[1]]
+    else:                            # fallback: whole kernel volume
+        k_spatial, c_in = math.prod(rshape) or 1, 1
+    out = _nelems(eqn.outvars[0])
+    factor = 4 if _is_complex(rhs) else 1
+    return 2 * out * k_spatial * c_in // max(groups, 1) * factor
+
+
+def _fft_flops(eqn) -> int:
+    """``5·N·log2(N)`` per transform over the batch (the classic radix-2
+    count; rfft/irfft batches use the larger of the two element counts).
+
+    No reference counterpart (module docstring)."""
+    n = math.prod(eqn.params.get("fft_lengths", ())) or 1
+    batch = max(_nelems(eqn.invars[0]), _nelems(eqn.outvars[0])) // max(n, 1)
+    return int(5 * max(batch, 1) * n * max(math.log2(n), 1.0))
+
+
+def _linalg_flops(eqn) -> int:
+    """Textbook dense-linalg flop cubics per matrix in the batch
+    (complex ×4): Cholesky ``n³/3``, eigh ``12·n³``, triangular solve
+    ``n²·m``, LU ``2n³/3``, QR ``2mn²``.
+
+    No reference counterpart (module docstring)."""
+    name = eqn.primitive.name
+    a = _first_shaped(eqn.invars)
+    shape = a.aval.shape if a is not None else ()
+    factor = 4 if (a is not None and _is_complex(a)) else 1
+    if len(shape) < 2:
+        return 0
+    n, m = shape[-1], shape[-2]
+    batch = math.prod(shape[:-2]) or 1
+    if name == "cholesky":
+        per = n * n * n // 3
+    elif name == "eigh":
+        per = 12 * n * n * n
+    elif name == "triangular_solve":
+        b = eqn.invars[1].aval.shape
+        per = n * n * (b[-1] if len(b) else 1)
+        batch = math.prod(b[:-2]) or 1
+    elif name == "lu":
+        per = 2 * n * n * n // 3
+    elif name in ("qr", "householder_product"):
+        per = 2 * m * n * n
+    elif name == "svd":
+        per = 12 * m * n * n
+    else:
+        per = 12 * n * n * n
+    return batch * per * factor
+
+
+#: dense-linalg primitives routed through :func:`_linalg_flops`
+_LINALG = frozenset((
+    "cholesky", "eigh", "triangular_solve", "lu", "qr",
+    "householder_product", "svd",
+))
+
+
+def classify(prim_name: str) -> str:
+    """Map one primitive name to its cost class (``'unmodeled'`` when the
+    model has no entry for it — the explicit-unknowns contract).
+
+    No reference counterpart (module docstring)."""
+    if prim_name in _MOVEMENT:
+        return "data_movement"
+    if prim_name in _CONVERT:
+        return "convert"
+    if prim_name in _GATHER_SCATTER:
+        return "gather_scatter"
+    if prim_name in _ELEMENTWISE_1 or prim_name in ("mul", "div",
+                                                    "integer_pow"):
+        return "elementwise"
+    if prim_name in _TRANSCENDENTAL:
+        return "elementwise"
+    if prim_name in _REDUCTION or prim_name in ("sort", "top_k"):
+        return "reduction"
+    if prim_name in _RANDOM:
+        return "random"
+    if prim_name == "fft":
+        return "fft"
+    if prim_name in ("dot_general", "conv_general_dilated"):
+        return "dot_general"
+    if prim_name in _LINALG:
+        return "linalg"
+    return "unmodeled"
+
+
+def _eqn_flops(eqn) -> int | None:
+    """Analytic flops of one (non-control) equation, None when unmodeled.
+
+    No reference counterpart (module docstring)."""
+    name = eqn.primitive.name
+    out = _first_shaped(eqn.outvars)
+    out_elems = _nelems(out) if out is not None else 0
+    cplx = 2 if (out is not None and _is_complex(out)) else 1
+    if name in _MOVEMENT or name in _CONVERT or name in _GATHER_SCATTER:
+        return 0
+    if name in _ELEMENTWISE_1:
+        return out_elems * cplx
+    if name == "mul":
+        return out_elems * (6 if cplx == 2 else 1)
+    if name == "div":
+        return out_elems * (20 if cplx == 2 else 4)
+    if name == "integer_pow":
+        return out_elems * 2 * cplx
+    if name in _TRANSCENDENTAL:
+        return out_elems * 10 * cplx
+    if name in _REDUCTION:
+        inp = _first_shaped(eqn.invars)
+        return _nelems(inp) * cplx if inp is not None else 0
+    if name in ("sort", "top_k"):
+        inp = _first_shaped(eqn.invars)
+        n = _nelems(inp) if inp is not None else 0
+        return int(n * max(math.log2(max(n, 2)), 1.0))
+    if name in _RANDOM:
+        return out_elems * 100
+    if name == "fft":
+        return _fft_flops(eqn)
+    if name == "dot_general":
+        return _dot_general_flops(eqn)
+    if name == "conv_general_dilated":
+        return _conv_flops(eqn)
+    if name in _LINALG:
+        return _linalg_flops(eqn)
+    return None
+
+
+def _sub_jaxprs(params: dict):
+    """Yield the ClosedJaxpr-like values of one equation's params.
+
+    No reference counterpart (module docstring)."""
+    for val in params.values():
+        vals = val if isinstance(val, (list, tuple)) else (val,)
+        for sub in vals:
+            if hasattr(sub, "jaxpr") or hasattr(sub, "eqns"):
+                yield sub
+
+
+def _inner(sub):
+    return sub.jaxpr if hasattr(sub, "jaxpr") else sub
+
+
+class _Acc:
+    """Accumulator one walk writes into (plain ints throughout so the
+    manifests serialize bit-identically).
+
+    No reference counterpart (module docstring)."""
+
+    def __init__(self):
+        self.flops: dict[str, int] = {}
+        self.traffic: dict[str, int] = {}
+        self.unmodeled_prims: dict[str, int] = {}
+        self.fused_islands: list[str] = []
+        self.while_loops = 0
+        self.n_eqns = 0
+        self.events: list = []   # (invars, outvars) for the liveness pass
+
+    def add(self, cls: str, flops: int, traffic: int) -> None:
+        self.flops[cls] = self.flops.get(cls, 0) + int(flops)
+        self.traffic[cls] = self.traffic.get(cls, 0) + int(traffic)
+
+    def merge(self, other: "_Acc", mult: int = 1) -> None:
+        for cls, v in other.flops.items():
+            self.flops[cls] = self.flops.get(cls, 0) + v * mult
+        for cls, v in other.traffic.items():
+            self.traffic[cls] = self.traffic.get(cls, 0) + v * mult
+        for name, v in other.unmodeled_prims.items():
+            self.unmodeled_prims[name] = self.unmodeled_prims.get(name, 0) + v
+        self.fused_islands.extend(other.fused_islands)
+        self.while_loops += other.while_loops
+        self.n_eqns += other.n_eqns
+        self.events.extend(other.events)
+
+
+def _boundary_bytes(eqn) -> int:
+    return (sum(_nbytes(v) for v in eqn.invars)
+            + sum(_nbytes(v) for v in eqn.outvars))
+
+
+def _walk(jaxpr, acc: _Acc, fused_units, in_island: bool) -> None:
+    """Depth-first cost walk of one jaxpr into ``acc`` (multipliers are
+    applied by the caller via :meth:`_Acc.merge`).
+
+    No reference counterpart (module docstring)."""
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        acc.n_eqns += 1
+        acc.events.append((tuple(eqn.invars), tuple(eqn.outvars)))
+        if name in _CONTROL or name.startswith("pallas_call"):
+            island = (not in_island) and (
+                name.startswith("pallas_call")
+                or (name == "pjit"
+                    and str(eqn.params.get("name", "")) in fused_units)
+            )
+            if island:
+                # VMEM-resident by contract: boundary traffic only; the
+                # interior still contributes flops (real work either way)
+                acc.add("data_movement", 0, _boundary_bytes(eqn))
+                acc.fused_islands.append(
+                    str(eqn.params.get("name", name)))
+            if name == "while":
+                # unknown trip count: body costed once, surfaced in the
+                # report so a reader knows the model floor-bounds it
+                acc.while_loops += 1
+            if name == "cond":
+                branches = [_inner(b) for b in eqn.params.get("branches", ())]
+                costed = []
+                for b in branches:
+                    sub = _Acc()
+                    _walk(b, sub, fused_units, in_island or island)
+                    costed.append(sub)
+                if costed:   # worst-case branch models the cond
+                    worst = max(
+                        costed,
+                        key=lambda a: (sum(a.traffic.values()),
+                                       sum(a.flops.values())),
+                    )
+                    acc.merge(worst)
+                continue
+            mult = 1
+            if name == "scan":
+                mult = int(eqn.params.get("length", 1))
+                if not (in_island or island):
+                    # the per-iteration carry round-trip + the streamed
+                    # xs/ys (already counted once via the outer operands)
+                    n_carry = int(eqn.params.get("num_carry", 0))
+                    n_consts = int(eqn.params.get("num_consts", 0))
+                    carry = sum(
+                        _nbytes(v)
+                        for v in eqn.invars[n_consts:n_consts + n_carry])
+                    acc.add("data_movement",
+                            0, 2 * carry * mult + _boundary_bytes(eqn))
+            for sub in _sub_jaxprs(eqn.params):
+                body = _Acc()
+                _walk(_inner(sub), body, fused_units, in_island or island)
+                if island or in_island:
+                    # interior of a fused island: flops count, traffic
+                    # stays in VMEM by contract
+                    body.traffic = {}
+                acc.merge(body, mult)
+            continue
+        flops = _eqn_flops(eqn)
+        traffic = 0 if in_island else _boundary_bytes(eqn)
+        if flops is None:
+            acc.unmodeled_prims[name] = acc.unmodeled_prims.get(name, 0) + 1
+            acc.add("unmodeled", 0, traffic)
+        else:
+            acc.add(classify(name), flops, traffic)
+
+
+def _peak_live_bytes(jaxpr, events) -> int:
+    """Linear-scan liveness high-water mark over the inlined walk.
+
+    No reference counterpart (module docstring)."""
+    last_use: dict[int, int] = {}
+    size: dict[int, int] = {}
+
+    def see(v, pos):
+        if not hasattr(v, "aval") or type(v).__name__ == "Literal":
+            return
+        key = id(v)
+        size[key] = _nbytes(v)
+        last_use[key] = pos
+
+    n = len(events)
+    for v in tuple(jaxpr.invars) + tuple(jaxpr.constvars):
+        see(v, 0)
+    for pos, (invars, outvars) in enumerate(events):
+        for v in invars:
+            see(v, pos)
+    for v in jaxpr.outvars:
+        see(v, n)
+    live: dict[int, int] = {
+        id(v): _nbytes(v)
+        for v in tuple(jaxpr.invars) + tuple(jaxpr.constvars)
+        if hasattr(v, "aval")
+    }
+    peak = sum(live.values())
+    for pos, (invars, outvars) in enumerate(events):
+        for v in outvars:
+            if hasattr(v, "aval") and type(v).__name__ != "DropVar":
+                live[id(v)] = _nbytes(v)
+        peak = max(peak, sum(live.values()))
+        for v in invars:
+            key = id(v)
+            if key in live and last_use.get(key, n + 1) <= pos:
+                del live[key]
+    return int(peak)
+
+
+def cost_of_jaxpr(closed_jaxpr, fused_units=FUSED_UNITS,
+                  program: str = "") -> dict:
+    """Cost report of one traced program (the manifest payload).
+
+    Pure function of the jaxpr object — no tracing, no device, no jax
+    import (attribute reads only), mirroring
+    :func:`disco_tpu.analysis.trace.fingerprint.fingerprint_jaxpr`.
+
+    No reference counterpart (module docstring).
+    """
+    jaxpr = (closed_jaxpr.jaxpr if hasattr(closed_jaxpr, "jaxpr")
+             else closed_jaxpr)
+    acc = _Acc()
+    _walk(jaxpr, acc, tuple(fused_units), in_island=False)
+    flops = sum(acc.flops.values())
+    traffic = sum(acc.traffic.values())
+    hbm_in = sum(_nbytes(v) for v in jaxpr.invars)
+    hbm_out = sum(_nbytes(v) for v in jaxpr.outvars)
+    unmodeled_traffic = acc.traffic.get("unmodeled", 0)
+    return {
+        "version": VERSION,
+        "program": program,
+        "flops": int(flops),
+        "flops_by_class": {k: v for k, v in sorted(acc.flops.items()) if v},
+        "traffic_bytes": int(traffic),
+        "traffic_by_class": {
+            k: v for k, v in sorted(acc.traffic.items()) if v},
+        "hbm_bytes_in": int(hbm_in),
+        "hbm_bytes_out": int(hbm_out),
+        "peak_live_bytes": _peak_live_bytes(jaxpr, acc.events),
+        "arithmetic_intensity": (
+            round(flops / traffic, 6) if traffic else None),
+        "fused_islands": sorted(set(acc.fused_islands)),
+        "while_loops": acc.while_loops,
+        "n_eqns": acc.n_eqns,
+        "unmodeled": {
+            "primitives": dict(sorted(acc.unmodeled_prims.items())),
+            "traffic_bytes": int(unmodeled_traffic),
+            "traffic_fraction": (
+                round(unmodeled_traffic / traffic, 6) if traffic else 0.0),
+        },
+    }
+
+
+def cost_of_fn(fn, args, kwargs=None, fused_units=FUSED_UNITS,
+               program: str = "") -> dict:
+    """Trace ``fn`` on abstract inputs and cost the jaxpr — the
+    :func:`~disco_tpu.analysis.trace.fingerprint.fingerprint_fn` twin.
+
+    No reference counterpart (module docstring).
+    """
+    import jax
+
+    kwargs = kwargs or {}
+    closed = jax.make_jaxpr(lambda *a: fn(*a, **kwargs))(*args)
+    return cost_of_jaxpr(closed, fused_units=fused_units, program=program)
+
+
+def diff_reports(golden: dict, current: dict) -> list:
+    """Readable per-class / per-primitive cost differences, empty when
+    identical — the meter gate's failure report names WHAT moved (flops,
+    traffic, boundary bytes, unmodeled set), not just two blobs.
+
+    No reference counterpart (module docstring).
+    """
+    out: list[str] = []
+    if golden.get("version") != current.get("version"):
+        return [
+            f"cost-model version {golden.get('version')} != "
+            f"{current.get('version')}: regenerate manifests with "
+            "`disco-meter --update`"
+        ]
+    for key, unit in (("flops", "flops"), ("traffic_bytes", "bytes"),
+                      ("hbm_bytes_in", "bytes"), ("hbm_bytes_out", "bytes"),
+                      ("peak_live_bytes", "bytes"), ("n_eqns", "eqns"),
+                      ("while_loops", "loops")):
+        a, b = golden.get(key), current.get(key)
+        if a != b:
+            rel = f" ({(b - a) / a:+.1%})" if a else ""
+            out.append(f"{key}: {a} -> {b} {unit}{rel}")
+    for table in ("flops_by_class", "traffic_by_class"):
+        ga, cu = golden.get(table, {}), current.get(table, {})
+        for cls in sorted(set(ga) | set(cu)):
+            a, b = ga.get(cls, 0), cu.get(cls, 0)
+            if a != b:
+                out.append(f"{table}[{cls}]: {a} -> {b} ({b - a:+d})")
+    gu = (golden.get("unmodeled") or {}).get("primitives", {})
+    cuu = (current.get("unmodeled") or {}).get("primitives", {})
+    for prim in sorted(set(gu) | set(cuu)):
+        a, b = gu.get(prim, 0), cuu.get(prim, 0)
+        if a != b:
+            out.append(f"unmodeled primitive {prim}: {a} -> {b} ({b - a:+d})")
+    if golden.get("fused_islands") != current.get("fused_islands"):
+        out.append(
+            f"fused islands: {golden.get('fused_islands')} -> "
+            f"{current.get('fused_islands')} (a lost island re-exposes its "
+            "interior traffic to HBM)"
+        )
+    return out
+
+
+def dumps(report: dict) -> str:
+    """Canonical JSON text of one report (sorted keys, indented — the
+    committed manifest format, reviewable in a PR diff).
+
+    No reference counterpart (module docstring).
+    """
+    return json.dumps(report, indent=2, sort_keys=True) + "\n"

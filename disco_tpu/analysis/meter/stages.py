@@ -1,0 +1,242 @@
+"""Workload-sized stage costs — the roofline join's cost side.
+
+The committed manifests (``analysis/golden/cost/``) are structural: tiny
+shapes, good for drift gating, useless for judging a measured
+``stage_ms`` against hardware peaks.  This module re-traces the SAME
+stage programs ``bench.py`` slope-times — the fused spec+magnitude STFT
+over the stacked y/s/n streams, the irm mask stage, step-1, the full
+offline tango chain (step-2 reported as full minus step-1, exactly how
+``bench.py`` times it), the iSTFT, the fused headline pipeline — at the
+*bench workload's* shapes, and costs them with the same jaxpr-walking
+model.  Tracing is abstract (``ShapeDtypeStruct`` in, ``jax.eval_shape``
+to chain stage output shapes): not one FLOP runs, so calling this inside
+a live bench process costs milliseconds and never touches the device.
+
+The streaming-scan and serve lanes get per-window / per-block costs from
+the same model (satellite of the meter round: RTF lanes with no flops
+had no computable MFU), parameterized on the exact shapes those bench
+lanes build.
+
+No reference counterpart: the reference repo has no cost model
+(SURVEY.md §5.1).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from disco_tpu.analysis.meter import costmodel
+
+#: the stage keys of ``bench.py``'s ``stage_ms`` dict, in pipeline order
+STAGE_KEYS = ("stft_x3", "masks", "step1_local_mwf", "step2_exchange_mwf",
+              "istft", "full_pipeline")
+
+
+@dataclasses.dataclass(frozen=True)
+class Workload:
+    """One offline bench workload (bench.py's headline defaults: the
+    8-node/4-mic north-star config on 10 s clips, batch 16).
+
+    No reference counterpart (module docstring)."""
+
+    batch: int = 16
+    dur_s: float = 10.0
+    fs: int = 16000
+    n_nodes: int = 8
+    mics_per_node: int = 4
+
+    @property
+    def samples(self) -> int:
+        return int(self.dur_s * self.fs)
+
+
+HEADLINE = Workload()
+
+
+def _cost(fn, args, program: str) -> dict:
+    rep = costmodel.cost_of_fn(fn, args, program=program)
+    return {
+        "flops": rep["flops"],
+        "traffic_bytes": rep["traffic_bytes"],
+        "arithmetic_intensity": rep["arithmetic_intensity"],
+    }
+
+
+def _sub(a: dict, b: dict) -> dict:
+    """Stage cost as a difference (bench times step-2 as full − step-1).
+
+    No reference counterpart (module docstring)."""
+    flops = max(a["flops"] - b["flops"], 0)
+    traffic = max(a["traffic_bytes"] - b["traffic_bytes"], 0)
+    return {
+        "flops": flops,
+        "traffic_bytes": traffic,
+        "arithmetic_intensity": (
+            round(flops / traffic, 6) if traffic else None),
+    }
+
+
+def offline_stage_costs(workload: Workload = HEADLINE,
+                        solver: str = "power") -> dict:
+    """Cost of each ``stage_ms`` stage at the workload's shapes.
+
+    Mirrors ``bench.py``'s staged jits one for one (bench.py:230-258):
+    ``stft_x3`` is the fused spec+magnitude STFT over stacked y/s/n,
+    ``step2_exchange_mwf`` is the full-tango cost minus the step-1 cost
+    (the same subtraction the timing uses), ``full_pipeline`` is the
+    fused headline program.  Returns ``{stage: {flops, traffic_bytes,
+    arithmetic_intensity}}`` with all counts covering the WHOLE batch —
+    divide by ``workload.batch`` for per-clip figures.
+
+    No reference counterpart (module docstring).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from disco_tpu.core.dsp import istft, stft
+    from disco_tpu.core.masks import tf_mask_mag
+    from disco_tpu.enhance import compute_z_signals, tango
+    from disco_tpu.ops.stft_ops import stft_with_mag
+
+    w = workload
+    yb = jax.ShapeDtypeStruct(
+        (w.batch, w.n_nodes, w.mics_per_node, w.samples), jnp.float32)
+
+    def f_stft(a, b, c):
+        return stft_with_mag(jnp.stack([a, b, c]))
+
+    spec_b, mag_b = jax.eval_shape(f_stft, yb, yb, yb)
+    spec1 = jax.ShapeDtypeStruct(spec_b.shape[1:], spec_b.dtype)
+    mag1 = jax.ShapeDtypeStruct(mag_b.shape[1:], mag_b.dtype)
+
+    f_mask = jax.vmap(lambda ms, mn: tf_mask_mag(ms[:, 0], mn[:, 0], "irm1"))
+    masks_b = jax.eval_shape(f_mask, mag1, mag1)
+
+    f_step1 = jax.vmap(
+        lambda Y, S, N, m: compute_z_signals(
+            None, None, None, Y=Y, S=S, N=N, masks_z=m)["z_y"])
+    f_full = jax.vmap(
+        lambda Y, S, N, m: tango(Y, S, N, m, m, policy="local",
+                                 solver=solver).yf)
+    yf_b = jax.eval_shape(f_full, spec1, spec1, spec1, masks_b)
+    f_istft = lambda Z: istft(Z, length=w.samples)   # noqa: E731
+
+    def f_headline(a, b, c):
+        def one(y, s, n):
+            spec, mag = stft_with_mag(jnp.stack([y, s, n]))
+            m = tf_mask_mag(mag[1][:, 0], mag[2][:, 0], "irm1")
+            return tango(spec[0], spec[1], spec[2], m, m, policy="local",
+                         solver=solver).yf
+        return jax.vmap(one)(a, b, c)
+
+    c_stft = _cost(f_stft, (yb, yb, yb), "stage:stft_x3")
+    c_mask = _cost(f_mask, (mag1, mag1), "stage:masks")
+    c_step1 = _cost(f_step1, (spec1, spec1, spec1, masks_b), "stage:step1")
+    c_full = _cost(f_full, (spec1, spec1, spec1, masks_b), "stage:tango_full")
+    c_istft = _cost(f_istft, (yf_b,), "stage:istft")
+    c_headline = _cost(f_headline, (yb, yb, yb), "stage:full_pipeline")
+    return {
+        "stft_x3": c_stft,
+        "masks": c_mask,
+        "step1_local_mwf": c_step1,
+        "step2_exchange_mwf": _sub(c_full, c_step1),
+        "istft": c_istft,
+        "full_pipeline": c_headline,
+    }
+
+
+def streaming_scan_cost(dur_s: float = 10.0, fs: int = 16000,
+                        n_nodes: int = 4, mics_per_node: int = 4,
+                        update_every: int = 4,
+                        blocks_per_dispatch: int = 8) -> dict | None:
+    """Per-window cost of the scanned super-tick at the bench lane's
+    shapes (bench.py:bench_streaming_scan, including its smoke-size block
+    shrink); ``None`` when the clip cannot hold the window.  MFU of the
+    lane = ``flops / (window wall seconds) / peak``.
+
+    No reference counterpart (module docstring).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from disco_tpu.core.dsp import stft
+    from disco_tpu.enhance.streaming import (
+        initial_stream_state,
+        streaming_tango_scan,
+    )
+
+    K, C, u = n_nodes, mics_per_node, update_every
+    y = jax.ShapeDtypeStruct((K, C, int(dur_s * fs)), jnp.float32)
+    Y = jax.eval_shape(stft, y)
+    F, T = Y.shape[-2:]
+    block = 4 * u
+    if T < blocks_per_dispatch * block:
+        block = (T // (blocks_per_dispatch * u)) * u
+    window = blocks_per_dispatch * block
+    if block < u:
+        return None
+    state = jax.eval_shape(
+        lambda: initial_stream_state(K, C, F, update_every=u))
+    Yw = jax.ShapeDtypeStruct((K, C, F, window), Y.dtype)
+    mw = jax.ShapeDtypeStruct((K, F, window), jnp.float32)
+    avail = jax.ShapeDtypeStruct((K, window // u), jnp.float32)
+
+    def run_scan(Yw, mw, st, av):
+        return streaming_tango_scan(
+            Yw, mw, mw, update_every=u, policy="local", state=st,
+            z_avail=av, blocks_per_dispatch=blocks_per_dispatch,
+        )["yf"]
+
+    out = _cost(run_scan, (Yw, mw, state, avail), "lane:streaming_scan")
+    out.update(window_frames=window, block_frames=block,
+               blocks_per_dispatch=blocks_per_dispatch)
+    return out
+
+
+def serve_block_cost(dur_s: float = 4.0, fs: int = 16000,
+                     n_nodes: int = 4, mics_per_node: int = 2,
+                     update_every: int = 4) -> dict:
+    """Per-block cost of the program the serve scheduler dispatches every
+    tick (``streaming_tango`` with continuation state) at the serve bench
+    lane's session shape (bench.py:bench_serve — Ks=4, Cs=2, u=4,
+    block=4·u).  MFU of the lane = ``flops · serve_blocks_per_s / peak``.
+
+    No reference counterpart (module docstring).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from disco_tpu.core.dsp import stft
+    from disco_tpu.enhance.streaming import (
+        initial_stream_state,
+        streaming_tango,
+    )
+
+    K, C, u = n_nodes, mics_per_node, update_every
+    block = 4 * u
+    y = jax.ShapeDtypeStruct((K, C, int(dur_s * fs)), jnp.float32)
+    Y = jax.eval_shape(stft, y)
+    F = Y.shape[-2]
+    state = jax.eval_shape(
+        lambda: initial_stream_state(K, C, F, update_every=u))
+    Yb = jax.ShapeDtypeStruct((K, C, F, block), Y.dtype)
+    mb = jax.ShapeDtypeStruct((K, F, block), jnp.float32)
+    avail = jax.ShapeDtypeStruct((K, block // u), jnp.float32)
+
+    def run_block(Yb, mb, st, av):
+        return streaming_tango(Yb, mb, mb, update_every=u, policy="local",
+                               state=st, z_avail=av)["yf"]
+
+    out = _cost(run_block, (Yb, mb, state, avail), "lane:serve_block")
+    out.update(block_frames=block)
+    return out
+
+
+def fused_pipeline_cost(workload: Workload = HEADLINE) -> dict:
+    """Whole-batch cost of the headline pipeline on the fused step-2
+    solve ('fused-xla' pinned, like the trace golden — the backend
+    resolution of plain 'fused' never changes the modeled structure).
+    MFU of the bench's ``rtf_fused_solver`` lane = ``flops / dt / peak``.
+
+    No reference counterpart (module docstring).
+    """
+    return offline_stage_costs(workload, solver="fused-xla")["full_pipeline"]

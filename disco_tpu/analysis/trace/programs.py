@@ -142,6 +142,32 @@ def _build_tango_step2_fused():
     }
 
 
+def _build_tango_step2_eigh():
+    """The separate-stage eigh baseline of the step-2 chain: identical
+    unit to :func:`_build_tango_step2_fused` with the classic
+    materialize-pencils-then-eigh solver.  It exists for the meter gate's
+    cross-program budget (analysis/meter/budgets.py): the fused solve's
+    modeled HBM traffic must stay strictly below THIS program's — the
+    solve-fusion round's thesis as a hard assertion.
+
+    No reference counterpart (module docstring)."""
+    import jax
+    import jax.numpy as jnp
+
+    from disco_tpu.enhance.tango import tango_step2
+
+    all_z = {key: _c64(K, F, T)
+             for key in ("z_y", "z_s", "z_n", "zn", "z_t1_s", "z_t1_n")}
+    args = (
+        _c64(C, F, T), _c64(C, F, T), _c64(C, F, T), _f32(F, T),
+        jax.ShapeDtypeStruct((), jnp.int32),          # traced node index k
+        all_z, _f32(K, F, T), _c64(K, F, T), _c64(K, F, T),
+    )
+    return tango_step2, args, {
+        "policy": "local", "solver": "eigh", "cov_impl": COV_IMPL,
+    }
+
+
 def _streaming_args():
     return (_c64(K, C, F, T), _f32(K, F, T), _f32(K, F, T))
 
@@ -270,6 +296,12 @@ PROGRAMS: dict = {
             "(ops/mwf_ops.py; 'fused-xla' lane pinned backend-independent) "
             "— one program, no pencil-shaped output escapes",
             _build_tango_step2_fused,
+        ),
+        ProgramSpec(
+            "tango_step2_eigh",
+            "offline step-2 with the separate-stage eigh solver — the "
+            "fused solve's HBM-traffic baseline (meter cross-budget)",
+            _build_tango_step2_eigh,
         ),
         ProgramSpec(
             "streaming_tango",

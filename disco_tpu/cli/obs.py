@@ -75,6 +75,21 @@ def build_parser():
                       help="relative RTF drop that counts as a regression "
                            "(default 0.05; BENCH_r04→r05 headline noise was ~0.2%%)")
 
+    roof = sub.add_parser(
+        "roofline",
+        help="per-stage roofline verdict of one bench record "
+             "(measured stage_ms x modeled stage costs)")
+    roof.add_argument("record", help="bench JSON (BENCH_r*.json / raw line "
+                                     "/ obs log with a bench_result)")
+    roof.add_argument("--peak-tflops", type=float, default=None,
+                      help="dense f32 peak to judge against "
+                           "(default: TPU v5e, 98)")
+    roof.add_argument("--peak-gbps", type=float, default=None,
+                      help="HBM bandwidth peak to judge against "
+                           "(default: TPU v5e, 819)")
+    roof.add_argument("--format", choices=("text", "json"), default="text",
+                      help="report format")
+
     trc = sub.add_parser("trace", help="list / render causal traces from an event log")
     trc.add_argument("log", help="event log written via --obs-log (span events)")
     trc.add_argument("trace_id", nargs="?", default=None,
@@ -494,6 +509,14 @@ def compare_records(old: dict, new: dict, threshold: float = 0.05) -> dict:
         n = (new.get("stage_ms") or {}).get(sk)
         rows.append({"key": f"stage_ms.{sk}", "old": o, "new": n,
                      "rel": rel(o, n), "higher_is_better": False})
+    # the meter round's per-stage roofline lanes (bench.py x
+    # analysis/meter/stages.py): achieved MFU and HBM GB/s per timed stage
+    for table in ("mfu_by_stage", "hbm_gbps_by_stage"):
+        for sk in sorted(set(old.get(table) or {}) | set(new.get(table) or {})):
+            o = (old.get(table) or {}).get(sk)
+            n = (new.get(table) or {}).get(sk)
+            rows.append({"key": f"{table}.{sk}", "old": o, "new": n,
+                         "rel": rel(o, n), "higher_is_better": True})
 
     o, n = old.get("value"), new.get("value")
     if n is None:
@@ -518,14 +541,15 @@ def compare_records(old: dict, new: dict, threshold: float = 0.05) -> dict:
     # the lane: older records don't, and their absence must not flag — but
     # a candidate that LOST a measured lane is a regression, not a skip.
     def lane(rec, key):
-        if key.startswith("stage_ms."):
-            return (rec.get("stage_ms") or {}).get(key[len("stage_ms."):])
+        for table in ("stage_ms", "mfu_by_stage", "hbm_gbps_by_stage"):
+            if key.startswith(table + "."):
+                return (rec.get(table) or {}).get(key[len(table) + 1:])
         return rec.get(key)
 
     # floor: an absolute value below which a relative drop never flags —
     # the span-overhead lane hovers at the ≈0 ns disabled cost, where
     # nanosecond scheduler noise would otherwise read as a 2x regression
-    for key, label, unit, higher_is_better, floor in (
+    gated_lanes = [
         ("streaming_rtf_scan", "streaming-scan", "x realtime", True, None),
         ("corpus_clips_per_s", "corpus", "clips/s", True, None),
         ("serve_blocks_per_s", "serve", "blocks/s", True, None),
@@ -535,7 +559,16 @@ def compare_records(old: dict, new: dict, threshold: float = 0.05) -> dict:
         ("mfu", "mfu", "", True, None),
         ("stage_ms.stft_x3", "stft stage", "ms", False, None),
         ("stage_ms.step2_exchange_mwf", "step2 stage", "ms", False, None),
-    ):
+    ]
+    # the per-stage roofline lanes are dynamic: every stage the BASELINE
+    # measured is gated (the r04/r05 records predate the tables and gate
+    # nothing; a candidate losing a measured stage lane = REGRESSION)
+    for table, label in (("mfu_by_stage", "mfu"),
+                         ("hbm_gbps_by_stage", "hbm GB/s")):
+        for sk in sorted(old.get(table) or {}):
+            gated_lanes.append(
+                (f"{table}.{sk}", f"{label}[{sk}]", "", True, None))
+    for key, label, unit, higher_is_better, floor in gated_lanes:
         o_lane, n_lane = lane(old, key), lane(new, key)
         if o_lane is None:
             continue
@@ -707,6 +740,31 @@ def cmd_slo(args):
     return verdict
 
 
+def cmd_roofline(args):
+    """``disco-obs roofline``: the per-stage roofline table of one bench
+    record.  The ONE disco-obs subcommand that traces programs (to cost
+    the stages at the record's workload), so it forces the CPU backend
+    first — rendering a roofline must never claim the tunneled chip."""
+    record = load_bench_record(args.record)
+    from disco_tpu.analysis.trace.check import ensure_cpu
+
+    ensure_cpu()
+    from disco_tpu.obs import roofline
+
+    result = roofline.stage_verdicts(
+        record,
+        peak_tflops=(args.peak_tflops if args.peak_tflops is not None
+                     else roofline.PEAK_TFLOPS),
+        peak_gbps=(args.peak_gbps if args.peak_gbps is not None
+                   else roofline.PEAK_GBPS),
+    )
+    if args.format == "json":
+        print(json.dumps(result, indent=2))
+    else:
+        print(roofline.render(result))
+    return result
+
+
 def main(argv=None):
     """``disco-obs`` console entry point."""
     args = build_parser().parse_args(argv)
@@ -720,6 +778,8 @@ def main(argv=None):
         return cmd_top(args)
     if args.cmd == "slo":
         return cmd_slo(args)
+    if args.cmd == "roofline":
+        return cmd_roofline(args)
     old_rec = load_bench_record(args.old)
     new_rec = load_bench_record(args.new)
     refusal = backend_mismatch(old_rec, new_rec)

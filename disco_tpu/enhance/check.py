@@ -170,6 +170,37 @@ def _check_bench_one_line(failures: list) -> dict | None:
                 f"bench: solver_lanes[{lane_key!r}].impl missing/invalid: "
                 f"{lane.get('impl')!r} (expected 'xla' or 'pallas')"
             )
+    # the roofline join (meter round): every timed stage must carry its
+    # modeled MFU and HBM GB/s, the lanes their attributed flops, and the
+    # record the cost-model version the join was computed under — a
+    # silent meter failure would strip disco-obs compare's per-stage
+    # regression lanes from the NEXT baseline
+    if not isinstance(rec.get("cost_model_version"), int):
+        failures.append(
+            f"bench: cost_model_version missing/null in the record "
+            f"(meter_error={rec.get('meter_error')!r})"
+        )
+    for table in ("mfu_by_stage", "hbm_gbps_by_stage"):
+        got = rec.get(table)
+        if not isinstance(got, dict) or not got:
+            failures.append(
+                f"bench: {table} missing/empty in the record "
+                f"(meter_error={rec.get('meter_error')!r})"
+            )
+        else:
+            missing = sorted(set(rec.get("stage_ms") or {}) - set(got))
+            if missing:
+                failures.append(
+                    f"bench: {table} lacks timed stage(s) {missing}")
+    lane_mfu = rec.get("lane_mfu")
+    if not isinstance(lane_mfu, dict) or not (
+            {"streaming_scan", "serve", "fused_solver"} <= set(lane_mfu)):
+        failures.append(
+            f"bench: lane_mfu missing/incomplete in the record: "
+            f"{lane_mfu!r} (meter_error={rec.get('meter_error')!r})"
+        )
+    if not isinstance(rec.get("workload"), dict):
+        failures.append("bench: workload missing/null in the record")
     return rec
 
 

@@ -1,0 +1,147 @@
+"""The roofline join: measured stage times × modeled stage costs.
+
+``disco-obs roofline`` merges a bench record's measured ``stage_ms``
+(on-device, k-queued slope — bench.py) with the analytic per-stage costs
+of :mod:`disco_tpu.analysis.meter.stages` re-traced at the record's
+workload, and renders per stage: achieved FLOP/s, achieved HBM GB/s,
+fraction of the declared hardware peaks, and a verdict —
+
+* **compute-bound** — the stage's modeled flops at peak throughput take
+  longer than its modeled bytes at peak bandwidth, and the measured time
+  is within sight of the compute roof;
+* **bandwidth-bound** — the modeled bytes dominate;
+* **dispatch-bound** — the measured time is so far above BOTH roofs
+  (below ``dispatch_frac`` of peak on the binding dimension) that
+  neither resource explains it: launch/dispatch overhead does.
+
+The join is deliberately hermetic: the record supplies every measured
+number, the cost model supplies every modeled one, and tracing is
+abstract — a roofline over an on-TPU record renders on a laptop with no
+TPU attached.  When a record predates the ``workload`` field
+(BENCH_r01–r05) the bench headline defaults are assumed and the table
+says so.
+
+No reference counterpart: the reference repo has no cost model and no
+benchmarks (SURVEY.md §5.1).
+"""
+from __future__ import annotations
+
+#: default hardware peaks the verdict is judged against — TPU v5e dense
+#: f32 MXU peak and HBM bandwidth (the attached testbed; override with
+#: ``--peak-tflops`` / ``--peak-gbps`` for other parts)
+PEAK_TFLOPS = 98.0
+PEAK_GBPS = 819.0
+
+#: below this fraction of peak on the BINDING dimension the stage is
+#: called dispatch-bound: neither roof explains the measured time
+DISPATCH_FRAC = 0.01
+
+
+def workload_of_record(record: dict):
+    """The record's workload (its ``workload`` field, else the bench
+    headline defaults) as a meter :class:`Workload` + an ``assumed`` flag.
+
+    No reference counterpart (module docstring)."""
+    from disco_tpu.analysis.meter.stages import HEADLINE, Workload
+
+    w = record.get("workload")
+    if not isinstance(w, dict):
+        return HEADLINE, True
+    return Workload(
+        batch=int(w.get("batch", HEADLINE.batch)),
+        dur_s=float(w.get("dur_s", HEADLINE.dur_s)),
+        fs=int(w.get("fs", HEADLINE.fs)),
+        n_nodes=int(w.get("n_nodes", HEADLINE.n_nodes)),
+        mics_per_node=int(w.get("mics_per_node", HEADLINE.mics_per_node)),
+    ), False
+
+
+def stage_verdicts(record: dict, peak_tflops: float = PEAK_TFLOPS,
+                   peak_gbps: float = PEAK_GBPS,
+                   dispatch_frac: float = DISPATCH_FRAC) -> dict:
+    """The per-stage roofline table of one bench record.
+
+    Returns ``{rows, workload, workload_assumed, peaks,
+    cost_model_version}`` where each row carries the measured ``ms``, the
+    modeled ``gflops``/``gbytes``, achieved ``gflops_per_s``/``gb_per_s``,
+    ``frac_compute``/``frac_bandwidth`` (of the respective peaks) and the
+    ``verdict``.  Stages without a measured time (or without a modeled
+    cost) are skipped — a roofline never invents a lane.
+
+    No reference counterpart (module docstring).
+    """
+    from disco_tpu.analysis.meter import costmodel
+    from disco_tpu.analysis.meter.stages import STAGE_KEYS, offline_stage_costs
+
+    workload, assumed = workload_of_record(record)
+    costs = offline_stage_costs(workload)
+    stage_ms = record.get("stage_ms") or {}
+    rows = []
+    for stage in STAGE_KEYS:
+        ms, cost = stage_ms.get(stage), costs.get(stage)
+        if not ms or not cost:
+            continue
+        secs = ms / 1e3
+        flops, traffic = cost["flops"], cost["traffic_bytes"]
+        achieved_f = flops / secs
+        achieved_b = traffic / secs
+        frac_c = achieved_f / (peak_tflops * 1e12)
+        frac_b = achieved_b / (peak_gbps * 1e9)
+        binding = "compute" if frac_c >= frac_b else "bandwidth"
+        frac_peak = max(frac_c, frac_b)
+        verdict = ("dispatch-bound" if frac_peak < dispatch_frac
+                   else f"{binding}-bound")
+        rows.append({
+            "stage": stage,
+            "ms": ms,
+            "gflops": round(flops / 1e9, 3),
+            "gbytes": round(traffic / 1e9, 3),
+            "arithmetic_intensity": cost["arithmetic_intensity"],
+            "gflops_per_s": round(achieved_f / 1e9, 2),
+            "gb_per_s": round(achieved_b / 1e9, 2),
+            "frac_compute": round(frac_c, 6),
+            "frac_bandwidth": round(frac_b, 6),
+            "fraction_of_peak": round(frac_peak, 6),
+            "verdict": verdict,
+        })
+    return {
+        "rows": rows,
+        "workload": {
+            "batch": workload.batch, "dur_s": workload.dur_s,
+            "fs": workload.fs, "n_nodes": workload.n_nodes,
+            "mics_per_node": workload.mics_per_node,
+        },
+        "workload_assumed": assumed,
+        "peaks": {"tflops": peak_tflops, "gbps": peak_gbps},
+        "cost_model_version": costmodel.VERSION,
+    }
+
+
+def render(result: dict) -> str:
+    """The ``disco-obs roofline`` text table.
+
+    No reference counterpart (module docstring)."""
+    lines = []
+    w = result["workload"]
+    src = ("assumed (record predates the workload field)"
+           if result["workload_assumed"] else "from record")
+    lines.append(
+        f"workload: batch={w['batch']} dur_s={w['dur_s']:g} "
+        f"K={w['n_nodes']} C={w['mics_per_node']} fs={w['fs']} — {src}")
+    p = result["peaks"]
+    lines.append(
+        f"peaks: {p['tflops']:g} TFLOP/s, {p['gbps']:g} GB/s "
+        f"(cost model v{result['cost_model_version']})")
+    lines.append(
+        f"{'stage':<20}{'ms':>10}{'GFLOP':>10}{'GB':>9}{'AI':>8}"
+        f"{'GFLOP/s':>10}{'GB/s':>9}{'%peak':>8}  verdict")
+    for r in result["rows"]:
+        lines.append(
+            f"{r['stage']:<20}{r['ms']:>10.2f}{r['gflops']:>10.2f}"
+            f"{r['gbytes']:>9.2f}{r['arithmetic_intensity'] or 0:>8.3f}"
+            f"{r['gflops_per_s']:>10.1f}{r['gb_per_s']:>9.1f}"
+            f"{r['fraction_of_peak']:>8.2%}  {r['verdict']}"
+        )
+    if not result["rows"]:
+        lines.append("(no stage_ms lanes in this record)")
+    return "\n".join(lines)

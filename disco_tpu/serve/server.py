@@ -35,6 +35,7 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import itertools
+import socket
 import threading
 import time
 
@@ -100,6 +101,8 @@ class EnhanceServer:
                  tick_deadline_s: float | None = None,
                  quarantine_ticks: int = 20,
                  ladder=None,
+                 sock_sndbuf: int | None = None,
+                 write_buffer_high: int | None = None,
                  run_info: dict | None = None):
         self.host, self.port, self.unix_path = host, port, unix_path
         if ladder is True:
@@ -125,6 +128,15 @@ class EnhanceServer:
         #: of evicting; False restores the old evict-on-drop behavior
         self.park_on_disconnect = park_on_disconnect
         self.max_backlog = max_backlog
+        #: bandwidth shaping for tests/drills: SO_SNDBUF applied to every
+        #: accepted socket, and the asyncio transport's write high-water
+        #: mark.  ``max_backlog`` only meters frames the writer could not
+        #: flush, so proving the slow-client eviction path needs a pipe
+        #: that actually jams — with both set small, drain() blocks as
+        #: soon as the peer stops reading instead of whenever the kernel's
+        #: autotuned buffers happen to fill.  None (default) = untouched.
+        self.sock_sndbuf = sock_sndbuf
+        self.write_buffer_high = write_buffer_high
         self.tick_interval_s = tick_interval_s
         self.state_dir = state_dir
         #: extra attrs folded into the ``run_start`` event (the CLI rides
@@ -195,6 +207,14 @@ class EnhanceServer:
             loop.call_soon_threadsafe(outq.put_nowait, data)
 
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        if self.sock_sndbuf is not None:
+            sock = writer.get_extra_info("socket")
+            if sock is not None:
+                sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF,
+                                self.sock_sndbuf)
+        if self.write_buffer_high is not None:
+            writer.transport.set_write_buffer_limits(
+                high=self.write_buffer_high)
         conn = _Conn()
         conn.outq = asyncio.Queue()
         with self._conns_lock:

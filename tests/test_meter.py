@@ -1,0 +1,430 @@
+"""disco-meter (disco_tpu.analysis.meter): the analytic cost model, the
+explicit-unknowns contract, the committed manifests and their budgets,
+the registry sync with the trace catalog, and the roofline join.
+
+Runs under the conftest CPU config (8 virtual devices) — which, like the
+trace goldens, is itself under test: the committed cost manifests must be
+reproduced bit-identically here, proving the model counts properties of
+the traced program, not of the device topology."""
+from __future__ import annotations
+
+import copy
+import json
+from pathlib import Path
+
+import pytest
+
+from disco_tpu.analysis.meter import budgets, check, costmodel, stages
+from disco_tpu.analysis.trace.programs import PROGRAMS
+from disco_tpu.obs import roofline
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+# -- cost model: known-flops sanity ------------------------------------------
+def test_dot_general_flops_and_traffic_are_exact():
+    import jax
+    import jax.numpy as jnp
+
+    M, K, N = 8, 16, 4
+    a = jax.ShapeDtypeStruct((M, K), jnp.float32)
+    b = jax.ShapeDtypeStruct((K, N), jnp.float32)
+    rep = costmodel.cost_of_fn(jnp.dot, (a, b), program="matmul")
+    assert rep["flops"] == 2 * M * N * K
+    assert rep["flops_by_class"] == {"dot_general": 2 * M * N * K}
+    # materialization model: each operand read + the result written once
+    assert rep["traffic_bytes"] == 4 * (M * K + K * N + M * N)
+    assert rep["hbm_bytes_in"] == 4 * (M * K + K * N)
+    assert rep["hbm_bytes_out"] == 4 * M * N
+    assert rep["unmodeled"]["traffic_fraction"] == 0.0
+    assert rep["version"] == costmodel.VERSION
+
+
+def test_complex_mul_and_fft_conventions():
+    import jax
+    import jax.numpy as jnp
+
+    z = jax.ShapeDtypeStruct((32,), jnp.complex64)
+    rep = costmodel.cost_of_fn(lambda a: a * a, (z,), program="cmul")
+    assert rep["flops"] == 32 * 6           # complex mul = 6 real flops
+    n = 64
+    x = jax.ShapeDtypeStruct((n,), jnp.float32)
+    rep = costmodel.cost_of_fn(jnp.fft.rfft, (x,), program="fft")
+    assert rep["flops_by_class"]["fft"] == int(5 * n * 6)   # 5·N·log2(N)
+
+
+def test_scan_costs_body_times_length_plus_carry_roundtrip():
+    import jax
+    import jax.numpy as jnp
+
+    L = 10
+    c0 = jax.ShapeDtypeStruct((4,), jnp.float32)
+    xs = jax.ShapeDtypeStruct((L, 4), jnp.float32)
+
+    def f(c, xs):
+        return jax.lax.scan(lambda c, x: (jnp.sin(c) + x, c), c, xs)
+
+    rep = costmodel.cost_of_fn(f, (c0, xs), program="scan")
+    one = costmodel.cost_of_fn(
+        lambda c, x: jnp.sin(c) + x, (c0, c0), program="body")
+    # body flops scale with the trip count
+    assert rep["flops"] == L * one["flops"]
+    # the carry round-trips HBM every iteration: 2·|carry|·L on top of the
+    # boundary, so the scan's traffic dominates L× the body boundary
+    assert rep["traffic_bytes"] >= 2 * 16 * L
+
+
+def test_while_loop_counted_once_and_surfaced():
+    import jax
+    import jax.numpy as jnp
+
+    def f(x):
+        return jax.lax.while_loop(lambda c: c[0] < 10.0,
+                                  lambda c: (c[0] + 1.0, jnp.cos(c[1])),
+                                  (x, x))
+
+    rep = costmodel.cost_of_fn(
+        f, (jax.ShapeDtypeStruct((), jnp.float32),), program="wh")
+    assert rep["while_loops"] == 1
+
+
+# -- fused islands: boundary-only traffic, interior flops kept ---------------
+def test_fused_island_zeroes_interior_traffic_but_keeps_flops():
+    import jax
+    import jax.numpy as jnp
+
+    x = jax.ShapeDtypeStruct((64, 64), jnp.float32)
+
+    def interior(a):
+        return jnp.sin(a) @ jnp.cos(a) + jnp.tanh(a)
+
+    def fused_mwf_xla(a):                   # pjit named by __name__
+        return interior(a)
+
+    jitted = jax.jit(fused_mwf_xla)
+
+    def with_island(a):
+        return jitted(a) * 2.0
+
+    def without_island(a):
+        return interior(a) * 2.0
+
+    ri = costmodel.cost_of_fn(with_island, (x,), program="island")
+    rf = costmodel.cost_of_fn(without_island, (x,), program="flat")
+    assert ri["fused_islands"] == ["fused_mwf_xla"]
+    assert rf["fused_islands"] == []
+    # the interior's real work counts either way…
+    assert ri["flops"] == rf["flops"]
+    # …but the island's intermediates never touch HBM: boundary bytes only
+    assert ri["traffic_bytes"] < rf["traffic_bytes"]
+    # a pjit NOT in the declared fused set is no island
+    other = jax.jit(interior)
+    rn = costmodel.cost_of_fn(
+        lambda a: other(a) * 2.0, (x,), program="nope",
+        fused_units=("something_else",))
+    assert rn["fused_islands"] == []
+    assert rn["traffic_bytes"] == rf["traffic_bytes"]
+
+
+# -- explicit unknowns: a primitive the model does not know ------------------
+def _bind_synthetic_primitive():
+    """A jaxpr whose only equation is a primitive the model has no entry
+    for (the explicit-unknowns fixture)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import core as jcore
+
+    prim = jcore.Primitive("frobnicate_v99")
+    prim.def_abstract_eval(lambda x: x)
+    return jax.make_jaxpr(lambda a: prim.bind(a))(
+        jax.ShapeDtypeStruct((128,), jnp.float32))
+
+
+def test_unknown_primitive_lands_in_unmodeled_bucket():
+    assert costmodel.classify("frobnicate_v99") == "unmodeled"
+    rep = costmodel.cost_of_jaxpr(_bind_synthetic_primitive(),
+                                  program="synthetic")
+    assert rep["unmodeled"]["primitives"] == {"frobnicate_v99": 1}
+    assert rep["unmodeled"]["traffic_bytes"] == 2 * 128 * 4
+    # the unknown is ALL this program's traffic: fraction 1.0
+    assert rep["unmodeled"]["traffic_fraction"] == 1.0
+    assert rep["traffic_by_class"]["unmodeled"] == rep["traffic_bytes"]
+
+
+def test_unmodeled_fraction_past_ceiling_trips_the_budget():
+    rep = costmodel.cost_of_jaxpr(_bind_synthetic_primitive(),
+                                  program="synthetic")
+    msgs = budgets.check_unmodeled(rep)
+    assert len(msgs) == 1
+    assert "frobnicate_v99" in msgs[0] and "ceiling" in msgs[0]
+    # an override reviewed in budgets.py grants headroom
+    assert budgets.unmodeled_ceiling("synthetic") == \
+        budgets.UNMODELED_FRACTION_MAX
+    rep_ok = dict(rep, unmodeled=dict(rep["unmodeled"], traffic_fraction=0.0))
+    assert budgets.check_unmodeled(rep_ok) == []
+
+
+def test_update_refuses_manifest_breaching_its_own_budget(
+        monkeypatch, tmp_path):
+    """`disco-meter --update` must not be able to smuggle an unmodeled hot
+    loop into the committed goldens."""
+    from disco_tpu.analysis.trace.programs import ProgramSpec
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax import core as jcore
+
+        prim = jcore.Primitive("frobnicate_v99")
+        prim.def_abstract_eval(lambda x: x)
+        return (lambda a: prim.bind(a),
+                (jax.ShapeDtypeStruct((128,), jnp.float32),), {})
+
+    spec = ProgramSpec("synthetic_unknown", "fixture", build)
+    monkeypatch.setattr(
+        "disco_tpu.analysis.trace.programs.PROGRAMS",
+        {"synthetic_unknown": spec})
+    monkeypatch.setattr(check, "GOLDEN_DIR", tmp_path / "cost")
+    result = check.run_checks(update=True, programs={"synthetic_unknown"})
+    assert not result.clean
+    checks = {f["check"] for f in result.findings}
+    assert "budget" in checks and "golden" in checks
+    assert not (tmp_path / "cost" / "synthetic_unknown.json").exists()
+    assert result.updated == []
+
+
+# -- committed manifests: bit-identical rebuild under this device config -----
+def test_committed_manifests_rebuild_bit_identically():
+    """The full gate — every catalog program re-traced and re-costed here
+    (8 virtual CPU devices) must match the committed manifests exactly,
+    hold every budget, and pass registry sync in both directions."""
+    result = check.run_checks()
+    assert result.findings == []
+    assert result.n_programs == len(PROGRAMS)
+    # and the manifest bytes on disk are the canonical dumps() form
+    for name in PROGRAMS:
+        path = check.golden_path(name)
+        text = path.read_text()
+        assert costmodel.dumps(json.loads(text)) == text, name
+
+
+def test_committed_fused_manifest_beats_eigh_on_hbm_traffic():
+    """The design thesis as data: the fused step-2 manifest models
+    strictly fewer HBM bytes than the separate-stage eigh manifest."""
+    fused = check.load_golden("tango_step2_fused")
+    eigh = check.load_golden("tango_step2_eigh")
+    assert fused is not None and eigh is not None
+    assert fused["traffic_bytes"] < eigh["traffic_bytes"]
+    # fusing keeps the flops (same math) while cutting the traffic, so the
+    # arithmetic intensity strictly improves
+    assert fused["arithmetic_intensity"] > eigh["arithmetic_intensity"]
+    assert "fused_mwf_xla" in fused["fused_islands"]
+    assert eigh["fused_islands"] == []
+    assert budgets.check_cross(
+        {"tango_step2_fused": fused, "tango_step2_eigh": eigh}) == []
+
+
+def test_cross_budget_reports_missing_program_and_violation():
+    fused = check.load_golden("tango_step2_fused")
+    msgs = budgets.check_cross({"tango_step2_fused": fused})
+    assert len(msgs) == 1 and "missing" in msgs[0]
+    inflated = dict(fused, traffic_bytes=10**12)
+    msgs = budgets.check_cross({
+        "tango_step2_fused": inflated,
+        "tango_step2_eigh": check.load_golden("tango_step2_eigh"),
+    })
+    assert len(msgs) == 1 and "violated" in msgs[0]
+    assert "pencils" in msgs[0]     # the thesis text travels with the red
+
+
+# -- drift: an inflated-traffic manifest fails with a readable diff ----------
+def test_inflated_traffic_fails_with_per_class_diff():
+    golden = check.load_golden("tango_step2_fused")
+    drifted = copy.deepcopy(golden)
+    drifted["traffic_bytes"] += 4096
+    drifted["traffic_by_class"]["data_movement"] = (
+        drifted["traffic_by_class"].get("data_movement", 0) + 4096)
+    drifted["fused_islands"] = []
+    lines = costmodel.diff_reports(golden, drifted)
+    assert any("traffic_bytes" in ln and "+" in ln for ln in lines)
+    assert any("traffic_by_class[data_movement]" in ln for ln in lines)
+    assert any("lost island re-exposes" in ln for ln in lines)
+
+
+def test_version_bump_short_circuits_to_regenerate_hint():
+    golden = check.load_golden("tango_step2_fused")
+    lines = costmodel.diff_reports(dict(golden, version=0), golden)
+    assert len(lines) == 1 and "regenerate" in lines[0]
+
+
+def test_unfusing_the_solver_trips_the_gate():
+    """Revert-style fixture: cost the fused program with the island
+    declaration gone (exactly what reverting the solve-fusion round would
+    do) — the re-exposed interior traffic must show up as a readable
+    manifest diff AND break the cross-budget."""
+    fn, args, kwargs = PROGRAMS["tango_step2_fused"].build()
+    current = costmodel.cost_of_fn(fn, args, kwargs=kwargs, fused_units=(),
+                                   program="tango_step2_fused")
+    golden = check.load_golden("tango_step2_fused")
+    assert current["traffic_bytes"] > golden["traffic_bytes"]
+    lines = costmodel.diff_reports(golden, current)
+    assert any("traffic_bytes" in ln for ln in lines)
+    assert any("fused islands" in ln for ln in lines)
+    msgs = budgets.check_cross({
+        "tango_step2_fused": current,
+        "tango_step2_eigh": check.load_golden("tango_step2_eigh"),
+    })
+    assert msgs and "violated" in msgs[0]
+
+
+# -- registry sync -----------------------------------------------------------
+def test_registry_sync_flags_missing_and_stale_manifests(
+        monkeypatch, tmp_path):
+    from disco_tpu.analysis.trace.programs import ProgramSpec
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        return (lambda a: a * 2.0,
+                (jax.ShapeDtypeStruct((8,), jnp.float32),), {})
+
+    specs = {"tiny_a": ProgramSpec("tiny_a", "fixture", build),
+             "tiny_b": ProgramSpec("tiny_b", "fixture", build)}
+    monkeypatch.setattr(
+        "disco_tpu.analysis.trace.programs.PROGRAMS", specs)
+    gdir = tmp_path / "cost"
+    gdir.mkdir()
+    monkeypatch.setattr(check, "GOLDEN_DIR", gdir)
+    # commit tiny_a's manifest plus a STALE one; leave tiny_b uncommitted
+    (gdir / "tiny_a.json").write_text(
+        costmodel.dumps(check.build_report(specs["tiny_a"])))
+    (gdir / "deleted_program.json").write_text("{}")
+    result = check.run_checks()
+    reg = {f["program"]: f["message"] for f in result.findings
+           if f["check"] == "registry"}
+    assert "tiny_b" in reg and "no cost manifest" in reg["tiny_b"]
+    assert "deleted_program" in reg and "stale" in reg["deleted_program"]
+    # cross-budget unevaluable on this synthetic catalog: also a finding
+    assert any(f["check"] == "cross" for f in result.findings)
+
+
+def test_unknown_program_raises_and_cli_exits_2(capsys):
+    from disco_tpu.analysis.meter import cli
+
+    with pytest.raises(KeyError):
+        check.run_checks(programs={"no_such_program"})
+    assert cli.main(["--programs", "no_such_program"]) == 2
+    assert "no_such_program" in capsys.readouterr().err
+    assert cli.main(["--list-programs"]) == 0
+    out = capsys.readouterr().out
+    for name in PROGRAMS:
+        assert name in out
+
+
+def test_single_program_pass_skips_catalog_wide_checks(capsys):
+    from disco_tpu.analysis.meter import cli
+
+    rc = cli.main(["--programs", "tango_step2_fused", "--format", "json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0 and payload["clean"]
+    assert payload["counts"]["programs"] == 1
+    assert list(payload["reports"]) == ["tango_step2_fused"]
+
+
+# -- workload-sized stage costs (the roofline's cost side) -------------------
+TINY = stages.Workload(batch=1, dur_s=0.2, n_nodes=2, mics_per_node=2)
+
+
+def test_offline_stage_costs_cover_bench_stage_keys():
+    costs = stages.offline_stage_costs(TINY)
+    assert set(costs) == set(stages.STAGE_KEYS)
+    for key, c in costs.items():
+        assert c["flops"] >= 0 and c["traffic_bytes"] >= 0, key
+    assert costs["full_pipeline"]["flops"] > 0
+    # step-2 is full minus step-1, like the bench timing
+    assert (costs["step2_exchange_mwf"]["flops"]
+            < costs["full_pipeline"]["flops"])
+
+
+def test_streaming_scan_cost_matches_bench_shrink_and_bounds():
+    out = stages.streaming_scan_cost(dur_s=2.0, blocks_per_dispatch=4)
+    assert out is not None
+    assert out["window_frames"] == 4 * out["block_frames"]
+    assert out["flops"] > 0
+    # a clip too short for even one update block: no lane, not a crash
+    assert stages.streaming_scan_cost(dur_s=0.05) is None
+
+
+def test_serve_block_cost_is_per_block():
+    out = stages.serve_block_cost()
+    assert out["block_frames"] == 16
+    assert out["flops"] > 0 and out["traffic_bytes"] > 0
+
+
+def test_fused_pipeline_cost_models_less_traffic_than_eigh_pipeline():
+    fused = stages.fused_pipeline_cost(TINY)
+    plain = stages.offline_stage_costs(TINY, solver="eigh")["full_pipeline"]
+    assert fused["flops"] > 0
+    assert fused["traffic_bytes"] < plain["traffic_bytes"]
+
+
+# -- roofline join -----------------------------------------------------------
+def test_roofline_renders_from_committed_bench_r05_without_tpu():
+    """The exact artifact the issue names: `disco-obs roofline
+    BENCH_r05.json` must produce a verdict per measured stage on a host
+    with no TPU, assuming the headline workload (r05 predates the
+    `workload` field)."""
+    from disco_tpu.cli.obs import load_bench_record
+
+    record = load_bench_record(ROOT / "BENCH_r05.json")
+    result = roofline.stage_verdicts(record)
+    assert result["workload_assumed"] is True
+    assert result["cost_model_version"] == costmodel.VERSION
+    got = {r["stage"] for r in result["rows"]}
+    assert got == set(record["stage_ms"]) & set(stages.STAGE_KEYS)
+    for row in result["rows"]:
+        assert row["verdict"] in (
+            "compute-bound", "bandwidth-bound", "dispatch-bound")
+        assert row["gflops_per_s"] >= 0 and row["gb_per_s"] >= 0
+    text = roofline.render(result)
+    assert "verdict" in text and "assumed" in text
+    for row in result["rows"]:
+        assert row["stage"] in text
+
+
+def test_roofline_verdict_boundaries():
+    record = {
+        "stage_ms": {"full_pipeline": 50.0},
+        "workload": {"batch": 1, "dur_s": 0.2, "n_nodes": 2,
+                     "mics_per_node": 2},
+    }
+    res = roofline.stage_verdicts(record)
+    assert res["workload_assumed"] is False
+    (row,) = res["rows"]
+    assert row["verdict"] in ("compute-bound", "bandwidth-bound",
+                              "dispatch-bound")
+    # blow the measured time up 10000x: neither roof explains it
+    slow = dict(record, stage_ms={"full_pipeline": 50.0 * 1e4})
+    (srow,) = roofline.stage_verdicts(slow)["rows"]
+    assert srow["verdict"] == "dispatch-bound"
+    assert srow["fraction_of_peak"] < roofline.DISPATCH_FRAC
+    # crank the declared peaks down far enough and the same measurement
+    # reads as AT the roof on its binding dimension
+    tiny_peaks = roofline.stage_verdicts(
+        record, peak_tflops=1e-9, peak_gbps=1e-9)
+    (trow,) = tiny_peaks["rows"]
+    assert trow["verdict"] == ("compute-bound"
+                               if trow["frac_compute"] >= trow["frac_bandwidth"]
+                               else "bandwidth-bound")
+    assert trow["fraction_of_peak"] > 1.0
+
+
+def test_workload_of_record_roundtrip():
+    w, assumed = roofline.workload_of_record({})
+    assert assumed is True and w == stages.HEADLINE
+    w, assumed = roofline.workload_of_record(
+        {"workload": {"batch": 2, "dur_s": 0.5}})
+    assert assumed is False
+    assert w.batch == 2 and w.dur_s == 0.5
+    assert w.n_nodes == stages.HEADLINE.n_nodes

@@ -1121,6 +1121,49 @@ def test_obs_compare_mfu_and_stage_lanes(tmp_path):
     )["verdict"] == "OK"
 
 
+def test_obs_compare_per_stage_mfu_and_gbps_tables(tmp_path):
+    """The meter round's per-stage efficiency tables: every
+    ``mfu_by_stage.*`` / ``hbm_gbps_by_stage.*`` row the BASELINE carries
+    is judged higher-is-better, a candidate that lost a measured stage
+    lane is a REGRESSION, and pre-meter baselines (r01–r05) gate
+    nothing."""
+    def rec(path, rtf, mfu=None, gbps=None):
+        d = _bench_record(rtf)
+        if mfu is not None:
+            d["mfu_by_stage"] = mfu
+            d["hbm_gbps_by_stage"] = gbps
+        p = tmp_path / path
+        p.write_text(json.dumps(d))
+        return str(p)
+
+    base = rec("base.json", 6700.0,
+               mfu={"stft_x3": 0.13, "full_pipeline": 0.03},
+               gbps={"stft_x3": 106.0, "full_pipeline": 90.0})
+    # one stage's efficiency collapsing flags even with the headline flat
+    slow = rec("slow.json", 6700.0,
+               mfu={"stft_x3": 0.05, "full_pipeline": 0.03},
+               gbps={"stft_x3": 106.0, "full_pipeline": 90.0})
+    with pytest.raises(SystemExit):
+        obs_cli.main(["compare", base, slow])
+    # a stage dropping OUT of the table is a REGRESSION, not a skip
+    lost = rec("lost.json", 6700.0,
+               mfu={"full_pipeline": 0.03}, gbps={"full_pipeline": 90.0})
+    with pytest.raises(SystemExit):
+        obs_cli.main(["compare", base, lost])
+    # both tables up: IMPROVED, with the rows visible in the diff
+    good = rec("good.json", 6700.0,
+               mfu={"stft_x3": 0.20, "full_pipeline": 0.05},
+               gbps={"stft_x3": 140.0, "full_pipeline": 120.0})
+    diff = obs_cli.main(["compare", base, good])
+    assert diff["verdict"] == "IMPROVED"
+    rows = {r["key"]: r for r in diff["rows"]}
+    assert rows["mfu_by_stage.stft_x3"]["higher_is_better"] is True
+    assert rows["hbm_gbps_by_stage.full_pipeline"]["higher_is_better"] is True
+    # a pre-meter baseline judges nothing: the candidate's tables ride along
+    pre = rec("pre.json", 6700.0)
+    assert obs_cli.main(["compare", pre, lost])["verdict"] == "OK"
+
+
 def test_bench_record_carries_fused_kernel_fields(monkeypatch, capsys):
     """The ONE-JSON-line record documents the active fused kernels: the
     stft_impl/precision fields plus the bf16 error-reporting lane ride the

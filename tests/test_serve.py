@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import socket
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -556,23 +557,52 @@ def test_server_rejects_over_capacity(stream):
 
 def test_server_evicts_slow_client(stream):
     """A client that streams blocks without draining its socket is evicted
-    with a clean error frame once the output backlog bound is hit."""
+    with a clean error frame once the output backlog bound is hit.
+
+    The jam must be real, not lucky.  Two independent races made the old
+    form of this test flaky-to-hanging: (1) the client started reading
+    right after its sends, and block compute is the bottleneck here, so
+    the "slow client" mostly did not exist — every frame was consumed as
+    it was posted and the backlog never formed; (2) even an unread frame
+    only registers as backlog once the writer blocks in drain(), and
+    default TCP autotuning gives the kernel megabytes of slack, so the
+    pipe never jammed.  Deterministic form: the client does NOT read at
+    all until the server has actually evicted the session (observed
+    in-process — eviction frees the registry slot), tiny socket buffers
+    on both ends plus a zero transport high-water mark jam the writer on
+    the FIRST unread ~66 KiB frame, and one block per tick spreads the
+    posts so a later tick's post observes the jammed queue (back-to-back
+    posts within one tick all read qsize before the loop thread executes
+    any put).  Only then does the client drain the socket and assert the
+    clean ``evicted`` error frame; the socket timeout turns any residual
+    no-eviction outcome into a failure instead of a hang."""
     from disco_tpu.serve import EnhanceServer
     from disco_tpu.serve.session import EVICTED
 
     Y, m, _ = stream
     F = Y.shape[-2]
-    srv = EnhanceServer(max_sessions=2, max_backlog=1, max_queue_blocks=16)
+    srv = EnhanceServer(max_sessions=2, max_backlog=1, max_queue_blocks=16,
+                        max_blocks_per_tick=1, sock_sndbuf=4096,
+                        write_buffer_high=0)
     addr = srv.start()
-    sock = socket.create_connection(addr)
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+    sock.connect(addr)
+    sock.settimeout(120.0)
     try:
         protocol.send_frame(sock, {"type": "open", "config": _config(F).to_dict()})
         opened = protocol.recv_frame(sock)
         assert opened["type"] == "open_ok"
         blk = {"Y": Y[..., :BLOCK].astype(np.complex64),
                "mask_z": m[..., :BLOCK], "mask_w": m[..., :BLOCK]}
-        for seq in range(6):  # never read -> backlog grows past max_backlog=1
+        for seq in range(6):  # sent up front; NOT read back until evicted
             protocol.send_frame(sock, {"type": "block", "seq": seq, **blk})
+        for _ in range(1200):  # bounded: ~2 min >> 3 one-block ticks
+            if not srv.scheduler.sessions():
+                break           # slot freed: the eviction has happened
+            time.sleep(0.1)
+        else:
+            raise AssertionError("session never evicted despite jammed pipe")
         frames = []
         while True:
             f = protocol.recv_frame(sock)
