@@ -3,9 +3,9 @@
 
 PYTHON ?= python
 
-.PHONY: test coverage doc install native clean bench milestone-corpus dryrun lint-check trace-check race-check meter-check obs-check fault-check chaos-check perf-check serve-check stream-check flywheel-check soak-check scope-check
+.PHONY: test coverage doc install native clean bench milestone-corpus dryrun lint-check trace-check race-check meter-check obs-check fault-check chaos-check perf-check serve-check stream-check flywheel-check soak-check scope-check promote-check
 
-test: lint-check trace-check race-check meter-check obs-check fault-check chaos-check perf-check stream-check serve-check flywheel-check soak-check scope-check
+test: lint-check trace-check race-check meter-check obs-check fault-check chaos-check perf-check stream-check serve-check flywheel-check soak-check scope-check promote-check
 	$(PYTHON) -m pytest tests/ -q
 
 # Static-analysis gate (runs FIRST: it needs no jax, no device and ~2 s):
@@ -200,6 +200,27 @@ soak-check:
 scope-check:
 	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= DISCO_TPU_COMPILE_CACHE=off \
 	    $(PYTHON) -m disco_tpu.obs.scope
+
+# Live-promotion gate (the fifteenth gate): drill the canary/gate/rollback
+# ladder on a loopback CPU server — a worse-on-purpose candidate is staged
+# against a live incumbent, canaried onto a fraction of the model-mask
+# sessions at an atomic block boundary, fails the SDR gate and rolls back
+# with every delivered frame of every session bit-exact against the
+# per-generation offline oracle and a flight-recorder demotion dump naming
+# the failing metric; a good candidate dropped into the watch directory
+# auto-stages, passes the SDR+SLO gate and promotes (ACTIVE pointer flip,
+# model_promotions / weight_generation / tap_to_promotion_ms recorded); a
+# ChaosCrash at the dispatch thread's pre_swap seam mid-rollout leaves no
+# torn weight file, checkpoint or pointer and the restarted server settles
+# the interrupted rollout from the ledger, resumes the checkpointed canary
+# bit-exact and still promotes a fresh candidate; mid_canary / post_gate
+# crashes kill the controller thread alone — serving continues bit-exact
+# and a fresh controller's ledger replay rolls the orphan back.  Hermetic:
+# CPU, loopback only, compile cache off, one JAX process, zero SIGKILLs
+# (disco_tpu/promote/check.py).
+promote-check:
+	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= DISCO_TPU_COMPILE_CACHE=off \
+	    $(PYTHON) -m disco_tpu.promote.check
 
 coverage:
 	$(PYTHON) -m coverage run --branch --source=disco_tpu -m pytest tests/ -q

@@ -68,6 +68,14 @@ LOCKS = {
         "one histogram's reservoir",
     "disco_tpu.obs.metrics:Registry::_lock":
         "the instrument name tables (get-or-create)",
+    "disco_tpu.promote.controller:PromotionController::_lock":
+        "the rollout state machine (phase/candidate/pending/swapped/"
+        "scores: controller thread steps it, dispatch thread reports "
+        "swaps, I/O thread offers scores); NEVER held across store I/O "
+        "or a model load",
+    "disco_tpu.promote.store::_MODEL_CACHE_LOCK":
+        "the per-architecture flax module cache (model_for_arch "
+        "get-or-create: dispatch thread vs controller)",
 }
 
 

@@ -76,6 +76,7 @@ ROLES = {
                 "disco_tpu.enhance.driver:enhance_rirs_batched",
                 "disco_tpu.serve.check:main",
                 "disco_tpu.flywheel.check:main",
+                "disco_tpu.promote.check:main",
                 "disco_tpu.obs.scope:main",
                 "disco_tpu.runs.soak:main",
             ),
@@ -129,6 +130,20 @@ ROLES = {
             flag_only=True,
             summary="SIGTERM/SIGINT handlers: flag-set allowlist only "
                     "(runs inside an arbitrary interrupted frame)",
+        ),
+        Role(
+            "promote_controller",
+            entry_points=(
+                "disco_tpu.promote.controller:PromotionController._run",
+            ),
+            # NOT jax_ok by design: the controller only REQUESTS swaps
+            # (pending map) and reads ledgers/stores; the dispatch thread
+            # loads weights and executes every swap (the single-chip-claim
+            # contract — a second jax-entering thread would contend for
+            # the one tunneled claim)
+            summary="the promotion-rollout controller thread: watch-dir "
+                    "scans, canary bookkeeping, gate verdicts, ledger "
+                    "writes — never jax",
         ),
         Role(
             "client_reader",
@@ -217,6 +232,12 @@ ATTR_TYPES = {
     "disco_tpu.serve.scheduler:Scheduler.tap": "disco_tpu.flywheel.tap:CorpusTap",
     "disco_tpu.serve.server:EnhanceServer.scheduler": "disco_tpu.serve.scheduler:Scheduler",
     "disco_tpu.serve.server:EnhanceServer.tap": "disco_tpu.flywheel.tap:CorpusTap",
+    "disco_tpu.serve.scheduler:Scheduler.promote":
+        "disco_tpu.promote.controller:PromotionController",
+    "disco_tpu.serve.server:EnhanceServer.promote":
+        "disco_tpu.promote.controller:PromotionController",
+    "disco_tpu.promote.controller:PromotionController.store":
+        "disco_tpu.promote.store:GenerationStore",
 }
 
 

@@ -555,6 +555,13 @@ def compare_records(old: dict, new: dict, threshold: float = 0.05) -> dict:
         ("serve_blocks_per_s", "serve", "blocks/s", True, None),
         ("train_steps_per_s", "train", "steps/s", True, None),
         ("tap_blocks_per_s", "tap", "blocks/s", True, None),
+        # promotion lanes: rollout latency (lower is better; CPU smoke
+        # rollouts run whole canary windows, so floor sub-10s jitter) and
+        # the completed-rollout count (a candidate that LOST the lane —
+        # None against a measured baseline — is the regression that
+        # matters, not the count itself)
+        ("tap_to_promotion_ms", "tap-to-promotion", "ms", False, 10_000.0),
+        ("model_promotions", "promotions", "", True, None),
         ("span_overhead_ns", "span-overhead", "ns", False, 1000.0),
         ("mfu", "mfu", "", True, None),
         ("stage_ms.stft_x3", "stft stage", "ms", False, None),

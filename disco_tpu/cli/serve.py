@@ -144,6 +144,30 @@ def build_parser():
                         "I/O")
     p.add_argument("--flight-capacity", type=int, default=256,
                    help="flight-ring depth per subsystem (entries)")
+    p.add_argument("--promote-dir", default=None, metavar="DIR",
+                   help="arm live model promotion (disco_tpu.promote): DIR "
+                        "holds the digest-addressed weight-generation store, "
+                        "the ACTIVE pointer and the rollout ledger; staged "
+                        "candidates are canaried onto a fraction of live "
+                        "model-mask sessions at an atomic block boundary, "
+                        "SDR/SLO-gated, then promoted or rolled back — "
+                        "checkpoints dropped into DIR/incoming are staged "
+                        "automatically")
+    p.add_argument("--canary-frac", type=float, default=0.25,
+                   help="fraction of live model-mask sessions canaried onto "
+                        "a candidate generation (at least one session when "
+                        "any exist; with --promote-dir)")
+    p.add_argument("--sdr-gate-db", type=float, default=None, metavar="DB",
+                   help="demote a candidate whose mean canary SDR falls more "
+                        "than this many dB below the incumbent's over the "
+                        "canary window (scores arrive via the promotion "
+                        "controller's offer_score API); default: no SDR leg "
+                        "— the gate judges SLO targets and window "
+                        "completion alone")
+    p.add_argument("--no-slo-gate", dest="slo_gate", action="store_false",
+                   default=True,
+                   help="do not judge the disco-obs slo serve targets in "
+                        "the promotion gate (with --promote-dir)")
     add_tap_args(p)
     add_fault_args(p)
     add_preflight_arg(p, what="the server")
@@ -170,6 +194,19 @@ def main(argv=None):
         from disco_tpu.runs import GracefulInterrupt
         from disco_tpu.serve import EnhanceServer
 
+        promote = None
+        if args.promote_dir:
+            from pathlib import Path
+
+            from disco_tpu.promote.controller import PromotionController
+
+            promote = PromotionController(
+                args.promote_dir,
+                canary_frac=args.canary_frac,
+                sdr_gate_db=args.sdr_gate_db,
+                slo_gate=args.slo_gate,
+                watch_dir=Path(args.promote_dir) / "incoming",
+            )
         srv = EnhanceServer(
             host=args.host, port=args.port, unix_path=args.unix,
             max_sessions=args.max_sessions,
@@ -188,7 +225,9 @@ def main(argv=None):
             dispatch_retries=args.dispatch_retries,
             tick_deadline_s=args.tick_deadline,
             ladder=args.ladder,
+            promote=promote,
             run_info={"preflight": preflight, "state_dir": args.state_dir,
+                      "promote_dir": args.promote_dir,
                       "max_sessions": args.max_sessions,
                       "blocks_per_super_tick": args.blocks_per_super_tick,
                       "park_ttl_s": args.park_ttl,

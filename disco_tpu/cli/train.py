@@ -72,6 +72,14 @@ def build_parser():
                         "mixed precision — bf16 apply-time params and "
                         "activations, float32 master params, optimizer "
                         "accumulators and loss")
+    p.add_argument("--promote-dir", default=None, metavar="DIR",
+                   help="publish every improved-best checkpoint as a "
+                        "digest-addressed weight generation under DIR "
+                        "(disco_tpu.promote), where a live disco-serve "
+                        "--promote-dir server canaries and promotes it; "
+                        "mid-epoch checkpoints of an interrupted run are "
+                        "refused by the ledger check, never staged "
+                        "(CRNN only)")
     add_ledger_arg(p, "epoch")
     add_preflight_arg(p, what="the multi-hour run")
     add_obs_log_arg(p, what="training")
@@ -136,8 +144,12 @@ def _run_shards(args):
         )
     ds = ShardDataset(args.shards, win_len=win_len, seed=args.seed)
     batch = args.batch_size or cfg.batch_size
-    model, tx = build_crnn(n_ch=1, win_len=win_len, n_freq=geom["n_freq"],
-                           learning_rate=cfg.lr, ff_units=(geom["n_freq"],))
+    # the arch dict doubles as the generation-store architecture record
+    # (--promote-dir): a serve-side GenerationStore.load rebuilds this
+    # exact model from it
+    arch = dict(n_ch=1, win_len=win_len, n_freq=geom["n_freq"],
+                learning_rate=cfg.lr, ff_units=(geom["n_freq"],))
+    model, tx = build_crnn(**arch)
     if model.conv_output_hw()[0] < 1:
         raise SystemExit(
             f"--shard-win-len {win_len} is too short for the canonical CRNN "
@@ -162,6 +174,8 @@ def _run_shards(args):
         ledger=args.ledger,
         mesh=_mesh(args),
         precision=args.precision,
+        promote_dir=args.promote_dir,
+        promote_arch=arch if args.promote_dir else None,
     )
     print(f"run {run_name}: best val loss {np.nanmin(val_losses):.6f}")
     return run_name
@@ -213,9 +227,18 @@ def _run(args):
         return gen
 
     n_ch = 1 if args.single_channel else 1 + dataset.z_nodes
+    arch = dict(n_ch=n_ch, win_len=cfg.win_len, n_freq=cfg.ff_units,
+                learning_rate=cfg.lr)
     if args.archi == "crnn":
-        model, tx = build_crnn(n_ch=n_ch, win_len=cfg.win_len, n_freq=cfg.ff_units, learning_rate=cfg.lr)
+        model, tx = build_crnn(**arch)
     else:
+        if args.promote_dir:
+            raise SystemExit(
+                "--promote-dir: only the CRNN architecture can be staged "
+                "as a serve weight generation (the serve model lane "
+                "rebuilds via build_crnn); drop --promote-dir or use "
+                "--archi crnn"
+            )
         from disco_tpu.nn.crnn import build_rnn
 
         model, tx = build_rnn(n_ch=n_ch, win_len=cfg.win_len, n_freq=cfg.ff_units, learning_rate=cfg.lr)
@@ -238,6 +261,8 @@ def _run(args):
             resume_from=none_str(args.weights),
             patience=cfg.early_stop_patience,
             ledger=args.ledger,
+            promote_dir=args.promote_dir,
+            promote_arch=arch if args.promote_dir else None,
         )
     print(f"run {run_name}: best val loss {np.nanmin(val_losses):.6f}")
     return run_name

@@ -78,6 +78,9 @@ def _check_bench_one_line(failures: list) -> dict | None:
         "BENCH_TRAIN_STEPS": "2",
         "BENCH_TRAIN_BATCH": "2",
         "BENCH_TAP_BLOCKS": "8",
+        # pinned: an exported =0 would null the promotion lane this gate
+        # asserts (the lane's one rollout IS its smoke size)
+        "BENCH_PROMOTE": "1",
         "BENCH_NP_DUR_S": "0",  # skip the minutes-long float64 baseline
         "BENCH_WATCHDOG_S": "900",
     }
@@ -121,7 +124,11 @@ def _check_bench_one_line(failures: list) -> dict | None:
                 f"(streaming_scan_error={rec.get('streaming_scan_error')!r})"
             )
     for key, err_key in (("train_steps_per_s", "train_error"),
-                         ("tap_blocks_per_s", "tap_error")):
+                         ("tap_blocks_per_s", "tap_error"),
+                         # the live-promotion lane: one gated rollout on a
+                         # loopback server must complete and be measured
+                         ("tap_to_promotion_ms", "promote_error"),
+                         ("model_promotions", "promote_error")):
         if not isinstance(rec.get(key), (int, float)):
             failures.append(
                 f"bench: {key} missing/null in the record "

@@ -399,6 +399,29 @@ def load_params_for_inference(path, state: TrainState) -> TrainState:
     return state
 
 
+def publish_checkpoint(promote_dir, ckpt_path, *, arch: dict, ledger=None,
+                       source: str | None = None):
+    """THE publish seam between training and live promotion: stage one
+    ``save_checkpoint`` file as an immutable weight generation under
+    ``promote_dir`` (:meth:`disco_tpu.promote.store.GenerationStore.
+    stage_checkpoint`).  ``arch``: the ``build_crnn`` kwargs the weights
+    were trained with; ``ledger``: the training run's ledger (path or
+    :class:`~disco_tpu.runs.RunLedger`) — a run whose latest ``epoch:*``
+    unit is still ``in_flight`` (a mid-epoch-interrupted trainer: the
+    checkpoint on disk predates the interrupted epoch) is refused with
+    :class:`~disco_tpu.promote.store.PublishRefused` naming the unit.
+    Returns the staged :class:`~disco_tpu.promote.store.Generation`.
+
+    No reference counterpart: the reference trains once to a bare file
+    (SURVEY.md §5.1)."""
+    from disco_tpu.promote.store import GenerationStore
+
+    ledger_path = getattr(ledger, "path", ledger)
+    return GenerationStore(promote_dir).stage_checkpoint(
+        ckpt_path, arch=arch, ledger=ledger_path,
+        source=source or str(ckpt_path))
+
+
 # -- the epoch loop ---------------------------------------------------------
 def _prefetch_host_batches(make_batches):
     """Double-buffered host batch feed: batch N+1's numpy prep (shard
@@ -443,6 +466,8 @@ def fit(
     ledger=None,
     mesh=None,
     precision: str = "f32",
+    promote_dir=None,
+    promote_arch: dict | None = None,
 ):
     """Full training loop (reference train.py:110-158): per-epoch train +
     no-grad validation, loss history saved every epoch, best-model
@@ -469,6 +494,12 @@ def fit(
     (SIGTERM/SIGINT) finishes the current epoch — its losses and any
     improved checkpoint persist — and returns early, resumable via
     ``resume_from``.
+
+    ``promote_dir`` (with ``promote_arch``, the ``build_crnn`` kwargs):
+    the live publish seam — every improved checkpoint is additionally
+    staged as a weight generation (:func:`publish_checkpoint`) AFTER its
+    epoch's ledger record lands, so a serving promotion controller
+    watching the store can canary it while this trainer keeps running.
     """
     from disco_tpu.runs import chaos as run_chaos
     from disco_tpu.runs import interrupt as run_interrupt
@@ -476,6 +507,10 @@ def fit(
 
     if ledger is not None and not isinstance(ledger, RunLedger):
         ledger = RunLedger(ledger)
+    if promote_dir is not None and promote_arch is None:
+        raise ValueError(
+            "fit(promote_dir=...) needs promote_arch (the build_crnn "
+            "kwargs) to stage generations with")
     train_step, eval_step = make_step_fns(model, output_frames, mesh=mesh,
                                           precision=precision)
     save_dir = Path(save_path)
@@ -601,6 +636,22 @@ def fit(
                 ckpt=str(ckpt_path) if improved else None,
                 ckpt_digest=file_digest(ckpt_path) if improved else None,
             )
+        if improved and promote_dir is not None:
+            # publish AFTER the epoch's done record: the staging-side
+            # ledger check reads this run's ledger, and an in_flight unit
+            # here would (correctly) refuse the freshly-written checkpoint
+            from disco_tpu.promote.store import PublishRefused
+
+            try:
+                gen = publish_checkpoint(promote_dir, ckpt_path,
+                                         arch=promote_arch, ledger=ledger)
+                obs_events.record("promotion", stage="train",
+                                  action="published", gen=gen.gen_id,
+                                  epoch=int(epoch))
+            except PublishRefused as e:
+                obs_events.record("promotion", stage="train",
+                                  action="refused", unit=e.unit,
+                                  reason=str(e))
         if gate.early_stop_query():
             break
     if interrupted:

@@ -51,6 +51,9 @@ EVENT_KINDS = frozenset(
         "warning",      # degraded input / requeued unit — visible, non-fatal
         "span",         # one causal-trace hop (obs.trace; attrs: trace/span/parent)
         "flight",       # a flight-recorder dump landed (obs.flight; attrs: trigger/path)
+        "promotion",    # a weight generation staged/adopted/promoted (promote/)
+        "canary",       # canary window lifecycle (attrs: action=assign/score/window)
+        "rollback",     # a demoted candidate rolled back (attrs: reason, failing metric)
         "note",         # freeform annotation
     }
 )

@@ -52,6 +52,7 @@ TRIGGERS = frozenset(
         "ladder_step_up",  # serve/ladder.py: the overload controller degraded
         "sentinel",      # obs/sentinels.py: non-finite tensor detected
         "chaos_crash",   # runs/chaos.py: injected in-process death
+        "demotion",      # promote/controller.py: canary gate failed, rollback issued
         "manual",        # explicit dump() calls (CLI / tests)
     }
 )

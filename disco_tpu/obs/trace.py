@@ -61,6 +61,10 @@ SPAN_STAGES = frozenset(
         "deliver",       # the enhanced block was handed to the connection writer
         "tap",           # the corpus tap spooled the delivered tuple
         "train_batch",   # a ShardDataset read the tapped record into training windows
+        "promote_stage",   # root of a promotion rollout: candidate staged
+        "promote_canary",  # canary sessions assigned onto the candidate
+        "promote_gate",    # the SDR/SLO gate verdict was computed
+        "promote_swap",    # the rollout's terminal swap (promote or rollback)
     }
 )
 
@@ -68,7 +72,11 @@ SPAN_STAGES = frozenset(
 #: serve chain; ``train_batch`` happens in a later process and is ordered
 #: last when present).
 STAGE_ORDER = ("client_block", "enqueue", "dispatch", "readback", "deliver",
-               "tap", "train_batch")
+               "tap", "train_batch",
+               # the promotion-rollout chain is its own waterfall (a rollout,
+               # not a block, is the traced unit — promote/controller.py)
+               "promote_stage", "promote_canary", "promote_gate",
+               "promote_swap")
 
 #: Bound on tracked in-flight spans (the ``status`` frame's inflight
 #: section); beyond it new entries are dropped, never an error.

@@ -1,0 +1,24 @@
+"""disco-promote: live SDR-gated model promotion (the fifteenth gate).
+
+The flywheel (PR 11) ends at a checkpoint file on disk; this package turns
+it into a loop: candidate CRNN weights are staged as immutable,
+digest-addressed **weight generations** (:mod:`disco_tpu.promote.store`), a
+configurable fraction of live sessions is canaried onto the candidate at an
+atomic block boundary, an SDR/SLO gate over a bounded canary window decides
+promote vs rollback, and every step is crash-drilled through the chaos
+seams ``pre_swap`` / ``mid_canary`` / ``post_gate``
+(:mod:`disco_tpu.promote.controller`).  ``make promote-check`` is the
+hermetic drill (:mod:`disco_tpu.promote.check`).
+
+No reference counterpart: the reference trains once and has no serving
+layer to roll anything out to (SURVEY.md §5.1).
+"""
+from disco_tpu.promote.controller import (  # noqa: F401
+    PromotionController,
+    rollout_unit,
+)
+from disco_tpu.promote.store import (  # noqa: F401
+    Generation,
+    GenerationStore,
+    PublishRefused,
+)

@@ -1,0 +1,740 @@
+"""``make promote-check`` — the live-model-promotion gate (fifteenth gate).
+
+Proves the canary/gate/rollback ladder end to end, hermetically (CPU
+backend forced by the Makefile, loopback sockets only, ONE jax process,
+compile cache off, zero SIGKILLs):
+
+1. **Demotion**: a worse-on-purpose candidate (zeroed CRNN parameters —
+   constant 0.5 masks) is staged against a live incumbent; the controller
+   canaries it onto a deterministic fraction of the model-mask sessions at
+   an atomic block boundary, the harness (playing the external scorer)
+   feeds canary SDR samples far below the incumbent's, the gate fails on
+   ``canary_sdr_db`` and rolls every canary back at the same boundary.
+   Every delivered frame of every session — through the swap AND the
+   rollback — is **bit-exact** against the offline per-generation oracle
+   (per-block :func:`~disco_tpu.promote.lane.block_masks` under each
+   block's recorded generation, chained through ``streaming_tango``), the
+   flight recorder dumps a ``demotion`` post-mortem naming the failing
+   metric, and the rollout ledger lands ``failed`` with the same reason.
+2. **Promotion**: a good candidate dropped into the controller's watch
+   directory is auto-staged, canaried, passes the SDR + SLO gate, and is
+   promoted to every model session; the store's ``ACTIVE`` pointer flips
+   atomically, ``model_promotions``/``weight_generation``/
+   ``tap_to_promotion_ms`` are recorded, and both sessions' full streams
+   stay bit-exact against their mixed-generation oracles.
+3. **Chaos (pre_swap)**: a :class:`~disco_tpu.runs.chaos.ChaosCrash` at
+   the dispatch thread's ``pre_swap`` seam kills the whole server
+   mid-rollout — after one canary already swapped and checkpointed, before
+   the second could.  No torn weight file (every generation still
+   digest-verifies), no torn session checkpoint, ``ACTIVE`` still the
+   incumbent, and the rollout unit still ``in_flight``.  A restarted
+   server resolves the interrupted rollout to ``failed`` from the ledger,
+   resumes the checkpointed session bit-exact on the incumbent, and then
+   promotes a fresh candidate cleanly — resumability, not just survival.
+4. **Chaos (controller)**: ``mid_canary`` and ``post_gate`` crashes kill
+   the controller thread alone — the server keeps serving bit-exact on
+   whatever generation each session holds, the crash is surfaced like a
+   dispatch-thread death (``PromotionController.crashed``), and a fresh
+   controller's ledger replay rolls the orphaned rollout back.
+
+No reference counterpart: the reference trains once to a bare file and
+serves nothing (SURVEY.md §5.1) — there is no rollout to drill.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+K, C, U = 4, 2, 4
+BLOCK = 2 * U
+WIN = BLOCK // 2
+WINDOW = 4           # canary window (blocks) for the gated legs
+LONG, SHORT = 49152, 32000   # clip lengths: 24 / 15 paced blocks
+
+
+def _scene(seed, L=LONG):
+    import numpy as np
+
+    from disco_tpu.core.dsp import stft
+
+    rng = np.random.default_rng(seed)
+    Y = np.asarray(stft(rng.standard_normal((K, C, L)).astype(np.float32)))
+    T = Y.shape[-1] - (Y.shape[-1] % BLOCK)   # whole blocks only
+    return Y[..., :T]
+
+
+def _offline(Y, m):
+    import numpy as np
+
+    from disco_tpu.enhance.streaming import streaming_tango
+
+    return np.asarray(
+        streaming_tango(Y, m, m, update_every=U, policy="local")["yf"])
+
+
+def _config(F):
+    from disco_tpu.serve import SessionConfig
+
+    return SessionConfig(n_nodes=K, mics_per_node=C, n_freq=F,
+                         block_frames=BLOCK, update_every=U, masks="model")
+
+
+def _arch(n_freq: int) -> dict:
+    """The gate's tiny-CRNN build_crnn kwargs — small enough to jit in
+    milliseconds, real enough to exercise the whole mask lane."""
+    return dict(n_ch=1, win_len=WIN, n_freq=n_freq,
+                cnn_filters=(4,), pool_kernels=((1, 4),),
+                conv_padding=((0, 1),), rnn_units=(16,),
+                ff_units=(n_freq,), rnn_dropouts=0.0)
+
+
+def _seed_variables(arch: dict, seed: int) -> dict:
+    import numpy as np
+
+    from disco_tpu.nn.crnn import build_crnn
+    from disco_tpu.nn.training import create_train_state
+
+    model, tx = build_crnn(**arch)
+    x0 = np.zeros((1, arch["n_ch"], WIN, arch["n_freq"]), np.float32)
+    state = create_train_state(model, tx, x0, seed=seed)
+    return {"params": state.params, "batch_stats": state.batch_stats}
+
+
+def _perturb(variables: dict, eps: float) -> dict:
+    """A 'good candidate': the incumbent nudged by eps — different digest,
+    comparable numbers."""
+    import jax
+
+    params = jax.tree_util.tree_map(
+        lambda a: (a + eps).astype(a.dtype), variables["params"])
+    return {"params": params, "batch_stats": variables["batch_stats"]}
+
+
+def _zeroed(variables: dict) -> dict:
+    """The worse-on-purpose candidate: zeroed parameters — every mask
+    collapses to sigmoid(0) = 0.5."""
+    import jax
+
+    params = jax.tree_util.tree_map(
+        lambda a: (a * 0).astype(a.dtype), variables["params"])
+    return {"params": params, "batch_stats": variables["batch_stats"]}
+
+
+def _rollout_rec(store, gen_id):
+    from disco_tpu.promote.controller import rollout_unit
+
+    return store.rollout_ledger().replay().get(rollout_unit(gen_id))
+
+
+def _round(clients, clips, cursors, delivered, score=None):
+    """One paced round: every client sends its next block and waits for the
+    delivery — block-boundary pacing, so generation swaps land between
+    rounds and every block runs under exactly one generation."""
+    for j, (cl, Yc) in enumerate(zip(clients, clips)):
+        i = cursors[j]
+        lo = i * BLOCK
+        cl.send_block(Yc[..., lo:lo + BLOCK])
+        delivered[j][i] = cl.recv_enhanced(i, timeout_s=120)
+        cursors[j] = i + 1
+        if score is not None:
+            score(j, i, cl.gen_of.get(i))
+
+
+def _gen_oracle(Y, gens, store):
+    """The offline replay oracle: per-block masks under each block's
+    recorded generation (store-loaded, digest-verified weights — loading
+    doubles as the no-torn-file check), chained through the same
+    streaming_tango carry the server runs."""
+    import numpy as np
+
+    from disco_tpu.promote.lane import block_masks
+    from disco_tpu.promote.store import model_for_arch
+
+    cache: dict = {}
+    ms = []
+    for i, g in enumerate(gens):
+        if g not in cache:
+            gen = store.get(g)
+            cache[g] = (model_for_arch(gen.arch), store.load(g)[1])
+        model, variables = cache[g]
+        lo = i * BLOCK
+        ms.append(block_masks(Y[..., lo:lo + BLOCK], model, variables))
+    m = np.concatenate(ms, axis=-1)
+    return _offline(Y[..., :len(gens) * BLOCK], m)
+
+
+def _assert_stream(failures, label, delivered, gen_of, Y, store,
+                   want_gens=None):
+    """Stitch one session's delivered frames and compare bit-for-bit
+    against its per-generation oracle; returns the per-block generation
+    list."""
+    import numpy as np
+
+    n = max(delivered) + 1 if delivered else 0
+    if sorted(delivered) != list(range(n)):
+        failures.append(f"{label}: delivered seqs have holes "
+                        f"({sorted(delivered)})")
+        return []
+    gens = [gen_of.get(i) for i in range(n)]
+    if None in gens:
+        failures.append(f"{label}: enhanced frames missing generation tags "
+                        f"at seqs {[i for i, g in enumerate(gens) if g is None]}")
+        return gens
+    if want_gens is not None and set(gens) != set(want_gens):
+        failures.append(f"{label}: generations {sorted(set(gens))} delivered, "
+                        f"expected exactly {sorted(set(want_gens))}")
+    got = np.concatenate([delivered[i] for i in range(n)], axis=-1)
+    ref = _gen_oracle(Y, gens, store)
+    if not np.array_equal(got, ref):
+        failures.append(
+            f"{label}: stream not bit-exact vs the per-generation offline "
+            f"oracle (max abs diff {np.abs(got - ref).max():g})")
+    return gens
+
+
+def _check_rollback(failures: list, tmp: Path) -> dict:
+    """Experiment 1: worse candidate → canary → SDR gate fails → rollback,
+    bit-exact throughout, flight dump names the metric."""
+    from disco_tpu.obs import flight as obs_flight
+    from disco_tpu.promote.controller import PromotionController
+    from disco_tpu.promote.store import GenerationStore
+    from disco_tpu.serve import EnhanceServer, ServeClient
+
+    clips = [_scene(71), _scene(72)]
+    F = clips[0].shape[-2]
+    n_blocks = clips[0].shape[-1] // BLOCK
+    store = GenerationStore(tmp / "p1")
+    arch = _arch(F)
+    vars_a = _seed_variables(arch, seed=1)
+    inc = store.stage_variables(vars_a, arch=arch, source="check-incumbent")
+    store.set_active(inc.gen_id)
+
+    flight_dir = tmp / "p1_flight"
+    obs_flight.enable(dump_dir=flight_dir, capacity=64)
+    ctl = PromotionController(store, canary_frac=0.5, sdr_gate_db=1.0,
+                              slo_gate=True, window_blocks=WINDOW,
+                              min_scores=2, gate_timeout_s=60.0, poll_s=0.01)
+    srv = EnhanceServer(max_sessions=4, promote=ctl)
+    cand_id = [None]
+
+    def score(j, i, gen):
+        # the harness plays the external scorer (offer_score is the serve
+        # API for it): the bad candidate's blocks measure far below the
+        # incumbent baseline
+        ctl.offer_score(f"m{j}", i, 2.0 if gen == cand_id[0] else 10.0,
+                        gen=gen)
+
+    try:
+        addr = srv.start()
+        clients = []
+        for j in range(2):
+            cl = ServeClient(addr)
+            cl.open(_config(F), session_id=f"m{j}")
+            clients.append(cl)
+        delivered = [{}, {}]
+        cursors = [0, 0]
+        for _ in range(2):                      # incumbent warm-up
+            _round(clients, clips, cursors, delivered, score)
+        cand = store.stage_variables(_zeroed(vars_a), arch=arch,
+                                     source="check-bad")
+        cand_id[0] = cand.gen_id
+        while cursors[0] < n_blocks - 2:
+            rec = _rollout_rec(store, cand.gen_id)
+            if rec is not None and rec["state"] == "failed":
+                break
+            _round(clients, clips, cursors, delivered, score)
+        for _ in range(2):                      # post-rollback service
+            _round(clients, clips, cursors, delivered, score)
+        for cl in clients:
+            cl.close()
+            cl.shutdown()
+        srv.stop(timeout_s=120)
+    finally:
+        obs_flight.disable()
+
+    rec = _rollout_rec(store, cand.gen_id)
+    if rec is None or rec["state"] != "failed":
+        failures.append(
+            f"rollback: bad candidate's rollout never resolved to failed "
+            f"within {cursors[0]} paced blocks "
+            f"(ledger: {None if rec is None else rec['state']})")
+    else:
+        attrs = rec.get("attrs") or {}
+        err = str(attrs.get("error", ""))
+        if "canary_sdr_db" not in err:
+            failures.append(
+                f"rollback: ledger failure reason {err!r} does not name the "
+                "failing metric canary_sdr_db")
+    if store.active() != inc.gen_id:
+        failures.append(
+            f"rollback: ACTIVE moved to {store.active()} — a demoted "
+            "candidate must never take the pointer")
+    dumps = sorted(flight_dir.glob("flight-*-demotion.json"))
+    if not dumps:
+        failures.append("rollback: no demotion flight dump was written")
+    elif "canary_sdr_db" not in dumps[-1].read_text():
+        failures.append(f"rollback: demotion dump {dumps[-1].name} does not "
+                        "name the failing metric")
+
+    gens0 = _assert_stream(failures, "rollback canary m0", delivered[0],
+                           clients[0].gen_of, clips[0], store,
+                           want_gens={inc.gen_id, cand.gen_id})
+    _assert_stream(failures, "rollback bystander m1", delivered[1],
+                   clients[1].gen_of, clips[1], store,
+                   want_gens={inc.gen_id})
+    # the canary's history must be exactly incumbent → candidate →
+    # incumbent: one swap in, one swap back, both at block boundaries
+    if gens0:
+        flips = [i for i in range(1, len(gens0)) if gens0[i] != gens0[i - 1]]
+        if len(flips) != 2 or gens0[0] != inc.gen_id or gens0[-1] != inc.gen_id:
+            failures.append(
+                f"rollback: canary generation history has {len(flips)} "
+                f"transitions ({gens0}) — expected incumbent → candidate → "
+                "incumbent")
+    return {"blocks": cursors[0], "candidate": cand.gen_id,
+            "dumps": len(dumps)}
+
+
+def _check_promote(failures: list, tmp: Path) -> dict:
+    """Experiment 2: a good candidate from the watch dir auto-stages,
+    passes the gate and promotes to every session."""
+    from flax import serialization
+
+    from disco_tpu.io.atomic import write_bytes_atomic
+    from disco_tpu.obs.metrics import REGISTRY as obs_registry
+    from disco_tpu.promote.controller import PromotionController
+    from disco_tpu.promote.store import GenerationStore
+    from disco_tpu.serve import EnhanceServer, ServeClient
+
+    clips = [_scene(81), _scene(82)]
+    F = clips[0].shape[-2]
+    n_blocks = clips[0].shape[-1] // BLOCK
+    store = GenerationStore(tmp / "p2")
+    watch = tmp / "p2_incoming"
+    watch.mkdir()
+    arch = _arch(F)
+    vars_a = _seed_variables(arch, seed=2)
+    inc = store.stage_variables(vars_a, arch=arch, source="check-incumbent")
+    store.set_active(inc.gen_id)
+
+    ctl = PromotionController(store, canary_frac=0.5, sdr_gate_db=1.0,
+                              slo_gate=True, window_blocks=WINDOW,
+                              min_scores=2, gate_timeout_s=60.0, poll_s=0.01,
+                              watch_dir=watch)
+    srv = EnhanceServer(max_sessions=4, promote=ctl)
+    cand_id = [None]
+
+    def score(j, i, gen):
+        ctl.offer_score(f"m{j}", i, 10.5 if gen == cand_id[0] else 10.0,
+                        gen=gen)
+
+    promotions0 = obs_registry.peek_counter("model_promotions")
+    addr = srv.start()
+    clients = []
+    for j in range(2):
+        cl = ServeClient(addr)
+        cl.open(_config(F), session_id=f"m{j}")
+        clients.append(cl)
+    delivered = [{}, {}]
+    cursors = [0, 0]
+    for _ in range(2):
+        _round(clients, clips, cursors, delivered, score)
+    # the publish seam the CLI trainer uses: a finished checkpoint dropped
+    # into the watch dir is staged by the controller itself
+    cand_vars = _perturb(vars_a, 1e-3)
+    blob = serialization.msgpack_serialize(serialization.to_state_dict(
+        {"params": cand_vars["params"],
+         "batch_stats": cand_vars["batch_stats"]}))
+    write_bytes_atomic(watch / "candidate.msgpack", blob)
+    deadline = time.monotonic() + 10.0
+    while len(store.list_ids()) < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    staged = [g for g in store.list_ids() if g != inc.gen_id]
+    if not staged:
+        failures.append("promote: the watch-dir candidate was never staged")
+        srv.stop(timeout_s=120)
+        return {"blocks": cursors[0]}
+    cand_id[0] = staged[0]
+    while cursors[0] < n_blocks - 2:
+        rec = _rollout_rec(store, cand_id[0])
+        if rec is not None and rec["state"] == "done":
+            break
+        _round(clients, clips, cursors, delivered, score)
+    for _ in range(2):                          # post-promotion service
+        _round(clients, clips, cursors, delivered, score)
+    for cl in clients:
+        cl.close()
+        cl.shutdown()
+    srv.stop(timeout_s=120)
+
+    rec = _rollout_rec(store, cand_id[0])
+    if rec is None or rec["state"] != "done":
+        failures.append(
+            f"promote: good candidate's rollout never resolved to done "
+            f"within {cursors[0]} paced blocks "
+            f"(ledger: {None if rec is None else rec['state']})")
+    if store.active() != cand_id[0]:
+        failures.append(
+            f"promote: ACTIVE is {store.active()}, expected the promoted "
+            f"candidate {cand_id[0]}")
+    promoted = obs_registry.peek_counter("model_promotions") - promotions0
+    if promoted != 1:
+        failures.append(
+            f"promote: model_promotions counter moved by {promoted}, "
+            "expected 1")
+    snap = obs_registry.snapshot()
+    if snap["gauges"].get("weight_generation") != 2:
+        failures.append(
+            f"promote: weight_generation gauge is "
+            f"{snap['gauges'].get('weight_generation')}, expected the "
+            "candidate's serial 2")
+    if not (snap["histograms"].get("tap_to_promotion_ms") or {}).get("count"):
+        failures.append("promote: tap_to_promotion_ms histogram was never "
+                        "observed")
+    for j in range(2):
+        gens = _assert_stream(failures, f"promote m{j}", delivered[j],
+                              clients[j].gen_of, clips[j], store,
+                              want_gens={inc.gen_id, cand_id[0]})
+        if gens and gens[-1] != cand_id[0]:
+            failures.append(f"promote: m{j} ended on {gens[-1]}, not the "
+                            "promoted candidate")
+    return {"blocks": cursors[0], "candidate": cand_id[0]}
+
+
+def _check_chaos_pre_swap(failures: list, tmp: Path) -> dict:
+    """Experiment 3: ChaosCrash at the pre_swap seam mid-rollout — the
+    whole server dies with one canary swapped+checkpointed and one not;
+    restart resumes from the ledger with zero torn state."""
+    import numpy as np
+
+    from disco_tpu.io.atomic import TMP_SUFFIX
+    from disco_tpu.promote.controller import PromotionController
+    from disco_tpu.promote.store import GenerationStore, PublishRefused
+    from disco_tpu.runs import chaos
+    from disco_tpu.serve import EnhanceServer, ServeClient, ServeError
+    from disco_tpu.serve.session import probe_session_state
+
+    clips = [_scene(91), _scene(92)]
+    F = clips[0].shape[-2]
+    n_blocks = clips[0].shape[-1] // BLOCK
+    root, state_dir = tmp / "p3", tmp / "p3_state"
+    store = GenerationStore(root)
+    arch = _arch(F)
+    vars_a = _seed_variables(arch, seed=3)
+    inc = store.stage_variables(vars_a, arch=arch, source="check-incumbent")
+    store.set_active(inc.gen_id)
+
+    def controller():
+        return PromotionController(store, canary_frac=1.0, sdr_gate_db=None,
+                                   slo_gate=True, window_blocks=2,
+                                   gate_timeout_s=60.0, poll_s=0.01)
+
+    srv = EnhanceServer(max_sessions=4, promote=controller(),
+                        state_dir=state_dir)
+    addr = srv.start()
+    clients = []
+    for j in range(2):
+        cl = ServeClient(addr)
+        cl.open(_config(F), session_id=f"m{j}")
+        clients.append(cl)
+    delivered = [{}, {}]
+    cursors = [0, 0]
+    for _ in range(2):
+        _round(clients, clips, cursors, delivered)
+    # with canary_frac=1.0 BOTH sessions get canary swap requests; the
+    # dispatch thread applies them in one tick — the first checkpoint+swap
+    # succeeds, the second hit dies like a process death mid-rollout
+    chaos.configure("pre_swap", after=2)
+    crashes = 0
+    cand = store.stage_variables(_perturb(vars_a, 2e-3), arch=arch,
+                                 source="check-crashee")
+    try:
+        while cursors[0] < n_blocks:
+            _round(clients, clips, cursors, delivered)
+        failures.append("chaos: pre_swap crash never fired")
+    except ServeError:
+        pass                  # the connection died with the server
+    finally:
+        chaos.disable()
+    try:
+        srv.wait(timeout_s=60)
+        failures.append("chaos: dispatch thread survived the pre_swap crash")
+    except chaos.ChaosCrash:
+        crashes += 1
+    for cl in clients:
+        cl.shutdown()
+
+    # zero torn state: pointer, weight files, checkpoints, ledger
+    if store.active() != inc.gen_id:
+        failures.append(f"chaos: ACTIVE moved to {store.active()} through a "
+                        "mid-rollout crash")
+    for gen_id in store.list_ids():
+        try:
+            store.load(gen_id)
+        except PublishRefused as e:
+            failures.append(f"chaos: generation {gen_id} torn after the "
+                            f"crash: {e}")
+    litter = [str(p) for d in (root, state_dir) if d.is_dir()
+              for p in d.rglob(f"*{TMP_SUFFIX}.*")]
+    if litter:
+        failures.append(f"chaos: atomic-write temp litter after the crash: "
+                        f"{litter}")
+    rec = _rollout_rec(store, cand.gen_id)
+    if rec is None or rec["state"] != "in_flight":
+        failures.append(
+            f"chaos: interrupted rollout is {None if rec is None else rec['state']!r} "
+            "in the ledger, expected in_flight (crash truth)")
+    ckpt = state_dir / "session_m0.state.msgpack"
+    if not ckpt.is_file() or not probe_session_state(ckpt):
+        failures.append("chaos: the swapped canary's boundary checkpoint is "
+                        "missing or fails its probe")
+
+    # restart: the resume settles the rollout, the checkpointed session
+    # reattaches on the incumbent, and a FRESH candidate still promotes
+    srv2 = EnhanceServer(max_sessions=4, promote=controller(),
+                         state_dir=state_dir)
+    addr2 = srv2.start()
+    rec = _rollout_rec(store, cand.gen_id)
+    if rec is None or rec["state"] != "failed":
+        failures.append(
+            f"chaos: restart left the interrupted rollout "
+            f"{None if rec is None else rec['state']!r}, expected failed "
+            "(rolled back from the ledger)")
+    cl = ServeClient(addr2)
+    cl.open(_config(F), resume="m0")
+    k = len(delivered[0])
+    if cl.blocks_done != k:
+        failures.append(f"chaos: resume landed at blocks_done="
+                        f"{cl.blocks_done}, expected {k} — the boundary "
+                        "checkpoint and the delivered stream disagree")
+        k = cl.blocks_done
+    cursors2 = [k]
+    delivered2 = [dict(delivered[0])]
+    for _ in range(2):
+        _round([cl], clips[:1], cursors2, delivered2)
+    cand2 = store.stage_variables(_perturb(vars_a, 3e-3), arch=arch,
+                                  source="check-post-crash")
+    while cursors2[0] < n_blocks - 2:
+        rec2 = _rollout_rec(store, cand2.gen_id)
+        if rec2 is not None and rec2["state"] == "done":
+            break
+        _round([cl], clips[:1], cursors2, delivered2)
+    for _ in range(2):
+        _round([cl], clips[:1], cursors2, delivered2)
+    cl.close()
+    cl.shutdown()
+    srv2.stop(timeout_s=120)
+    rec2 = _rollout_rec(store, cand2.gen_id)
+    if rec2 is None or rec2["state"] != "done":
+        failures.append(
+            "chaos: the post-restart candidate never promoted — the rollout "
+            f"machine did not survive the crash (ledger: "
+            f"{None if rec2 is None else rec2['state']})")
+    if store.active() != cand2.gen_id:
+        failures.append(f"chaos: post-restart ACTIVE is {store.active()}, "
+                        f"expected {cand2.gen_id}")
+
+    # every pre-crash frame ran under the incumbent (the crash fired before
+    # any candidate block could dispatch), and the stitched pre-crash +
+    # resumed stream is bit-exact vs the per-generation oracle
+    pre_gens = {g for cl_ in clients for g in cl_.gen_of.values()}
+    if pre_gens - {inc.gen_id}:
+        failures.append(
+            f"chaos: pre-crash frames tagged {sorted(pre_gens)} — blocks ran "
+            "under a generation the crash should have kept off the stream")
+    gen_of = dict(clients[0].gen_of)
+    gen_of.update(cl.gen_of)
+    _assert_stream(failures, "chaos resumed m0", delivered2[0], gen_of,
+                   clips[0], store, want_gens={inc.gen_id, cand2.gen_id})
+    n1 = len(delivered[1])
+    if n1:
+        got = np.concatenate([delivered[1][i] for i in range(n1)], axis=-1)
+        ref = _gen_oracle(clips[1], [inc.gen_id] * n1, store)
+        if not np.array_equal(got, ref):
+            failures.append(
+                "chaos: the unswapped session's pre-crash frames are not "
+                f"bit-exact (max abs diff {np.abs(got - ref).max():g})")
+    return {"crashes_injected": crashes, "blocks_before_crash": k,
+            "blocks_total": cursors2[0]}
+
+
+def _check_controller_crash(failures: list, tmp: Path) -> dict:
+    """Experiment 4: mid_canary / post_gate crashes kill the controller
+    thread only — the server keeps serving, the ledger replay rolls the
+    orphaned rollout back."""
+    from disco_tpu.promote.controller import PromotionController
+    from disco_tpu.promote.store import GenerationStore
+    from disco_tpu.runs import chaos
+    from disco_tpu.serve import EnhanceServer, ServeClient
+
+    clip = _scene(95, L=SHORT)
+    F = clip.shape[-2]
+    n_blocks = clip.shape[-1] // BLOCK
+    store = GenerationStore(tmp / "p4")
+    arch = _arch(F)
+    vars_a = _seed_variables(arch, seed=4)
+    inc = store.stage_variables(vars_a, arch=arch, source="check-incumbent")
+    store.set_active(inc.gen_id)
+
+    ctl = PromotionController(store, canary_frac=1.0, sdr_gate_db=None,
+                              slo_gate=False, window_blocks=2,
+                              gate_timeout_s=30.0, poll_s=0.01)
+    srv = EnhanceServer(max_sessions=4, promote=ctl)
+    addr = srv.start()
+    cl = ServeClient(addr)
+    cl.open(_config(F), session_id="m0")
+    delivered = [{}]
+    cursors = [0]
+    _round([cl], [clip], cursors, delivered)
+    crashes = 0
+    chaos.configure("mid_canary", after=1)
+    cand = store.stage_variables(_perturb(vars_a, 4e-3), arch=arch,
+                                 source="check-mid-canary")
+    try:
+        while ctl.crashed is None and cursors[0] < n_blocks - 3:
+            _round([cl], [clip], cursors, delivered)
+    finally:
+        chaos.disable()
+    if not isinstance(ctl.crashed, chaos.ChaosCrash):
+        failures.append("controller: mid_canary crash never fired "
+                        f"(crashed={ctl.crashed!r})")
+    else:
+        crashes += 1
+    # the serve process must keep delivering on the generations the
+    # sessions already hold — a dead controller degrades, never corrupts
+    for _ in range(3):
+        _round([cl], [clip], cursors, delivered)
+    cl.close()
+    cl.shutdown()
+    srv.stop(timeout_s=120)
+    _assert_stream(failures, "controller-crash m0", delivered[0], cl.gen_of,
+                   clip, store, want_gens={inc.gen_id, cand.gen_id})
+    rec = _rollout_rec(store, cand.gen_id)
+    if rec is None or rec["state"] != "in_flight":
+        failures.append(
+            f"controller: orphaned rollout is "
+            f"{None if rec is None else rec['state']!r}, expected in_flight")
+    ctl_r = PromotionController(store, poll_s=0.01)
+    ctl_r.start()
+    ctl_r.stop()
+    ctl_r.wait()
+    rec = _rollout_rec(store, cand.gen_id)
+    if rec is None or rec["state"] != "failed":
+        failures.append("controller: ledger replay did not roll the "
+                        "mid_canary rollout back")
+    if store.active() != inc.gen_id:
+        failures.append(f"controller: ACTIVE is {store.active()} after the "
+                        "mid_canary drill, expected the incumbent")
+
+    # post_gate: the verdict is reached (the zero-traffic timeout demotes)
+    # but the crash lands before the ledger goes final
+    chaos.configure("post_gate", after=1)
+    cand2 = store.stage_variables(_perturb(vars_a, 5e-3), arch=arch,
+                                  source="check-post-gate")
+    ctl_p = PromotionController(store, canary_frac=1.0, sdr_gate_db=None,
+                                slo_gate=False, window_blocks=2,
+                                gate_timeout_s=0.2, poll_s=0.01)
+    try:
+        ctl_p.start()
+        deadline = time.monotonic() + 10.0
+        while ctl_p.crashed is None and time.monotonic() < deadline:
+            time.sleep(0.01)
+    finally:
+        chaos.disable()
+        ctl_p.stop()
+        ctl_p.wait()
+    if not isinstance(ctl_p.crashed, chaos.ChaosCrash):
+        failures.append("controller: post_gate crash never fired "
+                        f"(crashed={ctl_p.crashed!r})")
+    else:
+        crashes += 1
+    rec = _rollout_rec(store, cand2.gen_id)
+    if rec is None or rec["state"] != "in_flight":
+        failures.append(
+            f"controller: post_gate rollout is "
+            f"{None if rec is None else rec['state']!r} at the crash, "
+            "expected in_flight (verdict reached, ledger not final)")
+    ctl_r2 = PromotionController(store, poll_s=0.01)
+    ctl_r2.start()
+    ctl_r2.stop()
+    ctl_r2.wait()
+    rec = _rollout_rec(store, cand2.gen_id)
+    if rec is None or rec["state"] != "failed":
+        failures.append("controller: ledger replay did not roll the "
+                        "post_gate rollout back")
+    return {"crashes_injected": crashes, "blocks": cursors[0]}
+
+
+def main(argv=None) -> int:
+    """Run the promotion gate (``make promote-check``); exit 1 on failure.
+
+    No reference counterpart (module docstring)."""
+    import os
+
+    os.environ.setdefault("DISCO_TPU_COMPILE_CACHE", "off")
+    from disco_tpu import obs
+
+    failures: list[str] = []
+    with tempfile.TemporaryDirectory() as tmp:
+        tmp = Path(tmp)
+        obs_log = tmp / "promote_check.jsonl"
+        with obs.recording(obs_log):
+            obs.write_manifest(tool="promote-check")
+            rollback = _check_rollback(failures, tmp)
+            promote = _check_promote(failures, tmp)
+            chaos_stats = _check_chaos_pre_swap(failures, tmp)
+            ctl_stats = _check_controller_crash(failures, tmp)
+            obs.record("counters", **obs.REGISTRY.snapshot())
+        events = obs.read_events(obs_log)   # schema-validating read
+
+        def count(kind, action):
+            return sum(1 for e in events if e["kind"] == kind
+                       and e["attrs"].get("action") == action)
+
+        if not count("promotion", "staged"):
+            failures.append("event log missing the watch-dir staged event")
+        if count("promotion", "promoted") < 2:
+            failures.append("event log missing promoted events (clean + "
+                            "post-crash promotion)")
+        if not count("canary", "assign") or not count("canary", "swap"):
+            failures.append("event log missing canary assign/swap events")
+        if not count("rollback", "begin") or not count("rollback", "done"):
+            failures.append("event log missing the demotion begin/done events")
+        if count("rollback", "resume") < 1:
+            failures.append("event log missing the crash-resume rollback "
+                            "event")
+        if count("rollback", "crashed") != 2:
+            failures.append(
+                f"event log carries {count('rollback', 'crashed')} "
+                "controller-crash events, expected 2 (mid_canary + post_gate)")
+        crashes = (chaos_stats["crashes_injected"]
+                   + ctl_stats["crashes_injected"])
+        chaos_events = [e for e in events if e["kind"] == "fault"
+                        and e["attrs"].get("fault") == "chaos_crash"]
+        if len(chaos_events) != crashes:
+            failures.append(
+                f"event log carries {len(chaos_events)} chaos_crash events, "
+                f"expected {crashes}")
+
+    if failures:
+        for f in failures:
+            print(f"promote-check FAIL: {f}", file=sys.stderr)
+        return 1
+    print(json.dumps({
+        "promote_check": "ok",
+        "rollback_blocks": rollback["blocks"],
+        "promote_blocks": promote["blocks"],
+        "canary_window": WINDOW,
+        "blocks_before_crash": chaos_stats["blocks_before_crash"],
+        "crashes_injected": crashes,
+        "jax_processes": 1,
+        "sigkills_issued": 0,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,48 @@
+"""The server-side model-mask lane: per-block CRNN masks from a generation.
+
+Sessions opened with ``SessionConfig(masks="model")`` send blocks WITHOUT
+``mask_z``/``mask_w``; the scheduler fills them at dispatch time from the
+session's current weight generation through :func:`block_masks` — one
+batched device launch over the block's K nodes
+(:func:`disco_tpu.enhance.inference.crnn_masks_batched`), using each
+node's reference-mic magnitude as the single CRNN input channel (the
+reference's local single-channel inference path, tango.py:211-215) and the
+resulting sigmoid mask for BOTH the compression (``mask_z``) and MWF
+(``mask_w``) roles.
+
+Determinism contract (what ``make promote-check`` pins): the mask is a
+pure function of ``(Y block, generation weights)`` — same block, same
+generation → bit-identical masks, host-side or replayed offline.  The jit
+program cache is shared across generations (the flax module instance is
+cached per architecture in :func:`disco_tpu.promote.store.model_for_arch`;
+weights enter as a traced argument), so a hot swap changes numbers, never
+programs — the throughput-parity contract of the atomic swap.
+
+No reference counterpart: the reference computes masks inside its offline
+per-clip loop (tango.py:188-249); serving them per streamed block against
+a swappable generation is new.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from disco_tpu.enhance.inference import crnn_masks_batched
+from disco_tpu.utils.transfer import to_device
+
+
+def block_masks(Y, model, variables, *, ref_mic: int = 0) -> np.ndarray:
+    """(K, F, T) float32 masks for one (K, C, F, T) complex block.
+
+    ``variables`` may be host or device trees (the scheduler caches them
+    on device per generation); the complex block crosses to the device
+    through :func:`disco_tpu.utils.transfer.to_device` (tunnel-safe), and
+    only the real-valued masks come back.
+
+    Reference counterpart: the CRNN branch of ``get_mask``
+    (tango.py:211-215) — here per served block instead of per clip.
+    """
+    Y = np.asarray(Y)
+    Ys = to_device(np.ascontiguousarray(Y[:, ref_mic]))   # (K, F, T) complex
+    masks = crnn_masks_batched(
+        Ys, model, variables, win_len=int(model.input_shape[1]))
+    return np.asarray(masks, dtype=np.float32)

@@ -1,0 +1,303 @@
+"""The generation store: immutable, digest-addressed CRNN weight bundles.
+
+A *weight generation* is the unit of live rollout: the inference slice of a
+training checkpoint (``params`` + ``batch_stats`` — never the optimizer
+state) serialized to canonical bytes, named by the digest of those bytes,
+and written once through :func:`disco_tpu.io.atomic.atomic_write` so a
+generation on disk is either complete or absent — no reader can ever
+observe a torn weight file (the repo-wide crash-safety invariant the
+``pre_swap`` chaos leg of ``make promote-check`` pins).
+
+Layout under one promote dir::
+
+    <root>/generations/<gen_id>/weights.msgpack   immutable weight bytes
+    <root>/generations/<gen_id>/meta.json         arch kwargs + provenance
+    <root>/ACTIVE                                 gen_id of the live generation
+    <root>/rollouts.jsonl                         the rollout RunLedger
+
+``meta.json`` is written AFTER the weights (its presence marks the
+generation complete), and ``ACTIVE`` is a one-line pointer file replaced
+atomically — the restart source of truth for which generation every
+resumed session adopts.
+
+Staging is idempotent (same weights → same digest → same generation) and
+**ledger-aware**: a checkpoint published from a mid-epoch-interrupted
+trainer — file-complete on disk but from a run whose latest ``epoch:*``
+ledger unit is still ``in_flight`` — is refused with
+:class:`PublishRefused` naming the unit, because at the file level a
+partially-trained checkpoint is indistinguishable from a finished one
+(the ``mid_epoch`` chaos regression in tests/test_promote.py).
+
+No reference counterpart: the reference trains once to a bare ``.torch``
+file and has no rollout story (SURVEY.md §4, §5.1).
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import threading
+import time
+from pathlib import Path
+
+from disco_tpu.io.atomic import atomic_write, file_digest, write_bytes_atomic
+from disco_tpu.runs.ledger import RunLedger
+
+#: The inference slice of a training checkpoint (training.save_checkpoint
+#: payload keys) that a generation carries.  Optimizer state stays behind.
+WEIGHT_KEYS = ("params", "batch_stats")
+
+#: Name of the atomic pointer file naming the live generation.
+ACTIVE_FILE = "ACTIVE"
+
+
+class PublishRefused(RuntimeError):
+    """A candidate checkpoint was refused at the publish seam.  ``unit``
+    names the offending run-ledger unit (e.g. ``"epoch:3"``) when the
+    refusal came from an interrupted training run."""
+
+    def __init__(self, message: str, unit: str | None = None):
+        super().__init__(message)
+        self.unit = unit
+
+
+@dataclasses.dataclass(frozen=True)
+class Generation:
+    """One immutable staged weight generation.
+
+    No reference counterpart (module docstring)."""
+
+    gen_id: str        # "g" + first 12 hex chars of the weight digest
+    path: Path         # <root>/generations/<gen_id>
+    digest: str        # "sha256:<hex>" over the canonical weight bytes
+    serial: int        # staging order (1-based) — the weight_generation gauge
+    arch: dict         # build_crnn(**arch) kwargs
+    meta: dict         # full meta.json payload
+
+    @property
+    def weights_path(self) -> Path:
+        return self.path / "weights.msgpack"
+
+
+def _canonical(tree):
+    """Recursively key-sort a pytree-of-dicts so the serialized bytes (and
+    therefore the generation digest) do not depend on dict insertion order
+    — staging the same weights from a live trainer and from a restored
+    checkpoint must land on the same generation."""
+    if isinstance(tree, dict):
+        return {k: _canonical(tree[k]) for k in sorted(tree)}
+    return tree
+
+
+def _ledger_in_flight_epoch(ledger_path) -> str | None:
+    """The first ``epoch:*`` unit whose latest recorded state is still
+    ``in_flight`` (an interrupted training run), or None for a clean run."""
+    latest = RunLedger(ledger_path).replay()
+    for unit in sorted(latest):
+        if unit.startswith("epoch:") and latest[unit]["state"] == "in_flight":
+            return unit
+    return None
+
+
+# one CRNN module instance per arch: flax modules hash by structure, so a
+# shared instance means every generation of the same architecture hits the
+# same `_jitted_apply` / `_jitted_sliding_masks` cache entry — the jit
+# caches are keyed by generation only through the traced `variables`
+# argument, and a hot swap never retraces (ISSUE 17 parity contract)
+_MODEL_CACHE: dict[str, object] = {}
+_MODEL_CACHE_LOCK = threading.Lock()
+
+
+def model_for_arch(arch: dict):
+    """The (cached) CRNN module for one arch-kwargs dict.  Import of
+    :func:`disco_tpu.nn.crnn.build_crnn` is deferred — the store itself is
+    usable from jax-free readers (listing generations, the CLI).
+
+    No reference counterpart (module docstring).
+    """
+    key = json.dumps(arch, sort_keys=True)
+    with _MODEL_CACHE_LOCK:
+        model = _MODEL_CACHE.get(key)
+    if model is None:
+        from disco_tpu.nn.crnn import build_crnn
+
+        model, _tx = build_crnn(**arch)
+        with _MODEL_CACHE_LOCK:
+            model = _MODEL_CACHE.setdefault(key, model)
+    return model
+
+
+class GenerationStore:
+    """Digest-addressed weight generations under one promote dir.
+
+    All writes go through ``io.atomic``; all methods are safe to call from
+    any thread (staging takes no lock — idempotence by digest makes
+    concurrent stages of the same weights converge on the same files).
+
+    No reference counterpart (module docstring).
+    """
+
+    def __init__(self, root):
+        self.root = Path(root)
+        (self.root / "generations").mkdir(parents=True, exist_ok=True)
+
+    # -- staging -------------------------------------------------------------
+    def stage_checkpoint(self, ckpt_path, *, arch: dict, ledger=None,
+                         source: str | None = None) -> Generation:
+        """Stage a training checkpoint (training.save_checkpoint payload)
+        as a weight generation.  ``ledger``: the training run's
+        :class:`~disco_tpu.runs.ledger.RunLedger` path — when given, a run
+        whose latest ``epoch:*`` unit is still ``in_flight`` (a mid-epoch
+        interrupted trainer) is refused with :class:`PublishRefused`
+        naming the unit.  Idempotent: same weights → same generation.
+
+        No reference counterpart (module docstring).
+        """
+        from flax import serialization
+
+        ckpt_path = Path(ckpt_path)
+        if ledger is not None:
+            unit = _ledger_in_flight_epoch(ledger)
+            if unit is not None:
+                raise PublishRefused(
+                    f"refusing to stage {ckpt_path.name}: training run "
+                    f"ledger {Path(ledger).name} shows unit {unit!r} still "
+                    f"in_flight — the checkpoint on disk predates an "
+                    f"interrupted epoch and is not a finished candidate",
+                    unit=unit,
+                )
+        try:
+            payload = serialization.msgpack_restore(ckpt_path.read_bytes())
+        except Exception as e:
+            raise PublishRefused(
+                f"refusing to stage {ckpt_path.name}: not a readable "
+                f"checkpoint ({type(e).__name__}: {e})"
+            ) from e
+        missing = [k for k in WEIGHT_KEYS if k not in payload]
+        if missing:
+            raise PublishRefused(
+                f"refusing to stage {ckpt_path.name}: checkpoint payload "
+                f"missing {missing} (keys: {sorted(payload)})"
+            )
+        variables = {k: payload[k] for k in WEIGHT_KEYS}
+        extra = {"source_ckpt": str(ckpt_path),
+                 "source_ckpt_digest": file_digest(ckpt_path)}
+        for k in ("val_loss", "train_loss", "epochs_done"):
+            if k in payload:
+                try:
+                    extra[k] = float(payload[k])
+                except (TypeError, ValueError):
+                    pass
+        return self.stage_variables(variables, arch=arch, source=source,
+                                    **extra)
+
+    def stage_variables(self, variables: dict, *, arch: dict,
+                        source: str | None = None, **extra) -> Generation:
+        """Stage an in-memory ``{"params", "batch_stats"}`` dict (the live
+        ``fit()`` publish path and the check harness).  Writes weights then
+        meta, each atomically; returns the (possibly pre-existing)
+        :class:`Generation`.
+
+        No reference counterpart (module docstring).
+        """
+        from flax import serialization
+
+        variables = {k: variables[k] for k in WEIGHT_KEYS}
+        blob = serialization.msgpack_serialize(
+            serialization.to_state_dict(_canonical(variables)))
+        digest = "sha256:" + hashlib.sha256(blob).hexdigest()
+        gen_id = "g" + digest.split(":", 1)[1][:12]
+        gen_dir = self.root / "generations" / gen_id
+        meta_path = gen_dir / "meta.json"
+        if meta_path.exists():
+            return self.get(gen_id)
+        gen_dir.mkdir(parents=True, exist_ok=True)
+        write_bytes_atomic(gen_dir / "weights.msgpack", blob)
+        meta = {
+            "gen": gen_id,
+            "digest": digest,
+            "serial": len(self.list_ids()) + 1,
+            "arch": dict(arch),
+            "source": source,
+            "staged_t": time.time(),
+            **extra,
+        }
+        with atomic_write(meta_path, mode="w", encoding="utf-8") as fh:
+            json.dump(meta, fh, sort_keys=True, indent=1)
+            fh.write("\n")
+        return Generation(gen_id=gen_id, path=gen_dir, digest=digest,
+                          serial=int(meta["serial"]), arch=dict(arch),
+                          meta=meta)
+
+    # -- reading -------------------------------------------------------------
+    def list_ids(self) -> list:
+        """Complete generation ids (meta.json present), staging order.
+
+        No reference counterpart (module docstring)."""
+        gens = []
+        base = self.root / "generations"
+        for d in base.iterdir() if base.is_dir() else ():
+            if (d / "meta.json").is_file():
+                gens.append(self.get(d.name))
+        return [g.gen_id for g in sorted(gens, key=lambda g: g.serial)]
+
+    def get(self, gen_id: str) -> Generation:
+        """Load one generation's metadata (raises ``FileNotFoundError``
+        for an unknown or incomplete generation).
+
+        No reference counterpart (module docstring)."""
+        gen_dir = self.root / "generations" / gen_id
+        meta = json.loads((gen_dir / "meta.json").read_text())
+        return Generation(gen_id=gen_id, path=gen_dir,
+                          digest=meta["digest"], serial=int(meta["serial"]),
+                          arch=dict(meta["arch"]), meta=meta)
+
+    def load(self, gen_id: str):
+        """(model, variables) for one generation — the CRNN module (cached
+        per arch, see :func:`model_for_arch`) and the restored host-side
+        ``{"params", "batch_stats"}`` dict.  The weight file is
+        digest-verified first: a torn or tampered file fails loudly here,
+        never as silent garbage masks.
+
+        No reference counterpart (module docstring).
+        """
+        gen = self.get(gen_id)
+        actual = file_digest(gen.weights_path)
+        if actual != gen.digest:
+            raise PublishRefused(
+                f"generation {gen_id}: weight file digest {actual} does not "
+                f"match staged digest {gen.digest} — torn or corrupt file"
+            )
+        from flax import serialization
+
+        variables = serialization.msgpack_restore(
+            gen.weights_path.read_bytes())
+        return model_for_arch(gen.arch), variables
+
+    # -- the ACTIVE pointer --------------------------------------------------
+    def active(self) -> str | None:
+        """gen_id of the live generation, or None before first activation.
+
+        No reference counterpart (module docstring)."""
+        path = self.root / ACTIVE_FILE
+        if not path.is_file():
+            return None
+        gen_id = path.read_text().strip()
+        return gen_id or None
+
+    def set_active(self, gen_id: str) -> None:
+        """Atomically repoint ``ACTIVE`` (the promotion commit point: after
+        this rename, every restart adopts ``gen_id``).
+
+        No reference counterpart (module docstring)."""
+        self.get(gen_id)   # unknown/incomplete generations must not go live
+        write_bytes_atomic(self.root / ACTIVE_FILE, (gen_id + "\n").encode())
+
+    # -- the rollout ledger ----------------------------------------------------
+    def rollout_ledger(self) -> RunLedger:
+        """The store's rollout :class:`~disco_tpu.runs.ledger.RunLedger`
+        (``rollouts.jsonl``) — one ``rollout:<gen_id>`` unit per attempted
+        promotion, phase carried in attrs.  Callers own closing it.
+
+        No reference counterpart (module docstring)."""
+        return RunLedger(self.root / "rollouts.jsonl")

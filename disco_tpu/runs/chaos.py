@@ -36,6 +36,18 @@ Seams wired through the pipeline (each a named :func:`tick` call):
   kills the server mid-stream (connections drop, nothing more is
   written), which is what lets ``make serve-check`` prove no client ever
   observes a truncated frame and every checkpoint survives intact.
+* ``pre_swap``       — inside the promotion rollout, on the DISPATCH
+  thread (``serve/scheduler.py``): a swap has been decided (recorded
+  in the promote ledger) but no session has been moved yet — a crash
+  here kills the server mid-rollout and must leave every session on
+  the incumbent with the rollout resumable from the ledger.
+* ``mid_canary``     — during an open canary window, after canary
+  sessions are live on the candidate but before the gate has enough
+  scores: a crash here must leave each session on exactly ONE intact
+  generation, and a restart re-adopts or rolls back from the ledger.
+* ``post_gate``      — after the gate verdict (promote or demote) is
+  computed but before the ledger records it: the classic
+  decided-but-not-durable window.
 
 Injection is armed either programmatically (:func:`configure`) or via the
 ``DISCO_TPU_CHAOS`` environment variable (``"seam"`` or ``"seam:N"`` —
@@ -72,6 +84,9 @@ SEAMS = frozenset(
         "chunk_load",      # enhance/driver.py, on the prefetch thread
         "between_blocks",  # enhance/streaming.py, streaming block loop
         "serve_tick",      # serve/scheduler.py, top of a scheduler tick
+        "pre_swap",        # serve/scheduler.py, swap decided but not yet applied
+        "mid_canary",      # promote/controller.py, canary window open, scores partial
+        "post_gate",       # promote/controller.py, verdict reached, ledger not yet final
     }
 )
 

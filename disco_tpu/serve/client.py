@@ -103,6 +103,10 @@ class ServeClient:
         self.resend_from: int | None = None   # lowest seq the server rejected
         self.closed_info: dict | None = None
         self.reattaches = 0           # completed transparent reattaches
+        #: {output seq: weight generation id} for generation-tagged
+        #: ``enhanced`` frames (sessions served with masks="model"); empty
+        #: for classic client-mask sessions — the wire carries no tag there
+        self.gen_of: dict[int, str] = {}
         self._next_expected = 0       # lowest output seq not yet received
         self._frames: "queue_mod.Queue" = queue_mod.Queue()
         self._enhanced: dict[int, np.ndarray] = {}
@@ -172,6 +176,8 @@ class ServeClient:
         if kind == "enhanced":
             seq = int(frame["seq"])
             self._enhanced[seq] = frame["yf"]
+            if frame.get("gen") is not None:
+                self.gen_of[seq] = frame["gen"]
             self._next_expected = max(self._next_expected, seq + 1)
         elif kind == "draining":
             self.draining = True
@@ -203,6 +209,9 @@ class ServeClient:
                     self._reattach(f"connection lost ({e.code})")
                     if self.closed_info is not None:
                         return self.closed_info   # finished during the drop
+                    if self.resend_from is not None:
+                        return {"type": "reattached",
+                                "resend_from": self.resend_from}
                     continue
                 raise
             if (frame.get("type") == "error"
@@ -213,6 +222,16 @@ class ServeClient:
                     retry_after_s=float(frame.get("retry_after_s", 0.0)))
                 if self.closed_info is not None:
                     return self.closed_info
+                if self.resend_from is not None:
+                    # the drop ate input blocks the server never queued:
+                    # blocking for another frame would deadlock (the server
+                    # is idle, waiting for the resend) — hand control back
+                    # so the wait loops re-check the resend cursor
+                    # (``recv_enhanced`` raises its documented
+                    # ``backpressure``; ``enhance_clip`` rolls ``next_send``
+                    # back and resends)
+                    return {"type": "reattached",
+                            "resend_from": self.resend_from}
                 continue
             self._fold(frame)
             return frame
@@ -322,10 +341,13 @@ class ServeClient:
                     return   # the session finished during the drop: the
                              # frame is moot, callers observe closed_info
 
-    def send_block(self, Y, mask_z, mask_w, seq: int | None = None) -> int:
+    def send_block(self, Y, mask_z=None, mask_w=None,
+                   seq: int | None = None) -> int:
         """Stream one input block; returns its seq.  ``Y`` (K, C, F, T)
         complex64, masks (K, F, T) float32; T = config.block_frames except
-        for a shorter final block."""
+        for a shorter final block.  Sessions opened with
+        ``SessionConfig(masks="model")`` send NO masks (the server fills
+        both from its live weight generation) — pass None, the default."""
         if self.session_id is None:
             raise ServeError("protocol", "send_block before open")
         seq = self.next_seq if seq is None else int(seq)
@@ -334,8 +356,10 @@ class ServeClient:
         frame = {
             "type": "block", "seq": seq,
             "Y": np.ascontiguousarray(Y, dtype=np.complex64),
-            "mask_z": np.ascontiguousarray(mask_z, dtype=np.float32),
-            "mask_w": np.ascontiguousarray(mask_w, dtype=np.float32),
+            "mask_z": (None if mask_z is None
+                       else np.ascontiguousarray(mask_z, dtype=np.float32)),
+            "mask_w": (None if mask_w is None
+                       else np.ascontiguousarray(mask_w, dtype=np.float32)),
         }
         if self._trace or (self._trace is None and obs_trace.enabled()):
             # mint the causal root at submission: the client_block span is
@@ -423,7 +447,7 @@ class ServeClient:
         self._sock.close()
 
     # -- convenience ---------------------------------------------------------
-    def enhance_clip(self, Y, mask_z, mask_w, *, window: int = 4,
+    def enhance_clip(self, Y, mask_z=None, mask_w=None, *, window: int = 4,
                      on_block=None) -> np.ndarray:
         """Stream a whole (K, C, F, T) clip through the open session and
         return the (K, F, T) enhanced STFT.
@@ -458,8 +482,11 @@ class ServeClient:
                 next_send = self.resend_from
             while next_send < n_blocks and next_send - next_recv < window:
                 lo, hi = next_send * Tb, min((next_send + 1) * Tb, T)
-                self.send_block(Y[..., lo:hi], mask_z[..., lo:hi], mask_w[..., lo:hi],
-                                seq=next_send)
+                self.send_block(
+                    Y[..., lo:hi],
+                    None if mask_z is None else mask_z[..., lo:hi],
+                    None if mask_w is None else mask_w[..., lo:hi],
+                    seq=next_send)
                 next_send += 1
             if next_recv in self._enhanced:
                 yf = self._enhanced.pop(next_recv)

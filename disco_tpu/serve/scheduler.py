@@ -211,7 +211,7 @@ class Scheduler:
                  max_quarantines: int = 2,
                  shed_retry_after_s: float = 1.0,
                  wait_window_ticks: int = 50,
-                 ladder=None, state_dir=None):
+                 ladder=None, state_dir=None, promote=None):
         if max_sessions < 1 or max_queue_blocks < 1 or max_blocks_per_tick < 1:
             raise ValueError("scheduler bounds must be >= 1")
         if blocks_per_super_tick < 1:
@@ -285,6 +285,20 @@ class Scheduler:
         #: sessions are checkpointed here on the next tick so a reattach
         #: survives even a server death in between
         self.state_dir = state_dir
+        #: optional PromotionController (promote/controller.py).  The
+        #: controller only ever *requests* generation swaps; this scheduler's
+        #: dispatch thread executes them at block boundaries
+        #: (:meth:`_apply_generation_swaps`) — the one-generation-per-block
+        #: invariant of the promote-check gate.  None = promotion off and
+        #: every promote seam in this file is a single attribute check.
+        self.promote = promote
+        #: per-generation device model cache {gen_id: (model, vars_device)},
+        #: dispatch-thread-only.  The flax module instance is shared per
+        #: arch (store.model_for_arch), so a new generation reuses the same
+        #: jitted programs — only its weights move to the device here.
+        self._gen_models: dict = {}
+        if promote is not None:
+            promote.bind(self)
         self.draining = False
         self._lock = threading.Lock()
         self._sessions: dict[str, Session] = {}
@@ -320,6 +334,15 @@ class Scheduler:
         with self._lock:
             return self._sessions.get(session_id)
 
+    def model_session_ids(self) -> list:
+        """Ids of the OPEN model-mask sessions — the promotion controller's
+        canary-eligible set (any thread).
+
+        No reference counterpart (module docstring)."""
+        with self._lock:
+            return [s.id for s in self._sessions.values()
+                    if s.status == OPEN and s.config.masks == "model"]
+
     def open_session(self, config, *, session_id: str | None = None,
                      z_mask=None, resume_from=None,
                      priority: bool = False) -> Session:
@@ -341,6 +364,13 @@ class Scheduler:
             except ValueError as e:
                 obs_registry.counter("admission_reject").inc()
                 raise AdmissionError("bad_config", str(e)) from None
+        if config.masks == "model" and self.promote is None:
+            obs_registry.counter("admission_reject").inc()
+            raise AdmissionError(
+                "bad_config",
+                'masks="model" needs a promotion store; start the server '
+                "with --promote-dir",
+            )
 
         with self._lock:
             if len(self._sessions) + len(self._parked) >= self.max_sessions:
@@ -381,6 +411,18 @@ class Scheduler:
                 ),
             )
         session.open_seq = seq
+        if config.masks == "model":
+            # every open (fresh or checkpoint-resumed) adopts the store's
+            # ACTIVE pointer — generations are deliberately NOT persisted in
+            # session checkpoints, so a crash mid-rollout lands every
+            # resumed session back on the committed generation (the
+            # rollback-on-crash semantics the chaos legs pin)
+            try:
+                gen = self.promote.active_generation()
+            except RuntimeError as e:
+                obs_registry.counter("admission_reject").inc()
+                raise AdmissionError("bad_config", str(e)) from None
+            session.set_generation(gen, at_seq=session.blocks_done)
         with self._lock:
             if session.id in self._sessions or session.id in self._parked:
                 obs_registry.counter("admission_reject").inc()
@@ -455,12 +497,23 @@ class Scheduler:
                 f"block shape {Y.shape} does not fit session shape {exp} "
                 "(only the final block may be shorter)"
             )
-        for name, m in (("mask_z", mask_z), ("mask_w", mask_w)):
-            m = np.asarray(m)  # disco-lint: disable=DL002 -- wire-decoded host arrays on the I/O thread; no device array can reach push_block
-            if not np.issubdtype(m.dtype, np.number):
-                raise ValueError(f"{name} dtype {m.dtype} is not numeric")
-            if m.shape != (cfg.n_nodes, cfg.n_freq, Y.shape[-1]):
-                raise QueueFull(f"{name} shape {m.shape} does not match block {Y.shape}")
+        if cfg.masks == "model":
+            # the model-mask lane: blocks arrive maskless and the dispatch
+            # thread fills both masks from the session's current weight
+            # generation (promote/lane.py) — a client that sends masks
+            # anyway is confused about its own config and dies loudly here
+            if mask_z is not None or mask_w is not None:
+                raise QueueFull(
+                    f'session {session.id} has masks="model"; blocks must '
+                    "not carry mask_z/mask_w"
+                )
+        else:
+            for name, m in (("mask_z", mask_z), ("mask_w", mask_w)):
+                m = np.asarray(m)  # disco-lint: disable=DL002 -- wire-decoded host arrays on the I/O thread; no device array can reach push_block
+                if not np.issubdtype(m.dtype, np.number):
+                    raise ValueError(f"{name} dtype {m.dtype} is not numeric")
+                if m.shape != (cfg.n_nodes, cfg.n_freq, Y.shape[-1]):
+                    raise QueueFull(f"{name} shape {m.shape} does not match block {Y.shape}")
         if session.queue_depth() >= self.max_queue_blocks:
             raise QueueFull(
                 f"session {session.id} input queue at max_queue_blocks="
@@ -477,8 +530,11 @@ class Scheduler:
                 (session.id, int(seq)), ctx, "enqueue",
                 session=session.id, seq=int(seq),
             )
-        session.push_block(seq, Y, np.asarray(mask_z), np.asarray(mask_w),
-                           time.time(), trace_ctx=ctx)
+        session.push_block(
+            seq, Y,
+            None if mask_z is None else np.asarray(mask_z),
+            None if mask_w is None else np.asarray(mask_w),
+            time.time(), trace_ctx=ctx)
         self._set_gauges()
 
     def request_close(self, session: Session) -> None:
@@ -588,6 +644,16 @@ class Scheduler:
             session.status = OPEN
             session.parked_at = None
             session.outage_tick = self.tick_no
+        if (self.promote is not None and session.config.masks == "model"
+                and session.generation is not None):
+            # a rollout can end while a session is parked (its swap request
+            # was voided): a reattaching session whose generation is neither
+            # ACTIVE nor the live candidate is stale and re-adopts ACTIVE —
+            # the same boundary semantics as a checkpoint resume
+            active = self.promote.active_generation()
+            if session.generation not in (active,
+                                          self.promote.current_candidate()):
+                session.set_generation(active, at_seq=session.blocks_done)
         obs_registry.counter("session_reattached").inc()
         obs_events.record("session", stage="serve", action="reattach",
                           session=session.id, resume_seq=resume_seq,
@@ -651,6 +717,107 @@ class Scheduler:
                     reason=f"park checkpoint failed for {s.id}: "
                            f"{type(e).__name__}: {e}",
                 )
+
+    # -- promotion (dispatch thread) -----------------------------------------
+    def _apply_generation_swaps(self) -> None:
+        """Execute the promotion controller's requested generation swaps —
+        HERE, on the dispatch thread, and only for sessions sitting at a
+        block boundary (``inflight == 0``): every block a session ever
+        dispatches therefore runs under exactly ONE generation, which is
+        what makes per-generation replay bit-exact (the promote-check
+        oracle).  Sessions not at a boundary are retried next tick;
+        sessions that left the live registry are reported void.
+
+        The ``pre_swap`` chaos seam fires here, after the rollout intent is
+        durable in the ledger but before any session moved — a crash kills
+        the whole server mid-rollout and the restart must resume from the
+        ledger with every session on the incumbent (the strongest drill).
+
+        When a state dir is configured, the session is checkpointed through
+        the atomic ``save_session_state`` path at the boundary first — the
+        park-checkpoint contract of the swap: the on-disk carry a resume
+        would adopt was produced entirely under the old generation.
+
+        No reference counterpart (module docstring)."""
+        swaps = self.promote.pending_swaps()
+        if not swaps:
+            return
+        from disco_tpu.runs import chaos
+
+        for sid, gen, kind in swaps:
+            with self._lock:
+                session = self._sessions.get(sid)
+            if session is None or session.status not in (OPEN, DRAINING):
+                self.promote.note_swap_void(sid)
+                continue
+            if session.inflight != 0:
+                continue   # mid-flight: not at a boundary — next tick
+            chaos.tick("pre_swap", session=sid, gen=gen, swap=kind)
+            boundary = session.blocks_done
+            if self.state_dir is not None:
+                from pathlib import Path
+
+                from disco_tpu.serve.session import save_session_state
+
+                state_dir = Path(self.state_dir)
+                state_dir.mkdir(parents=True, exist_ok=True)
+                try:
+                    save_session_state(
+                        state_dir / f"session_{sid}.state.msgpack", session)
+                except Exception as e:
+                    obs_events.record(
+                        "warning", stage="serve",
+                        reason=f"swap checkpoint failed for {sid}: "
+                               f"{type(e).__name__}: {e}",
+                    )
+            session.set_generation(gen, at_seq=boundary)
+            ev_kind, ev_action = {"canary": ("canary", "swap"),
+                                  "promote": ("promotion", "adopt"),
+                                  "rollback": ("rollback", "swap")}[kind]
+            obs_events.record(ev_kind, stage="serve", action=ev_action,
+                              session=sid, gen=gen, seq=boundary)
+            self.promote.note_swapped(sid, gen, boundary)
+        # drop device weights no live or parked session references anymore
+        # (a rolled-back candidate must not pin its variables on device)
+        refs = {s.generation for s in self.sessions()}
+        refs |= {s.generation for s in self.parked_sessions()}
+        for g in [g for g in self._gen_models if g not in refs]:
+            del self._gen_models[g]
+
+    def _gen_model(self, gen_id: str):
+        """(model, device variables) for one generation — cache miss loads
+        through the controller (digest-verified) and moves the weights to
+        the device once (dispatch thread only).
+
+        No reference counterpart (module docstring)."""
+        entry = self._gen_models.get(gen_id)
+        if entry is None:
+            import jax
+
+            from disco_tpu.utils.transfer import to_device
+
+            model, variables = self.promote.model_for(gen_id)
+            variables = jax.tree_util.tree_map(to_device, variables)
+            entry = self._gen_models[gen_id] = (model, variables)
+        return entry
+
+    def _fill_model_masks(self, session: Session, blocks: list) -> None:
+        """Fill a model-mask session's popped blocks IN PLACE with masks
+        from its current generation (promote/lane.py) — before grouping, so
+        the scan path, the corpus tap and a transport-retry requeue all see
+        the same computed masks (a retried block is never recomputed under
+        a later generation).
+
+        No reference counterpart (module docstring)."""
+        from disco_tpu.promote.lane import block_masks
+
+        model, variables = self._gen_model(session.generation)
+        for i, (seq, Y, mz, mw) in enumerate(blocks):
+            if mz is not None:
+                continue   # already filled (requeued after a retry)
+            m = block_masks(Y, model, variables,
+                            ref_mic=session.config.ref_mic)
+            blocks[i] = (seq, Y, m, m)
 
     # -- quarantine (dispatch thread) ----------------------------------------
     def _quarantine(self, session: Session, error: BaseException) -> None:
@@ -736,6 +903,8 @@ class Scheduler:
         self._release_quarantined()
         self._expire_parks()
         self._checkpoint_parked()
+        if self.promote is not None:
+            self._apply_generation_swaps()
         sessions = self.sessions()
         if sessions:
             # rotate the starting session each tick: under sustained overload
@@ -854,6 +1023,8 @@ class Scheduler:
         bf = session.config.block_frames
         if progress is None:
             progress = [0]
+        if self.promote is not None and session.config.masks == "model":
+            self._fill_model_masks(session, blocks)
         done = 0
         # every run of N consecutive full blocks rides one scanned
         # dispatch; the sub-N remainder (or a group holding the
@@ -1107,6 +1278,12 @@ class Scheduler:
                 session.record_delivery(
                     seq, blk if len(seqs) == 1 else np.ascontiguousarray(blk))
                 deliveries.append((session, seq, blk, lat_s))
+                if self.promote is not None and session.generation is not None:
+                    # advances the canary window (and the gate clock) —
+                    # attributed to the generation the block RAN under,
+                    # which a swap since dispatch cannot rewrite
+                    self.promote.note_delivery(session.id, seq,
+                                               session.gen_for(seq))
             if self.tap is not None and not self._tap_suspended and raw:
                 # THE corpus-tap seam: every delivered block's full training
                 # tuple is host-resident right here (inputs were retained at

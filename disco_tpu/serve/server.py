@@ -103,6 +103,7 @@ class EnhanceServer:
                  ladder=None,
                  sock_sndbuf: int | None = None,
                  write_buffer_high: int | None = None,
+                 promote=None,
                  run_info: dict | None = None):
         self.host, self.port, self.unix_path = host, port, unix_path
         if ladder is True:
@@ -121,8 +122,13 @@ class EnhanceServer:
             dispatch_retries=dispatch_retries, retry_seed=retry_seed,
             tick_deadline_s=tick_deadline_s,
             quarantine_ticks=quarantine_ticks,
-            ladder=ladder, state_dir=state_dir,
+            ladder=ladder, state_dir=state_dir, promote=promote,
         )
+        #: optional PromotionController — started/stopped with the server
+        #: (its thread never enters jax; swaps execute on the dispatch
+        #: thread).  A pre-built scheduler brings its own.
+        self.promote = (promote if promote is not None
+                        else getattr(self.scheduler, "promote", None))
         #: connection drops / mid-frame protocol truncations PARK the
         #: session (resume token, bounded TTL, bit-exact reattach) instead
         #: of evicting; False restores the old evict-on-drop behavior
@@ -483,8 +489,14 @@ class EnhanceServer:
             for seq, yf in entries:
                 if conn.session is not s or s.status == EVICTED:
                     break   # evicted mid-drain (slow client) / detached
-                self._post(conn, {"type": "enhanced", "session": s.id,
-                                  "seq": int(seq), "yf": yf})
+                frame = {"type": "enhanced", "session": s.id,
+                         "seq": int(seq), "yf": yf}
+                if s.generation is not None:
+                    # which weight generation enhanced this block — only
+                    # generation-tracked sessions carry the key, so a
+                    # promote-less server's wire stays bit-identical
+                    frame["gen"] = s.gen_for(seq)
+                self._post(conn, frame)
                 conn.next_out = seq + 1
 
     def _conn_of(self, session) -> _Conn | None:
@@ -629,6 +641,10 @@ class EnhanceServer:
         self._dispatch_thread = threading.Thread(
             target=self._dispatch_loop, name="disco-serve-dispatch", daemon=True)
         self._dispatch_thread.start()
+        if self.promote is not None:
+            # resume-then-run: an interrupted rollout is settled from the
+            # ledger BEFORE any session can open against a torn state
+            self.promote.start()
         obs_events.record("run_start", stage="serve", tool="disco-serve",
                           address=str(self.address), **self.run_info)
         return self.address
@@ -638,6 +654,8 @@ class EnhanceServer:
         checkpoint, close streams, stop threads.  Raises the dispatch
         thread's crash, if any (a chaos-injected death must surface)."""
         self._stop_event.set()
+        if self.promote is not None:
+            self.promote.stop()
         self.wait(timeout_s)
 
     def wait(self, timeout_s: float | None = None) -> None:
@@ -649,6 +667,9 @@ class EnhanceServer:
                 raise TimeoutError("serve: dispatch thread did not stop in time")
         if self._loop_thread is not None:
             self._loop_thread.join(5.0)
+        if self.promote is not None:
+            self.promote.stop()
+            self.promote.wait(timeout_s=5.0)
         if self.crashed is not None:
             crash, self.crashed = self.crashed, None
             raise crash

@@ -44,6 +44,14 @@ _STATE_VERSION = 1
 #: are offline-only — enhance/streaming._stream_stats).
 SERVE_POLICIES = ("local", "distant", "none")
 
+#: Where a session's masks come from.  ``"client"`` (default, the PR-16
+#: wire shape): every block frame carries ``mask_z``/``mask_w``.
+#: ``"model"``: blocks arrive maskless and the scheduler fills both masks
+#: at dispatch time from the session's current weight generation
+#: (:mod:`disco_tpu.promote.lane`) — requires the server to run with a
+#: promote store (``--promote-dir``).
+MASK_SOURCES = ("client", "model")
+
 
 @dataclasses.dataclass(frozen=True)
 class SessionConfig:
@@ -66,6 +74,7 @@ class SessionConfig:
     ref_mic: int = 0
     policy: str = "local"
     solver: str = "eigh"
+    masks: str = "client"
 
     def __post_init__(self):
         # lambda_cor / mu are traced floats with an omit-when-default calling
@@ -106,6 +115,11 @@ class SessionConfig:
             raise ValueError(
                 f"session config policy {self.policy!r} not servable; one of "
                 f"{SERVE_POLICIES} (oracle policies are offline-only)"
+            )
+        if self.masks not in MASK_SOURCES:
+            raise ValueError(
+                f"session config masks {self.masks!r} unknown; one of "
+                f"{MASK_SOURCES}"
             )
         if not 0.0 < float(self.lambda_cor) < 1.0:
             raise ValueError(
@@ -205,6 +219,18 @@ class Session:
         self.quarantine_count = 0
         #: scheduler tick number at which a QUARANTINED session re-opens
         self.quarantine_until_tick = 0
+        #: current weight generation id for model-mask sessions (None for
+        #: client-mask sessions and promote-less servers).  Written ONLY by
+        #: the dispatch thread at block boundaries (inflight == 0, between
+        #: dispatches — ``Scheduler._apply_generation_swaps``), so every
+        #: block is computed under exactly one generation.
+        self.generation: str | None = None
+        #: [(first_seq, gen_id)] — the session's generation history, one
+        #: entry per adoption/swap, first_seq ascending.  What makes a
+        #: delivered frame's generation derivable (:meth:`gen_for`) and the
+        #: per-generation bit-exact replay of ``make promote-check``
+        #: checkable.  Dispatch-thread-only, like :attr:`generation`.
+        self.gen_segments: list = []
         #: tick of this session's last outage transition (park, reattach,
         #: quarantine, release).  Queue-wait samples observed within the
         #: scheduler's grace window after it are EXCLUDED from the
@@ -320,6 +346,28 @@ class Session:
                 f"losing frames"
             )
         return missing
+
+    def set_generation(self, gen_id: str, at_seq: int) -> None:
+        """Adopt a weight generation from block ``at_seq`` on (dispatch
+        thread, at a block boundary only — see :attr:`generation`).  A
+        re-adoption of the current generation is a no-op segment-wise.
+
+        No reference counterpart (module docstring)."""
+        if self.generation == gen_id:
+            return
+        self.generation = gen_id
+        self.gen_segments.append((int(at_seq), gen_id))
+
+    def gen_for(self, seq: int) -> str | None:
+        """Generation that computed block ``seq`` (latest segment whose
+        ``first_seq`` <= seq), or None for an ungenerationed session.
+
+        No reference counterpart (module docstring)."""
+        gen = None
+        for first_seq, gen_id in self.gen_segments:
+            if first_seq <= int(seq):
+                gen = gen_id
+        return gen
 
     def block_z_avail(self, seq: int, n_blocks: int):
         """Availability columns for input block ``seq`` (``n_blocks``

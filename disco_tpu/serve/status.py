@@ -68,9 +68,10 @@ def status_payload(scheduler, *, ladder=None, tracer=None) -> dict:
     """
     ladder = ladder if ladder is not None else scheduler.ladder
     tracer = tracer if tracer is not None else obs_trace.tracer()
+    promote = getattr(scheduler, "promote", None)
     sessions = []
     for s in scheduler.sessions() + scheduler.parked_sessions():
-        sessions.append({
+        entry = {
             "id": s.id,
             "status": s.status,
             "blocks_in": s.blocks_in,
@@ -79,19 +80,27 @@ def status_payload(scheduler, *, ladder=None, tracer=None) -> dict:
             "inflight": s.inflight,
             "priority": bool(s.priority),
             "quarantine_count": s.quarantine_count,
-        })
+        }
+        if getattr(s, "generation", None) is not None:
+            # generation keys exist only on promotion-enabled servers —
+            # a promote-less payload stays byte-identical to PR 16
+            entry["generation"] = s.generation
+        sessions.append(entry)
     snap = obs_registry.snapshot()
+    sched_section = {
+        "tick_no": scheduler.tick_no,
+        "ticks_with_work": scheduler.ticks_with_work,
+        "draining": scheduler.draining,
+        "max_sessions": scheduler.max_sessions,
+        "max_blocks_per_tick": scheduler.max_blocks_per_tick,
+        "blocks_per_super_tick": scheduler.blocks_per_super_tick,
+        "pending_blocks": scheduler.pending_blocks(),
+    }
+    if promote is not None:
+        sched_section["active_generation"] = promote.store.active()
     return {
         "sessions": sessions,
-        "scheduler": {
-            "tick_no": scheduler.tick_no,
-            "ticks_with_work": scheduler.ticks_with_work,
-            "draining": scheduler.draining,
-            "max_sessions": scheduler.max_sessions,
-            "max_blocks_per_tick": scheduler.max_blocks_per_tick,
-            "blocks_per_super_tick": scheduler.blocks_per_super_tick,
-            "pending_blocks": scheduler.pending_blocks(),
-        },
+        "scheduler": sched_section,
         "ladder": (None if ladder is None else {
             "rung": ladder.rung,
             "mode": _rung_name(ladder.rung),

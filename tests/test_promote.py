@@ -1,0 +1,298 @@
+"""Promotion-subsystem tests: the generation store, the publish seam and
+the controller's resume/guard logic (disco_tpu/promote).  The end-to-end
+canary → gate → promote-or-rollback ladder (and its chaos drills) is gated
+by ``make promote-check``; these tests pin the pieces in isolation."""
+import numpy as np
+import pytest
+
+import jax
+
+from disco_tpu.obs.metrics import REGISTRY as obs_registry
+from disco_tpu.promote.controller import PromotionController, rollout_unit
+from disco_tpu.promote.store import (
+    WEIGHT_KEYS,
+    GenerationStore,
+    PublishRefused,
+    model_for_arch,
+)
+
+#: The flywheel tests' tiny CRNN, shared so the jit/module caches hit.
+ARCH = dict(n_ch=1, win_len=4, n_freq=9, cnn_filters=(2,),
+            pool_kernels=((1, 2),), conv_padding=((0, 1),),
+            rnn_units=(4,), ff_units=(9,), rnn_dropouts=0.0)
+
+
+def _variables(seed):
+    from disco_tpu.nn.crnn import build_crnn
+    from disco_tpu.nn.training import create_train_state
+
+    model, tx = build_crnn(**ARCH)
+    x = np.zeros((1, ARCH["win_len"], ARCH["n_freq"]), np.float32)
+    state = create_train_state(model, tx, x, seed=seed)
+    return {"params": state.params, "batch_stats": state.batch_stats}
+
+
+def _fake_variables(fill):
+    """Weight-shaped plain-numpy payload: staging never builds the model,
+    so store-mechanics tests stay jax-free and instant."""
+    return {"params": {"w": np.full(3, fill, np.float32)}, "batch_stats": {}}
+
+
+# ------------------------------------------------------------------ the store
+def test_stage_is_idempotent_and_digest_addressed(tmp_path):
+    store = GenerationStore(tmp_path / "promote")
+    g1 = store.stage_variables(_fake_variables(0.0), arch=ARCH)
+    g2 = store.stage_variables(_fake_variables(0.0), arch=ARCH)
+    assert g1.gen_id == g2.gen_id and g1.serial == g2.serial == 1
+    assert g1.gen_id.startswith("g") and len(g1.gen_id) == 13
+    assert g1.digest.startswith("sha256:")
+    assert store.list_ids() == [g1.gen_id]
+    g3 = store.stage_variables(_fake_variables(1.0), arch=ARCH)
+    assert g3.gen_id != g1.gen_id and g3.serial == 2
+    assert store.list_ids() == [g1.gen_id, g3.gen_id]
+
+
+def test_digest_is_key_order_canonical(tmp_path):
+    """Same weights staged from dicts with different insertion order (a
+    live trainer vs a restored checkpoint) must land on ONE generation."""
+    store = GenerationStore(tmp_path / "promote")
+    fwd = {"params": {"a": np.zeros(2, np.float32),
+                      "b": np.ones(2, np.float32)}, "batch_stats": {}}
+    rev = {"batch_stats": {},
+           "params": {"b": np.ones(2, np.float32),
+                      "a": np.zeros(2, np.float32)}}
+    assert (store.stage_variables(fwd, arch=ARCH).gen_id
+            == store.stage_variables(rev, arch=ARCH).gen_id)
+    assert len(store.list_ids()) == 1
+
+
+def test_active_pointer_and_load_roundtrip(tmp_path):
+    from flax import serialization
+
+    store = GenerationStore(tmp_path / "promote")
+    assert store.active() is None
+    variables = _variables(1)
+    gen = store.stage_variables(variables, arch=ARCH)
+    with pytest.raises(FileNotFoundError):
+        store.set_active("g000000000000")  # unknown gens must not go live
+    assert store.active() is None
+    store.set_active(gen.gen_id)
+    assert store.active() == gen.gen_id
+
+    model, loaded = store.load(gen.gen_id)
+    assert model is model_for_arch(gen.arch)  # per-arch cache shares modules
+    want = serialization.to_state_dict(
+        {k: variables[k] for k in WEIGHT_KEYS})
+    for a, b in zip(jax.tree_util.tree_leaves(want),
+                    jax.tree_util.tree_leaves(loaded), strict=True):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_torn_weight_file_fails_loudly_on_load(tmp_path):
+    store = GenerationStore(tmp_path / "promote")
+    gen = store.stage_variables(_fake_variables(0.5), arch=ARCH)
+    raw = bytearray(gen.weights_path.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF
+    gen.weights_path.write_bytes(bytes(raw))
+    assert store.get(gen.gen_id).gen_id == gen.gen_id  # meta still reads
+    with pytest.raises(PublishRefused, match="torn or corrupt"):
+        store.load(gen.gen_id)
+
+
+def test_stage_checkpoint_refuses_junk_and_missing_keys(tmp_path):
+    from flax import serialization
+
+    store = GenerationStore(tmp_path / "promote")
+    junk = tmp_path / "junk.msgpack"
+    junk.write_bytes(b"\x00\x01\x02not-a-checkpoint")
+    with pytest.raises(PublishRefused, match="not a readable"):
+        store.stage_checkpoint(junk, arch=ARCH)
+    partial = tmp_path / "partial.msgpack"
+    partial.write_bytes(serialization.msgpack_serialize(
+        serialization.to_state_dict(
+            {"params": {"w": np.zeros(2, np.float32)}})))
+    with pytest.raises(PublishRefused, match="batch_stats"):
+        store.stage_checkpoint(partial, arch=ARCH)
+    assert store.list_ids() == []  # refusals stage nothing
+
+
+def test_stage_checkpoint_is_ledger_aware(tmp_path):
+    """The publish-seam contract: a checkpoint from a run whose latest
+    epoch unit is still in_flight is refused NAMING the unit — at the file
+    level it is indistinguishable from a finished candidate."""
+    from flax import serialization
+
+    from disco_tpu.runs.ledger import RunLedger, unit_epoch
+
+    store = GenerationStore(tmp_path / "promote")
+    ck = tmp_path / "cand.msgpack"
+    ck.write_bytes(serialization.msgpack_serialize(
+        serialization.to_state_dict(_fake_variables(0.25))))
+
+    led = RunLedger(tmp_path / "train_led.jsonl")
+    led.mark_in_flight(unit_epoch(0))
+    led.record(unit_epoch(0), "done", val_loss=0.5)
+    gen = store.stage_checkpoint(ck, arch=ARCH, ledger=led.path)
+    assert gen.serial == 1  # clean ledger: stages fine
+
+    led.mark_in_flight(unit_epoch(1))  # mid-epoch-interrupted run
+    led.close()
+    with pytest.raises(PublishRefused, match="epoch:1") as ei:
+        store.stage_checkpoint(ck, arch=ARCH, ledger=tmp_path / "train_led.jsonl")
+    assert ei.value.unit == "epoch:1"
+
+
+# ---------------------------------------------------------- the publish seam
+def test_mid_epoch_crash_refuses_publish_until_clean_resume(tmp_path, rng):
+    """The satellite regression: a fit() killed at the ``mid_epoch`` chaos
+    seam leaves its ledger epoch in_flight, and the publish seam must
+    refuse the on-disk checkpoint (which predates the interrupted epoch)
+    with a clean error naming the unit — then accept it again after a
+    clean resumed run."""
+    from disco_tpu.nn.crnn import build_crnn
+    from disco_tpu.nn.training import create_train_state, fit, publish_checkpoint
+    from disco_tpu.runs import chaos
+    from disco_tpu.runs.ledger import RunLedger, unit_epoch
+
+    x = rng.random((4, ARCH["win_len"], ARCH["n_freq"])).astype("float32")
+    y = (rng.random((4, ARCH["win_len"], ARCH["n_freq"])) > 0.5).astype("float32")
+    batches = lambda: iter([(x, y)])
+    model, tx = build_crnn(**ARCH)
+    state = create_train_state(model, tx, x[:1], seed=2)
+    led_path = tmp_path / "train_led.jsonl"
+    promote_dir = tmp_path / "promote"
+
+    # epoch 0 completes (done record, improved checkpoint, published gen);
+    # the second mid_epoch tick kills epoch 1 with nothing persisted
+    chaos.configure("mid_epoch", after=2)
+    try:
+        with pytest.raises(chaos.ChaosCrash):
+            fit(model, state, batches, batches, n_epochs=2,
+                save_path=tmp_path / "m", run_name="t", verbose=False,
+                ledger=led_path, promote_dir=promote_dir, promote_arch=ARCH)
+    finally:
+        chaos.disable()
+
+    latest = RunLedger(led_path).replay()
+    assert latest[unit_epoch(0)]["state"] == "done"
+    assert latest[unit_epoch(1)]["state"] == "in_flight"
+    store = GenerationStore(promote_dir)
+    assert len(store.list_ids()) == 1  # epoch 0's publish landed
+
+    ckpt = tmp_path / "m" / "t_model.msgpack"
+    assert ckpt.is_file()
+    with pytest.raises(PublishRefused, match="epoch:1") as ei:
+        publish_checkpoint(promote_dir, ckpt, arch=ARCH, ledger=led_path)
+    assert ei.value.unit == "epoch:1"
+    assert len(store.list_ids()) == 1  # the refusal staged nothing
+
+    # a clean resumed run redoes epoch 1 end to end; the seam accepts again
+    state2 = create_train_state(model, tx, x[:1], seed=2)
+    fit(model, state2, batches, batches, n_epochs=1,
+        save_path=tmp_path / "m", run_name="t", verbose=False,
+        ledger=led_path, resume_from=ckpt)
+    assert RunLedger(led_path).replay()[unit_epoch(1)]["state"] == "done"
+    gen = publish_checkpoint(promote_dir, ckpt, arch=ARCH, ledger=led_path)
+    assert gen.gen_id in store.list_ids()
+
+
+# ------------------------------------------------------------- the controller
+def test_rollout_never_resurrects_superseded_candidate(tmp_path):
+    """Regression: after promoting serial N, the old serial N-1 incumbent
+    has no rollout unit — the controller must NOT treat it as a fresh
+    candidate and canary live sessions backwards onto it."""
+    store = GenerationStore(tmp_path / "promote")
+    g1 = store.stage_variables(_fake_variables(0.0), arch=ARCH)
+    g2 = store.stage_variables(_fake_variables(1.0), arch=ARCH)
+    store.set_active(g2.gen_id)
+
+    ctl = PromotionController(store, poll_s=0.01)
+    try:
+        ctl._maybe_begin_rollout()
+        assert ctl._phase == "idle" and ctl._candidate is None
+        assert rollout_unit(g1.gen_id) not in ctl._ledger.replay()
+
+        # a genuinely newer candidate IS picked up
+        g3 = store.stage_variables(_fake_variables(2.0), arch=ARCH)
+        ctl._maybe_begin_rollout()
+        assert ctl._phase == "canary"
+        assert ctl._candidate.gen_id == g3.gen_id
+        rec = ctl._ledger.replay()[rollout_unit(g3.gen_id)]
+        assert rec["state"] == "in_flight"
+        assert rec["attrs"]["incumbent"] == g2.gen_id
+    finally:
+        ctl._ledger.close()
+
+
+def test_resume_settles_interrupted_rollout_from_active_pointer(tmp_path):
+    """Crash-resume semantics: ACTIVE is the arbiter.  An in_flight
+    rollout whose candidate is NOT active rolls back (failed, naming the
+    interrupted phase); one whose ACTIVE already points at the candidate
+    completes as a promotion."""
+    store = GenerationStore(tmp_path / "promote")
+    g1 = store.stage_variables(_fake_variables(0.0), arch=ARCH)
+    g2 = store.stage_variables(_fake_variables(1.0), arch=ARCH)
+    store.set_active(g1.gen_id)
+    led = store.rollout_ledger()
+    led.record(rollout_unit(g2.gen_id), "in_flight", phase="canary",
+               candidate=g2.gen_id, incumbent=g1.gen_id)
+    led.close()
+
+    ctl = PromotionController(store, poll_s=0.01)
+    try:
+        ctl._resume()
+        rec = ctl._ledger.replay()[rollout_unit(g2.gen_id)]
+        assert rec["state"] == "failed"
+        assert "crash during 'canary'" in rec["attrs"]["error"]
+        assert store.active() == g1.gen_id
+    finally:
+        ctl._ledger.close()
+
+    # crash AFTER the ACTIVE flip: the promotion is completed, not undone
+    g3 = store.stage_variables(_fake_variables(2.0), arch=ARCH)
+    store.set_active(g3.gen_id)
+    led = store.rollout_ledger()
+    led.record(rollout_unit(g3.gen_id), "in_flight", phase="promoting",
+               candidate=g3.gen_id, incumbent=g1.gen_id)
+    led.close()
+    c0 = obs_registry.counter("model_promotions").value
+    ctl2 = PromotionController(store, poll_s=0.01)
+    try:
+        ctl2._resume()
+        rec = ctl2._ledger.replay()[rollout_unit(g3.gen_id)]
+        assert rec["state"] == "done" and rec["attrs"]["resumed"] is True
+        assert obs_registry.counter("model_promotions").value - c0 == 1
+        assert obs_registry.gauge("weight_generation").value == g3.serial
+        assert store.active() == g3.gen_id
+    finally:
+        ctl2._ledger.close()
+
+
+def test_controller_rejects_bad_knobs(tmp_path):
+    with pytest.raises(ValueError, match="canary_frac"):
+        PromotionController(tmp_path / "p", canary_frac=1.5)
+    with pytest.raises(ValueError, match="window_blocks"):
+        PromotionController(tmp_path / "p", window_blocks=0)
+
+
+# -------------------------------------------------------------- the admission
+def test_model_mask_sessions_need_a_promotion_store(tmp_path):
+    from disco_tpu.serve.scheduler import AdmissionError, Scheduler
+    from disco_tpu.serve.session import SessionConfig
+
+    cfg = SessionConfig(n_nodes=4, mics_per_node=2, n_freq=9,
+                        block_frames=8, update_every=4, masks="model")
+    sched = Scheduler(max_sessions=2)
+    with pytest.raises(AdmissionError, match="promote-dir") as ei:
+        sched.open_session(cfg)
+    assert ei.value.code == "bad_config"
+
+    # promote-wired but never activated: refused naming the missing ACTIVE
+    ctl = PromotionController(GenerationStore(tmp_path / "promote"),
+                              poll_s=0.01)
+    try:
+        sched2 = Scheduler(max_sessions=2, promote=ctl)
+        with pytest.raises(AdmissionError, match="ACTIVE"):
+            sched2.open_session(cfg)
+    finally:
+        ctl._ledger.close()

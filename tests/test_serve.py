@@ -967,6 +967,53 @@ def test_mid_frame_truncation_parks_not_corrupts(stream):
     np.testing.assert_array_equal(yf, ref)
 
 
+def test_shed_park_with_eaten_block_resends_not_deadlocks(stream):
+    """A shed-to-park notice that lands while the client is blocked in
+    ``recv_enhanced`` — with the awaited input block eaten by the park —
+    must surface the documented ``backpressure`` resend signal after the
+    transparent reattach, not keep waiting for an output the server will
+    never produce (the server is idle, waiting for the resend: a mutual
+    stall observed live behind a ladder shed on a cold-compile spike)."""
+    from disco_tpu.serve import EnhanceServer, ServeClient, ServeError
+
+    Y, m, ref = stream
+    F = Y.shape[-2]
+    srv = EnhanceServer(max_sessions=2)
+    addr = srv.start()
+    try:
+        cl = ServeClient(addr)
+        sid = cl.open(_config(F))
+        cl.send_block(Y[..., :BLOCK], m[..., :BLOCK], m[..., :BLOCK], seq=0)
+        cl.recv_enhanced(0, timeout_s=30)
+        # shed the session exactly as the ladder does: park with the
+        # connection up; the dispatch loop posts the ``parked`` notice
+        session = srv.scheduler.get(sid)
+        assert srv.scheduler.park(session, "shed: overload (test)",
+                                  notice=True)
+        deadline = time.monotonic() + 10.0
+        while cl._frames.qsize() == 0:        # notice reached the client
+            assert time.monotonic() < deadline, "park notice never posted"
+            time.sleep(0.01)
+        # this block is eaten — the parked session rejects it — and the
+        # client is blocked on its output when the notice is processed
+        cl.send_block(Y[..., BLOCK:2 * BLOCK], m[..., BLOCK:2 * BLOCK],
+                      m[..., BLOCK:2 * BLOCK], seq=1)
+        with pytest.raises(ServeError, match="resend") as ei:
+            cl.recv_enhanced(1, timeout_s=10)
+        assert ei.value.code == "backpressure"
+        assert cl.reattaches == 1 and cl.resend_from == 1
+        # the documented recovery: resend from the rollback point, then
+        # the stream continues bit-exact
+        cl.send_block(Y[..., BLOCK:2 * BLOCK], m[..., BLOCK:2 * BLOCK],
+                      m[..., BLOCK:2 * BLOCK], seq=1)
+        yf = cl.recv_enhanced(1, timeout_s=30)
+        np.testing.assert_array_equal(yf, ref[..., BLOCK:2 * BLOCK])
+        cl.close()
+        cl.shutdown()
+    finally:
+        srv.stop()
+
+
 def test_client_connect_retries_survive_server_restart_window():
     """First OSError on connect used to be fatal; the bounded seeded
     backoff must ride out a late-binding server (and still fail cleanly
