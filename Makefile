@@ -3,9 +3,9 @@
 
 PYTHON ?= python
 
-.PHONY: test coverage doc install native clean bench milestone-corpus dryrun lint-check trace-check race-check meter-check obs-check fault-check chaos-check perf-check serve-check stream-check flywheel-check soak-check scope-check promote-check
+.PHONY: test coverage doc install native clean bench milestone-corpus dryrun lint-check trace-check race-check meter-check obs-check fault-check chaos-check perf-check serve-check stream-check flywheel-check soak-check scope-check promote-check endure-check
 
-test: lint-check trace-check race-check meter-check obs-check fault-check chaos-check perf-check stream-check serve-check flywheel-check soak-check scope-check promote-check
+test: lint-check trace-check race-check meter-check obs-check fault-check chaos-check perf-check stream-check serve-check flywheel-check soak-check scope-check promote-check endure-check
 	$(PYTHON) -m pytest tests/ -q
 
 # Static-analysis gate (runs FIRST: it needs no jax, no device and ~2 s):
@@ -221,6 +221,24 @@ scope-check:
 promote-check:
 	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= DISCO_TPU_COMPILE_CACHE=off \
 	    $(PYTHON) -m disco_tpu.promote.check
+
+# Endurance gate (the sixteenth gate): disco-endure runs the WHOLE flywheel
+# co-resident — loopback serving, the corpus tap, the resident trainer
+# interleaving train-step slices on the dispatch thread, and the promotion
+# controller — through >= 3 full tap→train→publish→canary→promote
+# generations over ONE shared store/tap/ledger tree, crashing each
+# component at its seams along the way (mid_epoch, pre_publish,
+# between_generations, pre_swap, mid_canary) and asserting after every
+# restart: delivered frames bit-exact vs offline streaming_tango, a
+# monotone promoted-generation lineage with no torn weight file or
+# checkpoint, trainer ledger resume with ZERO re-consumed shard-epoch
+# units, recovery to the next promotion within a paced-round bound (never
+# wall-clock), the serve SLO green throughout, and a byte-stable summary.
+# Hermetic: CPU, loopback only, compile cache off, one JAX process, zero
+# SIGKILLs (disco_tpu/runs/endure.py).
+endure-check:
+	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= DISCO_TPU_COMPILE_CACHE=off \
+	    $(PYTHON) -m disco_tpu.runs.endure
 
 coverage:
 	$(PYTHON) -m coverage run --branch --source=disco_tpu -m pytest tests/ -q

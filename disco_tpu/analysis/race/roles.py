@@ -79,6 +79,7 @@ ROLES = {
                 "disco_tpu.promote.check:main",
                 "disco_tpu.obs.scope:main",
                 "disco_tpu.runs.soak:main",
+                "disco_tpu.runs.endure:main",
             ),
             jax_ok=True,
             summary="the process main thread: CLI/check mains + the "
@@ -238,6 +239,14 @@ ATTR_TYPES = {
         "disco_tpu.promote.controller:PromotionController",
     "disco_tpu.promote.controller:PromotionController.store":
         "disco_tpu.promote.store:GenerationStore",
+    # the co-resident trainer: driven by the dispatch thread between
+    # ticks (scheduler.resident.step), lifecycle by main (server start/
+    # stop) — both roles are jax_ok, which is what makes a trainer ON the
+    # dispatch thread legal under the single-chip-claim contract
+    "disco_tpu.serve.scheduler:Scheduler.resident":
+        "disco_tpu.flywheel.resident:ResidentTrainer",
+    "disco_tpu.serve.server:EnhanceServer.resident":
+        "disco_tpu.flywheel.resident:ResidentTrainer",
 }
 
 

@@ -165,10 +165,14 @@ def summarize(events: list[dict]) -> dict:
             "batch_occupancy": gvals.get("batch_occupancy"),
             "latency_ms": histograms.get("serve_block_latency_ms"),
         }
-    # -- flywheel section: corpus-tap spool + shard-training telemetry
+    # -- flywheel section: corpus-tap spool + shard-training telemetry,
+    # plus the resident trainer's generation/throttle lifecycle
     tap_events = [e for e in events if e["kind"] == "tap"]
+    gen_events = [e for e in events if e["kind"] == "generation"]
+    throttle_events = [e for e in events if e["kind"] == "train_throttled"]
     flywheel = None
-    if tap_events or any(k.startswith(("tap_", "shards_")) for k in cvals):
+    if (tap_events or gen_events or throttle_events
+            or any(k.startswith(("tap_", "shards_")) for k in cvals)):
         flywheel = {
             "tap_blocks": int(cvals.get("tap_blocks", 0)),
             "tap_dropped": int(cvals.get("tap_dropped", 0)),
@@ -178,6 +182,19 @@ def summarize(events: list[dict]) -> dict:
             "train_steps": int(cvals.get("train_steps", 0)),
             "rotations": sum(1 for e in tap_events
                              if e["attrs"].get("action") == "shard"),
+            "generations_published": sum(
+                1 for e in gen_events
+                if e["attrs"].get("action") == "published"),
+            "generations_refused": sum(
+                1 for e in gen_events
+                if e["attrs"].get("action") == "refused"),
+            "last_generation": next(
+                (e["attrs"] for e in reversed(gen_events)
+                 if e["attrs"].get("action") == "published"), None),
+            "throttle_pauses": sum(
+                1 for e in throttle_events
+                if e["attrs"].get("action") == "paused"),
+            "throttled_ticks": int(cvals.get("train_throttled_ticks", 0)),
         }
     # -- per-label recompile table: the log's own jit_trace events are the
     # run's truth (per-log scope); the jit_recompiles{label} counter series
@@ -313,6 +330,21 @@ def render_report(summary: dict) -> str:
             f"flywheel train: {fw['train_steps']} steps  "
             f"corrupt shards skipped={fw['shards_skipped']}"
         )
+        if fw.get("generations_published") or fw.get("generations_refused"):
+            last = fw.get("last_generation") or {}
+            tail = (f"  last={last.get('gen')} (serial {last.get('serial')}, "
+                    f"epoch {last.get('epoch')})" if last else "")
+            lines.append(
+                f"flywheel generations: published="
+                f"{fw['generations_published']}  "
+                f"refused={fw['generations_refused']}{tail}"
+            )
+        if fw.get("throttle_pauses") or fw.get("throttled_ticks"):
+            lines.append(
+                f"flywheel throttle: pauses={fw['throttle_pauses']}  "
+                f"throttled ticks={fw['throttled_ticks']} "
+                "(ladder rung >= trainer threshold)"
+            )
     if summary.get("spans"):
         lines.append(
             f"causal spans: {summary['spans']} over {summary['n_traces']} "
@@ -494,6 +526,7 @@ def compare_records(old: dict, new: dict, threshold: float = 0.05) -> dict:
         ("serve_p95_ms", False),
         ("train_steps_per_s", True),
         ("tap_blocks_per_s", True),
+        ("flywheel_generations", True),
         ("latency_ms_frame", False),
         ("dispatch_overhead_ms", False),
         ("span_overhead_ns", False),
@@ -555,12 +588,13 @@ def compare_records(old: dict, new: dict, threshold: float = 0.05) -> dict:
         ("serve_blocks_per_s", "serve", "blocks/s", True, None),
         ("train_steps_per_s", "train", "steps/s", True, None),
         ("tap_blocks_per_s", "tap", "blocks/s", True, None),
-        # promotion lanes: rollout latency (lower is better; CPU smoke
+        # flywheel lanes: promotion latency (lower is better; CPU smoke
         # rollouts run whole canary windows, so floor sub-10s jitter) and
-        # the completed-rollout count (a candidate that LOST the lane —
+        # the live-loop generation count (a candidate that LOST a lane —
         # None against a measured baseline — is the regression that
-        # matters, not the count itself)
+        # matters, not the counts themselves)
         ("tap_to_promotion_ms", "tap-to-promotion", "ms", False, 10_000.0),
+        ("flywheel_generations", "generations", "", True, None),
         ("model_promotions", "promotions", "", True, None),
         ("span_overhead_ns", "span-overhead", "ns", False, 1000.0),
         ("mfu", "mfu", "", True, None),

@@ -25,6 +25,12 @@ The production seams are the shared ones from :mod:`disco_tpu.cli.common`:
   rotating training shards on a host-only background thread — overflow
   drops-and-counts, serving never backpressures; train on the shards
   with ``disco-train --shards``.
+* ``--train`` closes the loop inside ONE process: the co-resident trainer
+  (``disco_tpu.flywheel.resident``) consumes the ``--tap-dir`` shards as
+  bounded train-step slices interleaved on the dispatch thread, publishes
+  generations into ``--promote-dir`` on a cadence, throttles under ladder
+  distress and resumes from its ledger after any crash — the continuous
+  serve→train→promote flywheel ``make endure-check`` drills.
 
 No reference counterpart: the reference pipeline is strictly offline
 (SURVEY.md §2); this is the ROADMAP's "serves heavy traffic" entry point.
@@ -168,6 +174,55 @@ def build_parser():
                    default=True,
                    help="do not judge the disco-obs slo serve targets in "
                         "the promotion gate (with --promote-dir)")
+    p.add_argument("--gen-gc-keep", type=int, default=None, metavar="N",
+                   help="bound the generation store after each promotion: "
+                        "keep ACTIVE, the rollout's incumbent, every "
+                        "generation pinned by a live session or in-flight "
+                        "rollout, and the last N by staging order; collect "
+                        "the rest (with --promote-dir; default: no GC — "
+                        "the store grows without bound)")
+    p.add_argument("--train", action="store_true",
+                   help="run the co-resident trainer (disco_tpu.flywheel."
+                        "resident): train-step slices interleaved on the "
+                        "dispatch thread between serve ticks, consuming the "
+                        "--tap-dir shards with ledger-verified resume and "
+                        "publishing generations into --promote-dir on a "
+                        "cadence; ladder-throttled (see "
+                        "--train-throttle-rung), crash-restartable from "
+                        "--train-dir (requires --tap-dir)")
+    p.add_argument("--train-dir", default=None, metavar="DIR",
+                   help="the resident trainer's working directory (ledger "
+                        "+ rolling atomic checkpoint; default: "
+                        "<--tap-dir>/resident)")
+    p.add_argument("--train-batch-size", type=int, default=8,
+                   help="resident trainer batch size")
+    p.add_argument("--train-steps-per-tick", type=int, default=4,
+                   help="train-step budget per scheduler tick — the "
+                        "interleaving grain against serve dispatch")
+    p.add_argument("--train-publish-every", type=int, default=1,
+                   metavar="EPOCHS",
+                   help="publish cadence in completed epochs (with "
+                        "--promote-dir)")
+    p.add_argument("--train-publish", choices=["improved", "always"],
+                   default="improved",
+                   help="publish policy: 'improved' stages only "
+                        "best-so-far epochs (the fit() gate), 'always' "
+                        "stages every cadence epoch")
+    p.add_argument("--train-throttle-rung", type=int, default=1,
+                   help="degradation-ladder rung at/above which a tick "
+                        "trains ZERO steps (serve overload pauses training "
+                        "before it costs serve SLOs)")
+    p.add_argument("--train-win-len", type=int, default=None,
+                   help="frames per training window (default: the tapped "
+                        "block length; must fit inside one block)")
+    p.add_argument("--train-max-epochs", type=int, default=None,
+                   help="stop training after this many completed epochs "
+                        "(default: train as long as the server runs)")
+    p.add_argument("--train-recent-shards", type=int, default=None,
+                   metavar="N",
+                   help="sliding-window corpus: each epoch consumes only "
+                        "the newest N tap shards (default: the whole "
+                        "directory — epoch cost then grows with uptime)")
     add_tap_args(p)
     add_fault_args(p)
     add_preflight_arg(p, what="the server")
@@ -205,7 +260,30 @@ def main(argv=None):
                 canary_frac=args.canary_frac,
                 sdr_gate_db=args.sdr_gate_db,
                 slo_gate=args.slo_gate,
+                gc_keep_last=args.gen_gc_keep,
                 watch_dir=Path(args.promote_dir) / "incoming",
+            )
+        resident = None
+        if args.train:
+            if not args.tap_dir:
+                raise SystemExit("--train needs --tap-dir (the shard "
+                                 "directory the trainer consumes)")
+            from pathlib import Path
+
+            from disco_tpu.flywheel.resident import ResidentTrainer
+
+            resident = ResidentTrainer(
+                args.tap_dir,
+                args.train_dir or Path(args.tap_dir) / "resident",
+                promote_dir=args.promote_dir,
+                batch_size=args.train_batch_size,
+                win_len=args.train_win_len,
+                steps_per_tick=args.train_steps_per_tick,
+                publish_every=args.train_publish_every,
+                publish=args.train_publish,
+                throttle_rung=args.train_throttle_rung,
+                max_epochs=args.train_max_epochs,
+                recent_shards=args.train_recent_shards,
             )
         srv = EnhanceServer(
             host=args.host, port=args.port, unix_path=args.unix,
@@ -226,8 +304,10 @@ def main(argv=None):
             tick_deadline_s=args.tick_deadline,
             ladder=args.ladder,
             promote=promote,
+            resident=resident,
             run_info={"preflight": preflight, "state_dir": args.state_dir,
                       "promote_dir": args.promote_dir,
+                      "train": bool(args.train),
                       "max_sessions": args.max_sessions,
                       "blocks_per_super_tick": args.blocks_per_super_tick,
                       "park_ttl_s": args.park_ttl,
@@ -247,6 +327,12 @@ def main(argv=None):
                       f"{stats['blocks_accepted']} block(s) spooled, "
                       f"{stats['blocks_dropped']} dropped under "
                       f"{args.tap_dir}")
+            if resident is not None:
+                st = resident.stats()
+                print(f"resident trainer: {st['epochs_done']} epoch(s), "
+                      f"{st['steps_total']} step(s), "
+                      f"{st['generations_published']} generation(s) "
+                      f"published")
         if stopped():
             n = len(srv.checkpoints)
             where = f" under {args.state_dir}" if n else ""

@@ -19,6 +19,11 @@ mask) traffic.  This package closes the loop end to end:
   digests → a ``mid_write`` chaos crash that must leave no torn shard →
   dataset resume → data-parallel training with loss parity against the
   single-device oracle.
+* :mod:`disco_tpu.flywheel.resident` — :class:`ResidentTrainer`, the
+  co-resident trainer: bounded train-step slices interleaved on the
+  serve scheduler's dispatch thread (one jax process, one chip claim),
+  ledger-restartable, ladder-throttled, publishing generations through
+  the promote store on a cadence; drilled by ``make endure-check``.
 
 The training side (mesh-sharded ``NamedSharding(mesh, P("batch"))`` data
 parallelism and the opt-in bf16 lane) lives in
@@ -32,6 +37,7 @@ No reference counterpart: the reference has neither a serving layer nor
 any path from deployment traffic back into training (SURVEY.md §2).
 """
 from disco_tpu.flywheel.dataset import ShardDataset, peek_geometry, unit_shard_epoch
+from disco_tpu.flywheel.resident import ResidentTrainer
 from disco_tpu.flywheel.shards import (
     RECORD_ARRAYS,
     SHARD_SUFFIX,
@@ -49,6 +55,7 @@ __all__ = [
     "CorpusTap",
     "MANIFEST_NAME",
     "RECORD_ARRAYS",
+    "ResidentTrainer",
     "SHARD_SUFFIX",
     "SHARD_VERSION",
     "ShardDataset",

@@ -139,7 +139,8 @@ class ShardDataset:
 
     # -- the batch stream ----------------------------------------------------
     def batches(self, batch_size: int, *, epoch: int = 0, shuffle: bool = True,
-                ledger=None, drop_last: bool = True):
+                ledger=None, drop_last: bool = True,
+                recent: int | None = None):
         """Yield ``(x, y)`` numpy batches for one epoch.
 
         Batches never cross shard boundaries (the streaming property: one
@@ -151,6 +152,12 @@ class ShardDataset:
         ``ledger``: a :class:`~disco_tpu.runs.RunLedger` (or path) arms
         verified resume — consumed shards are recorded per epoch and
         skipped when their digest still matches on replay.
+
+        ``recent``: sliding-window corpus — consume only the newest this
+        many shards (by shard number) this epoch.  A continuous trainer
+        over an ever-growing tap directory needs it: without a window each
+        epoch re-reads the WHOLE history, so epoch cost grows linearly
+        with uptime and training eventually falls behind serving.
 
         No reference counterpart (module docstring).
         """
@@ -166,6 +173,10 @@ class ShardDataset:
             if ledger is not None:
                 done, _requeued = ledger.verified_done()
             paths = self.shard_paths()
+            if recent is not None:
+                if int(recent) < 1:
+                    raise ValueError(f"recent must be >= 1, got {recent}")
+                paths = paths[-int(recent):]
             if shuffle:
                 order = np.random.default_rng([self.seed, int(epoch)]).permutation(len(paths))
                 paths = [paths[i] for i in order]

@@ -81,7 +81,14 @@ class CorpusTap:
         self.ledger = RunLedger(self.tap_dir / MANIFEST_NAME)
         self._q: queue_mod.Queue = queue_mod.Queue(maxsize=max_queue_blocks)
         self._buf: list[dict] = []
-        self._shard_seq = 0
+        # resume numbering after any shards already on disk: a restarted
+        # server over the same tap dir (crash recovery, the resident
+        # trainer's endurance campaign) must append, never overwrite shard
+        # 1 — an overwrite would also void the manifest's recorded digest
+        self._shard_seq = max(
+            (int(p.name[len("tap-"):len("tap-") + 6])
+             for p in self.tap_dir.glob(f"tap-??????{SHARD_SUFFIX}")),
+            default=0)
         self._closing = False
         self._crashed: BaseException | None = None
         self._lock = threading.Lock()

@@ -54,6 +54,8 @@ EVENT_KINDS = frozenset(
         "promotion",    # a weight generation staged/adopted/promoted (promote/)
         "canary",       # canary window lifecycle (attrs: action=assign/score/window)
         "rollback",     # a demoted candidate rolled back (attrs: reason, failing metric)
+        "generation",   # resident trainer published a generation (flywheel/resident)
+        "train_throttled",  # ladder rung paused/resumed resident training
         "note",         # freeform annotation
     }
 )

@@ -92,6 +92,12 @@ class PromotionController:
         (``*.msgpack``; a sibling ``<stem>.ledger.jsonl`` or
         ``ledger.jsonl`` is consulted for the mid-epoch refusal).
       poll_s: controller step period.
+      gc_keep_last: arm bounded generation retention — after every
+        successful promotion, :meth:`~disco_tpu.promote.store.
+        GenerationStore.collect` keeps ACTIVE, the just-replaced
+        incumbent, every generation a live/parked session still
+        references or an in-flight rollout names, and the last N staged;
+        None (default) = the store grows without bound.
 
     No reference counterpart (module docstring).
     """
@@ -100,11 +106,14 @@ class PromotionController:
                  sdr_gate_db: float | None = None, slo_gate: bool = True,
                  slo_targets: dict | None = None, window_blocks: int = 32,
                  min_scores: int = 2, gate_timeout_s: float = 120.0,
-                 watch_dir=None, poll_s: float = 0.05):
+                 watch_dir=None, poll_s: float = 0.05,
+                 gc_keep_last: int | None = None):
         if not 0.0 <= float(canary_frac) <= 1.0:
             raise ValueError(f"canary_frac must be in [0, 1], got {canary_frac}")
         if int(window_blocks) < 1:
             raise ValueError(f"window_blocks must be >= 1, got {window_blocks}")
+        if gc_keep_last is not None and int(gc_keep_last) < 0:
+            raise ValueError(f"gc_keep_last must be >= 0, got {gc_keep_last}")
         self.store = store if isinstance(store, GenerationStore) else GenerationStore(store)
         self.canary_frac = float(canary_frac)
         self.sdr_gate_db = None if sdr_gate_db is None else float(sdr_gate_db)
@@ -115,6 +124,7 @@ class PromotionController:
         self.gate_timeout_s = float(gate_timeout_s)
         self.watch_dir = Path(watch_dir) if watch_dir is not None else None
         self.poll_s = float(poll_s)
+        self.gc_keep_last = None if gc_keep_last is None else int(gc_keep_last)
 
         self.scheduler = None
         self.crashed: BaseException | None = None
@@ -324,8 +334,14 @@ class PromotionController:
                 obs_events.record("promotion", stage="stage", action="refused",
                                   path=path.name, unit=e.unit, reason=str(e))
                 continue
+            with self._lock:
+                queued = self._phase != "idle"
+            # a candidate landing mid-rollout is QUEUED, not dropped: it is
+            # staged now and picked up by _maybe_begin_rollout at the next
+            # idle step (newest-wins — see the superseded marking there)
             obs_events.record("promotion", stage="stage", action="staged",
-                              gen=gen.gen_id, serial=gen.serial, path=path.name)
+                              gen=gen.gen_id, serial=gen.serial,
+                              path=path.name, queued=queued)
 
     # -- phase steps -----------------------------------------------------------
     def _maybe_begin_rollout(self) -> None:
@@ -334,7 +350,7 @@ class PromotionController:
             return
         latest = self._ledger.replay()
         active_serial = self.store.get(active).serial
-        candidate = None
+        eligible = []
         for gen_id in self.store.list_ids():       # staging (serial) order
             if gen_id == active:
                 continue
@@ -344,9 +360,22 @@ class PromotionController:
             rec = latest.get(rollout_unit(gen_id))
             if rec is not None and rec["state"] in ("done", "failed"):
                 continue                            # already decided — never retried
-            candidate = self.store.get(gen_id)
-        if candidate is None:
+            eligible.append(self.store.get(gen_id))
+        if not eligible:
             return
+        candidate = eligible[-1]                    # newest wins
+        for stale in eligible[:-1]:
+            # decide the older queued candidates DURABLY: without a
+            # terminal record a failed rollout of the newest would let an
+            # already-obsolete generation roll out on the next idle step
+            self._ledger.mark_failed(
+                rollout_unit(stale.gen_id),
+                error=f"superseded by {candidate.gen_id}",
+                phase="superseded", superseded_by=candidate.gen_id)
+            obs_registry.counter("candidates_superseded").inc()
+            obs_events.record("promotion", stage="rollout", action="superseded",
+                              gen=stale.gen_id, serial=stale.serial,
+                              by=candidate.gen_id)
         unit = rollout_unit(candidate.gen_id)
         self._ledger.record(unit, "in_flight", phase="canary",
                             candidate=candidate.gen_id, incumbent=active,
@@ -514,7 +543,33 @@ class PromotionController:
                           latency_ms=round(latency_ms, 3))
         self._trace = obs_trace.span("promote_swap", self._trace,
                                      gen=cand.gen_id, action="promote")
+        self._collect_generations()
         self._reset_to_idle()
+
+    def _collect_generations(self) -> None:
+        """Bounded-retention sweep after a successful promotion (only when
+        ``gc_keep_last`` is set).  Pins the just-replaced incumbent plus
+        every generation a live or parked session still references — the
+        dispatch thread may deliver from them until the park boundary;
+        :meth:`~disco_tpu.promote.store.GenerationStore.collect` itself
+        pins ACTIVE and any in-flight rollout's sides.  A GC failure must
+        never break the rollout path: it is demoted to a warning event.
+
+        No reference counterpart (module docstring)."""
+        if self.gc_keep_last is None:
+            return
+        with self._lock:
+            pins = {self._incumbent}
+        sched = self.scheduler
+        if sched is not None:
+            pins |= {s.generation for s in sched.sessions()}
+            pins |= {s.generation for s in sched.parked_sessions()}
+        pins.discard(None)
+        try:
+            self.store.collect(keep_last=self.gc_keep_last, pinned=pins)
+        except Exception as e:  # noqa: BLE001 — GC is best-effort
+            obs_events.record("warning", stage="promote", action="gc_failed",
+                              error=f"{type(e).__name__}: {e}")
 
     def _begin_rollback(self, checks: list) -> None:
         failing = next(c for c in checks if not c["ok"])

@@ -182,12 +182,27 @@ class GenerationStore:
         variables = {k: payload[k] for k in WEIGHT_KEYS}
         extra = {"source_ckpt": str(ckpt_path),
                  "source_ckpt_digest": file_digest(ckpt_path)}
-        for k in ("val_loss", "train_loss", "epochs_done"):
+        import numpy as np
+
+        n_done = None
+        if "epochs_done" in payload:
+            try:
+                n_done = int(np.asarray(payload["epochs_done"]).reshape(()))
+                extra["epochs_done"] = float(n_done)
+            except (TypeError, ValueError):
+                pass
+        for k in ("val_loss", "train_loss"):
+            # the payload carries the whole (zero-padded) loss HISTORY —
+            # the meta scalar is the last completed epoch's value
             if k in payload:
                 try:
-                    extra[k] = float(payload[k])
+                    hist = np.asarray(payload[k], np.float64).reshape(-1)
                 except (TypeError, ValueError):
-                    pass
+                    continue
+                if n_done is not None:
+                    hist = hist[:n_done]
+                if hist.size:
+                    extra[k] = float(hist[-1])
         return self.stage_variables(variables, arch=arch, source=source,
                                     **extra)
 
@@ -292,6 +307,61 @@ class GenerationStore:
         No reference counterpart (module docstring)."""
         self.get(gen_id)   # unknown/incomplete generations must not go live
         write_bytes_atomic(self.root / ACTIVE_FILE, (gen_id + "\n").encode())
+
+    # -- retention -------------------------------------------------------------
+    def collect(self, *, keep_last: int, pinned=()) -> list:
+        """Bounded retention: delete every complete generation EXCEPT the
+        ACTIVE one, the ``keep_last`` most recently staged (by serial),
+        the candidate/incumbent of any rollout whose ledger unit is still
+        undecided (``in_flight`` — a crash mid-rollout must always find
+        both sides of the swap on disk), and anything in ``pinned`` (the
+        caller's live-session generation refs).  Returns the collected
+        gen_ids, oldest first; ticks the ``generations_collected``
+        counter and records one ``promotion`` ``action="collected"`` obs
+        event per sweep that removed anything.
+
+        Opt-in only — nothing in the store calls this on its own.  Under
+        a continuous trainer the store otherwise grows one immutable
+        generation per publish cadence, without bound.
+
+        No reference counterpart (module docstring).
+        """
+        import shutil
+
+        from disco_tpu.obs import events as obs_events
+        from disco_tpu.obs.metrics import REGISTRY as obs_registry
+
+        if keep_last < 0:
+            raise ValueError(f"keep_last must be >= 0, got {keep_last}")
+        ids = self.list_ids()      # serial order, oldest first
+        keep = set(pinned)
+        active = self.active()
+        if active is not None:
+            keep.add(active)
+        keep.update(ids[len(ids) - keep_last:] if keep_last else ())
+        for unit, rec in RunLedger(self.root / "rollouts.jsonl").replay().items():
+            if not unit.startswith("rollout:") or rec["state"] != "in_flight":
+                continue
+            keep.add(unit.split(":", 1)[1])
+            incumbent = (rec.get("attrs") or {}).get("incumbent")
+            if incumbent:
+                keep.add(incumbent)
+        collected = []
+        for gen_id in ids:
+            if gen_id in keep:
+                continue
+            # meta first: a crash mid-delete leaves an INCOMPLETE dir
+            # (no meta.json), which every reader already treats as absent
+            gen_dir = self.root / "generations" / gen_id
+            (gen_dir / "meta.json").unlink(missing_ok=True)
+            shutil.rmtree(gen_dir, ignore_errors=True)
+            collected.append(gen_id)
+        if collected:
+            obs_registry.counter("generations_collected").inc(len(collected))
+            obs_events.record("promotion", stage="promote",
+                              action="collected", gens=collected,
+                              keep_last=int(keep_last), kept=len(keep))
+        return collected
 
     # -- the rollout ledger ----------------------------------------------------
     def rollout_ledger(self) -> RunLedger:

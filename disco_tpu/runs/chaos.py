@@ -48,6 +48,16 @@ Seams wired through the pipeline (each a named :func:`tick` call):
 * ``post_gate``      — after the gate verdict (promote or demote) is
   computed but before the ledger records it: the classic
   decided-but-not-durable window.
+* ``pre_publish``    — inside the resident trainer
+  (``flywheel/resident.py``): an epoch's checkpoint is durable and its
+  ledger unit done, but the generation has not been staged yet — a crash
+  here must resume WITHOUT re-consuming the epoch's shards and the
+  restart must still publish the checkpoint (no lost generation).
+* ``between_generations`` — after one generation is fully published
+  (``flywheel/resident.py``): the clean boundary between two
+  generations — a crash here must leave the store with only complete,
+  digest-verified generations and the trainer resumable at the next
+  epoch.
 
 Injection is armed either programmatically (:func:`configure`) or via the
 ``DISCO_TPU_CHAOS`` environment variable (``"seam"`` or ``"seam:N"`` —
@@ -87,6 +97,8 @@ SEAMS = frozenset(
         "pre_swap",        # serve/scheduler.py, swap decided but not yet applied
         "mid_canary",      # promote/controller.py, canary window open, scores partial
         "post_gate",       # promote/controller.py, verdict reached, ledger not yet final
+        "pre_publish",     # flywheel/resident.py, checkpoint done, generation not staged
+        "between_generations",  # flywheel/resident.py, one generation fully published
     }
 )
 

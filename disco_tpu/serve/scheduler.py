@@ -211,7 +211,7 @@ class Scheduler:
                  max_quarantines: int = 2,
                  shed_retry_after_s: float = 1.0,
                  wait_window_ticks: int = 50,
-                 ladder=None, state_dir=None, promote=None):
+                 ladder=None, state_dir=None, promote=None, resident=None):
         if max_sessions < 1 or max_queue_blocks < 1 or max_blocks_per_tick < 1:
             raise ValueError("scheduler bounds must be >= 1")
         if blocks_per_super_tick < 1:
@@ -299,6 +299,15 @@ class Scheduler:
         self._gen_models: dict = {}
         if promote is not None:
             promote.bind(self)
+        #: optional co-resident trainer (flywheel/resident.py).  Stepped at
+        #: the END of every tick — after serving work is dispatched and the
+        #: ladder has folded this tick's metrics — with the current rung,
+        #: so an overloaded tick trains ZERO steps (the ladder-aware
+        #: contract).  All of the trainer's jax work happens inside that
+        #: call, i.e. on this dispatch thread: the single-chip-claim
+        #: contract needs no new jax_ok role.  None = training off and the
+        #: seam is one attribute check per tick.
+        self.resident = resident
         self.draining = False
         self._lock = threading.Lock()
         self._sessions: dict[str, Session] = {}
@@ -1006,6 +1015,14 @@ class Scheduler:
                     and session.queue_depth() == 0 and session.inflight == 0):
                 self._finish(session)
         self._step_ladder(deadline_hits)
+        if self.resident is not None and not self.draining:
+            # the co-resident trainer's slice rides the tail of the tick:
+            # serving work for this tick is fully dispatched and read back,
+            # and the ladder has already folded this tick's distress — a
+            # rung at/above the trainer's throttle threshold trains nothing
+            self.resident.step(
+                tick_no=self.tick_no,
+                rung=self.ladder.rung if self.ladder is not None else 0)
         self._set_gauges()
         return deliveries
 

@@ -104,6 +104,7 @@ class EnhanceServer:
                  sock_sndbuf: int | None = None,
                  write_buffer_high: int | None = None,
                  promote=None,
+                 resident=None,
                  run_info: dict | None = None):
         self.host, self.port, self.unix_path = host, port, unix_path
         if ladder is True:
@@ -123,12 +124,18 @@ class EnhanceServer:
             tick_deadline_s=tick_deadline_s,
             quarantine_ticks=quarantine_ticks,
             ladder=ladder, state_dir=state_dir, promote=promote,
+            resident=resident,
         )
         #: optional PromotionController — started/stopped with the server
         #: (its thread never enters jax; swaps execute on the dispatch
         #: thread).  A pre-built scheduler brings its own.
         self.promote = (promote if promote is not None
                         else getattr(self.scheduler, "promote", None))
+        #: optional co-resident trainer — stepped by the scheduler at the
+        #: tail of every tick (dispatch thread), closed when the server
+        #: stops.  A pre-built scheduler brings its own.
+        self.resident = (resident if resident is not None
+                         else getattr(self.scheduler, "resident", None))
         #: connection drops / mid-frame protocol truncations PARK the
         #: session (resume token, bounded TTL, bit-exact reattach) instead
         #: of evicting; False restores the old evict-on-drop behavior
@@ -670,6 +677,10 @@ class EnhanceServer:
         if self.promote is not None:
             self.promote.stop()
             self.promote.wait(timeout_s=5.0)
+        if self.resident is not None:
+            # the dispatch thread (its only stepper) is dead by here, so
+            # the flag-only close cannot race a running slice
+            self.resident.close()
         if self.crashed is not None:
             crash, self.crashed = self.crashed, None
             raise crash

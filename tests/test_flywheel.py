@@ -174,6 +174,49 @@ def test_dataset_ledger_resume_skips_consumed_shards(tmp_path, rng):
     )
 
 
+def test_dataset_recent_window_reads_only_newest_shards(tmp_path, rng):
+    """The sliding-window corpus knob: ``recent=N`` must consume exactly
+    the N newest shards (by shard number) — the contract that keeps a
+    continuous trainer's epoch cost bounded as the tap directory grows."""
+    tap_dir = _fill_tap_dir(tmp_path, rng, n_blocks=7, records_per_shard=3)
+    ds = ShardDataset(tap_dir, win_len=4, seed=7)
+    shards = list_shards(tap_dir)  # [3, 3, 1] records
+    led = tmp_path / "led.jsonl"
+    assert list(ds.batches(4, epoch=0, ledger=led, recent=2))
+    from disco_tpu.runs.ledger import RunLedger
+    done, _ = RunLedger(led).verified_done(requeue=False)
+    touched = {u.split(":")[1] for u in done}
+    assert touched == {p.name for p in shards[-2:]}  # oldest shard untouched
+    # a window wider than the directory degrades to the full corpus
+    assert len(list(ds.batches(4, epoch=1, recent=99))) == len(
+        list(ds.batches(4, epoch=1)))
+    with pytest.raises(ValueError):
+        next(ds.batches(4, epoch=0, recent=0))
+
+
+def test_tap_shard_numbering_resumes_after_restart(tmp_path, rng):
+    """A second CorpusTap over the same directory (crash recovery, the
+    resident trainer's endurance campaign) must APPEND after the highest
+    on-disk shard number — an overwrite of tap-000001 would both lose data
+    and void the manifest's recorded digest for that name."""
+    from disco_tpu.runs.ledger import RunLedger
+
+    tap_dir = _fill_tap_dir(tmp_path, rng, n_blocks=3, records_per_shard=3)
+    first = [p.name for p in list_shards(tap_dir)]
+    tap = CorpusTap(tap_dir, records_per_shard=3)
+    for i in range(3):
+        b = _block(rng, seq=i, session="s2")
+        assert tap.offer("s2", i, b["Y"], b["mask_z"], b["mask_w"], b["yf"])
+    tap.close()
+    names = [p.name for p in list_shards(tap_dir)]
+    assert names[: len(first)] == first and len(names) == len(first) + 1
+    assert len(set(names)) == len(names)
+    # every shard — both generations — still digest-verifies in the manifest
+    done, requeued = RunLedger(tap_dir / "manifest.jsonl").verified_done(
+        requeue=False)
+    assert len(done) == len(names) and not requeued
+
+
 def test_dataset_skips_corrupt_shard_with_warning(tmp_path, rng):
     from disco_tpu import obs
 

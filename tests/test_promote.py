@@ -273,6 +273,178 @@ def test_controller_rejects_bad_knobs(tmp_path):
         PromotionController(tmp_path / "p", canary_frac=1.5)
     with pytest.raises(ValueError, match="window_blocks"):
         PromotionController(tmp_path / "p", window_blocks=0)
+    with pytest.raises(ValueError, match="gc_keep_last"):
+        PromotionController(tmp_path / "p", gc_keep_last=-1)
+
+
+# ------------------------------------------------------------ the generation GC
+def test_collect_keeps_active_recent_and_pinned(tmp_path):
+    store = GenerationStore(tmp_path / "promote")
+    gens = [store.stage_variables(_fake_variables(float(i)), arch=ARCH)
+            for i in range(5)]
+    store.set_active(gens[2].gen_id)
+    c0 = obs_registry.counter("generations_collected").value
+
+    with pytest.raises(ValueError, match="keep_last"):
+        store.collect(keep_last=-1)
+    collected = store.collect(keep_last=1, pinned={gens[0].gen_id})
+    # keeps: g2 (ACTIVE), g4 (last 1), g0 (pinned) — collects g1, g3
+    assert collected == [gens[1].gen_id, gens[3].gen_id]
+    assert store.list_ids() == [gens[0].gen_id, gens[2].gen_id,
+                                gens[4].gen_id]
+    assert obs_registry.counter("generations_collected").value - c0 == 2
+    store.load(gens[2].gen_id)  # survivors still digest-verify
+    with pytest.raises(FileNotFoundError):
+        store.get(gens[1].gen_id)
+    # idempotent: a second sweep has nothing left to take
+    assert store.collect(keep_last=1, pinned={gens[0].gen_id}) == []
+
+
+def test_collect_refuses_inflight_rollout_sides(tmp_path):
+    """A crash mid-rollout must always find BOTH sides of the swap on
+    disk: the candidate and incumbent named by an undecided (in_flight)
+    rollout unit are unpinnable until the rollout is decided."""
+    store = GenerationStore(tmp_path / "promote")
+    g1, g2, g3, g4 = (store.stage_variables(_fake_variables(float(i)),
+                                            arch=ARCH) for i in range(4))
+    store.set_active(g4.gen_id)
+    led = store.rollout_ledger()
+    led.record(rollout_unit(g3.gen_id), "in_flight", phase="canary",
+               candidate=g3.gen_id, incumbent=g1.gen_id)
+    led.close()
+    collected = store.collect(keep_last=0)
+    # keeps: g4 (ACTIVE), g3 (in-flight candidate), g1 (its incumbent)
+    assert collected == [g2.gen_id]
+    assert store.list_ids() == [g1.gen_id, g3.gen_id, g4.gen_id]
+
+    # decided rollouts release their pins
+    led = store.rollout_ledger()
+    led.mark_failed(rollout_unit(g3.gen_id), error="demoted",
+                    phase="rolled_back")
+    led.close()
+    assert store.collect(keep_last=0) == [g1.gen_id, g3.gen_id]
+    assert store.list_ids() == [g4.gen_id]
+
+
+# ------------------------------------------------------- mid-rollout queueing
+@pytest.mark.parametrize("phase", ["canary", "gating", "promoting",
+                                   "rolling_back"])
+def test_candidate_arriving_mid_rollout_is_queued_not_dropped(tmp_path, phase):
+    """The queueing regression: a candidate staged while a rollout is in
+    ANY phase must neither hijack the in-flight rollout nor be silently
+    ignored — it rolls out at the next idle step (here: after the current
+    rollout fails, the harder case for the serial guard)."""
+    store = GenerationStore(tmp_path / "promote")
+    g1 = store.stage_variables(_fake_variables(0.0), arch=ARCH)
+    g2 = store.stage_variables(_fake_variables(1.0), arch=ARCH)
+    store.set_active(g1.gen_id)
+
+    ctl = PromotionController(store, poll_s=0.01)
+    try:
+        ctl._maybe_begin_rollout()
+        assert ctl._candidate.gen_id == g2.gen_id
+        with ctl._lock:
+            ctl._phase = phase           # simulate rollout progress
+        g3 = store.stage_variables(_fake_variables(2.0), arch=ARCH)
+        # the arrival changed nothing mid-flight
+        assert ctl._candidate.gen_id == g2.gen_id
+
+        # the g2 rollout fails; g3 must still roll out afterwards
+        with ctl._lock:
+            ctl._fail_reason = "synthetic demotion"
+        ctl._finish_rollback()
+        assert ctl._phase == "idle"
+        ctl._maybe_begin_rollout()
+        assert ctl._candidate.gen_id == g3.gen_id
+        rec = ctl._ledger.replay()[rollout_unit(g3.gen_id)]
+        assert rec["state"] == "in_flight"
+        assert rec["attrs"]["incumbent"] == g1.gen_id
+    finally:
+        ctl._ledger.close()
+
+
+def test_queued_candidates_dedupe_newest_wins(tmp_path):
+    """Several candidates queued behind one rollout: only the NEWEST rolls
+    out; the older ones are decided durably (superseded) so a failed
+    newest can never resurrect them."""
+    store = GenerationStore(tmp_path / "promote")
+    g1 = store.stage_variables(_fake_variables(0.0), arch=ARCH)
+    store.set_active(g1.gen_id)
+    g2 = store.stage_variables(_fake_variables(1.0), arch=ARCH)
+    g3 = store.stage_variables(_fake_variables(2.0), arch=ARCH)
+    # digest dedupe: re-staging g2's exact weights is NOT a new candidate
+    assert store.stage_variables(_fake_variables(1.0),
+                                 arch=ARCH).gen_id == g2.gen_id
+
+    c0 = obs_registry.counter("candidates_superseded").value
+    ctl = PromotionController(store, poll_s=0.01)
+    try:
+        ctl._maybe_begin_rollout()
+        assert ctl._candidate.gen_id == g3.gen_id   # newest wins
+        rec = ctl._ledger.replay()[rollout_unit(g2.gen_id)]
+        assert rec["state"] == "failed"
+        assert rec["attrs"]["superseded_by"] == g3.gen_id
+        assert obs_registry.counter("candidates_superseded").value - c0 == 1
+
+        # the newest FAILS: the superseded g2 stays decided — idle, no
+        # backwards rollout
+        with ctl._lock:
+            ctl._fail_reason = "synthetic demotion"
+        ctl._finish_rollback()
+        ctl._maybe_begin_rollout()
+        assert ctl._phase == "idle" and ctl._candidate is None
+    finally:
+        ctl._ledger.close()
+
+
+def test_watch_dir_arrival_mid_rollout_emits_queued_event(tmp_path):
+    from flax import serialization
+
+    from disco_tpu import obs
+
+    store = GenerationStore(tmp_path / "promote")
+    g1 = store.stage_variables(_fake_variables(0.0), arch=ARCH)
+    store.set_active(g1.gen_id)
+    watch = tmp_path / "incoming"
+    watch.mkdir()
+    ctl = PromotionController(store, poll_s=0.01, watch_dir=watch)
+    try:
+        with ctl._lock:
+            ctl._phase = "canary"        # a rollout is in flight
+        (watch / "cand.msgpack").write_bytes(serialization.msgpack_serialize(
+            serialization.to_state_dict(_fake_variables(0.5))))
+        log = tmp_path / "ev.jsonl"
+        with obs.recording(log):
+            ctl._scan_watch_dir()
+        (ev,) = [e for e in obs.read_events(log)
+                 if e["attrs"].get("action") == "staged"]
+        assert ev["attrs"]["queued"] is True
+        assert len(store.list_ids()) == 2  # staged now, decided later
+    finally:
+        ctl._ledger.close()
+
+
+def test_promotion_gc_sweeps_after_finish_promote(tmp_path):
+    """gc_keep_last wiring: a successful promotion sweeps the store,
+    keeping ACTIVE (the new generation) and the just-replaced incumbent."""
+    store = GenerationStore(tmp_path / "promote")
+    g1 = store.stage_variables(_fake_variables(0.0), arch=ARCH)
+    store.set_active(g1.gen_id)
+    g2 = store.stage_variables(_fake_variables(1.0), arch=ARCH)
+    g3 = store.stage_variables(_fake_variables(2.0), arch=ARCH)
+
+    ctl = PromotionController(store, poll_s=0.01, gc_keep_last=0)
+    try:
+        ctl._maybe_begin_rollout()       # g3 rolls out; g2 superseded
+        assert ctl._candidate.gen_id == g3.gen_id
+        ctl._finish_promote()
+        assert store.active() == g3.gen_id
+        # swept: g2 (superseded, undecided no more); kept: g3 (ACTIVE) and
+        # g1 (the incumbent pin — sessions may still deliver from it)
+        assert store.list_ids() == [g1.gen_id, g3.gen_id]
+        assert ctl._phase == "idle"
+    finally:
+        ctl._ledger.close()
 
 
 # -------------------------------------------------------------- the admission

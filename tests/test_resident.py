@@ -1,0 +1,227 @@
+"""Resident-trainer tests: the co-resident training slice that runs on the
+serve dispatch thread (disco_tpu/flywheel/resident).  The full serve +
+trainer + promotion-controller endurance campaign (multi-generation, with
+crashes at every seam) is gated by ``make endure-check``; these tests pin
+the trainer's three contracts in isolation: ladder-aware throttling,
+ledger-exact crash resume (zero re-consumed shard units, no torn
+checkpoint) and the idempotent publish bracket."""
+import json
+
+import numpy as np
+import pytest
+
+from disco_tpu import obs
+from disco_tpu.flywheel import ResidentTrainer, write_shard
+from disco_tpu.flywheel.resident import CKPT_NAME, LEDGER_NAME, unit_publish
+from disco_tpu.io.atomic import file_digest
+from disco_tpu.obs.metrics import REGISTRY as obs_registry
+from disco_tpu.promote.store import GenerationStore
+from disco_tpu.runs import chaos
+from disco_tpu.runs.ledger import RunLedger, unit_epoch
+
+K, C, F, T = 4, 2, 9, 8
+
+#: test_promote.py's tiny CRNN, shared so the jit/module caches hit.
+ARCH = dict(n_ch=1, win_len=4, n_freq=9, cnn_filters=(2,),
+            pool_kernels=((1, 2),), conv_padding=((0, 1),),
+            rnn_units=(4,), ff_units=(9,), rnn_dropouts=0.0)
+
+
+def _block(rng, seq=0, session="s"):
+    Y = (rng.standard_normal((K, C, F, T))
+         + 1j * rng.standard_normal((K, C, F, T))).astype(np.complex64)
+    yf = (rng.standard_normal((K, F, T))
+          + 1j * rng.standard_normal((K, F, T))).astype(np.complex64)
+    mz = rng.uniform(0.05, 0.95, (K, F, T)).astype(np.float32)
+    mw = rng.uniform(0.05, 0.95, (K, F, T)).astype(np.float32)
+    return {"session": session, "seq": seq, "Y": Y, "yf": yf,
+            "mask_z": mz, "mask_w": mw}
+
+
+def _fill_shards(tmp_path, rng, n_shards=2, records=3):
+    tap = tmp_path / "tap"
+    tap.mkdir(exist_ok=True)
+    for i in range(n_shards):
+        recs = [_block(rng, seq=i * records + j) for j in range(records)]
+        write_shard(tap / f"s{i:03d}.shard.msgpack", recs)
+    return tap
+
+
+def _run_until(trainer, pred, max_ticks=300):
+    """Tick the trainer until ``pred(trainer)`` holds (the harness stand-in
+    for the scheduler's per-tick call)."""
+    for tick in range(max_ticks):
+        trainer.step(tick_no=tick)
+        if pred(trainer):
+            return
+    raise AssertionError(f"predicate never held in {max_ticks} ticks: "
+                         f"{trainer.stats()}")
+
+
+def _done_counts(led_path, prefix):
+    """{unit: #done-records} over the RAW ledger file (not the replay) —
+    the zero-re-consumed-units contract counts appends, not latest state."""
+    counts = {}
+    for line in led_path.read_text().splitlines():
+        rec = json.loads(line)
+        if rec["unit"].startswith(prefix) and rec["state"] == "done":
+            counts[rec["unit"]] = counts.get(rec["unit"], 0) + 1
+    return counts
+
+
+# ------------------------------------------------------------- ladder throttle
+def test_ladder_throttle_runs_zero_steps_that_tick(tmp_path, rng):
+    """The ladder-aware contract: rung >= throttle_rung ⇒ ZERO train steps
+    that tick (counted + evented on the transitions), below ⇒ trains."""
+    tap = _fill_shards(tmp_path, rng)
+    tr = ResidentTrainer(tap, tmp_path / "train", arch=ARCH, batch_size=4,
+                         steps_per_tick=2, throttle_rung=2)
+    c0 = obs_registry.counter("train_throttled_ticks").value
+    log = tmp_path / "ev.jsonl"
+    try:
+        with obs.recording(log):
+            assert tr.step(tick_no=0, rung=2) == 0   # at the threshold
+            assert tr.step(tick_no=1, rung=3) == 0   # above it
+            assert tr.stats()["throttled"] is True
+            assert tr.stats()["steps_total"] == 0
+            assert tr.step(tick_no=2, rung=1) == 2   # back below: trains
+        assert tr.stats()["throttled"] is False
+        assert tr.stats()["steps_total"] == 2
+        assert obs_registry.counter("train_throttled_ticks").value - c0 == 2
+        throttle = [e for e in obs.read_events(log)
+                    if e["kind"] == "train_throttled"]
+        assert [e["attrs"]["action"] for e in throttle] == ["paused", "resumed"]
+        assert throttle[0]["attrs"]["rung"] == 2
+    finally:
+        tr.close()
+
+
+def test_trainer_idles_without_consuming_anything(tmp_path, rng):
+    """No shards: step() is a cheap no-op that never opens an epoch unit
+    (an idle server must not grow the ledger)."""
+    (tmp_path / "tap").mkdir()
+    tr = ResidentTrainer(tmp_path / "tap", tmp_path / "train", arch=ARCH)
+    try:
+        assert tr.step(tick_no=0) == 0
+        assert tr.step(tick_no=1) == 0
+        latest = RunLedger(tmp_path / "train" / LEDGER_NAME).replay()
+        assert not any(u.startswith("epoch:") for u in latest)
+        assert not tr.ckpt_path.exists()
+    finally:
+        tr.close()
+
+
+def test_trainer_rejects_bad_knobs(tmp_path):
+    with pytest.raises(ValueError, match="steps_per_tick"):
+        ResidentTrainer(tmp_path, tmp_path, steps_per_tick=0)
+    with pytest.raises(ValueError, match="publish_every"):
+        ResidentTrainer(tmp_path, tmp_path, publish_every=0)
+    with pytest.raises(ValueError, match="publish"):
+        ResidentTrainer(tmp_path, tmp_path, publish="sometimes")
+    with pytest.raises(ValueError, match="throttle_rung"):
+        ResidentTrainer(tmp_path, tmp_path, throttle_rung=-1)
+
+
+# ------------------------------------------------------------- crash + resume
+def test_mid_epoch_crash_resumes_without_reconsuming_shards(tmp_path, rng):
+    """ChaosCrash at ``mid_epoch`` (train pass done, nothing persisted):
+    the restart re-enters the interrupted epoch, every already-done shard
+    unit verifies and is skipped (zero re-consumed units), the epoch
+    closes with zero batches, and training continues into the next epoch
+    on the same shards under fresh units."""
+    tap = _fill_shards(tmp_path, rng)
+    train = tmp_path / "train"
+    kw = dict(arch=ARCH, promote_dir=tmp_path / "promote", batch_size=4,
+              steps_per_tick=4, publish="always", max_epochs=2)
+
+    tr = ResidentTrainer(tap, train, **kw)
+    chaos.configure("mid_epoch", after=1)
+    try:
+        with pytest.raises(chaos.ChaosCrash):
+            for tick in range(300):
+                tr.step(tick_no=tick)
+    finally:
+        chaos.disable()
+        tr.close()
+
+    led_path = train / LEDGER_NAME
+    latest = RunLedger(led_path).replay()
+    assert latest[unit_epoch(0)]["state"] == "in_flight"
+    shard0 = _done_counts(led_path, "shard:")
+    assert shard0 and all(u.endswith(":epoch:0") for u in shard0)
+    assert not tr.ckpt_path.exists()  # crash preceded the checkpoint
+    assert len(GenerationStore(tmp_path / "promote").list_ids()) == 0
+
+    tr2 = ResidentTrainer(tap, train, **kw)
+    try:
+        _run_until(tr2, lambda t: t.stats()["epochs_done"] >= 2)
+    finally:
+        tr2.close()
+
+    latest = RunLedger(led_path).replay()
+    rec0 = latest[unit_epoch(0)]
+    assert rec0["state"] == "done"
+    # the resumed epoch found every shard unit already done: ZERO batches
+    assert rec0["attrs"]["steps"] == 0
+    # raw-ledger proof: each shard unit was consumed exactly once — the
+    # epoch-0 units by the crashed pass only, never re-done by the resume
+    for unit, n in _done_counts(led_path, "shard:").items():
+        assert n == 1, f"shard unit {unit} consumed {n} times"
+    # epoch 1 then trained for real on fresh units and checkpointed
+    rec1 = latest[unit_epoch(1)]
+    assert rec1["state"] == "done" and rec1["attrs"]["steps"] > 0
+    assert file_digest(tr2.ckpt_path) == rec1["attrs"]["ckpt_digest"]
+    # the zero-batch epoch 0 never published; epoch 1 did
+    assert latest.get(unit_publish(0)) is None
+    assert latest[unit_publish(1)]["state"] == "done"
+    assert len(GenerationStore(tmp_path / "promote").list_ids()) == 1
+
+
+def test_pre_publish_crash_restages_idempotently(tmp_path, rng):
+    """ChaosCrash at ``pre_publish`` (checkpoint + epoch record durable,
+    generation NOT staged): the restart finds the in_flight publish unit,
+    re-stages the same checkpoint (same digest ⇒ same generation) before
+    training on, and consumes no shard unit twice."""
+    tap = _fill_shards(tmp_path, rng)
+    train = tmp_path / "train"
+    promote = tmp_path / "promote"
+    kw = dict(arch=ARCH, promote_dir=promote, batch_size=4,
+              steps_per_tick=4, publish="always", max_epochs=1)
+
+    tr = ResidentTrainer(tap, train, **kw)
+    chaos.configure("pre_publish", after=1)
+    try:
+        with pytest.raises(chaos.ChaosCrash):
+            for tick in range(300):
+                tr.step(tick_no=tick)
+    finally:
+        chaos.disable()
+        tr.close()
+
+    led_path = train / LEDGER_NAME
+    latest = RunLedger(led_path).replay()
+    rec0 = latest[unit_epoch(0)]
+    assert rec0["state"] == "done" and rec0["attrs"]["steps"] > 0
+    assert latest[unit_publish(0)]["state"] == "in_flight"
+    assert GenerationStore(promote).list_ids() == []  # nothing staged
+    # the checkpoint is intact (atomic save), exactly as the ledger digests it
+    assert file_digest(tr.ckpt_path) == rec0["attrs"]["ckpt_digest"]
+
+    tr2 = ResidentTrainer(tap, train, **kw)
+    try:
+        # one tick finishes the interrupted publish before any training
+        tr2.step(tick_no=0)
+    finally:
+        tr2.close()
+
+    latest = RunLedger(led_path).replay()
+    pub = latest[unit_publish(0)]
+    assert pub["state"] == "done" and pub["attrs"]["resumed"] is True
+    store = GenerationStore(promote)
+    assert [pub["attrs"]["gen"]] == store.list_ids()
+    store.load(pub["attrs"]["gen"])  # digest-verifies: no torn generation
+    assert tr2.stats()["generations_published"] == 1
+    for unit, n in _done_counts(led_path, "shard:").items():
+        assert n == 1, f"shard unit {unit} consumed {n} times"
+    # max_epochs=1 already done on the first run: the resume trained nothing
+    assert tr2.stats()["steps_total"] == 0
