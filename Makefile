@@ -39,7 +39,10 @@ lint-check:
 # per-label program count — the mu=1 trap, caught behaviorally); verifies
 # declared donation survives into the lowered modules' input-output
 # aliasing; and asserts the serve scheduler's CPU step IS the offline
-# jitted entry point.  CPU forced twice over (env here + ensure_cpu in the
+# jitted entry point.  The goldens include the disco-chain programs
+# (tango_clip_fused / streaming_clip_fused: the whole clip as ONE program
+# with no spectrogram escaping the output avals, and the step-1
+# fused-vs-eigh pair).  CPU forced twice over (env here + ensure_cpu in the
 # checker): tracing must never claim the tunneled chip
 # (doc/source/static_analysis.rst, "Program-level contracts").
 trace-check:
@@ -75,9 +78,10 @@ race-check:
 # bytes, peak-live-bytes, per-primitive-class breakdown, an EXPLICIT
 # unmodeled bucket — against the goldens committed under
 # disco_tpu/analysis/golden/cost/; enforces the declared budgets (the
-# unmodeled-traffic ceiling, and the fused step-2 solve modeling strictly
-# fewer HBM bytes than the separate-stage eigh path — the solve-fusion
-# thesis as a hard inequality); and keeps the trace catalog and the
+# unmodeled-traffic ceiling, and the fused step-2 AND batch-in-lanes
+# step-1 solves each modeling strictly fewer HBM bytes than their
+# separate-stage eigh paths — the solve-fusion and disco-chain theses as
+# hard inequalities); and keeps the trace catalog and the
 # manifest directory in exact sync (a program added without a manifest
 # fails, as does a stale manifest).  `disco-meter --update` after a
 # REVIEWED cost change (doc/source/observability.rst, "Reading the
@@ -118,8 +122,11 @@ chaos-check:
 # per chunk (device_get_batches), the overlap gauges recorded, the fused
 # kernels (spec+mag STFT, folded covariances, the VMEM-resident rank-1
 # GEVD-MWF solve in interpret mode) at parity with the unfused reference
-# formulations, and that bench.py still prints exactly ONE JSON line now
-# carrying corpus_clips_per_s plus the solve-lane provenance
+# formulations, the step-1 fused K×F batch at parity with the
+# separate-stage eigh step-1 on both impl lanes, and that bench.py still
+# prints exactly ONE JSON line now carrying corpus_clips_per_s, the
+# solve-lane provenance and the disco-chain lanes (rtf_chained_clip /
+# rtf_fused_step1 with their stage_ms rows)
 # (disco_tpu/enhance/check.py).
 perf-check:
 	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= $(PYTHON) -m disco_tpu.enhance.check
@@ -141,8 +148,10 @@ stream-check:
 # serve contract: every session's output bit-identical to the offline
 # streaming_tango run, ONE batched readback per scheduler tick, a graceful
 # drain with zero truncated/lost frames + atomic session checkpoints that
-# resume bit-exactly, and chaos crashes (serve_tick / mid_write) that never
-# corrupt a delivered frame or a checkpoint (disco_tpu/serve/check.py).
+# resume bit-exactly, chaos crashes (serve_tick / mid_write) that never
+# corrupt a delivered frame or a checkpoint, and the chained
+# (domain="time") lane bit-matching the offline streaming_clip_fused twin
+# with continuation state (disco_tpu/serve/check.py).
 # Hermetic like perf-check: compile cache off, loopback only, one JAX
 # process, zero SIGKILLs.
 serve-check:

@@ -159,6 +159,25 @@ def bench_jax(batch=16, dur_s=10.0, iters=5):
         rtf_fused = None
         fused_error = f"{type(e).__name__}: {e}"[:200]
 
+    # chained-clip lane (enhance/fused.py, the disco-chain attack): the
+    # ENTIRE per-clip chain — STFT, masks, both MWF steps, ISTFT — as one
+    # program, so the lane's slope is the on-device cost of the whole clip
+    # with zero inter-stage dispatches (the staged stage_ms rows below each
+    # pay their own fenced dispatch on the tunnel).
+    chained_error = None
+    rtf_chained = dt_ch = None
+    try:
+        from disco_tpu.enhance.fused import tango_clip_fused
+
+        jchained = jax.jit(jax.vmap(
+            lambda y, s, n: tango_clip_fused.__wrapped__(y, s, n,
+                                                         solver="fused")
+        ))
+        dt_ch, _ = _slope_time(jchained, yb, sb, nb, iters=iters)
+        rtf_chained = audio_s / dt_ch
+    except Exception as e:
+        chained_error = f"{type(e).__name__}: {e}"[:200]
+
     # fused masked-covariance kernel (ops/cov_ops.py, round-2 verdict #3):
     # same default solver, covariance stage reads Y once instead of
     # materializing the masked copies.
@@ -220,6 +239,11 @@ def bench_jax(batch=16, dur_s=10.0, iters=5):
         "rtf_eigh_solver": solver_lane_info("eigh"),
         "rtf_jacobi_solver": solver_lane_info("jacobi"),
         "rtf_fused_solver": solver_lane_info("fused"),
+        # the two disco-chain lanes both ride the fused solve spec: records
+        # must say which concrete impl the 'fused' auto spec resolved to
+        # when the chained/step-1 numbers were taken
+        "rtf_fused_step1": solver_lane_info("fused"),
+        "rtf_chained_clip": solver_lane_info("fused"),
     }
 
     # ---- per-stage breakdown, each stage's ON-DEVICE time via the slope
@@ -248,6 +272,25 @@ def bench_jax(batch=16, dur_s=10.0, iters=5):
     t_step1 = _slope_time(jstep1, Yb, Sb, Nb, Mb, iters=iters)[0]
     t_full = _slope_time(jfull, Yb, Sb, Nb, Mb, iters=iters)[0]
     t_istft = _slope_time(jistft, yf, iters=iters)[0]
+
+    # step-1 fused-solve lane (the step-1 half of the disco-chain attack):
+    # the SAME step-1 program with all K×F pencils through the
+    # batch-in-lanes fused solve (compute_z_signals(solver='fused')) —
+    # directly comparable to stage_ms.step1_local_mwf, which times the
+    # default per-node vmapped 'power' path.
+    fused_step1_error = None
+    rtf_fused_step1 = t_step1_fused = None
+    try:
+        jstep1_f = jax.jit(jax.vmap(
+            lambda Y, S, N, m: compute_z_signals(
+                None, None, None, Y=Y, S=S, N=N, masks_z=m, solver="fused"
+            )["z_y"]
+        ))
+        t_step1_fused = _slope_time(jstep1_f, Yb, Sb, Nb, Mb, iters=iters)[0]
+        rtf_fused_step1 = audio_s / t_step1_fused
+    except Exception as e:
+        fused_step1_error = f"{type(e).__name__}: {e}"[:200]
+
     stage_ms = {
         "stft_x3": round(t_stft * 1e3, 2),
         "masks": round(t_mask * 1e3, 2),
@@ -256,6 +299,10 @@ def bench_jax(batch=16, dur_s=10.0, iters=5):
         "istft": round(t_istft * 1e3, 2),
         "full_pipeline": round(dt * 1e3, 2),
     }
+    if t_step1_fused is not None:
+        stage_ms["step1_fused_mwf"] = round(t_step1_fused * 1e3, 2)
+    if dt_ch is not None:
+        stage_ms["chained_clip"] = round(dt_ch * 1e3, 2)
     return {
         "rtf": rtf,
         "cov_impl": cov_impl_active,
@@ -270,6 +317,10 @@ def bench_jax(batch=16, dur_s=10.0, iters=5):
         "jacobi_error": jacobi_error,
         "rtf_fused": rtf_fused,
         "fused_error": fused_error,
+        "rtf_chained": rtf_chained,
+        "chained_error": chained_error,
+        "rtf_fused_step1": rtf_fused_step1,
+        "fused_step1_error": fused_step1_error,
         "solver_lanes": solver_lanes,
         "rtf_covfused": rtf_covfused,
         "covfused_error": covfused_error,
@@ -1141,6 +1192,10 @@ def main(argv=None):
         "jacobi_error": r.get("jacobi_error"),
         "rtf_fused_solver": round(r["rtf_fused"], 2) if r.get("rtf_fused") else None,
         "fused_error": r.get("fused_error"),
+        "rtf_chained_clip": round(r["rtf_chained"], 2) if r.get("rtf_chained") else None,
+        "chained_clip_error": r.get("chained_error"),
+        "rtf_fused_step1": round(r["rtf_fused_step1"], 2) if r.get("rtf_fused_step1") else None,
+        "fused_step1_error": r.get("fused_step1_error"),
         "solver_lanes": r.get("solver_lanes"),
         "rtf_covfused": round(r["rtf_covfused"], 2) if r.get("rtf_covfused") else None,
         "covfused_error": r.get("covfused_error"),
@@ -1188,7 +1243,7 @@ def main(argv=None):
         "workload": meter["workload"],
         "cost_model_version": meter["cost_model_version"],
         "meter_error": meter["meter_error"],
-        "notes": "value = DEFAULT pipeline (solver=power since round 4; rtf_eigh_solver is the reference-bit-matching lane; rtf_fused_solver = the VMEM-resident cov->whiten->Jacobi->filter solve (ops/mwf_ops.py); solver_lanes records each solve lane's resolved spec AND concrete impl post-ops.resolve, so records distinguish jacobi XLA from pallas from fused without re-running; cov_impl/stft_impl fields name the ACTIVE kernels behind the 'auto' defaults — fused pallas on TPU, DISCO_TPU_COV_IMPL/DISCO_TPU_STFT_IMPL override; the hot path is fused: one spec+magnitude STFT over the stacked y/s/n streams, irm masks from the emitted magnitudes, mask-folded covariance accumulation; precision names the default lane, rtf_bf16/bf16_max_rel_err the opt-in bf16 compute lane measured against it), on-device RTF via k-queued slope timing (tunnel adds ~80ms/dispatch, reported separately; value_single_dispatch includes it); stages timed as separate fenced programs (full pipeline fuses tighter); streaming_rtf_scan / streaming_rtf_block = tunnel-included realtime factors of the scanned super-tick (blocks_per_dispatch blocks per fenced dispatch, streaming_tango_scan) vs per-block block-recursive deployment, dispatches_per_block from the obs fence accounting; corpus_clips_per_s = end-to-end miniature-corpus throughput through the pipelined prefetch/dispatch/readback engine (load+scoring included); serve_blocks_per_s / serve_p95_ms = online-service continuous-batching throughput and request-latency p95 over loopback (BENCH_SERVE_SESSIONS concurrent streaming sessions, compile warm-up excluded; serve_queue_wait/dispatch p95s split admission wait from device time); train_steps_per_s = flywheel CRNN train-step throughput (reduced-width model pinned in train_stats, one fence over the async step chain); tap_blocks_per_s = host-side corpus-tap spool throughput (offer -> shard rotation -> atomic write); tap_to_promotion_ms = live-flywheel promotion latency on a loopback server with the corpus tap, the co-resident trainer and the promotion controller all armed — served blocks tapped into shards -> trainer slices interleaved on the dispatch thread -> publish into the generation store -> canary swap at a block boundary -> SLO-gated canary window -> fleet adoption + atomic ACTIVE flip (p50 of the controller's own staged_t->flip observations; flywheel_generations counts the COMPLETE tap->train->publish->promote generations the live loop closed and doubles as the lane's liveness bit, model_promotions keeps the completed-rollout alias); span_overhead_ns = causal-tracing per-span cost, enabled (span bookkeeping + flight ring) minus disabled (the strict-no-op seam — span_stats.disabled_ns is the measured no-op, perf-check asserts it ~0); numpy baseline at 2s clips; MFU vs dense-f32 peak (pipeline is FFT/small-eig bound by design); mfu_by_stage/hbm_gbps_by_stage = measured stage_ms joined with the analytic disco-meter stage costs at this run's workload (analysis/meter/stages.py — conservative algorithmic flops under cost_model_version conventions, deliberately NOT the XLA cost_analysis flops behind mfu/flops_per_clip), lane_mfu/lane_flops attribute the streaming-scan window, serve block, and fused-solver lanes through the same model (disco-obs roofline renders the full verdict table from this record)",
+        "notes": "value = DEFAULT pipeline (solver=power since round 4; rtf_eigh_solver is the reference-bit-matching lane; rtf_fused_solver = the VMEM-resident cov->whiten->Jacobi->filter solve (ops/mwf_ops.py); rtf_chained_clip = the ENTIRE per-clip chain — STFT, masks, both MWF steps, ISTFT — as ONE dispatched program (enhance/fused.py tango_clip_fused; stage_ms.chained_clip is its slope in ms, to set against the sum of the staged rows which each pay their own fenced dispatch on the tunnel); rtf_fused_step1 = the step-1 local MWF with ALL KxF pencils through the batch-in-lanes fused solve (compute_z_signals(solver='fused'); stage_ms.step1_fused_mwf vs stage_ms.step1_local_mwf is the like-for-like stage comparison against the default per-node power path); solver_lanes records each solve lane's resolved spec AND concrete impl post-ops.resolve, so records distinguish jacobi XLA from pallas from fused without re-running; cov_impl/stft_impl fields name the ACTIVE kernels behind the 'auto' defaults — fused pallas on TPU, DISCO_TPU_COV_IMPL/DISCO_TPU_STFT_IMPL override; the hot path is fused: one spec+magnitude STFT over the stacked y/s/n streams, irm masks from the emitted magnitudes, mask-folded covariance accumulation; precision names the default lane, rtf_bf16/bf16_max_rel_err the opt-in bf16 compute lane measured against it), on-device RTF via k-queued slope timing (tunnel adds ~80ms/dispatch, reported separately; value_single_dispatch includes it); stages timed as separate fenced programs (full pipeline fuses tighter); streaming_rtf_scan / streaming_rtf_block = tunnel-included realtime factors of the scanned super-tick (blocks_per_dispatch blocks per fenced dispatch, streaming_tango_scan) vs per-block block-recursive deployment, dispatches_per_block from the obs fence accounting; corpus_clips_per_s = end-to-end miniature-corpus throughput through the pipelined prefetch/dispatch/readback engine (load+scoring included); serve_blocks_per_s / serve_p95_ms = online-service continuous-batching throughput and request-latency p95 over loopback (BENCH_SERVE_SESSIONS concurrent streaming sessions, compile warm-up excluded; serve_queue_wait/dispatch p95s split admission wait from device time); train_steps_per_s = flywheel CRNN train-step throughput (reduced-width model pinned in train_stats, one fence over the async step chain); tap_blocks_per_s = host-side corpus-tap spool throughput (offer -> shard rotation -> atomic write); tap_to_promotion_ms = live-flywheel promotion latency on a loopback server with the corpus tap, the co-resident trainer and the promotion controller all armed — served blocks tapped into shards -> trainer slices interleaved on the dispatch thread -> publish into the generation store -> canary swap at a block boundary -> SLO-gated canary window -> fleet adoption + atomic ACTIVE flip (p50 of the controller's own staged_t->flip observations; flywheel_generations counts the COMPLETE tap->train->publish->promote generations the live loop closed and doubles as the lane's liveness bit, model_promotions keeps the completed-rollout alias); span_overhead_ns = causal-tracing per-span cost, enabled (span bookkeeping + flight ring) minus disabled (the strict-no-op seam — span_stats.disabled_ns is the measured no-op, perf-check asserts it ~0); numpy baseline at 2s clips; MFU vs dense-f32 peak (pipeline is FFT/small-eig bound by design); mfu_by_stage/hbm_gbps_by_stage = measured stage_ms joined with the analytic disco-meter stage costs at this run's workload (analysis/meter/stages.py — conservative algorithmic flops under cost_model_version conventions, deliberately NOT the XLA cost_analysis flops behind mfu/flops_per_clip), lane_mfu/lane_flops attribute the streaming-scan window, serve block, and fused-solver lanes through the same model (disco-obs roofline renders the full verdict table from this record)",
     }
     # sideband first (mirror of the stdout record + final counter snapshot),
     # THEN the one stdout line — events go to the file, never stdout.

@@ -41,6 +41,13 @@ CROSS_BUDGETS = (
         "and writes back only the (F,C) weights — fusing must model "
         "strictly fewer HBM bytes than the separate-stage eigh path",
     ),
+    (
+        "tango_step1_fused", "tango_step1_eigh", "traffic_bytes",
+        "the disco-chain step-1: all K×F local-MWF pencils ride ONE "
+        "batch-in-lanes fused solve instead of K vmapped separate-stage "
+        "eigh instances — the fused step-1 must model strictly fewer HBM "
+        "bytes than the eigh baseline",
+    ),
 )
 
 

@@ -27,8 +27,12 @@ import dataclasses
 from disco_tpu.analysis.meter import costmodel
 
 #: the stage keys of ``bench.py``'s ``stage_ms`` dict, in pipeline order
-STAGE_KEYS = ("stft_x3", "masks", "step1_local_mwf", "step2_exchange_mwf",
-              "istft", "full_pipeline")
+#: (``step1_fused_mwf`` and ``chained_clip`` are the disco-chain lanes:
+#: the batch-in-lanes fused step-1 twin of ``step1_local_mwf``, and the
+#: whole-clip one-program chain)
+STAGE_KEYS = ("stft_x3", "masks", "step1_local_mwf", "step1_fused_mwf",
+              "step2_exchange_mwf", "istft", "full_pipeline",
+              "chained_clip")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -114,6 +118,13 @@ def offline_stage_costs(workload: Workload = HEADLINE,
     f_step1 = jax.vmap(
         lambda Y, S, N, m: compute_z_signals(
             None, None, None, Y=Y, S=S, N=N, masks_z=m)["z_y"])
+    # the disco-chain step-1 twin: all K×F pencils as ONE batch-in-lanes
+    # fused solve ('fused-xla' pinned — backend resolution of plain
+    # 'fused' never changes the modeled structure)
+    f_step1_fused = jax.vmap(
+        lambda Y, S, N, m: compute_z_signals(
+            None, None, None, Y=Y, S=S, N=N, masks_z=m,
+            solver="fused-xla")["z_y"])
     f_full = jax.vmap(
         lambda Y, S, N, m: tango(Y, S, N, m, m, policy="local",
                                  solver=solver).yf)
@@ -128,19 +139,32 @@ def offline_stage_costs(workload: Workload = HEADLINE,
                          solver=solver).yf
         return jax.vmap(one)(a, b, c)
 
+    # the whole-clip chained program (enhance/fused.py): the lane bench.py
+    # times as rtf_chained_clip / stage_ms.chained_clip
+    from disco_tpu.enhance.fused import tango_clip_fused
+
+    f_chained = jax.vmap(
+        lambda y, s, n: tango_clip_fused.__wrapped__(y, s, n,
+                                                     solver="fused-xla"))
+
     c_stft = _cost(f_stft, (yb, yb, yb), "stage:stft_x3")
     c_mask = _cost(f_mask, (mag1, mag1), "stage:masks")
     c_step1 = _cost(f_step1, (spec1, spec1, spec1, masks_b), "stage:step1")
+    c_step1_fused = _cost(f_step1_fused, (spec1, spec1, spec1, masks_b),
+                          "stage:step1_fused")
     c_full = _cost(f_full, (spec1, spec1, spec1, masks_b), "stage:tango_full")
     c_istft = _cost(f_istft, (yf_b,), "stage:istft")
     c_headline = _cost(f_headline, (yb, yb, yb), "stage:full_pipeline")
+    c_chained = _cost(f_chained, (yb, yb, yb), "stage:chained_clip")
     return {
         "stft_x3": c_stft,
         "masks": c_mask,
         "step1_local_mwf": c_step1,
+        "step1_fused_mwf": c_step1_fused,
         "step2_exchange_mwf": _sub(c_full, c_step1),
         "istft": c_istft,
         "full_pipeline": c_headline,
+        "chained_clip": c_chained,
     }
 
 

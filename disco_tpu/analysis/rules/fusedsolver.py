@@ -15,15 +15,21 @@ shapes silently bypass those seams:
   dispatch owns, and the bench provenance (``solver_lanes``) stops
   describing what actually ran;
 * branching on ``'fused'``-family string literals (``solver == "fused"``,
-  ``base in ("fused", ...)``) — ad-hoc grammar re-implementation, the same
-  drift hazard ``parse_solver_spec`` exists to prevent (a call site that
-  spells the family check itself will miss the next spec added to the
-  table).
+  ``base in ("fused", ...)``, and — since the step-1 fusion round —
+  prefix probes like ``solver.startswith("fused")``) — ad-hoc grammar
+  re-implementation, the same drift hazard ``parse_solver_spec`` exists
+  to prevent (a call site that spells the family check itself will miss
+  the next spec added to the table).
 
 Passing a fused spec AS DATA (``solver="fused"`` into ``rank1_gevd``/
 ``tango``/the CLI) is the sanctioned path and stays legal — the rule
-targets selection LOGIC, not spec strings.  Inside ``disco_tpu/ops/`` and
-``disco_tpu/beam/filters.py`` (the dispatch table itself) both shapes ARE
+targets selection LOGIC, not spec strings.  Call sites that genuinely
+need the family decision (the step-1 K×F pencil batching in
+``enhance.tango``, the chained-clip program in ``enhance.fused``) route
+it through ``solver_spec.is_fused_spec`` — a function call, not a
+comparison, so it stays legal everywhere by construction.  Inside
+``disco_tpu/ops/``, ``disco_tpu/beam/filters.py`` (the dispatch table)
+and ``disco_tpu/solver_spec.py`` (the grammar itself) both shapes ARE
 the implementation — exempt.
 
 No reference counterpart: the reference solves every pencil one way only
@@ -66,7 +72,8 @@ class FusedSolverSeam(Rule):
 
     def applies(self, ctx) -> bool:
         return not (ctx.in_dir("disco_tpu/ops")
-                    or ctx.is_file("disco_tpu/beam/filters.py"))
+                    or ctx.is_file("disco_tpu/beam/filters.py")
+                    or ctx.is_file("disco_tpu/solver_spec.py"))
 
     def check(self, ctx):
         for node in ast.walk(ctx.tree):
@@ -81,6 +88,20 @@ class FusedSolverSeam(Rule):
                         "'fused-pallas') through parse_solver_spec so the "
                         "grammar, the DISCO_TPU_MWF_IMPL resolution and the "
                         "sanitize policy stay owned by the seams",
+                    )
+                elif (chain and chain[-1] == "startswith"
+                        and any(_fused_literal(a) for a in node.args)):
+                    # solver.startswith("fused") — the prefix spelling of
+                    # the same ad-hoc family check (a "fused-xla" spec
+                    # matches it by accident, the next family member by
+                    # luck only); the sanctioned predicate is
+                    # solver_spec.is_fused_spec
+                    yield self.finding(
+                        ctx, node,
+                        "'fused' family probe via startswith: solver-family "
+                        "branching belongs behind solver_spec.is_fused_spec "
+                        "/ parse_solver_spec — a prefix check drifts the "
+                        "moment the spec grammar grows",
                     )
             elif isinstance(node, ast.Compare):
                 operands = [node.left, *node.comparators]

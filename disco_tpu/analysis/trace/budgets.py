@@ -59,12 +59,23 @@ from disco_tpu.analysis.trace.programs import (
 #: factory memoizes on the canonicalized key (nn.training.make_step_fns),
 #: so a spelling variant reaching jit as a distinct static is impossible
 #: by construction.
+#: tango_clip_fused: the deployment program ((K, L) out) + exactly ONE
+#: export-payload program (export=True is a static) — repeat calls and mu
+#: passed equal to the 1.0 default must not add a third.
+#: streaming_clip_fused: the warm-start super-tick + the continuation-state
+#: program (the carry pytree is a new input structure), like
+#: streaming_tango minus its bf16 lane (the chained lane rides the shared
+#: precision seam; its bf16 program is not part of this workload).
+#: run_batch_chained: the chained corpus runner traces once.
 BUDGETS: dict = {
     "streaming_tango": 3,
     "streaming_step1": 2,
     "streaming_tango_scan": 1,
     "run_batch": 1,
     "run_batch_with_masks": 1,
+    "run_batch_chained": 1,
+    "tango_clip_fused": 2,
+    "streaming_clip_fused": 2,
     "train_step": 3,
     "eval_step": 3,
 }
@@ -159,10 +170,79 @@ def run_workload(extra=None) -> None:
     Mz = np.stack([_inputs(rng, T)[1] for _ in range(B)])
     run_batch_with_masks(Yb, Sb, Nb, Mz, Mz)
 
+    _chained_workload(rng)
+
     _train_workload(rng)
 
     if extra is not None:
         extra(streaming, Y, mz, mw)
+
+
+def _chained_workload(rng) -> None:
+    """The disco-chain programs' share of the budget workload: the
+    whole-clip program in its two static shapes (deployment + export),
+    the streaming super-tick in warm + continuation form, and the chained
+    corpus runner once — with repeat calls and floats passed equal to the
+    defaults pinned non-retracing (the mu=1 trap at the chained entry
+    points).
+
+    No reference counterpart (module docstring).
+    """
+    import numpy as np
+
+    from disco_tpu.analysis.trace.programs import CLIP_L, STFT_F, WINDOW_L
+    from disco_tpu.enhance import fused
+    from disco_tpu.enhance.driver import make_batch_runners
+
+    for entry in (fused.tango_clip_fused, fused.streaming_clip_fused):
+        if entry.clear_cache is None:
+            raise RuntimeError(
+                "budget workload needs cold caches but this jax version "
+                "exposes no clear_cache on the chained entry points "
+                "(obs.accounting.counted_jit) — update the cache-clearing "
+                "seam in budgets._chained_workload"
+            )
+        entry.clear_cache()
+
+    yt, st, nt = (rng.standard_normal((K, C, CLIP_L)).astype(np.float32)
+                  for _ in range(3))
+    fused.tango_clip_fused(yt, st, nt, solver="fused-xla", cov_impl=COV_IMPL)
+    # cache hits: same shapes; mu passed EQUAL to the 1.0 default is
+    # stripped by the traced-float convention
+    fused.tango_clip_fused(yt, st, nt, solver="fused-xla", cov_impl=COV_IMPL)
+    fused.tango_clip_fused(yt, st, nt, mu=1.0, solver="fused-xla",
+                           cov_impl=COV_IMPL)
+    # the export-payload program: export is a static — exactly one more
+    fused.tango_clip_fused(yt, st, nt, solver="fused-xla", cov_impl=COV_IMPL,
+                           export=True)
+
+    t = BLOCKS_PER_DISPATCH * UPDATE_EVERY
+    yw = rng.standard_normal((K, C, WINDOW_L)).astype(np.float32)
+    mzw = rng.uniform(0.1, 0.9, (K, STFT_F, t)).astype(np.float32)
+    out = fused.streaming_clip_fused(
+        yw, masks_z=mzw, update_every=UPDATE_EVERY,
+        blocks_per_dispatch=BLOCKS_PER_DISPATCH)
+    # cache hit, then the continuation program (new carry pytree)
+    fused.streaming_clip_fused(
+        yw, masks_z=mzw, update_every=UPDATE_EVERY,
+        blocks_per_dispatch=BLOCKS_PER_DISPATCH)
+    fused.streaming_clip_fused(
+        yw, masks_z=mzw, update_every=UPDATE_EVERY,
+        blocks_per_dispatch=BLOCKS_PER_DISPATCH, state=out["state"])
+
+    # the chained corpus runner (a fresh counted_jit per factory call —
+    # cold by construction, like run_batch above)
+    run_batch_chained, _none = make_batch_runners(
+        mask_type="irm1", mu=1.0, policy="local", solver="fused-xla",
+        cov_impl=COV_IMPL, stft_impl="xla", n_nodes=K, chained=True,
+    )
+    ytb, stb, ntb = (
+        np.stack([rng.standard_normal((K, C, CLIP_L)).astype(np.float32)
+                  for _ in range(B)])
+        for _ in range(3)
+    )
+    run_batch_chained(ytb, stb, ntb)
+    run_batch_chained(ytb, stb, ntb)  # cache hit
 
 
 def _train_workload(rng) -> None:

@@ -39,6 +39,15 @@ BLOCKS_PER_DISPATCH = 2  #: super-tick width of the scanned program
 SOLVER = "power"
 COV_IMPL = "xla"
 
+#: time-domain lengths of the chained-clip programs (disco-chain round).
+#: The STFT grid is fixed (n_fft 512 → F = 257, hop 256), so the chained
+#: programs cannot use the tiny canonical F — only their clip lengths are
+#: shrunk: CLIP_L gives 1 + 1024//256 = 5 frames, WINDOW_L gives the
+#: 8 frames of one BLOCKS_PER_DISPATCH × UPDATE_EVERY super-tick window.
+CLIP_L = 1024
+WINDOW_L = (BLOCKS_PER_DISPATCH * UPDATE_EVERY - 1) * 256
+STFT_F = 257
+
 
 @dataclasses.dataclass(frozen=True)
 class ProgramSpec:
@@ -166,6 +175,85 @@ def _build_tango_step2_eigh():
     return tango_step2, args, {
         "policy": "local", "solver": "eigh", "cov_impl": COV_IMPL,
     }
+
+
+def _build_tango_step1_fused():
+    """The disco-chain round's step-1: ALL K×F local-MWF pencils as ONE
+    batch-in-lanes fused solve through ``compute_z_signals``'s solver spec
+    ('fused-xla' pinned backend-independent, like the step-2 twin).  The
+    contract the golden holds: one traced program over the whole K-node
+    stack — K is a batch lane of the solve, not a vmap of K per-node
+    programs — whose outputs are the (K, F, T) z streams only.
+
+    No reference counterpart (module docstring)."""
+    from disco_tpu.enhance.zexport import compute_z_signals
+
+    def step1_all(Y, S, N, m):
+        return compute_z_signals(None, None, None, Y=Y, S=S, N=N,
+                                 masks_z=m, solver="fused-xla",
+                                 cov_impl=COV_IMPL)
+
+    args = (_c64(K, C, F, T), _c64(K, C, F, T), _c64(K, C, F, T),
+            _f32(K, F, T))
+    return step1_all, args, {}
+
+
+def _build_tango_step1_eigh():
+    """The K-vmapped separate-stage eigh baseline of the step-1 chain —
+    the meter cross-budget's larger side: the fused step-1 must model
+    strictly fewer HBM bytes than THIS program (analysis/meter/budgets.py).
+
+    No reference counterpart (module docstring)."""
+    from disco_tpu.enhance.zexport import compute_z_signals
+
+    def step1_all(Y, S, N, m):
+        return compute_z_signals(None, None, None, Y=Y, S=S, N=N,
+                                 masks_z=m, solver="eigh",
+                                 cov_impl=COV_IMPL)
+
+    args = (_c64(K, C, F, T), _c64(K, C, F, T), _c64(K, C, F, T),
+            _f32(K, F, T))
+    return step1_all, args, {}
+
+
+def _build_tango_clip_fused():
+    """The whole-clip chained program (enhance/fused.py): time-domain
+    (K, C, L) in, the enhanced (K, L) signal out, every former stage seam
+    (STFT → masks → step-1 → z-exchange → step-2 → ISTFT) inside ONE
+    trace.  The contract the golden holds (pinned by tests/test_trace.py):
+    no (·, 257, ·) spectrogram-shaped intermediate escapes to the output
+    avals.  Statics pinned backend-independent ('fused-xla'/'xla').
+
+    No reference counterpart (module docstring)."""
+    from disco_tpu.enhance.fused import tango_clip_fused
+
+    args = (_f32(K, C, CLIP_L), _f32(K, C, CLIP_L), _f32(K, C, CLIP_L))
+    return tango_clip_fused.__wrapped__, args, {
+        "solver": "fused-xla", "cov_impl": COV_IMPL, "stft_impl": "xla",
+    }
+
+
+def _build_streaming_clip_fused():
+    """The streaming chained super-tick (enhance/fused.py): one window's
+    time-domain samples + its (K, F, T) masks in, the enhanced window and
+    the continuation state out — the program the serve scheduler's
+    time-domain sessions dispatch.  Masks ride as program inputs (the
+    serve wire contract is client masks); statics pinned
+    backend-independent.
+
+    No reference counterpart (module docstring)."""
+    from disco_tpu.enhance.fused import streaming_clip_fused
+
+    t = BLOCKS_PER_DISPATCH * UPDATE_EVERY
+
+    def fn(y, mz, mw):
+        return streaming_clip_fused.__wrapped__(
+            y, None, None, mz, mw, update_every=UPDATE_EVERY,
+            blocks_per_dispatch=BLOCKS_PER_DISPATCH, solver="fused-xla",
+            stft_impl="xla")
+
+    args = (_f32(K, C, WINDOW_L), _f32(K, STFT_F, t), _f32(K, STFT_F, t))
+    return fn, args, {}
 
 
 def _streaming_args():
@@ -302,6 +390,33 @@ PROGRAMS: dict = {
             "offline step-2 with the separate-stage eigh solver — the "
             "fused solve's HBM-traffic baseline (meter cross-budget)",
             _build_tango_step2_eigh,
+        ),
+        ProgramSpec(
+            "tango_step1_fused",
+            "step-1 local MWF over ALL K nodes as one batch-in-lanes fused "
+            "solve (enhance/zexport.py compute_z_signals, 'fused-xla' "
+            "pinned) — the disco-chain step-1 fusion",
+            _build_tango_step1_fused,
+        ),
+        ProgramSpec(
+            "tango_step1_eigh",
+            "step-1 local MWF, K-vmapped separate-stage eigh — the fused "
+            "step-1's HBM-traffic baseline (meter cross-budget)",
+            _build_tango_step1_eigh,
+        ),
+        ProgramSpec(
+            "tango_clip_fused",
+            "whole offline clip as ONE program: STFT → masks → both MWF "
+            "steps → ISTFT (enhance/fused.py) — no spectrogram-shaped "
+            "output escapes",
+            _build_tango_clip_fused,
+        ),
+        ProgramSpec(
+            "streaming_clip_fused",
+            "streaming chained super-tick: time-domain window + masks in, "
+            "enhanced window + continuation state out (enhance/fused.py) — "
+            "the serve time-domain session program",
+            _build_streaming_clip_fused,
         ),
         ProgramSpec(
             "streaming_tango",

@@ -516,6 +516,8 @@ def compare_records(old: dict, new: dict, threshold: float = 0.05) -> dict:
         ("rtf_eigh_solver", True),
         ("rtf_jacobi_solver", True),
         ("rtf_fused_solver", True),
+        ("rtf_fused_step1", True),
+        ("rtf_chained_clip", True),
         ("rtf_covfused", True),
         ("streaming_rtf", True),
         ("streaming_rtf_scan", True),
@@ -598,7 +600,13 @@ def compare_records(old: dict, new: dict, threshold: float = 0.05) -> dict:
         ("model_promotions", "promotions", "", True, None),
         ("span_overhead_ns", "span-overhead", "ns", False, 1000.0),
         ("mfu", "mfu", "", True, None),
+        # the disco-chain lanes: the whole-clip one-program RTF and the
+        # fused step-1 RTF, judged like every other lane once a baseline
+        # carries them
+        ("rtf_fused_step1", "fused step1", "x realtime", True, None),
+        ("rtf_chained_clip", "chained clip", "x realtime", True, None),
         ("stage_ms.stft_x3", "stft stage", "ms", False, None),
+        ("stage_ms.step1_local_mwf", "step1 stage", "ms", False, None),
         ("stage_ms.step2_exchange_mwf", "step2 stage", "ms", False, None),
     ]
     # the per-stage roofline lanes are dynamic: every stage the BASELINE

@@ -90,6 +90,15 @@ def build_parser():
                         "results stay bit-exact either way (1 = per-block "
                         "serving, the default; must be <= "
                         "--max-blocks-per-tick)")
+    p.add_argument("--no-chained-sessions", dest="allow_chained",
+                   action="store_false", default=True,
+                   help="do not admit chained (domain='time') sessions — "
+                        "clients that stream raw audio windows through the "
+                        "one-program chained twin "
+                        "(enhance.fused.streaming_clip_fused, one fenced "
+                        "dispatch per window).  Each chained shape bucket "
+                        "compiles its own program; this restores the "
+                        "bounded STFT-only compile surface")
     p.add_argument("--no-overlap-readback", dest="overlap_readback",
                    action="store_false", default=None,
                    help="disable the double-buffered tick state (with "
@@ -292,6 +301,7 @@ def main(argv=None):
             max_blocks_per_tick=args.max_blocks_per_tick,
             blocks_per_super_tick=args.blocks_per_super_tick,
             overlap_readback=args.overlap_readback,
+            allow_chained=args.allow_chained,
             max_backlog=args.max_backlog,
             tick_interval_s=args.tick_interval,
             state_dir=args.state_dir,
@@ -310,6 +320,7 @@ def main(argv=None):
                       "train": bool(args.train),
                       "max_sessions": args.max_sessions,
                       "blocks_per_super_tick": args.blocks_per_super_tick,
+                      "allow_chained": args.allow_chained,
                       "park_ttl_s": args.park_ttl,
                       "tick_deadline_s": args.tick_deadline,
                       "ladder": bool(args.ladder),

@@ -83,6 +83,16 @@ def build_parser():
                         "kernel on TPU, XLA elsewhere — DISCO_TPU_STFT_IMPL "
                         "env overrides), 'xla' or 'pallas' "
                         "(ops/stft_ops.stft_with_mag)")
+    p.add_argument("--chained", action="store_true",
+                   help="run each clip (or each --rirs chunk) as ONE "
+                        "dispatched program — STFT, oracle masks, both MWF "
+                        "steps and the scoring ISTFTs chained in-program "
+                        "(enhance.fused) with one batched readback.  Offline "
+                        "oracle lane only (rejects --streaming/--mods/--mesh/"
+                        "fault flags); the solver default becomes 'fused'; "
+                        "outputs are parity-matched to the staged path at the "
+                        "documented chained tolerance, not bit-identical "
+                        "(doc/source/performance.rst)")
     p.add_argument("--precision", choices=["f32", "bf16"], default="f32",
                    help="compute lane of the fused STFT/covariance kernels: "
                         "'f32' (default) or 'bf16' (bf16 multiplies with f32 "
@@ -306,6 +316,7 @@ def _run(args, policy):
                 z_sigs=args.zsigs[0] if len(args.zsigs) == 1 else "zs&zn",
                 solver=args.solver, cov_impl=args.cov_impl,
                 stft_impl=args.stft_impl, precision=args.precision, mesh=mesh,
+                chained=args.chained,
                 fault_spec=args.fault_spec,
                 ledger=args.ledger, resume=args.resume,
                 pipeline=not args.no_pipeline,
@@ -327,6 +338,7 @@ def _run(args, policy):
             z_sigs=args.zsigs[0] if len(args.zsigs) == 1 else "zs&zn",
             solver=args.solver, cov_impl=args.cov_impl,
             stft_impl=args.stft_impl, precision=args.precision,
+            chained=args.chained,
             fault_spec=args.fault_spec, ledger=args.ledger,
         )
     if results is None:

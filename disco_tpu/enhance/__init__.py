@@ -18,6 +18,7 @@ from disco_tpu.enhance.tango import (
     tango_step1,
     tango_step2,
 )
+from disco_tpu.enhance.fused import streaming_clip_fused, tango_clip_fused
 from disco_tpu.enhance.separation import separate_sources, separate_with_masks
 from disco_tpu.enhance.streaming import (hold_last_good, initial_stream_state,
                                           streaming_step1, streaming_tango,
@@ -45,9 +46,11 @@ __all__ = [
     "compute_z_signals",
     "export_z",
     "initial_stream_state",
+    "streaming_clip_fused",
     "streaming_step1",
     "streaming_tango",
     "streaming_tango_scan",
+    "tango_clip_fused",
     "separate_sources",
     "separate_with_masks",
 ]

@@ -175,9 +175,23 @@ def _check_bench_one_line(failures: list) -> dict | None:
             f"bench: rtf_fused_solver missing/null in the record "
             f"(fused_error={rec.get('fused_error')!r})"
         )
+    # the disco-chain lanes: the whole-clip chained program and the fused
+    # step-1 stage lane must both be measured, with their stage_ms rows
+    # present (the error fields say WHY when they are not)
+    for key, err_key in (("rtf_chained_clip", "chained_clip_error"),
+                         ("rtf_fused_step1", "fused_step1_error")):
+        if not isinstance(rec.get(key), (int, float)):
+            failures.append(
+                f"bench: {key} missing/null in the record "
+                f"({err_key}={rec.get(err_key)!r})"
+            )
+    for key in ("chained_clip", "step1_fused_mwf"):
+        if not isinstance((rec.get("stage_ms") or {}).get(key), (int, float)):
+            failures.append(f"bench: stage_ms.{key} missing/null in the record")
     lanes = rec.get("solver_lanes") or {}
     for lane_key in ("rtf", "rtf_eigh_solver", "rtf_jacobi_solver",
-                     "rtf_fused_solver"):
+                     "rtf_fused_solver", "rtf_fused_step1",
+                     "rtf_chained_clip"):
         lane = lanes.get(lane_key) or {}
         if lane.get("impl") not in ("xla", "pallas"):
             failures.append(
@@ -288,6 +302,28 @@ def _check_fused_parity(failures: list) -> None:
             failures.append(
                 f"fused parity: rank1_gevd[{spec}] drifted from the eigh "
                 f"solve ({err:.2e} > 1e-3 rel l2)"
+            )
+
+    # step-1 batch-in-lanes fused solve (the disco-chain round): BOTH fused
+    # lanes through compute_z_signals' solver spec — all K×F pencils ride
+    # ONE rank1_gevd call through THE dispatch table — against the
+    # reference-bit-matching eigh step-1 path
+    from disco_tpu.enhance.zexport import compute_z_signals
+
+    Ks, Cs, L1 = 2, 3, 12000
+    y1 = rng.standard_normal((Ks, Cs, L1)).astype(np.float32)
+    s1 = rng.standard_normal((Ks, Cs, L1)).astype(np.float32)
+    n1 = rng.standard_normal((Ks, Cs, L1)).astype(np.float32)
+    z_ref = np.asarray(compute_z_signals(y1, s1, n1, solver="eigh")["z_y"])
+    zscale = np.max(np.abs(z_ref))
+    for spec in ("fused-xla", "fused-pallas"):
+        # disco-lint: disable=DL002 -- hermetic CPU gate: interpret-mode/CPU arrays, no tunnel crossing to batch
+        z = np.asarray(compute_z_signals(y1, s1, n1, solver=spec)["z_y"])
+        err = np.max(np.abs(z - z_ref)) / zscale
+        if err > 1e-3:
+            failures.append(
+                f"fused parity: compute_z_signals[{spec}] step-1 z_y drifted "
+                f"from the eigh solve ({err:.2e} > 1e-3 max rel)"
             )
 
 

@@ -457,6 +457,7 @@ def enhance_rir(
     cov_impl: str = "auto",
     stft_impl: str = "auto",
     precision: str = "f32",
+    chained: bool = False,
     fault_spec=None,
     ledger=None,
 ):
@@ -465,6 +466,16 @@ def enhance_rir(
     oracle masks of ``mask_type``.  ``streaming=True`` runs the
     frame-recursive online pipeline (exponential-smoothing covariances,
     block filter refresh) instead of the offline frame-mean one.
+
+    ``chained=True`` runs the whole offline clip — STFT, oracle masks, both
+    MWF steps, the six scoring ISTFTs — as ONE dispatched program
+    (:func:`disco_tpu.enhance.fused.tango_clip_fused` with ``export=True``)
+    followed by ONE batched readback, instead of the staged
+    stft/masks/mwf/istft dispatch sequence.  Offline oracle lane only:
+    ``streaming``, CRNN ``models`` and ``fault_spec`` are rejected (the
+    chained program computes masks in-program and has no z-exchange host
+    seam).  ``solver=None`` then resolves to ``'fused'`` — the chained
+    program exists to compose with the batch-in-lanes fused solve.
 
     ``ledger``: optional :class:`disco_tpu.runs.RunLedger` (or path) —
     the clip's in_flight/done transitions and artifact digests are
@@ -498,8 +509,25 @@ def enhance_rir(
 
     Returns the tango results dict, or None when the RIR was already
     processed (idempotency)."""
+    if chained:
+        if streaming:
+            raise ValueError(
+                "chained=True is the offline whole-clip lane; the streaming "
+                "chained twin (enhance.fused.streaming_clip_fused) lives "
+                "behind the serve scheduler's time-domain sessions"
+            )
+        if models != (None, None):
+            raise ValueError(
+                "chained=True computes oracle masks in-program; the CRNN "
+                "mask lane needs host STFTs and stays on the staged path"
+            )
+        if fault_spec is not None:
+            raise ValueError(
+                "chained=True has no z-exchange host seam to inject faults "
+                "at; run fault scenarios on the staged path"
+            )
     if solver is None:
-        solver = "eigh" if streaming else "power"
+        solver = "fused" if chained else ("eigh" if streaming else "power")
     import jax.numpy as jnp
 
     from disco_tpu.ops.stft_ops import stft_with_mag
@@ -530,6 +558,49 @@ def enhance_rir(
     from disco_tpu.core.dsp import n_stft_frames
 
     T_true = n_stft_frames(L)  # saved masks/z trimmed to the true frames
+    if chained:
+        # TangoResult is re-imported here because the streaming branch's
+        # local import below makes the name function-local
+        from disco_tpu.enhance.fused import tango_clip_fused
+        from disco_tpu.enhance.tango import TangoResult
+
+        # The whole clip rides ONE dispatched program (one fenced ~80 ms
+        # RPC on the tunneled attachment) and the full scoring payload —
+        # six time-domain streams, both masks, the z export — crosses back
+        # in ONE batched readback; the staged stft/masks/mwf/istft stages
+        # above and below never run.
+        with obs_events.stage("mwf", rir=rir, mode="chained", solver=solver):
+            host = call_with_retries(
+                device_get_tree,
+                tango_clip_fused(
+                    jnp.asarray(y_in), jnp.asarray(s_in), jnp.asarray(n_in),
+                    mu=mu, policy=policy, mask_type=mask_type, solver=solver,
+                    cov_impl=cov_impl, stft_impl=stft_impl,
+                    precision=precision, export=True,
+                ),
+                retry_on=TRANSPORT_ERRORS,
+                label="chained_readback",
+            )
+        # bucket padding is trimmed on host (numpy views, no extra crossing)
+        td = tuple(a[..., :L] for a in host["td"])
+        obs_sentinels.check_finite("mwf_yf", td[0], stage="mwf")
+        res = TangoResult(
+            yf=None, sf=None, nf=None, z_y=host["z_y"],
+            z_s=None, z_n=None, zn=None,
+            masks_z=host["masks_z"], mask_w=host["mask_w"],
+        )
+        out_results = _persist_and_score(
+            out, layout, rir, noise, snr_range, y, s, n, s_dry, n_dry, fs,
+            rnd_snrs, res, L, T_true, n_nodes, save_fig, time_domain=td,
+        )
+        if ledger is not None:
+            ledger.mark_done(
+                unit_rir(rir, noise),
+                clip_artifacts(out, rir, noise, snr_range, n_nodes),
+            )
+        if obs_events.enabled():
+            obs_events.record("counters", **obs_registry.snapshot())
+        return out_results
     with obs_events.stage("stft", rir=rir):
         # ONE fused spec+magnitude program over the stacked y/s/n streams
         # (was three separate stft dispatches + an abs pass in the mask
@@ -701,10 +772,21 @@ def make_batch_runners(
     z_nan_arr=None,
     n_nodes: int = 4,
     mesh=None,
+    chained: bool = False,
+    stft_impl: str = "auto",
 ):
     """Build the per-chunk batch programs of :func:`enhance_rirs_batched`:
     ``(run_batch, run_batch_with_masks)`` over (B, K, C, F, T) STFT stacks
     (oracle masks computed in-program vs. masks passed in).
+
+    ``chained=True`` instead returns ``(run_batch_chained, None)``: one
+    jitted program over (B, K, C, L) *time-domain* stacks that vmaps the
+    whole chained clip (:func:`disco_tpu.enhance.fused.tango_clip_fused`
+    with ``export=True`` — STFT, oracle masks, both MWF steps and the six
+    scoring ISTFTs all inside the program), so a chunk's former
+    stft + masks + mwf dispatch sequence collapses to ONE launch.
+    Single-device oracle lane only (``mesh``/fault masks rejected);
+    ``stft_impl`` feeds the in-program STFT and is ignored otherwise.
 
     Hoisted out of :func:`enhance_rirs_batched` so the corpus driver and the
     program-contract checker (``disco_tpu.analysis.trace``) construct the
@@ -730,6 +812,34 @@ def make_batch_runners(
     from disco_tpu.ops.resolve import resolve_precision
 
     precision = resolve_precision(precision)
+    if chained:
+        if mesh is not None:
+            raise ValueError(
+                "chained batch runners are a single-device lane; mesh runs "
+                "stay on the staged STFT-stack runners"
+            )
+        if z_mask_arr is not None or z_nan_arr is not None:
+            raise ValueError(
+                "chained batch runners have no z-exchange host seam; run "
+                "fault scenarios on the staged path"
+            )
+        from disco_tpu.enhance.fused import tango_clip_fused
+
+        donate = (0, 1, 2) if jax.default_backend() != "cpu" else ()
+
+        @obs_accounting.counted_jit(label="run_batch_chained",
+                                    donate_argnums=donate)
+        def run_batch_chained(yb, sb, nb):
+            def one(y, s, n):
+                return tango_clip_fused.__wrapped__(
+                    y, s, n, mu=mu, policy=policy, mask_type=mask_type,
+                    solver=solver, cov_impl=cov_impl, stft_impl=stft_impl,
+                    precision=precision, export=True,
+                )
+
+            return jax.vmap(one)(yb, sb, nb)
+
+        return run_batch_chained, None
     if mesh is not None:
         if precision != "f32":
             # the sharded runners have no precision plumbing yet — reject
@@ -814,6 +924,7 @@ def enhance_rirs_batched(
     precision: str = "f32",
     score_workers: int = 4,
     mesh=None,
+    chained: bool = False,
     fault_spec=None,
     ledger=None,
     resume: bool = False,
@@ -884,11 +995,37 @@ def enhance_rirs_batched(
     tunneled attachment unless explicitly pointed at a directory);
     ``False`` disables; a string is the cache directory.
 
+    ``chained``: each chunk rides ONE dispatched program over the raw
+    (B, K, C, L) time stacks (the ``run_batch_chained`` runner — STFT,
+    oracle masks, both MWF steps and the scoring ISTFTs in-program) and
+    ONE batched readback, instead of the staged fused-STFT + batch-runner
+    sequence.  Offline oracle lane only: CRNN ``models``, ``mesh`` and
+    ``fault_spec`` are rejected, exactly as in :func:`enhance_rir`;
+    ``solver=None`` then resolves to ``'fused'``.
+
     Returns {rir: results dict} for the RIRs actually processed
     (already-done ones are skipped — same idempotency contract).
     """
+    if chained:
+        if models != (None, None):
+            raise ValueError(
+                "chained=True computes oracle masks in-program; the CRNN "
+                "mask lane needs host STFTs and stays on the staged path"
+            )
+        if mesh is not None:
+            raise ValueError(
+                "chained=True is a single-device lane; mesh runs stay on "
+                "the staged STFT-stack runners"
+            )
+        if fault_spec is not None:
+            raise ValueError(
+                "chained=True has no z-exchange host seam to inject faults "
+                "at; run fault scenarios on the staged path"
+            )
     if solver is None:
-        solver = "power"  # offline default, measured (round-3 solver_ab)
+        # offline default, measured (round-3 solver_ab); the chained lane
+        # exists to compose with the batch-in-lanes fused solve
+        solver = "fused" if chained else "power"
     import jax
     import jax.numpy as jnp
 
@@ -992,7 +1129,7 @@ def enhance_rirs_batched(
         mask_type=mask_type, mu=mu, policy=policy, solver=solver,
         cov_impl=cov_impl, precision=precision,
         z_mask_arr=z_mask_arr, z_nan_arr=z_nan_arr,
-        n_nodes=n_nodes, mesh=mesh,
+        n_nodes=n_nodes, mesh=mesh, chained=chained, stft_impl=stft_impl,
     )
 
     from collections import deque
@@ -1002,6 +1139,7 @@ def enhance_rirs_batched(
         MAX_PENDING_CHUNKS,
         ChunkPrefetcher,
         LoadedChunk,
+        fetch_chained_host,
         fetch_chunk_host,
         note_chunk_overlap,
     )
@@ -1082,6 +1220,12 @@ def enhance_rirs_batched(
         run_chaos.tick("pre_dispatch", bucket=lc.bucket, n_clips=lc.n_real)
         with obs_events.stage("chunk_enhance", n_clips=lc.n_real,
                               bucket=lc.bucket, batch=len(lc.ys)):
+            if chained:
+                # the whole chunk as ONE program over the raw time stacks:
+                # STFT, masks, both MWF steps and the scoring ISTFTs are
+                # inside run_batch_chained — nothing to stage here
+                return run_batch(jnp.asarray(lc.ys), jnp.asarray(lc.ss),
+                                 jnp.asarray(lc.ns))
             # one fused STFT program over the stacked y/s/n chunk (was
             # three separate stft dispatches); the batch runners compute
             # masks in-program, so the spec-only fused entry applies
@@ -1152,7 +1296,8 @@ def enhance_rirs_batched(
                                           bucket=lc.bucket,
                                           stall_ms=round(stall_s * 1e3, 3)):
                         res_b = dispatch_chunk(lc)
-                        host = fetch_chunk_host(res_b, lc.clip_lengths, lc.n_real)
+                        fetch = fetch_chained_host if chained else fetch_chunk_host
+                        host = fetch(res_b, lc.clip_lengths, lc.n_real)
                         submit_scoring(lc, host=host)
                     note_chunk_overlap(stall_s, time.perf_counter() - t0)
                     n_done_chunks += 1
@@ -1172,7 +1317,17 @@ def enhance_rirs_batched(
                     break
                 lc = load_chunk(Lp, chunk)
                 res_b = dispatch_chunk(lc)
-                submit_scoring(lc, res_b=res_b)
+                if chained:
+                    # the chained payload is a whole-chunk export dict, not
+                    # a sliceable TangoResult — score from the same single
+                    # batched readback the pipelined path uses
+                    submit_scoring(
+                        lc,
+                        host=fetch_chained_host(res_b, lc.clip_lengths,
+                                                lc.n_real),
+                    )
+                else:
+                    submit_scoring(lc, res_b=res_b)
         drain_chunks()
     if stopping:
         obs_events.record(

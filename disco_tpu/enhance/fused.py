@@ -1,0 +1,322 @@
+"""Whole-clip chained TANGO — the entire enhancement chain as ONE program.
+
+The staged offline driver (``enhance.driver.enhance_rir``) dispatches the
+clip as a sequence of separately jitted programs — fused STFT, mask
+estimation, the two-step ``tango`` pipeline, then six ISTFTs at persist
+time — and every stage boundary materializes full (K, F, T) spectrogram
+stacks to HBM and, on the tunneled attachment, pays a fenced ~80 ms RPC
+per dispatch (CLAUDE.md).  This module chains
+
+    stft_with_mag -> tf_mask_mag -> folded covariances -> fused step-1
+    -> z-exchange -> fused step-2 -> istft
+
+into one jitted program per clip (:func:`tango_clip_fused`) and one per
+streaming super-tick (:func:`streaming_clip_fused`, built on the shared
+:func:`~disco_tpu.enhance.streaming._streaming_tango_body` factoring via
+``streaming_tango_scan``): the only arrays that ever cross the program
+boundary are the time-domain inputs and outputs — plus the masks / z
+streams when exporting, and the continuation state of the streaming twin,
+all declared program I/O.  XLA then fuses across the former stage seams
+and no (K, F, T)-shaped intermediate escapes to the output avals (pinned
+by the committed disco-trace goldens, tests/test_trace.py).
+
+Bit-exactness: the chained program traces the SAME stage functions in the
+same order as the staged path, so the spectral pipeline itself is the
+identical computation — but the chained CLIP output is not guaranteed
+bit-equal to the staged driver's persisted wavs (XLA may fuse across the
+former dispatch boundaries and reassociate differently), and the
+streaming twin's per-window STFT sees each super-tick window's own
+reflect padding instead of the full clip's.  Parity is pinned at
+documented tolerances in tests/test_fused_clip.py; see
+doc/source/performance.rst ("Chaining the clip") for when each path is
+and isn't bit-exact.
+
+Defaults: this module is opt-in everywhere (driver ``chained=...``, CLI
+``--chained``); the staged path and its defaults are untouched.
+
+No reference counterpart: the reference enhances one clip per process
+through Python-loop stages (tango.py:460-641) and has no program
+boundary to fuse across.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax.numpy as jnp
+
+from disco_tpu.core.dsp import istft
+from disco_tpu.enhance.streaming import (
+    DEFAULT_LAMBDA_COR,
+    DEFAULT_MU,
+    DEFAULT_UPDATE_EVERY,
+    _chaos_between_blocks,
+    _float_kw,
+    streaming_tango_scan,
+)
+from disco_tpu.enhance.tango import oracle_masks, tango
+from disco_tpu.obs.accounting import counted_jit
+from disco_tpu.ops.resolve import check_canonical_precision, resolve_precision
+from disco_tpu.ops.stft_ops import stft_with_mag
+
+
+def _clip_oracle_masks(spec, mag, mask_type: str, ref_mic: int):
+    """(K, F, T) oracle step masks from the fused STFT's outputs: the
+    irm/ibm families consume the magnitudes the one STFT program already
+    emitted (``tf_mask_mag`` — no second ``abs`` pass over the spectra);
+    the iam family needs the complex sum and falls back to the spectral
+    entry point.  Reference counterpart: the mask branch of tango.py:189-211
+    (via :func:`~disco_tpu.enhance.tango.oracle_masks`).
+    """
+    if mask_type[:-1] in ("irm", "ibm"):
+        from disco_tpu.core.masks import tf_mask_mag
+
+        return tf_mask_mag(mag[1][:, ref_mic], mag[2][:, ref_mic], mask_type)
+    return oracle_masks(spec[1], spec[2], mask_type, ref_mic=ref_mic)
+
+
+@partial(counted_jit, label="tango_clip_fused",
+         static_argnames=("policy", "ref_mic", "mask_type",
+                          "oracle_step1_stats", "solver", "cov_impl",
+                          "stft_impl", "precision", "export"))
+def _tango_clip_fused_jit(
+    y,
+    s,
+    n,
+    masks_z=None,
+    mask_w=None,
+    mu: float = 1.0,
+    policy: str | None = "local",
+    ref_mic: int = 0,
+    mask_type: str = "irm1",
+    oracle_step1_stats: bool = False,
+    solver: str = "fused",
+    cov_impl: str = "auto",
+    stft_impl: str = "auto",
+    precision: str = "f32",
+    export: bool = False,
+):
+    """The jitted :func:`tango_clip_fused` (the public wrapper canonicalizes
+    the precision token and applies the traced-float convention)."""
+    precision = check_canonical_precision(precision)
+    L = y.shape[-1]
+    # ONE fused spec+magnitude program over the stacked y/s/n streams; the
+    # masks consume the emitted magnitudes in the same program.
+    spec, mag = stft_with_mag(jnp.stack([y, s, n]), impl=stft_impl,
+                              precision=precision)
+    Y, S, N = spec[0], spec[1], spec[2]
+    if masks_z is None:
+        masks_z = _clip_oracle_masks(spec, mag, mask_type, ref_mic)
+    if mask_w is None:
+        mask_w = masks_z
+    res = tango(Y, S, N, masks_z, mask_w, mu=mu, policy=policy,
+                ref_mic=ref_mic, mask_type=mask_type,
+                oracle_step1_stats=oracle_step1_stats, solver=solver,
+                cov_impl=cov_impl, precision=precision)
+    if not export:
+        return istft(res.yf, length=L)
+    # The export payload is exactly what the driver's scoring half needs
+    # (_persist_and_score's time_domain + masks/z contract): six
+    # time-domain streams through ONE stacked ISTFT, plus the (K, F, T)
+    # masks and the exported z — all declared program outputs.
+    td = istft(jnp.stack([res.yf, res.z_y, res.sf, res.nf, res.z_s, res.z_n]),
+               length=L)
+    return {
+        "td": tuple(td[i] for i in range(6)),
+        "masks_z": res.masks_z,
+        "mask_w": res.mask_w,
+        "z_y": res.z_y,
+    }
+
+
+def tango_clip_fused(
+    y,
+    s,
+    n,
+    masks_z=None,
+    mask_w=None,
+    mu: float = 1.0,
+    policy: str | None = "local",
+    ref_mic: int = 0,
+    mask_type: str = "irm1",
+    oracle_step1_stats: bool = False,
+    solver: str = "fused",
+    cov_impl: str = "auto",
+    stft_impl: str = "auto",
+    precision: str = "f32",
+    export: bool = False,
+):
+    """The whole offline clip — STFT, masks, both MWF steps, ISTFT — as ONE
+    jitted program: one dispatch (one fenced ~80 ms RPC on the tunneled
+    attachment) per clip, with no inter-stage HBM round-trip beyond the
+    declared program I/O.
+
+    Args:
+      y, s, n: (K, C, L) float time-domain mixture / speech / noise node
+        signals (the processed dataset layout of the staged driver).
+      masks_z, mask_w: optional (K, F, T) step-1 / step-2 masks as traced
+        program inputs (the CRNN path); ``None`` (default) computes oracle
+        masks of ``mask_type`` *inside* the program from the fused STFT's
+        magnitudes, and ``mask_w=None`` reuses ``masks_z`` exactly as the
+        staged oracle driver does.
+      solver: rank-1 GEVD-MWF solver spec (``beam.filters.rank1_gevd``).
+        Defaults to ``'fused'`` — the chained program exists to compose
+        with the batch-in-lanes fused solve; any spec in the grammar is
+        accepted (the 'eigh' chain is the meter baseline).
+      cov_impl / stft_impl / precision: the shared ops.resolve seams,
+        routed to every stage exactly as the staged path routes them.
+      export: ``False`` (default, the deployment program) returns only the
+        (K, L) enhanced time-domain signal; ``True`` returns the scoring
+        payload — ``td`` (the 6-tuple of (K, L) ISTFTs: yf, z_y, sf, nf,
+        z_s, z_n), ``masks_z``/``mask_w`` and the complex ``z_y`` export —
+        matching ``driver._persist_and_score``'s contract.
+
+    Reference counterpart: the full per-clip flow of
+    ``offline_tango``/``main`` (tango.py:460-641), collapsed from staged
+    Python phases into one traced program (module docstring).
+    """
+    kw = {} if (isinstance(mu, float) and mu == 1.0) else {"mu": mu}
+    return _tango_clip_fused_jit(
+        y, s, n, masks_z, mask_w, policy=policy, ref_mic=ref_mic,
+        mask_type=mask_type, oracle_step1_stats=oracle_step1_stats,
+        solver=solver, cov_impl=cov_impl, stft_impl=stft_impl,
+        precision=resolve_precision(precision), export=export, **kw,
+    )
+
+
+tango_clip_fused.jitted = _tango_clip_fused_jit.jitted
+tango_clip_fused.lower = _tango_clip_fused_jit.lower
+tango_clip_fused.clear_cache = _tango_clip_fused_jit.clear_cache
+tango_clip_fused.__wrapped__ = _tango_clip_fused_jit.__wrapped__
+
+
+@partial(counted_jit, label="streaming_clip_fused",
+         static_argnames=("update_every", "ref_mic", "mask_type", "policy",
+                          "solver", "blocks_per_dispatch", "stft_impl",
+                          "precision"))
+def _streaming_clip_fused_jit(
+    y,
+    s=None,
+    n=None,
+    masks_z=None,
+    mask_w=None,
+    lambda_cor: float = DEFAULT_LAMBDA_COR,
+    update_every: int = DEFAULT_UPDATE_EVERY,
+    mu: float = DEFAULT_MU,
+    ref_mic: int = 0,
+    mask_type: str = "irm1",
+    policy: str | None = "local",
+    state=None,
+    solver: str = "eigh",
+    z_avail=None,
+    blocks_per_dispatch: int = 1,
+    stft_impl: str = "auto",
+    precision: str = "f32",
+):
+    """The jitted :func:`streaming_clip_fused` (the public wrapper adds the
+    host-side chaos seam and the traced-float convention)."""
+    precision = check_canonical_precision(precision)
+    L = y.shape[-1]
+    if masks_z is None:
+        if s is None or n is None:
+            raise ValueError(
+                "streaming_clip_fused: either pass masks_z explicitly or "
+                "provide s and n for in-program oracle masks"
+            )
+        spec, mag = stft_with_mag(jnp.stack([y, s, n]), impl=stft_impl,
+                                  precision=precision)
+        Y = spec[0]
+        masks_z = _clip_oracle_masks(spec, mag, mask_type, ref_mic)
+    else:
+        Y = stft_with_mag(y, impl=stft_impl, precision=precision)[0]
+    if mask_w is None:
+        mask_w = masks_z
+    # The scan machinery of streaming_tango_scan, inlined into THIS trace
+    # (__wrapped__ is the raw function): the per-block state transition is
+    # the shared _streaming_tango_body, so the spectral pipeline inside
+    # this program is the per-block streaming program by construction.
+    out = streaming_tango_scan.__wrapped__(
+        Y, masks_z, mask_w, lambda_cor=lambda_cor, update_every=update_every,
+        mu=mu, ref_mic=ref_mic, policy=policy, state=state, solver=solver,
+        z_avail=z_avail, blocks_per_dispatch=blocks_per_dispatch,
+        precision=precision,
+    )
+    return {"yf": istft(out["yf"], length=L), "state": out["state"]}
+
+
+def streaming_clip_fused(
+    y,
+    s=None,
+    n=None,
+    masks_z=None,
+    mask_w=None,
+    lambda_cor: float = DEFAULT_LAMBDA_COR,
+    update_every: int = DEFAULT_UPDATE_EVERY,
+    mu: float = DEFAULT_MU,
+    ref_mic: int = 0,
+    mask_type: str = "irm1",
+    policy: str | None = "local",
+    state=None,
+    solver: str = "eigh",
+    z_avail=None,
+    blocks_per_dispatch: int = 1,
+    stft_impl: str = "auto",
+    precision: str = "f32",
+):
+    """One streaming super-tick — window STFT, masks, the scanned N-block
+    two-step streaming pipeline, ISTFT — as ONE jitted program: the
+    time-domain window goes in, the enhanced time-domain window and the
+    continuation ``state`` come out, and nothing else crosses the program
+    boundary.
+
+    Built on the same ``_streaming_tango_body`` factoring as
+    ``streaming_tango``/``streaming_tango_scan``: the scan body inside
+    this program IS the per-block streaming program (the load-bearing
+    bit-exactness contract of the scanned driver — see
+    ``streaming_tango_scan``'s docstring), so the spectral pipeline
+    matches the staged streaming path exactly.  The *window* STFT is where
+    the twin differs: each super-tick window is transformed with its own
+    centered reflect padding, so the first/last frames of a window differ
+    from a full-clip STFT's — the documented chained-vs-staged boundary
+    tolerance (module docstring).
+
+    Args:
+      y: (K, C, Lw) time-domain window with ``1 + Lw // hop`` STFT frames
+        splitting into ``blocks_per_dispatch`` refresh-aligned blocks
+        (``streaming_tango_scan``'s frame contract; e.g. Lw = 1792 gives
+        T = 8 = 2 blocks x update_every 4 at the defaults).
+      s, n: optional (K, C, Lw) clean components for in-program oracle
+        masks of ``mask_type``; alternatively pass ``masks_z`` (and
+        optionally ``mask_w``) explicitly as (K, F, T) program inputs.
+      state: optional continuation carry from the previous super-tick's
+        returned ``state`` (same pytree as ``streaming_tango``); the
+        ``between_blocks`` chaos seam fires on continuation entry exactly
+        like the staged wrappers.
+      solver / precision: the shared dispatch seams — a ``'fused*'`` spec
+        runs every refresh GEVD batch through the fused solve.
+      z_avail: optional (K,) or (K, n_refresh) float availability of the
+        exchanged z streams, routed to the scan's fault path unchanged
+        (``streaming_tango_scan``) — the serve scheduler's per-session
+        fault plans reach the chained lane through this.
+
+    Returns:
+      dict with ``yf`` (K, Lw) enhanced time-domain window and ``state``
+      for the next super-tick.
+
+    No direct reference counterpart: the reference never wires its online
+    estimator into any driver (see ``streaming_tango_scan``), and
+    dispatch-RPC amortization is a tunneled-TPU concern.
+    """
+    _chaos_between_blocks(state)
+    return _streaming_clip_fused_jit(
+        y, s, n, masks_z, mask_w, update_every=update_every, ref_mic=ref_mic,
+        mask_type=mask_type, policy=policy, state=state, solver=solver,
+        z_avail=z_avail, blocks_per_dispatch=blocks_per_dispatch,
+        stft_impl=stft_impl, precision=resolve_precision(precision),
+        **_float_kw(lambda_cor, mu),
+    )
+
+
+streaming_clip_fused.jitted = _streaming_clip_fused_jit.jitted
+streaming_clip_fused.lower = _streaming_clip_fused_jit.lower
+streaming_clip_fused.clear_cache = _streaming_clip_fused_jit.clear_cache
+streaming_clip_fused.__wrapped__ = _streaming_clip_fused_jit.__wrapped__

@@ -240,6 +240,44 @@ def fetch_chunk_host(res_b, clip_lengths, n_real: int) -> dict:
     return host
 
 
+def fetch_chained_host(out_b, clip_lengths, n_real: int) -> dict:
+    """Chained-lane twin of :func:`fetch_chunk_host`: the ``run_batch_chained``
+    runner already converted every clip to time domain *inside* the chained
+    program (its export payload carries six (B, K, Lp) stacks), so this
+    fetch only moves the payload across in ONE batched ``device_get_tree``
+    and trims each clip's bucket padding to its true length on host (numpy
+    views — the trim is not a device crossing).  Returns the same dict
+    shape as :func:`fetch_chunk_host`.
+
+    The trimmed streams are the chained program's own ISTFTs of the padded
+    clip, sliced — not a per-clip ``istft(length=L_i)`` — so chained chunk
+    artifacts are parity-matched to the staged path at the documented
+    chained tolerance (``enhance.fused``), not bit-identical.
+
+    No reference counterpart (module docstring).
+    """
+    from disco_tpu.utils.transfer import device_get_tree
+
+    with obs_events.stage("chunk_readback", n_clips=n_real, chained=True):
+        t0 = time.perf_counter()
+        host = device_get_tree({
+            "td": tuple(a[:n_real] for a in out_b["td"]),
+            "masks_z": out_b["masks_z"][:n_real],
+            "mask_w": out_b["mask_w"][:n_real],
+            "z_y": out_b["z_y"][:n_real],
+        })
+        dt_ms = (time.perf_counter() - t0) * 1e3
+    obs_registry.gauge("readback_ms").set(dt_ms)
+    obs_registry.histogram("readback_ms").observe(dt_ms)
+    obs_registry.counter("chunk_readbacks").inc()
+    td_stacks = host["td"]
+    host["td"] = [
+        tuple(a[i][..., : int(clip_lengths[i])] for a in td_stacks)
+        for i in range(n_real)
+    ]
+    return host
+
+
 def note_chunk_overlap(stall_s: float, busy_s: float) -> None:
     """Record one chunk's overlap economics: the stall the dispatch loop
     paid waiting for the prefetcher and the busy time it then spent, folded

@@ -53,6 +53,7 @@ from disco_tpu.beam.covariance import frame_mean_covariance
 from disco_tpu.beam.filters import rank1_gevd
 from disco_tpu.core.masks import tf_mask
 from disco_tpu.ops.resolve import check_canonical_precision
+from disco_tpu.solver_spec import is_fused_spec
 
 Policy = str | None
 _POLICIES = ("local", "none", "distant", "compressed", "use_oracle_refs", "use_oracle_zs")
@@ -134,6 +135,41 @@ def _masked_cov_pair(X, mask, cov_impl: str, frame_axis, precision: str = "f32")
 
 
 # ------------------------------------------------------------------ step 1
+def _step1_covariances(Y, S, N, mask_z, oracle_stats: bool, frame_axis,
+                       cov_impl: str, precision: str):
+    """The covariance stage of step 1 at ONE node: (F, C, C) (Rss, Rnn)
+    pencils from the masked mixture (or the oracle S/N stats).  Factored
+    out of :func:`tango_step1` so :func:`tango` can vmap THIS stage alone
+    over the node axis and hand the stacked (K, F, C, C) pencils to a
+    single batch-in-lanes fused solve (same ops, same order — the
+    composition in ``tango_step1`` traces the identical program).
+
+    Reference counterpart: the covariance half of tango.py:326-349.
+    """
+    if oracle_stats:
+        Rss = frame_mean_covariance(S, axis_name=frame_axis)  # (F, C, C)
+        Rnn = frame_mean_covariance(N, axis_name=frame_axis)
+        return Rss, Rnn
+    return _masked_cov_pair(Y, mask_z, cov_impl, frame_axis, precision)
+
+
+def _step1_apply(w, t1, Y, S, N, ref_mic: int = 0):
+    """The filter-application stage of step 1 at ONE node: (F, C) weights →
+    the compressed (F, T) exchange streams (the other factored half of
+    :func:`tango_step1` — see :func:`_step1_covariances`).
+
+    Reference counterpart: the ``np.inner`` applications of
+    tango.py:361-374.
+    """
+    z_y = jnp.einsum("fc,cft->ft", jnp.conj(w), Y)
+    z_s = jnp.einsum("fc,cft->ft", jnp.conj(w), S)
+    z_n = jnp.einsum("fc,cft->ft", jnp.conj(w), N)
+    z_t1_s = jnp.einsum("fc,cft->ft", t1, S)  # np.inner(t1, ·): no conjugate
+    z_t1_n = jnp.einsum("fc,cft->ft", t1, N)
+    zn = Y[ref_mic] - z_y
+    return {"z_y": z_y, "z_s": z_s, "z_n": z_n, "zn": zn, "z_t1_s": z_t1_s, "z_t1_n": z_t1_n}
+
+
 @partial(jax.jit, static_argnames=("oracle_stats", "ref_mic", "frame_axis", "solver",
                                    "cov_impl", "precision"))
 def tango_step1(
@@ -164,19 +200,10 @@ def tango_step1(
       z_t1_s/z_t1_n (F, T) (the ``z_gevd_*`` diagnostics of tango.py:372-374).
     """
     precision = check_canonical_precision(precision)
-    if oracle_stats:
-        Rss = frame_mean_covariance(S, axis_name=frame_axis)  # (F, C, C)
-        Rnn = frame_mean_covariance(N, axis_name=frame_axis)
-    else:
-        Rss, Rnn = _masked_cov_pair(Y, mask_z, cov_impl, frame_axis, precision)
+    Rss, Rnn = _step1_covariances(Y, S, N, mask_z, oracle_stats, frame_axis,
+                                  cov_impl, precision)
     w, t1 = rank1_gevd(Rss, Rnn, mu=mu, solver=solver, precision=precision)  # (F, C) each
-    z_y = jnp.einsum("fc,cft->ft", jnp.conj(w), Y)
-    z_s = jnp.einsum("fc,cft->ft", jnp.conj(w), S)
-    z_n = jnp.einsum("fc,cft->ft", jnp.conj(w), N)
-    z_t1_s = jnp.einsum("fc,cft->ft", t1, S)  # np.inner(t1, ·): no conjugate
-    z_t1_n = jnp.einsum("fc,cft->ft", t1, N)
-    zn = Y[ref_mic] - z_y
-    return {"z_y": z_y, "z_s": z_s, "z_n": z_n, "zn": zn, "z_t1_s": z_t1_s, "z_t1_n": z_t1_n}
+    return _step1_apply(w, t1, Y, S, N, ref_mic)
 
 
 # ------------------------------------------------------------------ step 2
@@ -420,13 +447,31 @@ def tango(
     canonicalize with ``resolve_precision`` first, as the CLI/driver do).
     """
     precision = check_canonical_precision(precision)
-    step1 = jax.vmap(
-        lambda y, s, n, m: tango_step1(
-            y, s, n, m, mu=mu, oracle_stats=oracle_step1_stats, ref_mic=ref_mic,
-            solver=solver, cov_impl=cov_impl, precision=precision,
+    if is_fused_spec(solver):
+        # Step-1 fused solve, batched across K×F (the step-1 fusion round):
+        # vmapping the whole of tango_step1 over the node axis would run K
+        # separate fused-solve instances, each padding its F pencils to a
+        # full lane tile (~half the lanes dead at F=257, tile=512).  The
+        # fused kernels are batch-polymorphic — ``planes()`` flattens every
+        # leading axis into lanes (ops/mwf_ops.py) — so instead the
+        # covariance stage alone vmaps to stacked (K, F, C, C) pencils and
+        # ALL K·F step-1 solves run as ONE batch-in-lanes VMEM-resident
+        # program through the same dispatch table.  Identical math, one
+        # program instead of K; parity pinned in tests/test_mwf_ops.py.
+        Rss, Rnn = jax.vmap(
+            lambda y, s, n, m: _step1_covariances(
+                y, s, n, m, oracle_step1_stats, None, cov_impl, precision)
+        )(Y, S, N, masks_z)
+        w1, t1 = rank1_gevd(Rss, Rnn, mu=mu, solver=solver, precision=precision)
+        all_z = jax.vmap(partial(_step1_apply, ref_mic=ref_mic))(w1, t1, Y, S, N)
+    else:
+        step1 = jax.vmap(
+            lambda y, s, n, m: tango_step1(
+                y, s, n, m, mu=mu, oracle_stats=oracle_step1_stats, ref_mic=ref_mic,
+                solver=solver, cov_impl=cov_impl, precision=precision,
+            )
         )
-    )
-    all_z = step1(Y, S, N, masks_z)
+        all_z = step1(Y, S, N, masks_z)
 
     K = Y.shape[0]
     if z_nan is not None:

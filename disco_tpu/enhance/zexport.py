@@ -25,7 +25,8 @@ from disco_tpu.io.layout import DatasetLayout, case_of_rir
 
 def compute_z_signals(
     y, s, n, masks_z=None, mask_type: str = "irm1", mu: float = 1.0, oracle_stats: bool = False,
-    Y=None, S=None, N=None,
+    Y=None, S=None, N=None, solver: str = "power", cov_impl: str = "auto",
+    precision: str = "f32",
 ):
     """Step 1 over all nodes: (K, C, L) time signals → dict of (K, F, T)
     z streams (reference get_z_signals.py:213-317, vectorized).
@@ -35,7 +36,20 @@ def compute_z_signals(
     explicit masks, ``s``/``n`` may be None (the clean-component streams
     z_s/z_n then come out zero; export_z does not save them).  Precomputed
     STFTs may be passed as ``Y``/``S``/``N`` to skip the transform.
+
+    ``solver``/``cov_impl``/``precision`` route to the step-1 solve and
+    covariance stages exactly as in :func:`disco_tpu.enhance.tango.tango`
+    (defaults unchanged — 'power'/'auto'/'f32').  A ``'fused*'`` solver
+    spec runs ALL K×F step-1 pencils as ONE batch-in-lanes fused solve
+    (the step-1 fusion round) instead of K vmapped per-node instances;
+    this is the step-1 lane ``bench.py`` times as ``rtf_fused_step1``.
     """
+    from disco_tpu.enhance.tango import _step1_apply, _step1_covariances
+    from disco_tpu.beam.filters import rank1_gevd
+    from disco_tpu.ops.resolve import check_canonical_precision
+    from disco_tpu.solver_spec import is_fused_spec
+
+    precision = check_canonical_precision(precision)
     Y = stft(jnp.asarray(y)) if Y is None else jnp.asarray(Y)
     if S is None:
         S = stft(jnp.asarray(s)) if s is not None else jnp.zeros_like(Y)
@@ -45,8 +59,20 @@ def compute_z_signals(
         if s is None or n is None:
             raise ValueError("either pass masks_z explicitly or provide s and n for oracle masks")
         masks_z = oracle_masks(S, N, mask_type)
-    step1 = jax.vmap(lambda yk, sk, nk, mk: tango_step1(yk, sk, nk, mk, mu=mu, oracle_stats=oracle_stats))
-    out = step1(Y, S, N, jnp.asarray(masks_z))
+    if is_fused_spec(solver):
+        # the K×F batch-in-lanes seam of enhance.tango.tango: one fused
+        # solve over the stacked pencils, covariance/apply stages vmapped
+        Rss, Rnn = jax.vmap(
+            lambda yk, sk, nk, mk: _step1_covariances(
+                yk, sk, nk, mk, oracle_stats, None, cov_impl, precision)
+        )(Y, S, N, jnp.asarray(masks_z))
+        w1, t1 = rank1_gevd(Rss, Rnn, mu=mu, solver=solver, precision=precision)
+        out = jax.vmap(_step1_apply)(w1, t1, Y, S, N)
+    else:
+        step1 = jax.vmap(lambda yk, sk, nk, mk: tango_step1(
+            yk, sk, nk, mk, mu=mu, oracle_stats=oracle_stats, solver=solver,
+            cov_impl=cov_impl, precision=precision))
+        out = step1(Y, S, N, jnp.asarray(masks_z))
     out["masks_z"] = masks_z
     return out
 

@@ -106,6 +106,10 @@ def _check_parity(failures: list, server_kw: dict | None = None,
         (33, {"lambda_cor": 0.97}, {"lambda_cor": 0.97}, None),
         (34, {}, {"z_avail": np.array([1, 0, 1, 1], np.float32)},
          np.array([1, 0, 1, 1], np.float32)),
+        # the step-1+step-2 fused solve rides the session config through
+        # the same _resolve_step discipline — bit-parity must hold for the
+        # fused spec exactly as for eigh (per-block AND super-tick cycles)
+        (35, {"solver": "fused-xla"}, {"solver": "fused-xla"}, None),
     ]
     scenes = [(_scene(seed), ckw, okw, zm) for seed, ckw, okw, zm in specs]
     refs = [_offline(Y, m, **okw) for (Y, m), _ckw, okw, _zm in scenes]
@@ -446,6 +450,62 @@ def _check_overload(failures: list) -> dict:
             "recovery_tick_budget": tick_budget}
 
 
+def _check_chained(failures: list) -> dict:
+    """Experiment 6: the chained (time-domain) lane.  One client streams
+    raw float audio windows; the server dispatches each whole window as ONE
+    jitted program (window STFT -> masks -> scanned two-step pipeline ->
+    ISTFT, :func:`disco_tpu.enhance.fused.streaming_clip_fused`) resolved
+    through the same ``_resolve_step`` discipline as every other serve
+    step, with the fused batch-in-lanes solver riding the session config —
+    so serve output is bit-identical to the offline chained twin by
+    construction, continuation state included."""
+    import numpy as np
+
+    from disco_tpu.enhance.fused import streaming_clip_fused
+    from disco_tpu.serve import EnhanceServer, ServeClient
+
+    F = 257
+    block_t = BLOCK
+    Lw = (block_t - 1) * (F - 1)
+    rng = np.random.default_rng(71)
+    wins = [rng.standard_normal((K, C, Lw)).astype(np.float32)
+            for _ in range(2)]
+    masks = [rng.uniform(0.05, 0.95, size=(K, F, block_t)).astype(np.float32)
+             for _ in range(2)]
+    refs, state = [], None
+    for y, m in zip(wins, masks):
+        out = streaming_clip_fused(y, masks_z=m, mask_w=m, update_every=U,
+                                   policy="local", state=state,
+                                   solver="fused-xla")
+        # disco-lint: disable=DL002 -- hermetic CPU gate: two offline reference windows on host arrays, no tunnel crossing to batch
+        refs.append(np.asarray(out["yf"]))
+        state = out["state"]
+
+    srv = EnhanceServer(max_sessions=2)
+    addr = srv.start()
+    try:
+        cl = ServeClient(addr)
+        cl.open(_config(F, solver="fused-xla", domain="time"))
+        got = []
+        for i, (y, m) in enumerate(zip(wins, masks)):
+            cl.send_block(y, m, m)
+            got.append(cl.recv_enhanced(i, timeout_s=120))
+        cl.close()
+        cl.shutdown()
+    finally:
+        srv.stop()
+    for i, (g, r) in enumerate(zip(got, refs)):
+        if g.shape != r.shape or g.dtype.kind != "f":
+            failures.append(
+                f"chained: window {i} came back {g.dtype}{g.shape}, "
+                f"expected float {r.shape}")
+        elif not np.array_equal(g, r):
+            failures.append(
+                f"chained: window {i} differs from the offline chained twin "
+                f"(max abs diff {np.abs(g - r).max():g})")
+    return {"windows": len(got)}
+
+
 def main(argv=None) -> int:
     """Run the online-serving gate (``make serve-check``); exit 1 on failure."""
     import os
@@ -501,6 +561,7 @@ def main(argv=None) -> int:
                                     server_kw=st_kw)
             chaos_stats["crashes_injected"] += st_chaos["crashes_injected"]
             overload = _check_overload(failures)
+            chained = _check_chained(failures)
             obs.record("counters", **obs.REGISTRY.snapshot())
         events = obs.read_events(obs_log)  # schema-validating read
 
@@ -551,6 +612,7 @@ def main(argv=None) -> int:
         "overload_peak_rung": overload["peak_rung"],
         "overload_capacity_rejects": overload["capacity_rejects"],
         "overload_recoveries": overload["recoveries"],
+        "chained_windows": chained["windows"],
         "jax_processes": 1,   # by construction: clients are numpy threads
         "sigkills_issued": 0,
     }))

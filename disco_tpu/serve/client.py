@@ -347,15 +347,22 @@ class ServeClient:
         complex64, masks (K, F, T) float32; T = config.block_frames except
         for a shorter final block.  Sessions opened with
         ``SessionConfig(masks="model")`` send NO masks (the server fills
-        both from its live weight generation) — pass None, the default."""
+        both from its live weight generation) — pass None, the default.
+        Chained sessions (``SessionConfig(domain="time")``) send float32
+        (K, C, samples) time windows instead — one whole super-tick window
+        per block, masks on the window's STFT grid (K, F, 1 + samples //
+        (n_freq - 1)) — and receive (K, samples) enhanced float windows."""
         if self.session_id is None:
             raise ServeError("protocol", "send_block before open")
         seq = self.next_seq if seq is None else int(seq)
         if self.resend_from is not None and seq <= self.resend_from:
             self.resend_from = None      # resending from the rejection point
+        wire_dtype = (np.float32
+                      if self.config is not None and self.config.domain == "time"
+                      else np.complex64)
         frame = {
             "type": "block", "seq": seq,
-            "Y": np.ascontiguousarray(Y, dtype=np.complex64),
+            "Y": np.ascontiguousarray(Y, dtype=wire_dtype),
             "mask_z": (None if mask_z is None
                        else np.ascontiguousarray(mask_z, dtype=np.float32)),
             "mask_w": (None if mask_w is None

@@ -140,14 +140,19 @@ _STEP_LOCK = threading.Lock()
 _STEP_STATICS = ("update_every", "ref_mic", "with_diagnostics", "policy", "solver")
 
 
-def _resolve_step(attr: str, label: str, extra_static=()):
+def _resolve_step(attr: str, label: str, extra_static=(), statics=None):
     """The ONE step-resolution discipline, lazily cached per entry point.
 
-    CPU: literally the offline jitted wrapper (``enhance.streaming.<attr>``)
-    itself, so serve and offline share one compiled program per shape
-    bucket and parity is true by construction.  Off-CPU: a ``counted_jit``
-    of the same underlying function with the continuation carry donated
-    (aliasing metadata only — the HLO math is unchanged).
+    CPU: literally the offline jitted wrapper itself — resolved from
+    ``enhance.streaming``, falling back to ``enhance.fused`` for the
+    chained lane's twin — so serve and offline share one compiled program
+    per shape bucket and parity is true by construction.  Off-CPU: a
+    ``counted_jit`` of the same underlying function with the continuation
+    carry donated (aliasing metadata only — the HLO math is unchanged).
+    ``statics`` overrides the default ``extra_static + _STEP_STATICS``
+    off-CPU static set for entry points whose signature differs from the
+    per-block streaming step's (jit rejects static names absent from the
+    wrapped signature).
     """
     step = _STEPS.get(attr)
     if step is None:
@@ -155,17 +160,20 @@ def _resolve_step(attr: str, label: str, extra_static=()):
             if attr not in _STEPS:
                 import jax
 
-                from disco_tpu.enhance import streaming
+                from disco_tpu.enhance import fused, streaming
                 from disco_tpu.obs.accounting import counted_jit
 
-                wrapper = getattr(streaming, attr)
+                wrapper = getattr(streaming, attr, None)
+                if wrapper is None:
+                    wrapper = getattr(fused, attr)
                 if jax.default_backend() == "cpu":
                     _STEPS[attr] = wrapper
                 else:
                     _STEPS[attr] = counted_jit(
                         wrapper.__wrapped__,
                         label=label,
-                        static_argnames=tuple(extra_static) + _STEP_STATICS,
+                        static_argnames=(tuple(statics) if statics is not None
+                                         else tuple(extra_static) + _STEP_STATICS),
                         donate_argnames=("state",),
                     )
             step = _STEPS[attr]
@@ -186,6 +194,27 @@ def _serve_scan_step():
                          extra_static=("blocks_per_dispatch",))
 
 
+def _serve_chained_step():
+    """The chained-lane step callable: one whole time-domain window through
+    the ONE-program twin (:func:`~disco_tpu.enhance.fused.
+    streaming_clip_fused` — window STFT, masks applied, the scanned
+    two-step streaming pipeline and ISTFT inside a single dispatch),
+    resolved with exactly the :func:`_resolve_step` discipline.
+    Time-domain sessions never group into multi-window scans: the window
+    STFT's reflect padding is per-window, so concatenating two windows
+    would change the transform — every dispatch is one window at
+    ``blocks_per_dispatch=1`` and the RPC amortization comes from the
+    window WIDTH (one fenced dispatch per ``block_frames`` STFT frames of
+    audio), not from grouping.
+
+    No reference counterpart (module docstring)."""
+    return _resolve_step(
+        "streaming_clip_fused", "serve_chained_step",
+        statics=("update_every", "ref_mic", "mask_type", "policy", "solver",
+                 "blocks_per_dispatch", "stft_impl", "precision"),
+    )
+
+
 class Scheduler:
     """Session registry + the per-tick continuous-batching loop body.
 
@@ -200,6 +229,7 @@ class Scheduler:
                  max_blocks_per_tick: int = DEFAULT_MAX_BLOCKS_PER_TICK,
                  blocks_per_super_tick: int = 1,
                  overlap_readback: bool | None = None,
+                 allow_chained: bool = True,
                  fault_spec=None, tap=None,
                  dispatch_retries: int = 2,
                  dispatch_retry_base_s: float = 0.05,
@@ -244,6 +274,11 @@ class Scheduler:
         #: when super-ticks are on.
         self.overlap_readback = (blocks_per_super_tick > 1
                                  if overlap_readback is None else overlap_readback)
+        #: admit ``domain="time"`` (chained-lane) sessions?  Each chained
+        #: shape bucket compiles its own one-program window; an operator
+        #: who wants the bounded STFT-only compile surface turns the lane
+        #: off at the door (``disco-serve --no-chained-sessions``).
+        self.allow_chained = allow_chained
         self.fault_spec = fault_spec
         #: opt-in flywheel corpus tap (disco_tpu.flywheel.CorpusTap), fed at
         #: the post-readback seam with every delivered block's host arrays
@@ -380,6 +415,13 @@ class Scheduler:
                 'masks="model" needs a promotion store; start the server '
                 "with --promote-dir",
             )
+        if config.domain == "time" and not self.allow_chained:
+            obs_registry.counter("admission_reject").inc()
+            raise AdmissionError(
+                "bad_config",
+                'domain="time" (chained-lane) sessions are disabled on this '
+                "server (--no-chained-sessions)",
+            )
 
         with self._lock:
             if len(self._sessions) + len(self._parked) >= self.max_sessions:
@@ -506,6 +548,26 @@ class Scheduler:
                 f"block shape {Y.shape} does not fit session shape {exp} "
                 "(only the final block may be shorter)"
             )
+        if cfg.domain == "time":
+            # the chained lane: each block is one float time window whose
+            # STFT frame count must stay refresh-aligned (the scan's
+            # contract) — reject at the door, not as a dispatch-thread
+            # evict the client can't interpret
+            if np.iscomplexobj(Y):
+                raise QueueFull(
+                    f"session {session.id} has domain='time'; blocks must "
+                    "be float time windows, not complex STFT frames"
+                )
+            t_frames = cfg.frames_of(Y.shape[-1])
+            if t_frames % cfg.update_every:
+                raise QueueFull(
+                    f"time window of {Y.shape[-1]} samples has {t_frames} "
+                    f"STFT frames — not a multiple of update_every="
+                    f"{cfg.update_every} (chunk-exact streaming needs "
+                    "refresh-aligned windows)"
+                )
+        else:
+            t_frames = Y.shape[-1]
         if cfg.masks == "model":
             # the model-mask lane: blocks arrive maskless and the dispatch
             # thread fills both masks from the session's current weight
@@ -521,7 +583,7 @@ class Scheduler:
                 m = np.asarray(m)  # disco-lint: disable=DL002 -- wire-decoded host arrays on the I/O thread; no device array can reach push_block
                 if not np.issubdtype(m.dtype, np.number):
                     raise ValueError(f"{name} dtype {m.dtype} is not numeric")
-                if m.shape != (cfg.n_nodes, cfg.n_freq, Y.shape[-1]):
+                if m.shape != (cfg.n_nodes, cfg.n_freq, t_frames):
                     raise QueueFull(f"{name} shape {m.shape} does not match block {Y.shape}")
         if session.queue_depth() >= self.max_queue_blocks:
             raise QueueFull(
@@ -1042,6 +1104,13 @@ class Scheduler:
             progress = [0]
         if self.promote is not None and session.config.masks == "model":
             self._fill_model_masks(session, blocks)
+        # the chained (time-domain) lane: every block is one whole window
+        # through the one-program twin — never scan-grouped (per-window
+        # reflect padding, _serve_chained_step docstring) and never tapped
+        # (the corpus tap's shard contract is STFT tuples)
+        chained = session.config.domain == "time"
+        if chained:
+            keep_raw = False
         done = 0
         # every run of N consecutive full blocks rides one scanned
         # dispatch; the sub-N remainder (or a group holding the
@@ -1051,7 +1120,7 @@ class Scheduler:
         # ever sees N full refresh-aligned blocks).
         for g in range(0, len(blocks), n_super):
             group = blocks[g:g + n_super]
-            if (n_super > 1 and len(group) == n_super
+            if (not chained and n_super > 1 and len(group) == n_super
                     and all(b[1].shape[-1] == bf for b in group)):
                 yf = self._dispatch_resilient(self._dispatch_scan,
                                               session, group)
@@ -1066,8 +1135,9 @@ class Scheduler:
                                      len(group))
             else:
                 for seq, Y, mz, mw in group:
-                    yf = self._dispatch_resilient(self._dispatch,
-                                                  session, seq, Y, mz, mw)
+                    yf = self._dispatch_resilient(
+                        self._dispatch_chained if chained else self._dispatch,
+                        session, seq, Y, mz, mw)
                     units.append(
                         (session, [seq], yf, time.time(),
                          [(seq, Y, mz, mw)] if keep_raw else None)
@@ -1350,6 +1420,45 @@ class Scheduler:
             to_device(np.ascontiguousarray(Y)),
             to_device(np.ascontiguousarray(mz)),
             to_device(np.ascontiguousarray(mw)),
+            update_every=u,
+            ref_mic=cfg.ref_mic,
+            policy=cfg.policy,
+            state=state,
+            solver=cfg.solver,
+            z_avail=session.block_z_avail(seq, n_refresh),
+            **kw,
+        )
+        session.state = out["state"]
+        return out["yf"]
+
+    def _dispatch_chained(self, session: Session, seq: int, y, mz, mw):
+        """Queue one time-domain session's window on device (async — no
+        readback): the chained lane's counterpart of :meth:`_dispatch`.
+        The whole window rides ONE jitted program — window STFT, the masks
+        applied, the scanned two-step streaming pipeline and the ISTFT
+        (:func:`~disco_tpu.enhance.fused.streaming_clip_fused`) — so only
+        the float window crosses in and only the enhanced float window and
+        the continuation carry cross out.  The carry is the same streaming
+        state pytree as the STFT lane's: a window boundary is a block
+        boundary for checkpoints, generation swaps and replay unchanged."""
+        if _DISPATCH_FAULT_INJECTOR is not None:
+            _DISPATCH_FAULT_INJECTOR(session.id, [seq])
+        import jax
+
+        from disco_tpu.utils.transfer import to_device
+
+        from disco_tpu.enhance.streaming import _float_kw
+
+        cfg = session.config
+        u = cfg.update_every
+        n_refresh = cfg.frames_of(y.shape[-1]) // u
+        step = _serve_chained_step()
+        state = jax.tree_util.tree_map(to_device, session.state)
+        kw = _float_kw(cfg.lambda_cor, cfg.mu)
+        out = step(
+            to_device(np.ascontiguousarray(y)),
+            masks_z=to_device(np.ascontiguousarray(mz)),
+            mask_w=to_device(np.ascontiguousarray(mw)),
             update_every=u,
             ref_mic=cfg.ref_mic,
             policy=cfg.policy,

@@ -90,6 +90,7 @@ class EnhanceServer:
                  max_blocks_per_tick: int = DEFAULT_MAX_BLOCKS_PER_TICK,
                  blocks_per_super_tick: int = 1,
                  overlap_readback: bool | None = None,
+                 allow_chained: bool = True,
                  max_backlog: int = DEFAULT_MAX_BACKLOG,
                  tick_interval_s: float = 0.002,
                  state_dir=None, fault_spec=None, tap=None,
@@ -117,8 +118,8 @@ class EnhanceServer:
             max_sessions=max_sessions, max_queue_blocks=max_queue_blocks,
             max_blocks_per_tick=max_blocks_per_tick,
             blocks_per_super_tick=blocks_per_super_tick,
-            overlap_readback=overlap_readback, fault_spec=fault_spec,
-            tap=tap,
+            overlap_readback=overlap_readback, allow_chained=allow_chained,
+            fault_spec=fault_spec, tap=tap,
             park_ttl_s=park_ttl_s, replay_blocks=replay_blocks,
             dispatch_retries=dispatch_retries, retry_seed=retry_seed,
             tick_deadline_s=tick_deadline_s,

@@ -52,6 +52,17 @@ SERVE_POLICIES = ("local", "distant", "none")
 #: promote store (``--promote-dir``).
 MASK_SOURCES = ("client", "model")
 
+#: Wire domain of a session's blocks.  ``"stft"`` (default, the PR-16 wire
+#: shape): blocks are (K, C, F, T) complex STFT frames, outputs (K, F, T)
+#: complex — the client owns the transforms.  ``"time"`` (the chained
+#: lane): each block is one (K, C, samples) float super-tick *window*,
+#: dispatched whole through the one-program chained twin
+#: (:func:`disco_tpu.enhance.fused.streaming_clip_fused` — window STFT,
+#: masks, scanned two-step pipeline and ISTFT all inside one jitted
+#: program), and the delivered output is the (K, samples) enhanced float
+#: window.  Masks still ride the wire in the STFT grid (K, F, T_frames).
+DOMAINS = ("stft", "time")
+
 
 @dataclasses.dataclass(frozen=True)
 class SessionConfig:
@@ -75,6 +86,7 @@ class SessionConfig:
     policy: str = "local"
     solver: str = "eigh"
     masks: str = "client"
+    domain: str = "stft"
 
     def __post_init__(self):
         # lambda_cor / mu are traced floats with an omit-when-default calling
@@ -121,6 +133,27 @@ class SessionConfig:
                 f"session config masks {self.masks!r} unknown; one of "
                 f"{MASK_SOURCES}"
             )
+        if self.domain not in DOMAINS:
+            raise ValueError(
+                f"session config domain {self.domain!r} unknown; one of "
+                f"{DOMAINS}"
+            )
+        if self.domain == "time":
+            # the chained lane's window STFT derives its hop from the
+            # config's frequency grid (hop = n_fft/2 = n_freq - 1); the
+            # model-mask lane estimates masks from STFT-domain wire blocks
+            # (promote/lane.block_masks) which a time session never sends
+            if self.n_freq < 2:
+                raise ValueError(
+                    "session config domain='time' needs n_freq >= 2 "
+                    "(hop is derived as n_freq - 1)"
+                )
+            if self.masks != "client":
+                raise ValueError(
+                    "session config domain='time' supports masks='client' "
+                    "only: the model-mask lane fills masks from STFT wire "
+                    "blocks, which a time-domain session never sends"
+                )
         if not 0.0 < float(self.lambda_cor) < 1.0:
             raise ValueError(
                 f"session config lambda_cor must be in (0, 1), got {self.lambda_cor!r}"
@@ -140,8 +173,27 @@ class SessionConfig:
             raise ValueError(f"session config solver: {e}") from None
 
     @property
+    def hop(self):
+        """STFT hop of the chained (time-domain) lane's window transform —
+        derived from the config's frequency grid (n_fft/2 = n_freq - 1)."""
+        return self.n_freq - 1
+
+    @property
+    def block_samples(self):
+        """Samples per full time-domain window: the window whose STFT has
+        exactly ``block_frames`` frames (T = 1 + samples // hop)."""
+        return (self.block_frames - 1) * self.hop
+
+    def frames_of(self, samples: int) -> int:
+        """STFT frame count of a ``samples``-long time window."""
+        return 1 + samples // self.hop
+
+    @property
     def block_shape(self):
-        """(K, C, F, T) of one input block's mixture STFT."""
+        """Shape of one input block: (K, C, F, T) mixture STFT frames for
+        ``domain='stft'``, (K, C, samples) float window for ``'time'``."""
+        if self.domain == "time":
+            return (self.n_nodes, self.mics_per_node, self.block_samples)
         return (self.n_nodes, self.mics_per_node, self.n_freq, self.block_frames)
 
     @property

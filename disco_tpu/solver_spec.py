@@ -26,6 +26,25 @@ RANK1_SOLVERS = ("eigh", "power", "jacobi", "jacobi-pallas",
 FUSED_IMPLS = {"fused": "auto", "fused-xla": "xla", "fused-pallas": "pallas"}
 
 
+def is_fused_spec(v: str | None) -> bool:
+    """True when a solver spec selects the fused rank-1 GEVD-MWF family
+    (``'fused'``/``'fused-xla'``/``'fused-pallas'``, optionally ``':N'``).
+
+    THE sanctioned family predicate (DL016 ``fused-solver-selection``):
+    call sites that restructure around the fused solve — the step-1 K×F
+    pencil batching in ``enhance.tango``, the chained-clip program in
+    ``enhance.fused`` — branch through this helper instead of re-spelling
+    the family grammar with ``'fused'`` literals or ``startswith`` probes,
+    so the branch tracks the grammar when the spec table grows.  ``None``
+    (the driver's "defer to the mode default" spelling) is not fused.
+
+    No reference counterpart (module docstring).
+    """
+    if v is None:
+        return False
+    return parse_solver_spec(v)[0] in FUSED_IMPLS
+
+
 def parse_solver_spec(v: str) -> tuple[str, int | None]:
     """THE parser for rank-1 GEVD solver specs — ``'base'`` or ``'base:N'``
     with base in :data:`RANK1_SOLVERS` — shared by ``rank1_gevd``, the CLI

@@ -1,0 +1,307 @@
+"""disco-chain (disco_tpu.enhance.fused): the whole-clip and streaming
+chained programs against their staged twins, the chained batch runners,
+and the chained driver path.
+
+Documented tolerances (enhance/fused.py module docstring, the
+performance doc's "Chaining the clip" section):
+
+* offline clip (``tango_clip_fused`` vs the staged stft -> masks ->
+  tango -> istft dispatches): the SAME stage functions trace in the same
+  order, so parity is float32 reassociation noise across the former
+  dispatch boundaries — <= 1e-4 relative to the output scale (measured
+  ~1e-6);
+* streaming window (``streaming_clip_fused`` vs stft ->
+  ``streaming_tango_scan`` -> istft on the SAME window): identical
+  computation, jit-boundary noise only — <= 1e-5 absolute at unit input
+  scale.  (The documented window-vs-full-clip STFT boundary difference is
+  between the streaming twin and the OFFLINE path, not covered here — it
+  is a design property, not a tolerance.)
+* driver level (``enhance_rir(chained=True)`` vs the staged driver):
+  SDR within 0.1 dB per node, bucket-matched.
+"""
+import pickle
+
+import numpy as np
+import pytest
+
+from disco_tpu.enhance.fused import streaming_clip_fused, tango_clip_fused
+
+
+def _staged_clip(y, s, n, solver="fused-xla", export=False):
+    """The staged path mirrored stage for stage (bench.py's staged jits):
+    fused STFT -> magnitude masks -> two-step tango -> ISTFT, each stage a
+    separate dispatch."""
+    import jax.numpy as jnp
+
+    from disco_tpu.core.dsp import istft
+    from disco_tpu.core.masks import tf_mask_mag
+    from disco_tpu.enhance.tango import tango
+    from disco_tpu.ops.stft_ops import stft_with_mag
+
+    L = y.shape[-1]
+    spec, mag = stft_with_mag(jnp.stack([y, s, n]), impl="xla")
+    m = tf_mask_mag(mag[1][:, 0], mag[2][:, 0], "irm1")
+    res = tango(spec[0], spec[1], spec[2], m, m, policy="local",
+                solver=solver)
+    if not export:
+        return np.asarray(istft(res.yf, length=L))
+    return res, np.asarray(istft(res.yf, length=L))
+
+
+def _clip_signals(rng, K=2, C=2, L=4096):
+    src = rng.standard_normal(L)
+    s = np.stack(
+        [np.stack([np.convolve(src, rng.standard_normal(8) * 0.5,
+                               mode="same") for _ in range(C)])
+         for _ in range(K)]
+    ).astype(np.float32)
+    n = (0.5 * rng.standard_normal((K, C, L))).astype(np.float32)
+    return s + n, s, n
+
+
+# -- the offline chained program vs its staged twin ---------------------------
+def test_tango_clip_fused_matches_staged_pipeline(rng):
+    """ONE dispatched program == the staged stage sequence at the
+    documented offline tolerance, oracle-mask path."""
+    y, s, n = _clip_signals(rng)
+    ref = _staged_clip(y, s, n)
+    got = np.asarray(tango_clip_fused(y, s, n, solver="fused-xla",
+                                      stft_impl="xla"))
+    assert got.shape == ref.shape == (2, 4096)
+    scale = np.abs(ref).max()
+    assert np.abs(got - ref).max() <= 1e-4 * scale, (
+        np.abs(got - ref).max(), scale)
+
+
+def test_tango_clip_fused_client_masks_match_staged(rng):
+    """The CRNN lane: explicit (K, F, T) masks as traced program inputs
+    reproduce the staged path run on the same masks."""
+    import jax.numpy as jnp
+
+    from disco_tpu.core.dsp import istft, stft
+    from disco_tpu.enhance.tango import tango
+
+    y, s, n = _clip_signals(rng)
+    Y, S, N = stft(y), stft(s), stft(n)
+    K, _, F, T = Y.shape
+    m = rng.uniform(0.05, 0.95, (K, F, T)).astype(np.float32)
+    res = tango(Y, S, N, jnp.asarray(m), jnp.asarray(m), policy="local",
+                solver="fused-xla")
+    ref = np.asarray(istft(res.yf, length=y.shape[-1]))
+    got = np.asarray(tango_clip_fused(y, s, n, masks_z=m, solver="fused-xla",
+                                      stft_impl="xla"))
+    scale = np.abs(ref).max()
+    assert np.abs(got - ref).max() <= 1e-4 * scale
+
+
+def test_tango_clip_fused_export_payload_contract(rng):
+    """export=True returns exactly the driver's scoring payload — the six
+    time-domain streams (yf, z_y, sf, nf, z_s, z_n), the masks, the z
+    export — each matching the staged stage outputs."""
+    from disco_tpu.core.dsp import istft
+
+    y, s, n = _clip_signals(rng)
+    L = y.shape[-1]
+    res, ref_yf = _staged_clip(y, s, n, export=True)
+    out = tango_clip_fused(y, s, n, solver="fused-xla", stft_impl="xla",
+                           export=True)
+    assert set(out) == {"td", "masks_z", "mask_w", "z_y"}
+    assert len(out["td"]) == 6
+    scale = np.abs(ref_yf).max()
+    assert np.abs(np.asarray(out["td"][0]) - ref_yf).max() <= 1e-4 * scale
+    for i, stream in enumerate((res.yf, res.z_y, res.sf, res.nf, res.z_s,
+                                res.z_n)):
+        ref_td = np.asarray(istft(stream, length=L))
+        got_td = np.asarray(out["td"][i])
+        assert got_td.shape == (2, L)
+        sc = max(np.abs(ref_td).max(), 1e-12)
+        assert np.abs(got_td - ref_td).max() <= 1e-4 * sc, i
+    np.testing.assert_allclose(np.asarray(out["masks_z"]),
+                               np.asarray(res.masks_z), rtol=0, atol=1e-6)
+    zsc = np.abs(np.asarray(res.z_y)).max()
+    assert np.abs(np.asarray(out["z_y"])
+                  - np.asarray(res.z_y)).max() <= 1e-4 * zsc
+
+
+# -- the streaming chained window vs the staged scan --------------------------
+def test_streaming_clip_fused_continuation_matches_staged_scan(rng):
+    """Two consecutive super-tick windows through the chained program,
+    state threaded, against stft -> streaming_tango_scan -> istft staged
+    over the SAME windows — identical computation, jit-boundary noise
+    only; and the second window really continues (differs from a cold
+    start)."""
+    from disco_tpu.core.dsp import istft, stft
+    from disco_tpu.enhance.streaming import streaming_tango_scan
+
+    K, C, U, BT, F = 2, 2, 4, 8, 257
+    Lw = (BT - 1) * (F - 1)
+    wins = [rng.standard_normal((K, C, Lw)).astype(np.float32)
+            for _ in range(2)]
+    masks = [rng.uniform(0.05, 0.95, (K, F, BT)).astype(np.float32)
+             for _ in range(2)]
+
+    refs, st_ref = [], None
+    for y, m in zip(wins, masks):
+        out = streaming_tango_scan(stft(y), m, m, update_every=U,
+                                   policy="local", state=st_ref,
+                                   blocks_per_dispatch=2,
+                                   solver="fused-xla")
+        refs.append(np.asarray(istft(out["yf"], length=Lw)))
+        st_ref = out["state"]
+
+    got, st = [], None
+    for y, m in zip(wins, masks):
+        out = streaming_clip_fused(y, masks_z=m, mask_w=m, update_every=U,
+                                   policy="local", state=st,
+                                   blocks_per_dispatch=2,
+                                   solver="fused-xla", stft_impl="xla")
+        got.append(np.asarray(out["yf"]))
+        st = out["state"]
+
+    for i, (g, r) in enumerate(zip(got, refs)):
+        assert g.shape == r.shape == (K, Lw)
+        assert np.abs(g - r).max() <= 1e-5, (i, np.abs(g - r).max())
+    cold = np.asarray(
+        streaming_clip_fused(wins[1], masks_z=masks[1], mask_w=masks[1],
+                             update_every=U, policy="local",
+                             blocks_per_dispatch=2, solver="fused-xla",
+                             stft_impl="xla")["yf"])
+    assert np.abs(cold - got[1]).max() > 1e-4  # the state is load-bearing
+
+
+def test_streaming_clip_fused_needs_masks_or_components(rng):
+    K, C, Lw = 2, 2, 1792
+    y = rng.standard_normal((K, C, Lw)).astype(np.float32)
+    with pytest.raises(ValueError, match="masks_z"):
+        streaming_clip_fused(y, update_every=4, blocks_per_dispatch=2)
+
+
+# -- the chained batch runners and host fetch ---------------------------------
+def test_make_batch_runners_chained_parity_trim_and_guards(rng):
+    """The vmapped chained runner reproduces the per-clip chained program
+    clip for clip; fetch_chained_host trims ragged lengths; the
+    incompatible-option guards reject at construction."""
+    from disco_tpu.enhance.driver import make_batch_runners
+    from disco_tpu.enhance.pipeline import fetch_chained_host
+
+    B, K, C, L = 2, 2, 2, 1024
+    yb = rng.standard_normal((B, K, C, L)).astype(np.float32)
+    sb = rng.standard_normal((B, K, C, L)).astype(np.float32)
+    nb = rng.standard_normal((B, K, C, L)).astype(np.float32)
+
+    run_batch, run_batch_with_masks = make_batch_runners(
+        solver="fused-xla", chained=True, stft_impl="xla")
+    assert run_batch_with_masks is None  # chained = oracle-mask lane only
+    out_b = run_batch(yb, sb, nb)
+    assert set(out_b) == {"td", "masks_z", "mask_w", "z_y"}
+    assert len(out_b["td"]) == 6
+    assert out_b["td"][0].shape == (B, K, L)
+
+    host = fetch_chained_host(out_b, clip_lengths=[1024, 900], n_real=2)
+    assert len(host["td"]) == 2
+    assert host["td"][0][0].shape == (K, 1024)
+    assert host["td"][1][0].shape == (K, 900)
+    assert host["masks_z"].shape[0] == 2
+
+    for i in range(B):
+        ref = tango_clip_fused(yb[i], sb[i], nb[i], solver="fused-xla",
+                               stft_impl="xla", export=True)
+        ref_td = np.asarray(ref["td"][0])
+        got = host["td"][i][0]
+        Lr = got.shape[-1]
+        scale = np.abs(ref_td).max()
+        assert np.abs(got - ref_td[..., :Lr]).max() <= 1e-4 * scale, i
+
+    for kw, frag in (
+        (dict(mesh=object()), "single-device"),
+        (dict(z_mask_arr=np.ones(4, np.float32)), "z-exchange"),
+    ):
+        with pytest.raises(ValueError, match=frag):
+            make_batch_runners(solver="fused-xla", chained=True, **kw)
+
+
+# -- the chained driver path --------------------------------------------------
+@pytest.mark.slow
+def test_enhance_rir_chained_matches_staged_and_guards(tmp_path):
+    """enhance_rir(chained=True) enhances (SDR up at every node), lands
+    within 0.1 dB per node of the staged driver on the same solver, and
+    rejects the staged-only options."""
+    from tests.test_driver import (
+        EXPECTED_KEYS,
+        NOISE,
+        RIR,
+        SNR_RANGE,
+        _build_corpus,
+    )
+
+    from disco_tpu.enhance.driver import enhance_rir
+
+    corpus = _build_corpus(tmp_path / "dataset", [RIR], lengths=[32000])
+    res = enhance_rir(str(corpus), "living", RIR, NOISE,
+                      snr_range=SNR_RANGE,
+                      out_root=str(tmp_path / "results"), save_fig=False,
+                      chained=True)
+    assert res is not None
+    assert EXPECTED_KEYS <= set(res), EXPECTED_KEYS - set(res)
+    assert res["sdr_cnv"].shape == (4,)
+    assert np.all(res["sdr_cnv"] > res["sdr_in_cnv"])
+
+    res_s = enhance_rir(str(corpus), "living", RIR, NOISE,
+                        snr_range=SNR_RANGE,
+                        out_root=str(tmp_path / "results_staged"),
+                        save_fig=False, solver="fused-xla")
+    assert np.abs(res["sdr_cnv"] - res_s["sdr_cnv"]).max() < 0.1
+
+    for kw in (dict(streaming=True), dict(fault_spec={"seed": 1}),
+               dict(models=(1, None))):
+        with pytest.raises(ValueError):
+            enhance_rir(str(corpus), "living", RIR, NOISE,
+                        out_root=str(tmp_path / "x"), chained=True,
+                        force=True, **kw)
+
+
+@pytest.mark.slow
+def test_enhance_rirs_batched_chained_corpus(tmp_path):
+    """The bucketed chained corpus engine on ragged lengths: per-RIR
+    results with the full pickle schema, parity with the per-clip chained
+    driver at the SAME bucket (padding shifts absolute SDR, so
+    comparisons must be bucket-matched), artifacts on disk, and the
+    non-pipelined path sharing the fetch."""
+    from tests.test_driver import (
+        EXPECTED_KEYS,
+        NOISE,
+        RIR,
+        SNR_RANGE,
+        _build_corpus,
+    )
+
+    from disco_tpu.enhance.driver import enhance_rir, enhance_rirs_batched
+
+    corpus = _build_corpus(tmp_path / "dataset", [RIR, RIR + 1],
+                           lengths=[32000, 30000])
+    res_b = enhance_rirs_batched(
+        str(corpus), "living", [RIR, RIR + 1], NOISE, snr_range=SNR_RANGE,
+        out_root=str(tmp_path / "results_batched"), save_fig=False,
+        chained=True, bucket=8192, max_batch=2, score_workers=1)
+    assert set(res_b) == {RIR, RIR + 1}
+    for r, d in res_b.items():
+        assert EXPECTED_KEYS <= set(d)
+        assert np.all(d["sdr_cnv"] > d["sdr_in_cnv"]), r
+    pkl = (tmp_path / "results_batched" / "OIM"
+           / f"results_tango_{RIR + 1}_{NOISE}.p")
+    assert pkl.exists()
+    with open(pkl, "rb") as f:
+        assert EXPECTED_KEYS <= set(pickle.load(f))
+
+    res_p = enhance_rir(str(corpus), "living", RIR, NOISE,
+                        snr_range=SNR_RANGE,
+                        out_root=str(tmp_path / "results_padded"),
+                        save_fig=False, chained=True, bucket=8192)
+    assert np.abs(res_b[RIR]["sdr_cnv"] - res_p["sdr_cnv"]).max() < 0.1
+
+    res_np = enhance_rirs_batched(
+        str(corpus), "living", [RIR], NOISE, snr_range=SNR_RANGE,
+        out_root=str(tmp_path / "results_nopipe"), save_fig=False,
+        chained=True, bucket=8192, max_batch=2, score_workers=1,
+        pipeline=False)
+    assert np.allclose(res_np[RIR]["sdr_cnv"], res_b[RIR]["sdr_cnv"])

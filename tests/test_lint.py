@@ -625,6 +625,47 @@ def test_dl016_near_misses():
     assert rule_ids(lint(src2, "disco_tpu/beam/filters.py", rules={"DL016"})) == []
 
 
+def test_dl016_flags_startswith_family_probes():
+    # the step-1 fusion round's scope extension: prefix probes are the
+    # same ad-hoc family check as literal comparisons
+    src = """
+    def pick(solver):
+        if solver.startswith("fused"):
+            return 1
+        return 0
+    """
+    assert rule_ids(lint(src, "disco_tpu/enhance/foo.py",
+                         rules={"DL016"})) == ["DL016"]
+    # the ':N'-suffixed and dashed spellings are the same family
+    src2 = 'chained = spec.startswith("fused-pallas")\n'
+    assert rule_ids(lint(src2, "disco_tpu/serve/foo.py",
+                         rules={"DL016"})) == ["DL016"]
+
+
+def test_dl016_startswith_and_predicate_near_misses():
+    # is_fused_spec IS the sanctioned family predicate (a call, not a
+    # comparison); startswith against non-family strings stays untouched;
+    # the grammar module itself is exempt
+    src = """
+    from disco_tpu.solver_spec import is_fused_spec
+    def pick(solver):
+        if is_fused_spec(solver):
+            return 1
+        if name.startswith("fused_mwf"):
+            return 2
+        if path.startswith("ops/"):
+            return 3
+        return 0
+    """
+    assert rule_ids(lint(src, "disco_tpu/enhance/foo.py", rules={"DL016"})) == []
+    src2 = """
+    def is_fused_spec(v):
+        return parse_solver_spec(v)[0] in FUSED_IMPLS
+    ok = base == "fused"
+    """
+    assert rule_ids(lint(src2, "disco_tpu/solver_spec.py", rules={"DL016"})) == []
+
+
 # -- the repo itself ---------------------------------------------------------
 def test_repo_lints_clean():
     """The self-run gate: zero unsuppressed findings over the default

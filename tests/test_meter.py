@@ -209,32 +209,48 @@ def test_committed_manifests_rebuild_bit_identically():
 
 
 def test_committed_fused_manifest_beats_eigh_on_hbm_traffic():
-    """The design thesis as data: the fused step-2 manifest models
-    strictly fewer HBM bytes than the separate-stage eigh manifest."""
-    fused = check.load_golden("tango_step2_fused")
-    eigh = check.load_golden("tango_step2_eigh")
-    assert fused is not None and eigh is not None
-    assert fused["traffic_bytes"] < eigh["traffic_bytes"]
-    # fusing keeps the flops (same math) while cutting the traffic, so the
-    # arithmetic intensity strictly improves
-    assert fused["arithmetic_intensity"] > eigh["arithmetic_intensity"]
-    assert "fused_mwf_xla" in fused["fused_islands"]
-    assert eigh["fused_islands"] == []
-    assert budgets.check_cross(
-        {"tango_step2_fused": fused, "tango_step2_eigh": eigh}) == []
+    """The design thesis as data: the fused manifests model strictly
+    fewer HBM bytes than their separate-stage eigh twins — step 2
+    (PR 15) and step 1 (the disco-chain round) alike."""
+    goldens = {}
+    for step in ("step1", "step2"):
+        fused = check.load_golden(f"tango_{step}_fused")
+        eigh = check.load_golden(f"tango_{step}_eigh")
+        assert fused is not None and eigh is not None, step
+        assert fused["traffic_bytes"] < eigh["traffic_bytes"], step
+        # fusing keeps the flops (same math) while cutting the traffic, so
+        # the arithmetic intensity strictly improves
+        assert fused["arithmetic_intensity"] > eigh["arithmetic_intensity"]
+        assert "fused_mwf_xla" in fused["fused_islands"], step
+        assert eigh["fused_islands"] == [], step
+        goldens[f"tango_{step}_fused"] = fused
+        goldens[f"tango_{step}_eigh"] = eigh
+    assert budgets.check_cross(goldens) == []
 
 
 def test_cross_budget_reports_missing_program_and_violation():
     fused = check.load_golden("tango_step2_fused")
     msgs = budgets.check_cross({"tango_step2_fused": fused})
-    assert len(msgs) == 1 and "missing" in msgs[0]
-    inflated = dict(fused, traffic_bytes=10**12)
-    msgs = budgets.check_cross({
-        "tango_step2_fused": inflated,
+    # one message per declared inequality that cannot be evaluated:
+    # step-2 is missing its eigh twin, step-1 is missing both programs
+    assert len(msgs) == len(budgets.CROSS_BUDGETS)
+    assert all("missing" in m for m in msgs)
+    full = {
+        "tango_step2_fused": dict(fused, traffic_bytes=10**12),
         "tango_step2_eigh": check.load_golden("tango_step2_eigh"),
-    })
+        "tango_step1_fused": check.load_golden("tango_step1_fused"),
+        "tango_step1_eigh": check.load_golden("tango_step1_eigh"),
+    }
+    msgs = budgets.check_cross(full)
     assert len(msgs) == 1 and "violated" in msgs[0]
     assert "pencils" in msgs[0]     # the thesis text travels with the red
+    # the step-1 inequality trips the same way
+    full["tango_step2_fused"] = fused
+    full["tango_step1_fused"] = dict(
+        full["tango_step1_fused"], traffic_bytes=10**12)
+    msgs = budgets.check_cross(full)
+    assert len(msgs) == 1 and "violated" in msgs[0]
+    assert "batch-in-lanes" in msgs[0]
 
 
 # -- drift: an inflated-traffic manifest fails with a readable diff ----------

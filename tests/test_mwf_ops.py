@@ -189,6 +189,119 @@ def test_fused_specs_through_rank1_gevd_dispatch(rng):
     assert _rel(w_1, w_e) > 10 * _rel(rank1_gevd(Rss, Rnn, solver="fused")[0], w_e)
 
 
+# -- the step-1 batch-in-lanes fused lane (disco-chain) -----------------------
+def _step1_field(rng, K, C, F=16, T=64):
+    """Per-node speech-over-noise STFT fields (float64 + complex64 copies)
+    and speech-presence masks — the step-1 local MWF's input shape."""
+    Y64 = np.empty((K, C, F, T), np.complex128)
+    masks = np.empty((K, F, T), np.float32)
+    for k in range(K):
+        src = rng.standard_normal((F, T)) + 1j * rng.standard_normal((F, T))
+        gains = rng.standard_normal((C, 1, 1)) + 1j * rng.standard_normal(
+            (C, 1, 1))
+        S = gains * src
+        N = 0.6 * (rng.standard_normal((C, F, T))
+                   + 1j * rng.standard_normal((C, F, T)))
+        Y64[k] = S + N
+        ps, pn = np.abs(S[0]) ** 2, np.abs(N[0]) ** 2
+        masks[k] = (ps / (ps + pn)).astype(np.float32)
+    return Y64, Y64.astype(np.complex64), masks
+
+
+def _step1_oracle_z(Y64, masks):
+    """Float64 step-1 z per node: masked covariances -> GEVD filter ->
+    compression (the step-1 half of reference_impls.tango_np)."""
+    from tests.reference_impls import covariances_np
+
+    K, C, F, T = Y64.shape
+    z = np.zeros((K, F, T), np.complex128)
+    for k in range(K):
+        Rss = covariances_np(masks[k][None] * Y64[k])
+        Rnn = covariances_np((1 - masks[k][None]) * Y64[k])
+        for f in range(F):
+            w, _ = intern_filter_np(Rss[f], Rnn[f], mu=1.0, ftype="gevd",
+                                    rank=1)
+            z[k, f] = np.conj(w) @ Y64[k, :, f, :]
+    return z
+
+
+@pytest.mark.parametrize("C", [2, 4, 6])
+def test_step1_fused_matches_float64_oracle(rng, C):
+    """compute_z_signals(solver='fused*') — ALL K x F step-1 pencils as
+    ONE batch-in-lanes solve — against the float64 per-pencil GEVD oracle
+    at the documented exact-lane tolerance, on both impl lanes, across
+    the step-1 mic range; the separate-stage eigh path sits at the same
+    level (the fused lane replaces it 1:1)."""
+    from disco_tpu.enhance import compute_z_signals
+
+    K = 3
+    Y64, Y, masks = _step1_field(rng, K, C)
+    z64 = _step1_oracle_z(Y64, masks)
+    z_e = np.asarray(compute_z_signals(None, None, None, Y=Y, S=Y, N=Y,
+                                       masks_z=masks, solver="eigh")["z_y"])
+    assert _rel(z_e, z64) < 1e-3, (C, _rel(z_e, z64))
+    for spec in ("fused-xla", "fused-pallas"):
+        z_f = np.asarray(compute_z_signals(None, None, None, Y=Y, S=Y, N=Y,
+                                           masks_z=masks,
+                                           solver=spec)["z_y"])
+        assert _rel(z_f, z64) < 1e-3, (spec, C, _rel(z_f, z64))
+        assert _rel(z_f, z_e) < 1e-3, (spec, C, _rel(z_f, z_e))
+
+
+def test_step1_fused_bf16_documented_tolerance(rng):
+    """The bf16 solve lane through the step-1 fusion: really quantized,
+    still inside the documented <= 2e-2 rel tolerance vs the oracle."""
+    from disco_tpu.enhance import compute_z_signals
+
+    Y64, Y, masks = _step1_field(rng, 3, 4)
+    z64 = _step1_oracle_z(Y64, masks)
+    z_b = np.asarray(compute_z_signals(None, None, None, Y=Y, S=Y, N=Y,
+                                       masks_z=masks, solver="fused-xla",
+                                       precision="bf16")["z_y"])
+    err = _rel(z_b, z64)
+    assert 1e-6 < err < 2e-2, err
+
+
+def test_step1_fused_warmup_scale_stays_finite(rng):
+    """Warm-up-scale step-1 statistics (tiny trace, fewer frames than
+    mics): the fused lane's sanitize guard keeps every z bin finite —
+    same degenerate-bin policy as the eigh path it replaces."""
+    from disco_tpu.enhance import compute_z_signals
+
+    K, C, F, T = 2, 4, 8, 2
+    Y = (1e-6 * (rng.standard_normal((K, C, F, T))
+                 + 1j * rng.standard_normal((K, C, F, T)))
+         ).astype(np.complex64)
+    masks = rng.uniform(0.05, 0.95, (K, F, T)).astype(np.float32)
+    for spec in ("fused-xla", "eigh"):
+        z = np.asarray(compute_z_signals(None, None, None, Y=Y, S=Y, N=Y,
+                                         masks_z=masks, solver=spec)["z_y"])
+        assert np.isfinite(z).all(), spec
+
+
+def test_step1_fused_time_domain_entry_and_zn_invariant(rng):
+    """The (K, C, L) time entry point with a fused spec: matches the eigh
+    step-1 at tolerance and preserves the zn = y_ref - z export contract
+    (test_inference's invariant, fused edition); a malformed spec fails
+    through THE shared grammar."""
+    from disco_tpu.core.dsp import stft
+    from disco_tpu.enhance import compute_z_signals
+
+    K, C, L = 2, 3, 4096
+    s = rng.standard_normal((K, C, L)).astype(np.float32)
+    n = (0.3 * rng.standard_normal((K, C, L))).astype(np.float32)
+    y = s + n
+    out_f = compute_z_signals(y, s, n, mask_type="irm1", solver="fused")
+    out_e = compute_z_signals(y, s, n, mask_type="irm1", solver="eigh")
+    assert _rel(out_f["z_y"], out_e["z_y"]) < 1e-3
+    Y = stft(y)
+    np.testing.assert_allclose(
+        np.asarray(out_f["zn"]),
+        np.asarray(Y[:, 0] - out_f["z_y"]), rtol=1e-4, atol=1e-5)
+    with pytest.raises(ValueError, match="unknown GEVD solver"):
+        compute_z_signals(y, s, n, solver="fused-mosaic")
+
+
 def test_resolve_mwf_impl_policy(monkeypatch):
     """The shared ops.resolve policy: 'auto' = xla off-TPU, the
     DISCO_TPU_MWF_IMPL env escape hatch overrides, explicit choices pass

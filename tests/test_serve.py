@@ -1318,3 +1318,92 @@ def test_evicted_session_clears_tracer_inflight(stream, _tracing):
     sched.evict(s, "test: slow client")
     assert obs_trace.tracer().inflight_snapshot()["count"] == 0
     assert s.trace_ctx == {}
+
+
+# -- chained (time-domain) sessions ------------------------------------------
+def _chained_config(**kw):
+    # Lw = (block_frames - 1) * hop = 7 * 256 = 1792 samples per window
+    return SessionConfig(n_nodes=K, mics_per_node=C, n_freq=257,
+                         block_frames=8, update_every=U, domain="time",
+                         solver="fused-xla", **kw)
+
+
+@pytest.mark.slow
+def test_chained_session_bit_parity_with_offline_twin():
+    """Two whole time-domain windows through a domain='time' session come
+    back BIT-identical to the offline ``streaming_clip_fused`` run with
+    the same continuation state — serve and offline dispatch the same
+    jitted program by construction (scheduler._serve_chained_step), so
+    this parity is an identity, not a tolerance."""
+    from disco_tpu.enhance.fused import streaming_clip_fused
+    from disco_tpu.serve import EnhanceServer, ServeClient
+
+    cfg = _chained_config()
+    Lw = cfg.block_samples
+    rng = np.random.default_rng(5)
+    wins = [rng.standard_normal((K, C, Lw)).astype(np.float32)
+            for _ in range(2)]
+    masks = [rng.uniform(0.05, 0.95, (K, 257, 8)).astype(np.float32)
+             for _ in range(2)]
+
+    refs, state = [], None
+    for y, m in zip(wins, masks):
+        out = streaming_clip_fused(y, masks_z=m, mask_w=m, update_every=U,
+                                   policy="local", state=state,
+                                   solver="fused-xla")
+        refs.append(np.asarray(out["yf"]))
+        state = out["state"]
+
+    srv = EnhanceServer(max_sessions=2)
+    addr = srv.start()
+    try:
+        cl = ServeClient(addr)
+        cl.open(cfg)
+        got = []
+        for i, (y, m) in enumerate(zip(wins, masks)):
+            cl.send_block(y, m, m)
+            got.append(cl.recv_enhanced(i, timeout_s=300))
+        cl.close()
+        cl.shutdown()
+    finally:
+        srv.stop()
+    for i, (g, r) in enumerate(zip(got, refs)):
+        assert g.shape == r.shape == (K, Lw), i
+        np.testing.assert_array_equal(g, r)
+
+
+def test_chained_sessions_admission_gate():
+    """--no-chained-sessions turns the time-domain lane off at the door:
+    admission fails with a clean error naming the flag, before any
+    program is compiled for the session."""
+    from disco_tpu.serve import EnhanceServer, ServeClient, ServeError
+
+    srv = EnhanceServer(max_sessions=2, allow_chained=False)
+    addr = srv.start()
+    try:
+        cl = ServeClient(addr)
+        with pytest.raises(ServeError, match="chained"):
+            cl.open(_chained_config())
+        cl.shutdown()
+    finally:
+        srv.stop()
+
+
+def test_chained_misaligned_window_rejected():
+    """A window whose frame count is not refresh-aligned is rejected at
+    validation (a clean per-session error), never dispatched."""
+    from disco_tpu.serve import EnhanceServer, ServeClient, ServeError
+
+    srv = EnhanceServer(max_sessions=2)
+    addr = srv.start()
+    try:
+        cl = ServeClient(addr)
+        cl.open(_chained_config())
+        bad = np.zeros((K, C, 1792 - 256), np.float32)  # 7 frames, U = 4
+        mbad = np.zeros((K, 257, 7), np.float32)
+        cl.send_block(bad, mbad, mbad)
+        with pytest.raises(ServeError):
+            cl.recv_enhanced(0, timeout_s=60)
+        cl.shutdown()
+    finally:
+        srv.stop()
