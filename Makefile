@@ -3,9 +3,9 @@
 
 PYTHON ?= python
 
-.PHONY: test coverage doc install native clean bench milestone-corpus dryrun lint-check trace-check race-check meter-check obs-check fault-check chaos-check perf-check serve-check stream-check flywheel-check soak-check scope-check promote-check endure-check
+.PHONY: test coverage doc install native clean bench milestone-corpus dryrun lint-check trace-check race-check meter-check obs-check fault-check chaos-check perf-check serve-check stream-check flywheel-check soak-check scope-check promote-check endure-check scene-check
 
-test: lint-check trace-check race-check meter-check obs-check fault-check chaos-check perf-check stream-check serve-check flywheel-check soak-check scope-check promote-check endure-check
+test: lint-check trace-check race-check meter-check obs-check fault-check chaos-check perf-check stream-check serve-check flywheel-check soak-check scope-check promote-check endure-check scene-check
 	$(PYTHON) -m pytest tests/ -q
 
 # Static-analysis gate (runs FIRST: it needs no jax, no device and ~2 s):
@@ -248,6 +248,23 @@ promote-check:
 endure-check:
 	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= DISCO_TPU_COMPILE_CACHE=off \
 	    $(PYTHON) -m disco_tpu.runs.endure
+
+# Scenario-factory gate (the seventeenth gate): disco-scenes must hold the
+# batched-simulation contract end to end — shoebox_rirs_batched at parity
+# with the inlined float64 NumPy image-source oracle AND bit-close to the
+# per-scene shoebox_rirs path under vmap; a B>=8 scene batch simulated as
+# exactly ONE fenced dispatch per (max_order, rir_len) bucket (readback +
+# retrace accounting, the one-program-per-bucket budget); dynamic scenes'
+# overlap-add crossfade strictly smoother than a hard RIR switch at segment
+# edges; the batched disco-gen writer crash-resumed at a chaos seam to a
+# byte-identical dataset tree (the per-scene (seed, rir_id, stream)
+# reseeding discipline); and SceneStream's seeded draws deterministic,
+# ledger-resumable mid-epoch, and emitting the registered scene events at
+# both the "scenes" and "datagen" stages.  Hermetic: CPU, compile cache
+# off, one JAX process, zero SIGKILLs (disco_tpu/scenes/check.py).
+scene-check:
+	env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= DISCO_TPU_COMPILE_CACHE=off \
+	    $(PYTHON) -m disco_tpu.scenes.check
 
 coverage:
 	$(PYTHON) -m coverage run --branch --source=disco_tpu -m pytest tests/ -q

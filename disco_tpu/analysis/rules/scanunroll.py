@@ -36,6 +36,9 @@ from disco_tpu.analysis.registry import Rule, register
 _GATED_FILES = (
     "disco_tpu/enhance/streaming.py",
     "disco_tpu/serve/scheduler.py",
+    # the dynamic-scene blend: scene-check's crash-and-resume leg compares
+    # artifact trees byte-for-byte, so its scan order is load-bearing too
+    "disco_tpu/scenes/dynamic.py",
 )
 
 

@@ -293,6 +293,31 @@ def _batch_args(with_masks: bool):
     return stack + ((_f32(B, K, F, T), _f32(B, K, F, T)) if with_masks else ())
 
 
+# -- the batched scenario factory (disco-scenes round) -----------------------
+#: tiny scene-batch statics (structural, not workload sized: 2 scenes ×
+#: 2 sources × 3 mics, a 512-tap RIR bucket at order 2, 1024-sample dry
+#: clips — the full factory shape of batched ISM → convolve → mix → STFT
+#: magnitudes → IRM mask in one program)
+SCENE_B, SCENE_S, SCENE_M = 2, 2, 3
+SCENE_RIR_LEN, SCENE_ORDER, SCENE_L = 512, 2, 1024
+
+
+def _build_scene_batch():
+    from disco_tpu.scenes.batched import _scene_batch_program
+
+    args = (
+        _f32(SCENE_B, 3),                    # room_dims
+        _f32(SCENE_B, SCENE_S, 3),           # sources
+        _f32(SCENE_B, SCENE_M, 3),           # mics
+        _f32(SCENE_B),                       # alphas
+        _f32(SCENE_B, SCENE_S, SCENE_L),     # dry
+        _f32(SCENE_B),                       # noise_gains
+    )
+    return _scene_batch_program.__wrapped__, args, {
+        "max_order": SCENE_ORDER, "rir_len": SCENE_RIR_LEN, "fs": 16000,
+    }
+
+
 # -- the flywheel training step (sharded data-parallel lane) -----------------
 #: tiny CRNN the train_step golden is traced on (structural, not workload
 #: sized: one conv layer, one GRU, sigmoid FF — the full step shape of
@@ -444,6 +469,13 @@ PROGRAMS: dict = {
             f"scanned super-tick driver, N={BLOCKS_PER_DISPATCH} "
             "(enhance/streaming.py) — the unroll=N contract",
             _build_streaming_tango_scan,
+        ),
+        ProgramSpec(
+            "scene_batch",
+            "batched scenario factory: B scenes' ISM RIRs → dry→wet FFT "
+            "convolve → SNR mix → reference-mic STFT magnitudes + IRM mask "
+            "as ONE program (scenes/batched.py) — one dispatch per batch",
+            _build_scene_batch,
         ),
         ProgramSpec(
             "train_step",

@@ -33,6 +33,12 @@ def build_parser():
     p.add_argument("--duration", nargs=2, type=float, default=[5, 10],
                    help="min/max clip duration in seconds (convolve_signals.py:404)")
     p.add_argument("--seed", type=int, default=30, help="global seed (convolve_signals.py:330)")
+    p.add_argument("--batched", action="store_true",
+                   help="batched scenario factory: one RIR-engine dispatch "
+                        "per --batch scenes (disco_tpu.scenes) instead of "
+                        "one per scene")
+    p.add_argument("--batch", type=int, default=8,
+                   help="scenes per batched dispatch (with --batched)")
     add_ledger_arg(p, "scene",
                    default_hint="<dir_out>/log/ledger_<scenario>_<dset>.jsonl")
     add_resume_arg(p, "scene", regen="regenerated")
@@ -66,11 +72,21 @@ def main(argv=None):
     from disco_tpu.runs import GracefulInterrupt
 
     with GracefulInterrupt() as stopped:
-        done = generate_disco_rirs(
-            args.scenario, args.dset, rir_start, n_rirs, signal_setup, layout,
-            rng=rng, max_order=args.max_order,
-            ledger=args.ledger, resume=args.resume,
-        )
+        if args.batched:
+            from disco_tpu.datagen.disco import generate_disco_rirs_batched
+
+            done = generate_disco_rirs_batched(
+                args.scenario, args.dset, rir_start, n_rirs, signal_setup,
+                layout, rng=rng, max_order=args.max_order,
+                ledger=args.ledger, resume=args.resume, batch=args.batch,
+                seed=args.seed,
+            )
+        else:
+            done = generate_disco_rirs(
+                args.scenario, args.dset, rir_start, n_rirs, signal_setup, layout,
+                rng=rng, max_order=args.max_order,
+                ledger=args.ledger, resume=args.resume,
+            )
     if stopped():
         print("interrupted — generation is resumable: rerun the same command "
               "(idempotent; add --resume for digest-verified skips)")

@@ -213,6 +213,21 @@ def summarize(events: list[dict]) -> dict:
         if (name.startswith("jit_recompiles{") and name.endswith("}")
                 and int(v) > 0):
             by_label.setdefault(name[len("jit_recompiles{"):-1], int(v))
+    # -- scenario-factory section: batched scene-simulation telemetry
+    # (scenes/stream.py feed batches + datagen/disco.py batched chunks)
+    scene_events = [e for e in events if e["kind"] == "scene"]
+    scenes = None
+    if scene_events or any(k in ("scene_batches", "scenes_simulated")
+                           for k in cvals):
+        scenes = {
+            "scene_batches": int(cvals.get("scene_batches", 0)),
+            "scenes_simulated": int(cvals.get("scenes_simulated", 0)),
+            "stream_batches": sum(1 for e in scene_events
+                                  if e.get("stage") == "scenes"),
+            "datagen_batches": sum(1 for e in scene_events
+                                   if e.get("stage") == "datagen"),
+            "last_scene": scene_events[-1]["attrs"] if scene_events else None,
+        }
     # -- causal tracing + flight dumps (the scope layer)
     span_events = [e for e in events if e["kind"] == "span"]
     traces: dict[str, int] = {}
@@ -241,6 +256,7 @@ def summarize(events: list[dict]) -> dict:
         "histograms": histograms,
         "serve": serve,
         "flywheel": flywheel,
+        "scenes": scenes,
         "n_events": len(events),
         "n_fences": n_fences,
         "est_rpc_s": n_fences * RPC_MS_ESTIMATE / 1e3,
@@ -344,6 +360,23 @@ def render_report(summary: dict) -> str:
                 f"flywheel throttle: pauses={fw['throttle_pauses']}  "
                 f"throttled ticks={fw['throttled_ticks']} "
                 "(ladder rung >= trainer threshold)"
+            )
+    sc = summary.get("scenes")
+    if sc:
+        lines.append("")
+        lines.append(
+            f"scene factory: {sc['scenes_simulated']} scenes over "
+            f"{sc['scene_batches']} batched dispatches  "
+            f"(stream batches={sc['stream_batches']}  "
+            f"datagen chunks={sc['datagen_batches']})"
+        )
+        last = sc.get("last_scene") or {}
+        if last:
+            lines.append(
+                f"scene factory last batch: n_scenes={last.get('n_scenes')}  "
+                f"scenario={last.get('scenario')}  "
+                f"rir_len={last.get('rir_len')}  "
+                f"max_order={last.get('max_order')}"
             )
     if summary.get("spans"):
         lines.append(
@@ -528,6 +561,7 @@ def compare_records(old: dict, new: dict, threshold: float = 0.05) -> dict:
         ("serve_p95_ms", False),
         ("train_steps_per_s", True),
         ("tap_blocks_per_s", True),
+        ("scenes_per_s", True),
         ("flywheel_generations", True),
         ("latency_ms_frame", False),
         ("dispatch_overhead_ms", False),
@@ -590,6 +624,9 @@ def compare_records(old: dict, new: dict, threshold: float = 0.05) -> dict:
         ("serve_blocks_per_s", "serve", "blocks/s", True, None),
         ("train_steps_per_s", "train", "steps/s", True, None),
         ("tap_blocks_per_s", "tap", "blocks/s", True, None),
+        # the scenario-factory lane: batched scene-simulation throughput
+        # (one compiled program + one batched readback per scene batch)
+        ("scenes_per_s", "scenes", "scenes/s", True, None),
         # flywheel lanes: promotion latency (lower is better; CPU smoke
         # rollouts run whole canary windows, so floor sub-10s jitter) and
         # the live-loop generation count (a candidate that LOST a lane —

@@ -1,5 +1,6 @@
 from disco_tpu.datagen.disco import (
     generate_disco_rirs,
+    generate_disco_rirs_batched,
     reverb_other_noises,
     simulate_scene,
     snr_at_mics,
@@ -23,5 +24,6 @@ __all__ = [
     "snr_at_mics",
     "reverb_other_noises",
     "generate_disco_rirs",
+    "generate_disco_rirs_batched",
     "PostGenerator",
 ]

@@ -31,7 +31,13 @@ from disco_tpu.core.metrics import fw_snr
 from disco_tpu.core.sigproc import increase_to_snr
 from disco_tpu.io import DatasetLayout
 from disco_tpu.io.atomic import atomic_write, probe_npy, save_npy_atomic, write_wav_atomic
-from disco_tpu.sim import RoomSetup, fft_convolve, rir_length_for, shoebox_rirs
+from disco_tpu.sim import (
+    RoomSetup,
+    fft_convolve,
+    rir_bucket,
+    shoebox_rirs,
+    shoebox_rirs_batched,
+)
 
 
 @dataclasses.dataclass
@@ -98,8 +104,11 @@ def simulate_scene(
         weight=True, vad_tar=target_vad, vad_noi=noise_vad, fs=fs,
     )
 
-    # RIRs for both sources to all mics: one batched device launch.
-    rir_len = rir_length_for(room_cfg.beta, fs=fs)
+    # RIRs for both sources to all mics: one batched device launch.  The
+    # bucket comes from the ONE canonical policy (sim.ism.rir_bucket), so
+    # the per-scene and batched paths can never disagree on sizing.
+    max_order, rir_len = rir_bucket(room_cfg.beta, room_cfg.room_dim,
+                                    max_order=max_order, fs=fs)
     srcs = np.asarray(room_cfg.source_positions[:2], np.float32)
     mics = np.asarray(room_cfg.mic_positions.T, np.float32)  # (M, 3)
     rirs = np.asarray(
@@ -362,6 +371,278 @@ def generate_disco_rirs(
         generated.append(rir_id)
         run_chaos.tick("between_scenes", rir=rir_id)
         i_file += 1
+    return generated
+
+
+def _draw_dry_pair(signal_setup, i_file: int, fs: int):
+    """The dry-signal preamble of :func:`simulate_scene` (target + SSN
+    noise, convolve_signals.py:216-240), factored so the batched driver can
+    draw signals for a whole chunk before its one RIR dispatch.
+
+    Returns ``(sig_stack (2, L), target_vad)`` or None (unusable target
+    file — the caller advances ``i_file``, the "redraw_source_signal"
+    protocol)."""
+    target_file = signal_setup.target_list[i_file % len(signal_setup.target_list)]
+    target, target_vad, _fs_t = signal_setup.get_target_segment(target_file)
+    if target is None:
+        return None
+    noise, _, _, noise_vad, _ = signal_setup.get_noise_segment(
+        "SSN", signal_setup.target_duration)
+    noise = increase_to_snr(
+        target, noise, signal_setup.source_snr[0],
+        weight=True, vad_tar=target_vad, vad_noi=noise_vad, fs=fs,
+    )
+    L = len(target)
+    sig_stack = np.zeros((2, L), np.float32)
+    sig_stack[0] = target
+    sig_stack[1, : len(noise)] = noise[:L]
+    return sig_stack, target_vad
+
+
+def _simulate_scenes_batched(cfgs, sig_stacks, target_vads, dset, signal_setup,
+                             mics_per_node, max_order, fs):
+    """Simulate a list of scenes with ONE RIR-engine dispatch.
+
+    The batched twin of :func:`simulate_scene`'s device half
+    (convolve_signals.py:216-282): all rooms' RIRs come from one
+    ``shoebox_rirs_batched`` launch in the chunk's shared
+    ``scenes.batched`` bucket, all dry→wet convolutions from one padded
+    batched FFT convolve, and the results cross the tunnel in one batched
+    readback.  SNR gating stays host-side per scene — a scene failing the
+    node-SNR window returns None in its slot ("redraw_room_setup").
+
+    Returns a list of :class:`SimulatedScene` or None per slot."""
+    from disco_tpu.scenes.batched import BATCH_QUANTUM
+    from disco_tpu.utils.transfer import device_get_tree
+
+    B = len(cfgs)
+    rir_len = 0
+    for cfg in cfgs:
+        _, n = rir_bucket(cfg.beta, cfg.room_dim, max_order=max_order, fs=fs,
+                          quantum=BATCH_QUANTUM)
+        rir_len = max(rir_len, n)
+    dims = np.stack([np.asarray(c.room_dim, np.float32) for c in cfgs])
+    srcs = np.stack([np.asarray(c.source_positions[:2], np.float32) for c in cfgs])
+    mics = np.stack([np.asarray(c.mic_positions.T, np.float32) for c in cfgs])
+    alphas = np.asarray([c.alpha for c in cfgs], np.float32)
+
+    lens = [s.shape[-1] for s in sig_stacks]
+    L_max = max(lens)
+    dry = np.zeros((B, 2, L_max), np.float32)
+    for b, s in enumerate(sig_stacks):
+        dry[b, :, : s.shape[-1]] = s
+
+    rirs_d = shoebox_rirs_batched(dims, srcs, mics, alphas,
+                                  max_order=max_order, rir_len=rir_len, fs=fs)
+    images_d = fft_convolve(dry[:, :, None, :], rirs_d, out_len=L_max)
+    got = device_get_tree({"rirs": rirs_d, "images": images_d})
+
+    scenes = []
+    for b, cfg in enumerate(cfgs):
+        images = got["images"][b][:, :, : lens[b]]
+        image_vads = get_convolved_vads(images[0])
+        snr_images, snr_nodes, snr_diff = snr_at_mics(
+            images[0], images[1], mics_per_node, fs, vad_s=image_vads)
+        lo, hi = signal_setup.snr_cnv_range
+        if not (np.all(lo < snr_nodes) and np.all(snr_nodes < hi)
+                and signal_setup.min_delta_snr < snr_diff):
+            scenes.append(None)  # redraw_room_setup
+            continue
+        if dset == "train":
+            len_max = int((signal_setup.duration_range[-1] + 1) * fs)
+            pad = max(len_max - images.shape[-1], 0)
+            images = np.pad(images, ((0, 0), (0, 0), (0, pad)))[:, :, :len_max]
+        scenes.append(SimulatedScene(
+            setup=cfg, rirs=got["rirs"][b], sources=sig_stacks[b],
+            images=images, target_vad=target_vads[b],
+            image_vads=image_vads, snr_images=snr_images,
+        ))
+    return scenes
+
+
+def generate_disco_rirs_batched(
+    scenario: str,
+    dset: str,
+    rir_start: int,
+    n_rirs: int,
+    signal_setup,
+    layout: DatasetLayout,
+    rng=None,
+    max_order: int = 20,
+    fs: int = 16000,
+    max_redraws: int = 50,
+    ledger=None,
+    resume: bool = False,
+    batch: int = 8,
+    seed: int | None = None,
+):
+    """The batched generation driver (``disco-gen --batched``): same
+    idempotency, ledger and redraw semantics as :func:`generate_disco_rirs`,
+    but the RIR engine runs once per chunk of ``batch`` scenes instead of
+    once per scene — on the tunneled attachment that turns B×~80 ms of
+    dispatch RPC into one.
+
+    Redraw protocol per chunk: every pending scene draws its dry signals
+    up front (unusable targets advance the talker index, bounded); then
+    redraw ROUNDS run — each round simulates all still-unsatisfied scenes
+    in one dispatch and host-gates their node SNRs, failed scenes drawing
+    a fresh room next round (the "redraw_room_setup" sentinel, amortized).
+    Saving, ledger units (``scene:<id>``), the infos completion marker and
+    the ``between_scenes`` chaos seam are IDENTICAL to the per-scene
+    driver, so a batched corpus resumes (and chaos-drills) exactly like a
+    per-scene one; the chunk boundary adds the ``between_scene_batches``
+    seam.
+
+    Unlike the per-scene driver — whose rng state at scene N depends on
+    every draw scenes 1..N-1 consumed — the batched driver reseeds the
+    samplers deterministically PER SCENE from ``(seed, rir_id, stream)``
+    (the SURVEY §5.2 per-file reseeding discipline): scene ``rir_id``
+    produces identical bytes whether it runs in a fresh run, a resumed
+    run, or a different chunk split, which is what lets ``make
+    scene-check`` assert byte-identical crash-and-resume trees.  ``seed``
+    defaults to one integer drawn from ``rng`` (pass it explicitly — the
+    CLI passes ``--seed`` — for cross-run reproducibility).
+
+    Returns the list of RIR ids actually generated.
+    """
+    from disco_tpu.obs import events as obs_events
+    from disco_tpu.runs import chaos as run_chaos
+    from disco_tpu.runs import interrupt as run_interrupt
+    from disco_tpu.runs.ledger import RunLedger, unit_scene
+    from disco_tpu.sim import make_setup
+    from disco_tpu.sim.defaults import RoomDefaults
+
+    if batch < 1:
+        raise ValueError(f"batch must be >= 1, got {batch}")
+    rng = np.random.default_rng() if rng is None else rng
+    if seed is None:
+        seed = int(rng.integers(2**31 - 1))
+    defaults = RoomDefaults()
+    room_sampler = make_setup(scenario, rng=rng)
+    generated = []
+
+    if ledger is not None and not isinstance(ledger, RunLedger):
+        ledger = RunLedger(ledger)
+    if resume:
+        from disco_tpu.io.atomic import remove_tmp_litter
+
+        litter = remove_tmp_litter(layout.base)
+        if litter:
+            obs_events.record("warning", stage="resume",
+                              reason=f"removed {len(litter)} abandoned temp file(s) "
+                                     f"from a crashed writer", files=litter[:20])
+    ledger_done: set = set()
+    requeued_units: set = set()
+    if ledger is not None and resume:
+        ledger_done, requeued = ledger.verified_done()
+        requeued_units = set(requeued)
+        obs_events.record(
+            "run_resume", stage="datagen", ledger=str(ledger.path),
+            n_done=len(ledger_done), n_requeued=len(requeued),
+            requeued=sorted(requeued),
+        )
+
+    # Pending ids under the same skip rules as the per-scene driver: ledger
+    # done, or a validated infos completion marker (unless requeued).
+    pending = []
+    for rir_id in range(rir_start, rir_start + n_rirs):
+        if unit_scene(rir_id) in ledger_done:
+            continue
+        if unit_scene(rir_id) not in requeued_units and probe_npy(layout.infos(rir_id)):
+            continue
+        pending.append(rir_id)
+
+    for c0 in range(0, len(pending), batch):
+        if run_interrupt.stop_requested():
+            break  # graceful stop between chunks: everything saved, resumable
+        chunk = pending[c0 : c0 + batch]
+        if ledger is not None:
+            for rir_id in chunk:
+                ledger.mark_in_flight(unit_scene(rir_id))
+        # Dry signals per scene, drawn up front (redraw_source_signal
+        # advances the talker index, bounded like the per-scene loop).
+        # Stream 0 of the per-scene reseeding: scene rir_id's signal
+        # draws never depend on what other scenes consumed.
+        sig_stacks, target_vads = [], []
+        for rir_id in chunk:
+            signal_setup.rng = np.random.default_rng([seed, rir_id, 0])
+            signal_setup.get_random_dry_snr()
+            i_file = (rir_id - 1) * 2  # per-scene driver's talker convention
+            pair = None
+            for _ in range(max_redraws):
+                pair = _draw_dry_pair(signal_setup, i_file, fs)
+                if pair is not None:
+                    break
+                i_file += 1
+            if pair is None:
+                raise RuntimeError(
+                    f"no usable target signal after {max_redraws} files")
+            sig_stacks.append(pair[0])
+            target_vads.append(pair[1])
+        # Redraw rounds: one RIR dispatch per round over the unsatisfied
+        # slots, until every scene passes its SNR gate.  Stream 1000+round
+        # per scene: a scene's round-r room draw is a pure function of
+        # (seed, rir_id, r), so resumed runs redraw identical rooms.
+        scenes: list = [None] * len(chunk)
+        active = list(range(len(chunk)))
+        for _round in range(max_redraws):
+            cfgs = []
+            for slot in active:
+                room_sampler.rng = np.random.default_rng(
+                    [seed, chunk[slot], 1000 + _round])
+                cfgs.append(room_sampler.create_room_setup())
+            results = _simulate_scenes_batched(
+                cfgs, [sig_stacks[i] for i in active],
+                [target_vads[i] for i in active], dset, signal_setup,
+                defaults.n_sensors_per_node, max_order, fs)
+            still = []
+            for slot, scene in zip(active, results):
+                if scene is None:
+                    still.append(slot)
+                else:
+                    scenes[slot] = scene
+            active = still
+            if not active:
+                break
+        if active:
+            raise RuntimeError(
+                f"RIRs {[chunk[i] for i in active]}: no valid configuration "
+                f"after {max_redraws} batched redraw rounds")
+        obs_events.record("scene", stage="datagen", n_scenes=len(chunk),
+                          rir_start=int(chunk[0]), rir_end=int(chunk[-1]),
+                          scenario=scenario)
+        for rir_id, scene in zip(chunk, scenes):
+            # Stream 1: extra-noise reverb draws, reseeded per scene.
+            signal_setup.rng = np.random.default_rng([seed, rir_id, 1])
+            extra_dry, extra_rev, files, starts = reverb_other_noises(
+                scene, signal_setup, dset, fs)
+            dims = np.asarray(scene.setup.room_dim)
+            infos = {
+                "room": {
+                    "length": float(dims[0]),
+                    "width": float(dims[1]),
+                    "height": float(dims[2]),
+                    "alpha": scene.setup.alpha,
+                    "rt60": scene.setup.beta,
+                },
+                "mics": np.asarray(scene.setup.mic_positions),
+                "sources": np.asarray(scene.setup.source_positions),
+                "nodes_centers": scene.setup.nodes_centers,
+                "rirs": scene.rirs,
+                "snr_images": scene.snr_images,
+                "noise_files": files,
+                "noise_starts": starts,
+            }
+            written = save_scene(
+                scene, extra_dry, extra_rev, infos, rir_id, layout, fs,
+                extra_names=list(signal_setup.noises_dict.keys()),
+            )
+            if ledger is not None:
+                ledger.mark_done(unit_scene(rir_id), written)
+            generated.append(rir_id)
+            run_chaos.tick("between_scenes", rir=rir_id)
+        run_chaos.tick("between_scene_batches", rir_start=int(chunk[0]),
+                       rir_end=int(chunk[-1]))
     return generated
 
 

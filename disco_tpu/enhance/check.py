@@ -78,6 +78,13 @@ def _check_bench_one_line(failures: list) -> dict | None:
         "BENCH_TRAIN_STEPS": "2",
         "BENCH_TRAIN_BATCH": "2",
         "BENCH_TAP_BLOCKS": "8",
+        # scenario-factory lane at smoke size (low ISM order + short dry
+        # clips: the gate asserts the field and the one-dispatch-per-batch
+        # contract, not TPU-representative throughput)
+        "BENCH_SCENE_BATCHES": "2",
+        "BENCH_SCENE_B": "4",
+        "BENCH_SCENE_DUR_S": "0.5",
+        "BENCH_SCENE_ORDER": "2",
         # pinned: an exported =0 would null the promotion lane this gate
         # asserts (the lane's one rollout IS its smoke size)
         "BENCH_PROMOTE": "1",
@@ -130,6 +137,9 @@ def _check_bench_one_line(failures: list) -> dict | None:
             )
     for key, err_key in (("train_steps_per_s", "train_error"),
                          ("tap_blocks_per_s", "tap_error"),
+                         # the scenario-factory lane: one compiled program
+                         # + one batched readback per scene batch
+                         ("scenes_per_s", "scene_error"),
                          # the live-flywheel lane: complete tap->train->
                          # publish->promote generations must close on a
                          # loopback server and be measured

@@ -112,6 +112,13 @@ class ResidentTrainer:
         long as the server serves, so an unwindowed epoch re-reads the
         entire history and training falls ever further behind serving.
       precision: training compute lane (``'f32'``/``'bf16'``).
+      dataset: an alternative training feed replacing the
+        :class:`~disco_tpu.flywheel.dataset.ShardDataset` over
+        ``shard_dir`` — anything with the same ``batches`` /
+        ``peek_geometry`` surface (the scenario factory's
+        :class:`~disco_tpu.scenes.stream.SceneStream` is the intended
+        plug: training never starves on thin serve traffic because its
+        corpus is simulated on demand).
 
     No reference counterpart (module docstring).
     """
@@ -122,7 +129,8 @@ class ResidentTrainer:
                  steps_per_tick: int = 4, publish_every: int = 1,
                  publish: str = "improved", throttle_rung: int = 1,
                  max_epochs: int | None = None,
-                 recent_shards: int | None = None, precision: str = "f32"):
+                 recent_shards: int | None = None, precision: str = "f32",
+                 dataset=None):
         if steps_per_tick < 1:
             raise ValueError(f"steps_per_tick must be >= 1, got {steps_per_tick}")
         if recent_shards is not None and int(recent_shards) < 1:
@@ -147,6 +155,7 @@ class ResidentTrainer:
         self.seed = int(seed)
         self._arch = dict(arch) if arch is not None else None
         self._win_len = int(win_len) if win_len is not None else None
+        self._feed = dataset       # None = ShardDataset over shard_dir
         self._ready = False
         self._closed = False       # flag-only close signal (server shutdown)
         self._failed = None        # first training Exception — trainer parks
@@ -277,7 +286,8 @@ class ResidentTrainer:
         if self._ready:
             return True
         if self._arch is None:
-            geom = peek_geometry(self.shard_dir)
+            geom = (self._feed.peek_geometry() if self._feed is not None
+                    else peek_geometry(self.shard_dir))
             if geom is None:
                 if not self._waiting_for_shards:
                     self._waiting_for_shards = True
@@ -288,15 +298,22 @@ class ResidentTrainer:
                 return False
             from disco_tpu.config import TrainConfig
 
-            win_len = self._win_len or geom["block_frames"]
+            # an injected feed windows at ITS OWN win_len — the model must
+            # match the windows it will actually be fed, not the feed's
+            # full block length
+            feed_win = getattr(self._feed, "win_len", None)
+            win_len = self._win_len or feed_win or geom["block_frames"]
             self._arch = dict(n_ch=1, win_len=win_len,
                               n_freq=geom["n_freq"],
                               learning_rate=TrainConfig().lr,
                               ff_units=(geom["n_freq"],))
         self._waiting_for_shards = False
         win_len = self._win_len or int(self._arch["win_len"])
-        self._dataset = ShardDataset(self.shard_dir, win_len=win_len,
-                                     seed=self.seed)
+        # The feed seam: an injected dataset (e.g. scenes.SceneStream)
+        # replaces the tapped-shard reader wholesale — same batches()
+        # contract, so the epoch/ledger machinery below is untouched.
+        self._dataset = self._feed if self._feed is not None else ShardDataset(
+            self.shard_dir, win_len=win_len, seed=self.seed)
         self.train_dir.mkdir(parents=True, exist_ok=True)
         self._ledger = RunLedger(self.train_dir / LEDGER_NAME)
 

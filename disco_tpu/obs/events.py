@@ -56,6 +56,7 @@ EVENT_KINDS = frozenset(
         "rollback",     # a demoted candidate rolled back (attrs: reason, failing metric)
         "generation",   # resident trainer published a generation (flywheel/resident)
         "train_throttled",  # ladder rung paused/resumed resident training
+        "scene",        # one simulated scene batch (scenes/; attrs: epoch, index, n_scenes)
         "note",         # freeform annotation
     }
 )

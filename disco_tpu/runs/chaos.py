@@ -58,6 +58,12 @@ Seams wired through the pipeline (each a named :func:`tick` call):
   generations — a crash here must leave the store with only complete,
   digest-verified generations and the trainer resumable at the next
   epoch.
+* ``between_scene_batches`` — after one simulated scene batch's training
+  windows are fully yielded (``scenes/stream.py``) and after one batched
+  datagen round is fully saved (``datagen/disco.py``): the scenario
+  factory's clean boundary — a crash here must leave only complete,
+  ledger-done scene batches, with the resumed run skipping them
+  byte-identically (``make scene-check``'s crash-and-resume leg).
 
 Injection is armed either programmatically (:func:`configure`) or via the
 ``DISCO_TPU_CHAOS`` environment variable (``"seam"`` or ``"seam:N"`` —
@@ -99,6 +105,7 @@ SEAMS = frozenset(
         "post_gate",       # promote/controller.py, verdict reached, ledger not yet final
         "pre_publish",     # flywheel/resident.py, checkpoint done, generation not staged
         "between_generations",  # flywheel/resident.py, one generation fully published
+        "between_scene_batches",  # scenes/stream.py + datagen/disco.py, one scene batch complete
     }
 )
 

@@ -21,7 +21,8 @@ and asserts the survival invariants after every run:
 3. **bit-exact reattach** — every session's stitched output equals the
    offline ``streaming_tango`` run of the same clip, byte for byte.
 4. **bounded recovery** — after the last injected fault the server drains
-   the remaining work within :data:`RECOVERY_TICK_BOUND` scheduler ticks.
+   the remaining work within :func:`recovery_tick_bound` scheduler ticks
+   (a load-scaled budget: base + per-block slack for the campaign's size).
 5. **byte-stable ledger** — the per-seed event summary (planned faults +
    deterministic survival counts distilled from the obs JSONL ledger) is
    byte-identical across runs of the same seed (asserted by literally
@@ -51,9 +52,24 @@ from pathlib import Path
 SEEDS = (201, 202, 203, 204, 205)
 
 #: declared recovery bound: scheduler ticks between the last injected fault
-#: and full drain of the remaining work (2 ms idle ticks — generous, but a
-#: wedged server blows it by orders of magnitude, which is the point)
-RECOVERY_TICK_BOUND = 3000
+#: and full drain of the remaining work.  The bound is LOAD-SCALED, not a
+#: single constant: a seeded campaign draws 2-3 sessions with seed-dependent
+#: clip lengths, so the drain tail after the last fault is proportional to
+#: the blocks still in flight — a fixed ceiling sized for the smallest draw
+#: flaked on the largest one (the eleventh-gate slow-host flake), while one
+#: sized for the largest stops binding on the smallest.  A wedged server
+#: still blows the scaled bound by orders of magnitude, which is the point.
+RECOVERY_TICK_BOUND_BASE = 3000
+RECOVERY_TICKS_PER_BLOCK = 50
+
+
+def recovery_tick_bound(total_blocks: int) -> int:
+    """Ticks allowed between the last injected fault and full drain for a
+    campaign carrying ``total_blocks`` client blocks.
+
+    No reference counterpart: the reference has no serving layer to soak.
+    """
+    return RECOVERY_TICK_BOUND_BASE + RECOVERY_TICKS_PER_BLOCK * total_blocks
 
 K, C, U = 4, 2, 4
 BLOCK = 2 * U
@@ -335,10 +351,12 @@ def run_soak(seed: int, tmp: Path, failures: list) -> dict:
         # when the clients joined; the tick budget bounds how long the tail
         # (reattach + quarantine release + drain) took after the LAST fault
         ticks_total = srv.scheduler.tick_no
-        if ticks_total - recovery_start_tick > RECOVERY_TICK_BOUND:
+        tick_bound = recovery_tick_bound(sum(n_blocks))
+        if ticks_total - recovery_start_tick > tick_bound:
             failures.append(
                 f"seed {seed}: drain took {ticks_total - recovery_start_tick} "
-                f"ticks after the campaign (> {RECOVERY_TICK_BOUND})"
+                f"ticks after the campaign (> {tick_bound} for "
+                f"{sum(n_blocks)} blocks)"
             )
 
         # invariant 1: no torn artifact or shard
@@ -527,7 +545,8 @@ def main(argv=None) -> int:
         "quarantines": sum(s["quarantines"] for s in summaries),
         "crash_legs": sum(1 for s in summaries if "crash_leg" in s),
         "byte_stable_seeds": 1,
-        "recovery_tick_bound": RECOVERY_TICK_BOUND,
+        "recovery_tick_bound_base": RECOVERY_TICK_BOUND_BASE,
+        "recovery_ticks_per_block": RECOVERY_TICKS_PER_BLOCK,
         "jax_processes": 1,
         "sigkills_issued": 0,
     }))

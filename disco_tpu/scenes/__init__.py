@@ -1,0 +1,50 @@
+"""disco_tpu.scenes — the batched on-device scenario factory.
+
+Three layers (module docstrings carry the detail):
+
+* :mod:`disco_tpu.scenes.batched` — B rooms × S sources × M mics simulated
+  as ONE compiled program (RIRs, convolution, SNR mixing, STFT, mask).
+* :mod:`disco_tpu.scenes.dynamic` — piecewise-stationary moving-source /
+  moving-node scenes with crossfaded segment RIRs.
+* :mod:`disco_tpu.scenes.stream` — the SceneStream training feed
+  (ShardDataset-shaped; plugs into ``flywheel.fit`` and the resident
+  trainer).
+
+``make scene-check`` (:mod:`disco_tpu.scenes.check`) is the subsystem's
+hermetic gate.
+
+No reference counterpart: the reference simulates scenes one at a time on
+the host (SURVEY.md §0; gen_disco/convolve_signals.py).
+"""
+from disco_tpu.scenes.batched import (
+    BATCH_QUANTUM,
+    SceneBatch,
+    draw_scene_batch,
+    noise_gain_for_snr,
+    scene_batch_bucket,
+    simulate_scene_batch,
+    synthetic_dry_pair,
+)
+from disco_tpu.scenes.dynamic import (
+    boundary_jumps,
+    dynamic_scene_mixture,
+    piecewise_trajectory,
+    segment_weights,
+)
+from disco_tpu.scenes.stream import SceneStream, unit_scene_batch
+
+__all__ = [
+    "BATCH_QUANTUM",
+    "SceneBatch",
+    "SceneStream",
+    "boundary_jumps",
+    "draw_scene_batch",
+    "dynamic_scene_mixture",
+    "noise_gain_for_snr",
+    "piecewise_trajectory",
+    "scene_batch_bucket",
+    "segment_weights",
+    "simulate_scene_batch",
+    "synthetic_dry_pair",
+    "unit_scene_batch",
+]

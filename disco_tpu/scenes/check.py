@@ -1,0 +1,482 @@
+"""``make scene-check`` — the batched scenario-factory gate (seventeenth gate).
+
+Proves the scenes subsystem end to end, hermetically (CPU backend forced by
+the Makefile, compile cache off, ONE jax process, zero SIGKILLs):
+
+1. **Oracle parity**: the batched ISM engine
+   (:func:`~disco_tpu.sim.ism.shoebox_rirs_batched`) matches an
+   independent loop-based float64 NumPy Allen & Berkley oracle (inlined
+   below, the same physics as ``tests/reference_impls.shoebox_rir_np``)
+   per (scene, source, mic) at relative error < 2e-4.
+2. **Batched = per-scene**: the (B,) scene axis is pure vmap — batched
+   RIRs match B independent :func:`~disco_tpu.sim.ism.shoebox_rirs`
+   dispatches in the same ``(max_order, rir_len)`` bucket bit-for-bit
+   (atol 1e-6; identical program, different batching).
+3. **One dispatch per batch + retrace budget**: simulating a B=8 scene
+   batch is exactly ONE batched readback (fence accounting, the ISSUE's
+   acceptance criterion), and the ``scene_batch`` program retraces
+   exactly once per distinct bucket — a second same-bucket batch adds
+   ZERO recompiles.
+4. **Dynamic continuity**: crossfaded segment weights sum to one
+   everywhere, and on a smooth (sine) dry signal the worst boundary jump
+   of a crossfaded moving-source mixture is under half the hard-switch
+   (crossfade=0) jump of the same trajectory — the overlap-add crossfade
+   demonstrably removes the segment-boundary click.
+5. **Chaos crash-and-resume**: a :class:`~disco_tpu.runs.chaos.ChaosCrash`
+   at the ``between_scenes`` seam inside ``disco-gen --batched`` dies like
+   a process death; the resumed run (same seed) completes the corpus and
+   the artifact tree is **byte-identical** to an uninterrupted run — the
+   per-scene ``(seed, rir_id, stream)`` reseeding discipline at work.
+6. **SceneStream determinism + verified resume**: the training feed's
+   (seed, epoch) batch stream is deterministic, a RunLedger-armed epoch
+   replays to zero duplicate scene batches, and a chaos crash at the
+   ``between_scene_batches`` seam resumes to exactly the missing batches
+   (crashed + resumed == uninterrupted).
+
+No reference counterpart: the reference pre-generates its corpus to disk
+with per-scene pyroomacoustics loops and has no on-line scenario factory
+(SURVEY.md §0, gen_disco/convolve_signals.py).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import tempfile
+from pathlib import Path
+
+FS = 16000
+
+#: oracle-parity bound: float32 engine vs float64 loop oracle, relative
+#: l2 error per RIR (tests/test_sim.py pins the per-scene path at 1e-4;
+#: the batched engine shares its kernel, measured ~2e-5 on this workload).
+ORACLE_RTOL = 2e-4
+
+#: dynamic-continuity bound: the crossfaded boundary jump must be under
+#: this fraction of the hard-switch jump on the same (sine-dry) scene —
+#: measured ~0.1 on the gate workload, 0.5 leaves margin while still
+#: failing if the crossfade stops doing its job.
+CROSSFADE_JUMP_RATIO = 0.5
+
+
+def _oracle_rir_np(room_dim, source, mic, alpha, max_order, rir_len,
+                   fs=FS, c=343.0, fdl=81):
+    """Loop-based float64 Allen & Berkley shoebox ISM oracle — independent
+    of disco_tpu.sim (no jax, no shared helpers; the same physics as the
+    tests/reference_impls.py oracle that pins the per-scene kernel):
+    sum-order truncation, uniform sqrt(1-alpha) wall reflection,
+    1/(4 pi d) spreading, windowed-sinc fractional delays.
+
+    Reference counterpart: pyroomacoustics libroom conventions as used by
+    gen_disco/convolve_signals.py:84-99 (SURVEY.md §L1)."""
+    import numpy as np
+
+    room_dim = np.asarray(room_dim, np.float64)
+    source = np.asarray(source, np.float64)
+    mic = np.asarray(mic, np.float64)
+    beta = np.sqrt(max(1.0 - float(alpha), 0.0))
+    half = fdl // 2
+    rir = np.zeros(rir_len)
+    N = max_order
+    for n in range(-N, N + 1):
+        for l in range(-N, N + 1):  # noqa: E741 — ISM lattice convention
+            for m in range(-N, N + 1):
+                for u in (0, 1):
+                    for v in (0, 1):
+                        for w in (0, 1):
+                            n_refl = (abs(n - u) + abs(n) + abs(l - v)
+                                      + abs(l) + abs(m - w) + abs(m))
+                            if n_refl > N:
+                                continue
+                            img = np.array([
+                                (1 - 2 * u) * source[0] + 2 * n * room_dim[0],
+                                (1 - 2 * v) * source[1] + 2 * l * room_dim[1],
+                                (1 - 2 * w) * source[2] + 2 * m * room_dim[2],
+                            ])
+                            d = max(np.linalg.norm(img - mic), 1e-3)
+                            amp = beta ** n_refl / (4 * np.pi * d)
+                            delay = d * fs / c
+                            t0 = int(np.floor(delay))
+                            frac = delay - t0
+                            for tap in range(-half, half + 1):
+                                t = t0 + tap
+                                if 0 <= t < rir_len:
+                                    arg = tap - frac
+                                    win = 0.5 * (1 + np.cos(np.pi * arg / (half + 1)))
+                                    rir[t] += amp * np.sinc(arg) * win
+    return rir
+
+
+def _check_oracle_parity(failures: list) -> dict:
+    """Experiment 1: batched engine vs the inlined float64 oracle."""
+    import numpy as np
+
+    from disco_tpu.sim import shoebox_rirs_batched
+
+    max_order, rir_len = 2, 1024
+    dims = np.array([[4.0, 3.0, 2.5], [5.5, 4.0, 3.0]], np.float32)
+    srcs = np.array([[[1.0, 1.2, 1.1]], [[1.5, 2.0, 1.4]]], np.float32)
+    mics = np.array([[[2.5, 2.0, 1.3], [3.0, 1.0, 1.2]],
+                     [[3.5, 2.5, 1.5], [4.0, 3.0, 1.8]]], np.float32)
+    alphas = np.array([0.35, 0.5], np.float32)
+    got = np.asarray(shoebox_rirs_batched(dims, srcs, mics, alphas,
+                                          max_order=max_order,
+                                          rir_len=rir_len))
+    worst = 0.0
+    for b in range(2):
+        for mi in range(2):
+            want = _oracle_rir_np(dims[b], srcs[b, 0], mics[b, mi],
+                                  alphas[b], max_order, rir_len)
+            err = float(np.linalg.norm(got[b, 0, mi] - want)
+                        / np.linalg.norm(want))
+            worst = max(worst, err)
+            if err > ORACLE_RTOL:
+                failures.append(
+                    f"oracle: batched RIR (scene {b}, mic {mi}) off the "
+                    f"float64 oracle by rel {err:g} > {ORACLE_RTOL:g}"
+                )
+    return {"oracle_rel_err": worst}
+
+
+def _check_batched_equals_per_scene(failures: list) -> dict:
+    """Experiment 2: the (B,) axis is pure vmap — batched == per-scene."""
+    import numpy as np
+
+    from disco_tpu.scenes import draw_scene_batch, scene_batch_bucket, simulate_scene_batch
+    from disco_tpu.sim import shoebox_rirs
+
+    rng = np.random.default_rng(41)
+    batch = draw_scene_batch(rng, 3, duration_s=0.5,
+                             setup_overrides={"n_sensors_per_node": (2, 2)})
+    max_order, rir_len = scene_batch_bucket(batch, max_order=4)
+    out = simulate_scene_batch(batch, max_order=4)
+    worst = 0.0
+    for b in range(batch.n_scenes):
+        single = np.asarray(shoebox_rirs(
+            batch.room_dims[b], batch.sources[b], batch.mics[b],
+            float(batch.alphas[b]), max_order=max_order, rir_len=rir_len))
+        err = float(np.abs(out["rirs"][b] - single).max())
+        worst = max(worst, err)
+        if err > 1e-6:
+            failures.append(
+                f"vmap-parity: scene {b} batched RIRs differ from the "
+                f"per-scene dispatch by {err:g} > 1e-6"
+            )
+    # the factory's derived products are finite and the mask is a mask
+    for k in ("noisy", "clean", "mag_noisy"):
+        if not np.isfinite(out[k]).all():
+            failures.append(f"vmap-parity: non-finite values in {k!r}")
+    if not (np.all(out["mask"] >= 0) and np.all(out["mask"] <= 1)):
+        failures.append("vmap-parity: IRM mask left [0, 1]")
+    return {"vmap_max_abs_err": worst, "bucket_rir_len": rir_len}
+
+
+def _check_dispatch_budget(failures: list) -> dict:
+    """Experiment 3: one readback per batch, one retrace per bucket."""
+    import numpy as np
+
+    from disco_tpu.obs.accounting import device_get_count, recompile_count
+    from disco_tpu.scenes import draw_scene_batch, simulate_scene_batch
+
+    rng = np.random.default_rng(43)
+    overrides = {"n_sensors_per_node": (2, 2)}
+
+    g0, r0 = device_get_count(), recompile_count("scene_batch")
+    first = draw_scene_batch(rng, 8, duration_s=0.5, setup_overrides=overrides)
+    simulate_scene_batch(first, max_order=2)
+    gets_first = device_get_count() - g0
+    if gets_first != 1:
+        failures.append(
+            f"dispatch: a B=8 scene batch cost {gets_first} batched "
+            "readbacks — the acceptance criterion is exactly ONE"
+        )
+    retraces_first = recompile_count("scene_batch") - r0
+    if retraces_first != 1:
+        failures.append(
+            f"dispatch: first B=8 batch retraced {retraces_first}×, "
+            "expected exactly 1 (a fresh bucket compiles once)"
+        )
+    # same bucket again: zero recompiles, still one readback each
+    g1, r1 = device_get_count(), recompile_count("scene_batch")
+    again = draw_scene_batch(rng, 8, duration_s=0.5, setup_overrides=overrides)
+    simulate_scene_batch(again, max_order=2)
+    if recompile_count("scene_batch") - r1 != 0:
+        failures.append(
+            f"dispatch: a same-bucket batch retraced "
+            f"{recompile_count('scene_batch') - r1}× — the bucket policy "
+            "failed to reuse the compiled program"
+        )
+    if device_get_count() - g1 != 1:
+        failures.append("dispatch: second batch broke the one-readback rule")
+    # a different bucket (B changes the traced shape): exactly one more
+    r2 = recompile_count("scene_batch")
+    small = draw_scene_batch(rng, 4, duration_s=0.5, setup_overrides=overrides)
+    simulate_scene_batch(small, max_order=2)
+    if recompile_count("scene_batch") - r2 != 1:
+        failures.append(
+            f"dispatch: a new (B=4) bucket retraced "
+            f"{recompile_count('scene_batch') - r2}×, expected exactly 1"
+        )
+    return {"readbacks_per_batch": gets_first,
+            "retraces_total": recompile_count("scene_batch") - r0}
+
+
+def _check_dynamic_continuity(failures: list) -> dict:
+    """Experiment 4: crossfade weights + boundary continuity on a sine dry."""
+    import numpy as np
+
+    from disco_tpu.scenes import (
+        boundary_jumps,
+        dynamic_scene_mixture,
+        piecewise_trajectory,
+        segment_weights,
+    )
+
+    n_seg, L = 5, FS // 2
+    w = segment_weights(L, n_seg, crossfade=512)
+    colsum = np.abs(w.sum(axis=0) - 1.0).max()
+    if colsum > 1e-6:
+        failures.append(
+            f"dynamic: crossfade weights sum off unity by {colsum:g} — "
+            "overlap-add would rescale the mixture"
+        )
+    hard = segment_weights(L, n_seg, crossfade=0)
+    if not np.array_equal(np.unique(hard), [0.0, 1.0]):
+        failures.append("dynamic: crossfade=0 weights are not a hard switch")
+
+    t = np.arange(L) / FS
+    dry = np.sin(2 * np.pi * 440 * t).astype(np.float32)
+    path = piecewise_trajectory([1.0, 1.0, 1.5], [3.0, 2.0, 1.5], n_seg)
+    mics = np.asarray([[2.0, 1.5, 1.0], [2.2, 1.5, 1.0]], np.float32)
+    room = [4.0, 3.0, 2.5]
+
+    def jump(crossfade):
+        out = dynamic_scene_mixture(room, path, mics, 0.3, dry,
+                                    crossfade=crossfade, max_order=2,
+                                    rir_len=2048)
+        if not np.isfinite(out["mixture"]).all():
+            failures.append(f"dynamic: non-finite mixture at crossfade={crossfade}")
+        return float(boundary_jumps(out["mixture"], n_seg).max())
+
+    j_cross, j_hard = jump(512), jump(0)
+    if j_cross > CROSSFADE_JUMP_RATIO * j_hard:
+        failures.append(
+            f"dynamic: crossfaded boundary jump {j_cross:g} is not under "
+            f"{CROSSFADE_JUMP_RATIO} × the hard-switch jump {j_hard:g} — "
+            "the crossfade is not removing the segment click"
+        )
+    return {"jump_crossfade": j_cross, "jump_hard_switch": j_hard}
+
+
+def _raw_corpus(root: Path):
+    """Tiny synthetic LibriSpeech-shaped raw corpus (the tests/test_datagen.py
+    recipe): two 6 s envelope-gated 'speech' files + one 8 s noise file,
+    written atomically so the chaos legs never see torn inputs."""
+    import numpy as np
+
+    from disco_tpu.io.atomic import write_wav_atomic
+
+    rng = np.random.default_rng(0)
+    speech = []
+    for spk in ("7", "8"):
+        f = root / "LibriSpeech" / spk / "1" / f"{spk}-1-0001.wav"
+        t = np.arange(6 * FS) / FS
+        env = (np.sin(2 * np.pi * 1.1 * t + float(spk)) > -0.2).astype(np.float64)
+        write_wav_atomic(f, 0.3 * env * rng.standard_normal(len(t)), FS)
+        speech.append(str(f))
+    nf = root / "noises" / "n0.wav"
+    write_wav_atomic(nf, 0.2 * rng.standard_normal(8 * FS), FS)
+    return speech, [str(nf)]
+
+
+def _signal_setup(speech, noise):
+    import numpy as np
+
+    from disco_tpu.sim import SpeechAndNoiseSetup
+
+    return SpeechAndNoiseSetup(
+        target_list=speech, talkers_list=speech, noises_dict={"fs": noise},
+        duration_range=(5, 10), var_tar=10 ** (-23 / 10),
+        snr_dry_range=[[0, 0]],
+        snr_cnv_range=(-60, 60),  # wide gate: the tiny corpus must not redraw forever
+        min_delta_snr=-1,
+        rng=np.random.default_rng(3),
+    )
+
+
+def _run_batched_gen(out_root: Path, speech, noise, crash_after=None) -> list:
+    """One ``disco-gen --batched`` run against the mini corpus; optionally
+    chaos-crashed at the between_scenes seam then resumed."""
+    import numpy as np
+
+    from disco_tpu.datagen import generate_disco_rirs_batched
+    from disco_tpu.io import DatasetLayout
+    from disco_tpu.runs import chaos
+
+    layout = DatasetLayout(str(out_root / "dataset"), "random", "test")
+    ledger = str(out_root / "ledger.jsonl")
+    kw = dict(max_order=2, batch=2, ledger=ledger, resume=True, seed=17)
+    if crash_after is not None:
+        chaos.configure("between_scenes", after=crash_after)
+        try:
+            generate_disco_rirs_batched(
+                "random", "test", 1, 4, _signal_setup(speech, noise), layout,
+                rng=np.random.default_rng(5), **kw)
+            return ["CRASH-NEVER-FIRED"]
+        except chaos.ChaosCrash:
+            pass
+        finally:
+            chaos.disable()
+    done = generate_disco_rirs_batched(
+        "random", "test", 1, 4, _signal_setup(speech, noise), layout,
+        rng=np.random.default_rng(5 if crash_after is None else 999), **kw)
+    return done
+
+
+def _check_datagen_chaos_resume(failures: list, scratch: Path) -> dict:
+    """Experiment 5: byte-identical crash-and-resume of disco-gen --batched."""
+    from disco_tpu.runs.check import _trees_identical
+
+    speech, noise = _raw_corpus(scratch / "corpus")
+    a, b = scratch / "uninterrupted", scratch / "crashed"
+    a.mkdir()
+    b.mkdir()
+    done_plain = _run_batched_gen(a, speech, noise)
+    if done_plain != [1, 2, 3, 4]:
+        failures.append(f"datagen: uninterrupted run generated {done_plain}, "
+                        "expected [1, 2, 3, 4]")
+    done_resumed = _run_batched_gen(b, speech, noise, crash_after=2)
+    if done_resumed == ["CRASH-NEVER-FIRED"]:
+        failures.append("datagen: the between_scenes chaos crash never fired")
+    elif set(done_resumed) & {1, 2}:
+        failures.append(
+            f"datagen: the resumed run regenerated ledger-done scenes "
+            f"{sorted(set(done_resumed) & {1, 2})} — verified resume broken"
+        )
+    _trees_identical(a / "dataset", b / "dataset", failures, "datagen")
+    return {"scenes_resumed": len(done_resumed)}
+
+
+def _check_stream(failures: list, scratch: Path) -> dict:
+    """Experiment 6: SceneStream determinism, ledger resume, chaos seam."""
+    import numpy as np
+
+    from disco_tpu.runs import chaos
+    from disco_tpu.scenes import SceneStream
+
+    def stream():
+        return SceneStream(seed=7, scenes_per_batch=2, batches_per_epoch=2,
+                           duration_s=0.5, max_order=2, win_len=4,
+                           setup_overrides={"n_sensors_per_node": (2, 2)})
+
+    full = list(stream().batches(8, epoch=0))
+    twin = list(stream().batches(8, epoch=0))
+    if not full:
+        failures.append("stream: the feed yielded no training batches")
+    if len(full) != len(twin) or not all(
+        np.array_equal(xa, xb) and np.array_equal(ya, yb)
+        for (xa, ya), (xb, yb) in zip(full, twin)
+    ):
+        failures.append("stream: the (seed, epoch) batch stream is not deterministic")
+    geom = stream().peek_geometry()
+    if full and full[0][0].shape[-1] != geom["n_freq"]:
+        failures.append(
+            f"stream: batch feature dim {full[0][0].shape[-1]} != "
+            f"peek_geometry n_freq {geom['n_freq']}"
+        )
+
+    # ledger-armed epoch replays to zero duplicate scene batches
+    led = scratch / "stream_ledger.jsonl"
+    first = list(stream().batches(8, epoch=0, ledger=led))
+    if len(first) != len(full):
+        failures.append("stream: the ledger-armed epoch differs from the bare one")
+    again = list(stream().batches(8, epoch=0, ledger=led))
+    if again:
+        failures.append(
+            f"stream: a completed epoch replayed {len(again)} batches — "
+            "verified resume must skip every consumed scene batch"
+        )
+
+    # chaos at the batch seam: crash, then resume to exactly the rest
+    led2 = scratch / "stream_ledger_chaos.jsonl"
+    chaos.configure("between_scene_batches", after=1)
+    got: list = []
+    try:
+        for xy in stream().batches(8, epoch=0, ledger=led2):
+            got.append(xy)
+        failures.append("stream: the between_scene_batches crash never fired")
+    except chaos.ChaosCrash:
+        pass
+    finally:
+        chaos.disable()
+    rest = list(stream().batches(8, epoch=0, ledger=led2))
+    combined = got + rest
+    if len(combined) != len(full) or not all(
+        np.array_equal(xa, xb) and np.array_equal(ya, yb)
+        for (xa, ya), (xb, yb) in zip(combined, full)
+    ):
+        failures.append(
+            f"stream: crashed ({len(got)}) + resumed ({len(rest)}) batches "
+            f"!= the uninterrupted epoch ({len(full)}) — the scene-batch "
+            "resume unit is not seamless"
+        )
+    return {"batches_per_epoch": len(full),
+            "batches_after_crash": len(rest)}
+
+
+def main(argv=None) -> int:
+    """Run the scenario-factory gate (``make scene-check``); exit 1 on failure.
+
+    No reference counterpart (module docstring)."""
+    import os
+
+    os.environ.setdefault("DISCO_TPU_COMPILE_CACHE", "off")
+    from disco_tpu import obs
+
+    failures: list[str] = []
+    with tempfile.TemporaryDirectory() as tmp:
+        tmp = Path(tmp)
+        obs_log = tmp / "scene_check.jsonl"
+        with obs.recording(obs_log):
+            obs.write_manifest(tool="scene-check")
+            oracle = _check_oracle_parity(failures)
+            vmapped = _check_batched_equals_per_scene(failures)
+            dispatch = _check_dispatch_budget(failures)
+            dynamic = _check_dynamic_continuity(failures)
+            datagen = _check_datagen_chaos_resume(failures, tmp)
+            streamed = _check_stream(failures, tmp)
+            obs.record("counters", **obs.REGISTRY.snapshot())
+        events = obs.read_events(obs_log)  # schema-validating read
+
+        scene_stages = {e.get("stage") for e in events
+                        if e["kind"] == "scene"}
+        if "scenes" not in scene_stages:
+            failures.append("event log missing SceneStream scene events "
+                            "(stage='scenes')")
+        if "datagen" not in scene_stages:
+            failures.append("event log missing batched-datagen scene events "
+                            "(stage='datagen')")
+        if not any(e["kind"] == "run_resume" for e in events):
+            failures.append("event log missing the datagen run_resume event")
+
+    if failures:
+        for f in failures:
+            print(f"scene-check FAIL: {f}", file=sys.stderr)
+        return 1
+    print(json.dumps({
+        "scene_check": "ok",
+        "oracle_rel_err": oracle["oracle_rel_err"],
+        "vmap_max_abs_err": vmapped["vmap_max_abs_err"],
+        "readbacks_per_batch": dispatch["readbacks_per_batch"],
+        "retraces_total": dispatch["retraces_total"],
+        "jump_crossfade": dynamic["jump_crossfade"],
+        "jump_hard_switch": dynamic["jump_hard_switch"],
+        "scenes_resumed": datagen["scenes_resumed"],
+        "stream_batches_per_epoch": streamed["batches_per_epoch"],
+        "jax_processes": 1,
+        "sigkills_issued": 0,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

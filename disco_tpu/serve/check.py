@@ -414,10 +414,26 @@ def _check_overload(failures: list) -> dict:
     tick_budget = 4 * (sched.wait_window_ticks
                        + ladder.max_rung * ladder.recover_ticks) + 100
     tick_end = sched.tick_no + tick_budget
-    hang_guard = time.monotonic() + 120.0   # hang protection ONLY: the
-    # bound that matters is the tick budget (deterministic per host)
-    while (ladder.rung > 0 and sched.tick_no < tick_end
-           and time.monotonic() < hang_guard):
+    # Hang protection ONLY: the bound that matters is the tick budget
+    # (deterministic per host).  A fixed wall-clock deadline here was the
+    # last host-speed-dependent term in the drill — on a loaded machine
+    # ticks advance slowly but steadily and the old 120 s guard could fire
+    # mid-recovery.  The guard now watches tick PROGRESS instead: only a
+    # scheduler whose tick counter stops moving entirely for 10 s straight
+    # counts as hung, so a slow host just takes longer while a genuinely
+    # wedged tick loop still fails fast.
+    last_tick = sched.tick_no
+    last_progress = time.monotonic()
+    while ladder.rung > 0 and sched.tick_no < tick_end:
+        now = time.monotonic()
+        if sched.tick_no != last_tick:
+            last_tick = sched.tick_no
+            last_progress = now
+        elif now - last_progress > 10.0:
+            failures.append(
+                "overload: scheduler tick counter stalled for 10 s during "
+                f"recovery (stuck at tick {last_tick}, rung {ladder.rung})")
+            break
         time.sleep(0.005)
     recovery_ticks_used = tick_budget - max(tick_end - sched.tick_no, 0)
     if ladder.rung != 0:

@@ -16,9 +16,11 @@ from disco_tpu.sim.signals import (
 from disco_tpu.sim.ism import (
     fft_convolve,
     image_lattice,
+    rir_bucket,
     rir_length_for,
     shoebox_rir,
     shoebox_rirs,
+    shoebox_rirs_batched,
 )
 
 __all__ = [
@@ -34,7 +36,9 @@ __all__ = [
     "eyring_absorption",
     "shoebox_rir",
     "shoebox_rirs",
+    "shoebox_rirs_batched",
     "fft_convolve",
+    "rir_bucket",
     "rir_length_for",
     "image_lattice",
     "SpeechAndNoiseSetup",

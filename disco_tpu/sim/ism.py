@@ -129,9 +129,97 @@ def shoebox_rirs(room_dim, sources, mics, alpha, max_order: int = 20, rir_len: i
     )(sources)
 
 
+def rir_bucket(
+    beta: float,
+    room_dim=None,
+    max_order: int = 20,
+    fs: int = 16000,
+    margin: float = 1.3,
+    quantum: int = 256,
+) -> tuple[int, int]:
+    """The canonical static ``(max_order, rir_len)`` bucket for one scene.
+
+    This is the ONE place the RIR-buffer policy lives (the reference lets
+    pyroomacoustics size the RIR from the actual image set,
+    ``gen_disco/convolve_signals.py:84-99``; a static-shape compile needs the
+    length picked up front).  Two bounds are combined:
+
+    * the RT60 bound — ``beta * margin`` seconds of tail (the historical
+      ``rir_length_for`` policy), and
+    * the order-coverage bound — when ``room_dim`` is given, the arrival
+      time of the farthest order-``max_order`` image,
+      ``|(2*max_order + 1) * room_dim| / c``, plus the FDL half-width.
+      A buffer longer than that only holds zeros, so the bucket is clamped
+      to it: ``rir_len`` never outruns what ``max_order`` can fill (the
+      DL006 fix — previously the margin clamped ``rir_len`` independently
+      of ``max_order``).
+
+    ``rir_len`` is rounded up to ``quantum`` so nearby scenes share a
+    compiled program; the batched engine passes a coarser quantum to bound
+    its bucket count.  Returns ``(max_order, rir_len)``.
+    """
+    rt60_len = int(np.ceil(float(beta) * margin * fs))
+    rir_len = rt60_len
+    if room_dim is not None:
+        dim = np.asarray(room_dim, np.float64).reshape(-1, 3)
+        # Farthest image position per axis is (2*max_order + 1) * L_ax (the
+        # mic sits inside the room, so distance is bounded by the image
+        # position norm); arrival sample = d * fs / c, plus half the
+        # windowed-sinc support.
+        far = float(np.max(np.linalg.norm((2 * max_order + 1) * dim, axis=-1)))
+        order_len = int(np.ceil(far * fs / C_SOUND)) + FDL // 2 + 1
+        rir_len = min(rir_len, order_len)
+    rir_len = max(rir_len, FDL)
+    rir_len = int(np.ceil(rir_len / quantum) * quantum)
+    return max_order, rir_len
+
+
 def rir_length_for(beta: float, fs: int = 16000, margin: float = 1.3) -> int:
-    """A static RIR length comfortably covering an RT60 of ``beta`` seconds."""
-    return int(np.ceil(beta * margin * fs / 256) * 256)
+    """A static RIR length comfortably covering an RT60 of ``beta`` seconds.
+
+    Delegates to :func:`rir_bucket`, the one canonical rir_len/max_order
+    policy (without a ``room_dim`` the order-coverage clamp is skipped, so
+    this reproduces the historical RT60-only sizing byte-for-byte).
+    """
+    return rir_bucket(beta, None, fs=fs, margin=margin)[1]
+
+
+@partial(jax.jit, static_argnames=("max_order", "rir_len", "fs"))
+def shoebox_rirs_batched(
+    room_dims: jnp.ndarray,
+    sources: jnp.ndarray,
+    mics: jnp.ndarray,
+    alphas: jnp.ndarray,
+    max_order: int = 20,
+    rir_len: int = 8192,
+    fs: int = 16000,
+) -> jnp.ndarray:
+    """A (B,) batch of rooms — B × S sources × M mics in ONE program.
+
+    ``vmap`` of :func:`shoebox_rirs` over a leading scene axis: the image
+    lattice stays one static host-side constant shared by every room, and
+    the scatter-adds for all ``B * S * M`` RIRs fuse into a single XLA
+    launch.  The reference simulates rooms one ``pra.ShoeBox`` at a time
+    (``gen_disco/convolve_signals.py:84-99``); on a tunnel where each
+    fenced dispatch costs ~80 ms, batching the scene axis is what makes a
+    100k-scene corpus tractable (ROADMAP item 4).
+
+    Args:
+      room_dims: (B, 3) room dimensions.
+      sources: (B, S, 3) source positions per room.
+      mics: (B, M, 3) mic positions per room.
+      alphas: (B,) wall energy absorption per room.
+      max_order/rir_len: the static bucket — pick via :func:`rir_bucket`
+        (shared across the batch; every scene in a batch must agree).
+
+    Returns:
+      (B, S, M, rir_len) float32 RIRs.
+    """
+    return jax.vmap(
+        lambda dim, src, mc, al: shoebox_rirs(
+            dim, src, mc, al, max_order=max_order, rir_len=rir_len, fs=fs
+        )
+    )(room_dims, sources, mics, alphas)
 
 
 @partial(jax.jit, static_argnames=("out_len",))

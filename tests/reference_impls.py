@@ -248,6 +248,29 @@ def shoebox_rir_np(room_dim, source, mic, alpha, max_order=3, rir_len=4096, fs=1
     return rir
 
 
+def shoebox_rirs_batched_np(room_dims, sources, mics, alphas, max_order=3,
+                            rir_len=4096, fs=16000, c=343.0, fdl=81):
+    """Float64 oracle of the BATCHED ISM lane
+    (disco_tpu.sim.ism.shoebox_rirs_batched): B scenes x S sources x M mics
+    of independent :func:`shoebox_rir_np` calls, stacked to
+    ``(B, S, M, rir_len)``.  Deliberately the dumbest possible composition —
+    the batched kernel's vmap-over-scenes structure never enters, so a
+    broadcasting bug along any batch axis shows up as a parity failure."""
+    room_dims = np.asarray(room_dims, np.float64)
+    sources = np.asarray(sources, np.float64)
+    mics = np.asarray(mics, np.float64)
+    B, S = sources.shape[:2]
+    M = mics.shape[1]
+    out = np.zeros((B, S, M, rir_len))
+    for b in range(B):
+        for s in range(S):
+            for m in range(M):
+                out[b, s, m] = shoebox_rir_np(
+                    room_dims[b], sources[b, s], mics[b, m], float(alphas[b]),
+                    max_order=max_order, rir_len=rir_len, fs=fs, c=c, fdl=fdl)
+    return out
+
+
 def shoebox_rir_np_order20(room_dim, source, mics, alpha, max_order=20,
                            rir_len=8192, fs=16000, c=343.0, fdl=81,
                            chunk=20000):

@@ -1,0 +1,329 @@
+"""Tests for disco_tpu.scenes — the batched scenario factory: batched-ISM
+parity against the float64 NumPy oracle, the one-bucket policy, SNR gain
+math, dynamic-scene crossfade continuity, SceneStream determinism and
+ledger resume, the training-feed seam, and the geometry samplers'
+rejection-sampling properties (seeded determinism + bounded retry).
+
+``make scene-check`` (disco_tpu/scenes/check.py) drills the heavier
+end-to-end invariants (dispatch accounting, chaos crash-and-resume trees);
+these tests pin the component-level contracts the gate builds on.
+"""
+import numpy as np
+import pytest
+
+from disco_tpu.scenes import (
+    BATCH_QUANTUM,
+    SceneBatch,
+    SceneStream,
+    boundary_jumps,
+    draw_scene_batch,
+    dynamic_scene_mixture,
+    noise_gain_for_snr,
+    piecewise_trajectory,
+    scene_batch_bucket,
+    segment_weights,
+    simulate_scene_batch,
+    synthetic_dry_pair,
+    unit_scene_batch,
+)
+from tests.reference_impls import shoebox_rirs_batched_np
+
+FS = 16000
+
+
+# ------------------------------------------------------------ batched oracle
+def _tiny_batch(rng, n_scenes=2, n_mics=2, L=2048):
+    """A hand-built SceneBatch (no geometry sampler): B scenes x 2 sources
+    x n_mics mics in small rooms, synthetic dry pairs."""
+    dims, srcs, mics, alphas, betas, drys, gains, snrs = [], [], [], [], [], [], [], []
+    for _ in range(n_scenes):
+        dim = rng.uniform([3.5, 3.0, 2.5], [5.0, 4.0, 3.0])
+        dims.append(dim.astype(np.float32))
+        srcs.append(rng.uniform(0.8, 2.2, size=(2, 3)).astype(np.float32))
+        mics.append(rng.uniform(1.0, 2.4, size=(n_mics, 3)).astype(np.float32))
+        alphas.append(np.float32(rng.uniform(0.2, 0.5)))
+        betas.append(np.float32(rng.uniform(0.3, 0.5)))
+        target, noise = synthetic_dry_pair(rng, L)
+        drys.append(np.stack([target, noise]))
+        snr = float(rng.uniform(-5, 10))
+        gains.append(np.float32(noise_gain_for_snr(target, noise, snr)))
+        snrs.append(np.float32(snr))
+    return SceneBatch(
+        room_dims=np.stack(dims), sources=np.stack(srcs), mics=np.stack(mics),
+        alphas=np.asarray(alphas, np.float32), betas=np.asarray(betas, np.float32),
+        dry=np.stack(drys), noise_gains=np.asarray(gains, np.float32),
+        snr_db=np.asarray(snrs, np.float32),
+    )
+
+
+def test_batched_rirs_match_f64_oracle():
+    """The batched lane against the independent float64 loop oracle — same
+    tolerance regime as the per-scene parity test (test_sim.py)."""
+    from disco_tpu.sim.ism import shoebox_rirs_batched
+
+    rng = np.random.default_rng(11)
+    batch = _tiny_batch(rng, n_scenes=2, n_mics=2)
+    got = np.asarray(shoebox_rirs_batched(
+        batch.room_dims, batch.sources, batch.mics, batch.alphas,
+        max_order=2, rir_len=1024, fs=FS))
+    want = shoebox_rirs_batched_np(
+        batch.room_dims, batch.sources, batch.mics, batch.alphas,
+        max_order=2, rir_len=1024, fs=FS)
+    scale = np.abs(want).max()
+    assert np.abs(got - want).max() / scale < 1e-4
+
+
+def test_batched_rirs_match_per_scene_path():
+    """vmap parity: scene b of the batched kernel == the per-scene
+    shoebox_rirs launch on scene b's geometry."""
+    from disco_tpu.sim.ism import shoebox_rirs, shoebox_rirs_batched
+
+    rng = np.random.default_rng(12)
+    batch = _tiny_batch(rng, n_scenes=3, n_mics=2)
+    got = np.asarray(shoebox_rirs_batched(
+        batch.room_dims, batch.sources, batch.mics, batch.alphas,
+        max_order=3, rir_len=1024, fs=FS))
+    for b in range(batch.n_scenes):
+        one = np.asarray(shoebox_rirs(
+            batch.room_dims[b], batch.sources[b], batch.mics[b],
+            float(batch.alphas[b]), max_order=3, rir_len=1024, fs=FS))
+        np.testing.assert_allclose(got[b], one, atol=1e-6)
+
+
+def test_simulate_scene_batch_shapes_and_mask_range():
+    rng = np.random.default_rng(13)
+    batch = _tiny_batch(rng, n_scenes=2, n_mics=2, L=2048)
+    out = simulate_scene_batch(batch, max_order=2, fs=FS)
+    B, M, L = 2, 2, 2048
+    assert out["noisy"].shape == (B, M, L)
+    assert out["clean"].shape == (B, M, L)
+    assert out["rirs"].shape[:3] == (B, 2, M)
+    assert out["mag_noisy"].shape == out["mask"].shape
+    assert np.all(np.isfinite(out["noisy"]))
+    assert np.all((out["mask"] >= 0.0) & (out["mask"] <= 1.0))
+
+
+# ------------------------------------------------------------- bucket policy
+def test_scene_batch_bucket_dominates_every_scene():
+    """The batch bucket is the max of the canonical per-scene rir_bucket
+    policy at the batch quantum — every scene's tail fits, and the length
+    is quantum-aligned."""
+    from disco_tpu.sim.ism import rir_bucket
+
+    rng = np.random.default_rng(14)
+    batch = _tiny_batch(rng, n_scenes=4)
+    order, rir_len = scene_batch_bucket(batch, max_order=8, fs=FS)
+    assert order == 8
+    assert rir_len % BATCH_QUANTUM == 0
+    per_scene = [rir_bucket(float(batch.betas[b]), batch.room_dims[b],
+                            max_order=8, fs=FS, quantum=BATCH_QUANTUM)[1]
+                 for b in range(batch.n_scenes)]
+    assert rir_len == max(per_scene)
+
+
+@pytest.mark.parametrize("snr_db", [-10.0, 0.0, 7.5])
+def test_noise_gain_hits_snr(snr_db):
+    rng = np.random.default_rng(15)
+    target = rng.standard_normal(4096) * 0.3
+    noise = rng.standard_normal(4096) * 2.0
+    g = noise_gain_for_snr(target, noise, snr_db)
+    got = 10 * np.log10(np.mean(target**2) / np.mean((g * noise) ** 2))
+    assert got == pytest.approx(snr_db, abs=1e-3)
+
+
+def test_synthetic_dry_pair_deterministic_and_normalized():
+    a = synthetic_dry_pair(np.random.default_rng(3), 4096)
+    b = synthetic_dry_pair(np.random.default_rng(3), 4096)
+    assert np.array_equal(a[0], b[0]) and np.array_equal(a[1], b[1])
+    assert np.std(a[0]) == pytest.approx(1.0, rel=1e-3)
+    assert np.std(a[1]) == pytest.approx(1.0, rel=1e-3)
+
+
+# ------------------------------------------------------------ dynamic scenes
+def test_piecewise_trajectory_endpoints_and_monotone():
+    path = piecewise_trajectory([0.0, 0.0, 1.0], [2.0, 4.0, 1.0], 4)
+    assert path.shape == (4, 3)
+    # segment-center sampling: first/last waypoints sit half a segment in
+    np.testing.assert_allclose(path[0], [0.25, 0.5, 1.0], atol=1e-6)
+    np.testing.assert_allclose(path[-1], [1.75, 3.5, 1.0], atol=1e-6)
+    assert np.all(np.diff(path[:, 0]) > 0)
+    with pytest.raises(ValueError):
+        piecewise_trajectory([0, 0, 0], [1, 1, 1], 0)
+
+
+def test_segment_weights_partition_of_unity():
+    w = segment_weights(4096, 5, crossfade=256)
+    assert w.shape == (5, 4096)
+    np.testing.assert_allclose(w.sum(0), 1.0, atol=1e-6)
+    assert np.all(w >= 0.0)
+
+
+def test_segment_weights_hard_switch_is_binary():
+    w = segment_weights(1000, 4, crossfade=0)
+    assert set(np.unique(w)) <= {np.float32(0.0), np.float32(1.0)}
+    np.testing.assert_allclose(w.sum(0), 1.0, atol=0)
+
+
+def test_dynamic_crossfade_smoother_than_hard_switch():
+    """The scene-check continuity contract at test scale: on a sine dry
+    signal, the crossfaded mixture's boundary jumps are well under the
+    hard-switched blend's click."""
+    t = np.arange(4096) / FS
+    dry = np.sin(2 * np.pi * 440.0 * t).astype(np.float32)
+    room = np.array([4.0, 3.0, 2.5], np.float32)
+    path = piecewise_trajectory([1.0, 1.0, 1.2], [3.0, 2.0, 1.2], 3)
+    mics = np.array([[2.0, 1.5, 1.5], [2.1, 1.5, 1.5]], np.float32)
+    kw = dict(alpha=0.3, dry=dry, max_order=2, rir_len=1024, fs=FS)
+    soft = dynamic_scene_mixture(room, path, mics, crossfade=512, **kw)
+    hard = dynamic_scene_mixture(room, path, mics, crossfade=0, **kw)
+    j_soft = boundary_jumps(soft["mixture"], 3).max()
+    j_hard = boundary_jumps(hard["mixture"], 3).max()
+    assert j_soft < 0.5 * j_hard
+
+
+# --------------------------------------------------------------- SceneStream
+def _tiny_stream(seed=7, batches_per_epoch=2):
+    return SceneStream(
+        seed=seed, scenes_per_batch=2, batches_per_epoch=batches_per_epoch,
+        duration_s=0.25, max_order=2, win_len=4, snr_range=(0.0, 5.0),
+        setup_overrides={"n_sensors_per_node": (2, 2)},
+    )
+
+
+def test_scene_stream_deterministic_across_instances():
+    a = [x for x, _y in _tiny_stream(seed=7).batches(4, epoch=0)]
+    b = [x for x, _y in _tiny_stream(seed=7).batches(4, epoch=0)]
+    c = [x for x, _y in _tiny_stream(seed=8).batches(4, epoch=0)]
+    assert len(a) == len(b) > 0
+    for xa, xb in zip(a, b):
+        np.testing.assert_array_equal(xa, xb)
+    assert not all(np.array_equal(xa, xc) for xa, xc in zip(a, c))
+
+
+def test_scene_stream_window_convention():
+    stream = _tiny_stream()
+    geo = stream.peek_geometry()
+    x, y = next(stream.batches(3, epoch=0))
+    assert x.shape == (3, stream.win_len, geo["n_freq"])
+    assert y.shape == x.shape
+    assert np.all((y >= 0.0) & (y <= 1.0))
+
+
+def test_scene_stream_ledger_resume_skips_consumed_batches(tmp_path):
+    """A fully consumed epoch's scene-batch units replay to ZERO batches
+    through the same ledger — the verified_done resume contract."""
+    led = tmp_path / "led.jsonl"
+    stream = _tiny_stream()
+    n_first = sum(1 for _ in stream.batches(4, epoch=0, ledger=led))
+    assert n_first > 0
+    n_replay = sum(1 for _ in stream.batches(4, epoch=0, ledger=led))
+    assert n_replay == 0
+    # a FRESH epoch through the same ledger still serves in full
+    assert sum(1 for _ in stream.batches(4, epoch=1, ledger=led)) == n_first
+
+
+def test_scene_stream_batch_fn_start_epoch():
+    stream = _tiny_stream(batches_per_epoch=1)
+    make = stream.batch_fn(4)
+    make.set_start_epoch(2)
+    resumed = [x for x, _y in make()]
+    direct = [x for x, _y in stream.batches(4, epoch=2)]
+    assert len(resumed) == len(direct)
+    for xa, xb in zip(resumed, direct):
+        np.testing.assert_array_equal(xa, xb)
+
+
+def test_unit_scene_batch_ids():
+    assert unit_scene_batch(3, 7) == "scene_batch:3:7"
+
+
+@pytest.mark.slow
+def test_scene_stream_feeds_fit(tmp_path):
+    """The training-feed seam: fit() trains off SceneStream.batch_fn exactly
+    as it does off ShardDataset.batch_fn (the resident trainer's dataset=
+    seam rides the same surface)."""
+    from disco_tpu.nn.crnn import build_crnn
+    from disco_tpu.nn.training import create_train_state, fit
+
+    stream = _tiny_stream(batches_per_epoch=1)
+    F = stream.peek_geometry()["n_freq"]
+    model, tx = build_crnn(n_ch=1, win_len=4, n_freq=F, cnn_filters=(2,),
+                           pool_kernels=((1, 2),), conv_padding=((0, 1),),
+                           rnn_units=(4,), ff_units=(F,), rnn_dropouts=0.0)
+    first = next(stream.batches(2, epoch=0))
+    state = create_train_state(model, tx, first[0][:1], seed=2)
+    _state, tr, va, _name = fit(
+        model, state, stream.batch_fn(4), stream.batch_fn(4, shuffle=False),
+        n_epochs=1, save_path=tmp_path / "m", verbose=False,
+    )
+    assert len(tr) == 1 and np.isfinite(tr[0]) and tr[0] > 0.0
+    assert len(va) == 1 and np.isfinite(va[0])
+
+
+# ----------------------------------------------- geometry sampling properties
+def test_draw_scene_batch_rectangular_and_seeded():
+    rng_a = np.random.default_rng(21)
+    rng_b = np.random.default_rng(21)
+    kw = dict(duration_s=0.25, setup_overrides={"n_sensors_per_node": (2, 2)})
+    a = draw_scene_batch(rng_a, 3, **kw)
+    b = draw_scene_batch(rng_b, 3, **kw)
+    assert a.room_dims.shape == (3, 3)
+    assert a.sources.shape == (3, 2, 3)
+    assert a.mics.shape == (3, 4, 3)
+    for field in ("room_dims", "sources", "mics", "alphas", "dry",
+                  "noise_gains", "snr_db"):
+        np.testing.assert_array_equal(getattr(a, field), getattr(b, field))
+
+
+@pytest.mark.parametrize("seed", [5, 6])
+def test_geometry_sampler_seeded_determinism(seed):
+    """Two samplers driven by equal-seeded generators produce identical
+    configurations — the property the per-scene (seed, rir_id, stream)
+    reseeding discipline in datagen/disco.py rests on."""
+    from disco_tpu.sim import make_setup
+
+    cfg_a = make_setup("random", rng=np.random.default_rng(seed)).create_room_setup()
+    cfg_b = make_setup("random", rng=np.random.default_rng(seed)).create_room_setup()
+    np.testing.assert_array_equal(cfg_a.room_dim, cfg_b.room_dim)
+    np.testing.assert_array_equal(cfg_a.source_positions, cfg_b.source_positions)
+    np.testing.assert_array_equal(cfg_a.mic_positions, cfg_b.mic_positions)
+    assert cfg_a.alpha == cfg_b.alpha and cfg_a.beta == cfg_b.beta
+
+
+def test_geometry_rejection_sampling_bounded_retry():
+    """Unsatisfiable constraints fail loudly within the trial budget — a
+    RuntimeError, never an infinite rejection loop."""
+    from disco_tpu.sim import make_setup
+
+    sampler = make_setup(
+        "random", rng=np.random.default_rng(9),
+        # two nodes forced >= 50 m apart inside a <= 8 m room: impossible
+        d_nn=50.0, n_sensors_per_node=(2, 2),
+    )
+    with pytest.raises(RuntimeError, match="no valid room configuration"):
+        sampler.create_room_setup(max_config_trials=5)
+
+
+def test_geometry_rejection_sampling_respects_constraints():
+    """Sampled configurations honor the declared min-distance constraints
+    (wall clearance, node spacing, source-node spacing)."""
+    from disco_tpu.sim import make_setup
+
+    sampler = make_setup("random", rng=np.random.default_rng(10))
+    for _ in range(5):
+        cfg = sampler.create_room_setup()
+        dims = cfg.room_dim
+        nodes = sampler.nodes_centers
+        # pairwise node spacing in the xy plane
+        for i in range(len(nodes)):
+            for j in range(i + 1, len(nodes)):
+                assert np.hypot(*(nodes[i][:2] - nodes[j][:2])) >= sampler.d_nn - 1e-9
+        # wall clearance for nodes and sources
+        for n in nodes:
+            assert np.all(n[:2] >= sampler.d_nw - 1e-9)
+            assert np.all(n[:2] <= dims[:2] - sampler.d_nw + 1e-9)
+        for s in cfg.source_positions:
+            assert np.all(s[:2] >= sampler.d_sw - 1e-9)
+            assert np.all(s[:2] <= dims[:2] - sampler.d_sw + 1e-9)
+            for n in nodes:
+                assert np.hypot(*(s[:2] - n[:2])) >= sampler.d_sn - 1e-9
